@@ -1,0 +1,56 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of the reference Ray runtime
+(tasks, actors, objects, placement groups + Data/Train/Tune/Serve/RLlib
+libraries), designed idiomatically for JAX/XLA/Pallas on TPU pods: tensor
+traffic runs as XLA collectives over ICI (pjit/shard_map meshes), control
+traffic as framed RPC over DCN, and bulk data through a per-node
+shared-memory object store.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "timeline",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "get_runtime_context",
+    "exceptions",
+]

@@ -1,0 +1,137 @@
+"""Runtime configuration flag table.
+
+Equivalent of the reference's RAY_CONFIG macro table (reference:
+src/ray/common/ray_config_def.h — 221 entries, env-overridable), redesigned as
+a typed Python registry: every flag is declared once with a type and a default,
+is overridable via ``RTPU_<NAME>`` environment variables and via the
+``_system_config`` dict handed to ``ray_tpu.init``, and is serialized to
+workers at connect time (mirroring GetSystemConfig in node_manager.proto:438).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RTPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, typ: type, default: Any, doc: str):
+        self.name = name
+        self.type = typ
+        self.default = default
+        self.doc = doc
+
+    def parse(self, raw: str) -> Any:
+        if self.type is bool:
+            return _parse_bool(raw)
+        return self.type(raw)
+
+
+class Config:
+    """Process-wide flag registry. Thread-safe writes; lock-free reads."""
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, typ: type, default: Any, doc: str = "") -> None:
+        flag = _Flag(name, typ, default, doc)
+        self._flags[name] = flag
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        self._values[name] = flag.parse(env) if env is not None else default
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"unknown config flag: {name}") from None
+
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"unknown config flag: {name}")
+            self._values[name] = value
+
+    def apply_system_config(self, overrides: Dict[str, Any]) -> None:
+        for k, v in overrides.items():
+            self.set(k, v)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Serializable view shipped to spawned workers."""
+        return dict(self._values)
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        with self._lock:
+            self._values.update(snap)
+
+    def dump_json(self) -> str:
+        return json.dumps(self._values, default=str, sort_keys=True)
+
+
+GLOBAL_CONFIG = Config()
+_d = GLOBAL_CONFIG.define
+
+# --- core object plane ---
+_d("object_store_memory_bytes", int, 2 * 1024**3, "per-node shm store size")
+_d("object_store_inline_max_bytes", int, 100 * 1024,
+   "results <= this are inlined in RPC replies / memory store instead of shm")
+_d("object_spilling_enabled", bool, True, "spill shm objects to disk under pressure")
+_d("object_spilling_dir", str, "/tmp/ray_tpu_spill", "spill directory")
+_d("object_transfer_chunk_bytes", int, 4 * 1024**2, "node-to-node object push chunk")
+_d("object_store_eviction_fraction", float, 0.2, "fraction evicted per LRU pass")
+
+# --- scheduling ---
+_d("lease_timeout_ms", int, 10_000, "worker lease validity")
+_d("scheduler_spread_threshold", float, 0.5,
+   "hybrid policy: pack onto a node until utilization crosses this, then spread")
+_d("max_pending_lease_requests_per_scheduling_key", int, 10, "lease pipelining cap")
+_d("worker_pool_min_workers", int, 0, "prestarted workers per node")
+_d("worker_pool_idle_ttl_s", float, 60.0, "idle worker reap time")
+_d("worker_niceness", int, 0, "niceness applied to spawned workers")
+
+# --- fault tolerance ---
+_d("task_max_retries_default", int, 3, "default retries for retriable tasks")
+_d("task_retry_delay_ms", int, 100, "backoff between task retries")
+_d("actor_max_restarts_default", int, 0, "default actor restarts")
+_d("health_check_period_ms", int, 1000, "controller -> nodelet ping period")
+_d("health_check_failure_threshold", int, 5, "missed pings before node is dead")
+_d("max_lineage_bytes", int, 64 * 1024**2, "lineage table cap before eviction")
+
+# --- rpc / control plane ---
+_d("rpc_connect_timeout_s", float, 10.0, "TCP connect timeout")
+_d("rpc_retry_max_attempts", int, 5, "retryable RPC attempts")
+_d("rpc_retry_delay_ms", int, 100, "base retry backoff")
+_d("rpc_chaos_failure_prob", float, 0.0,
+   "fault-injection: probability an RPC is dropped (request or reply). "
+   "Equivalent of the reference's RAY_testing_rpc_failure chaos flag "
+   "(src/ray/rpc/rpc_chaos.h)")
+_d("pubsub_poll_timeout_s", float, 30.0, "long-poll timeout")
+
+# --- TPU / accelerator ---
+_d("tpu_chips_per_host", int, 4, "chips per TPU VM host (v5e/v5p default 4)")
+_d("tpu_slice_exclusive", bool, True,
+   "enforce one-process-per-host TPU ownership when leasing TPU resources")
+_d("device_prefetch_depth", int, 2, "host->HBM prefetch pipeline depth for data")
+
+# --- metrics / events ---
+_d("metrics_report_period_ms", int, 5000, "metrics push period")
+_d("task_events_buffer_size", int, 10_000, "ring buffer of per-task state events")
+_d("event_stats_enabled", bool, True, "per-handler latency accounting")
+
+# --- logging ---
+_d("log_dir", str, "/tmp/ray_tpu/logs", "per-process log files")
+_d("log_to_driver", bool, True, "ship worker stdout/stderr lines to the driver")

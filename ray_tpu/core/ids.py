@@ -1,0 +1,187 @@
+"""Binary identifiers with embedded lineage.
+
+Design parity with the reference's ID scheme (reference: src/ray/common/id.h),
+re-designed rather than ported: IDs are flat ``bytes`` wrappers with lineage
+*embedded by prefix* so that containment tests and owner extraction are O(1)
+slices instead of table lookups:
+
+    JobID   (4B)                         -- per driver/job
+    ActorID (12B) = unique(8)  + job(4)  -- actor identity
+    TaskID  (16B) = unique(4)  + actor(12)
+    ObjectID(24B) = index(4)   + task(16) + flags(4)
+
+So ``ObjectID.task_id()`` and ``TaskID.actor_id()`` are pure slicing, which the
+lineage/ownership layers (ray_tpu/core/lineage.py, refcount.py) rely on in
+their hot paths.  NodeID / WorkerID / PlacementGroupID are 16B random.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import struct
+
+_rng_lock = threading.Lock()
+_counter = 0
+
+
+def _rand_bytes(n: int) -> bytes:
+    return os.urandom(n)
+
+
+def _next_counter() -> int:
+    global _counter
+    with _rng_lock:
+        _counter += 1
+        return _counter
+
+
+class BaseID:
+    """Immutable binary ID. Subclasses fix SIZE."""
+
+    SIZE = 16
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bytes = bytes(binary)
+        self._hash = hash(self._bytes)
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(_rand_bytes(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, i: int) -> "JobID":
+        return cls(struct.pack("<I", i))
+
+    def int_value(self) -> int:
+        return struct.unpack("<I", self._bytes)[0]
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+
+class ClusterID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    SIZE = 12
+    UNIQUE = 8
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(_rand_bytes(cls.UNIQUE) + job_id.binary())
+
+    @classmethod
+    def nil_for_job(cls, job_id: JobID) -> "ActorID":
+        return cls(b"\xff" * cls.UNIQUE + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[self.UNIQUE :])
+
+
+class TaskID(BaseID):
+    SIZE = 16
+    UNIQUE = 4
+
+    @classmethod
+    def for_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(_rand_bytes(cls.UNIQUE) + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls.for_task(ActorID.nil_for_job(job_id))
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[self.UNIQUE :])
+
+    def job_id(self) -> JobID:
+        return self.actor_id().job_id()
+
+
+# ObjectID flag bits (last 4 bytes, little-endian u32).
+_FLAG_PUT = 0x1  # created by put() rather than a task return
+_FLAG_STREAM = 0x2  # streaming-generator return
+
+
+class ObjectID(BaseID):
+    SIZE = 24
+    _IDX = 4
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(struct.pack("<I", index) + task_id.binary() + struct.pack("<I", 0))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        return cls(
+            struct.pack("<I", put_index) + task_id.binary() + struct.pack("<I", _FLAG_PUT)
+        )
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[self._IDX : self._IDX + TaskID.SIZE])
+
+    def index(self) -> int:
+        return struct.unpack("<I", self._bytes[: self._IDX])[0]
+
+    def flags(self) -> int:
+        return struct.unpack("<I", self._bytes[self._IDX + TaskID.SIZE :])[0]
+
+    def is_put(self) -> bool:
+        return bool(self.flags() & _FLAG_PUT)
+
+    def created_by_task(self) -> bool:
+        return not self.is_put()

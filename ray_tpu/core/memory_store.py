@@ -1,0 +1,112 @@
+"""In-process object store for small / inlined results.
+
+Equivalent of the reference's CoreWorkerMemoryStore
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h):
+holds deserialized values keyed by ObjectID, wakes blocked getters, and fires
+async callbacks registered before the value arrived.  Values larger than the
+inline threshold never land here — they go to the node's shared-memory store
+(ray_tpu/core/object_store.py) and this store holds only a location stub.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError
+
+
+class _Record:
+    __slots__ = ("value", "is_exception", "in_plasma")
+
+    def __init__(self, value: Any, is_exception: bool = False, in_plasma: bool = False):
+        self.value = value
+        self.is_exception = is_exception
+        self.in_plasma = in_plasma
+
+
+class PlasmaStub:
+    """Marker stored here when the real bytes live in the shm store."""
+
+    __slots__ = ("object_id",)
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._objects: Dict[ObjectID, _Record] = {}
+        self._callbacks: Dict[ObjectID, List[Callable[[_Record], None]]] = {}
+
+    def put(self, object_id: ObjectID, value: Any, is_exception: bool = False) -> None:
+        with self._cv:
+            if object_id in self._objects:
+                return  # idempotent: retries may double-store
+            rec = _Record(value, is_exception, isinstance(value, PlasmaStub))
+            self._objects[object_id] = rec
+            callbacks = self._callbacks.pop(object_id, [])
+            self._cv.notify_all()
+        for cb in callbacks:
+            cb(rec)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_async(self, object_id: ObjectID, callback: Callable[[_Record], None]) -> None:
+        with self._lock:
+            rec = self._objects.get(object_id)
+            if rec is None:
+                self._callbacks.setdefault(object_id, []).append(callback)
+                return
+        callback(rec)
+
+    def get(
+        self,
+        object_ids: List[ObjectID],
+        timeout: Optional[float] = None,
+    ) -> List[_Record]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        records: List[_Record] = []
+        with self._cv:
+            for oid in object_ids:
+                while oid not in self._objects:
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(f"timed out waiting for {oid}")
+                    self._cv.wait(timeout=remaining)
+                records.append(self._objects[oid])
+        return records
+
+    def wait(
+        self,
+        object_ids: List[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> Set[ObjectID]:
+        """Returns the set of ready ids (>= num_returns unless timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = {oid for oid in object_ids if oid in self._objects}
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self._cv.wait(timeout=remaining)
+
+    def delete(self, object_ids: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                self._objects.pop(oid, None)
+                self._callbacks.pop(oid, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

@@ -1,0 +1,135 @@
+"""Distributed reference counting for object lifetimes.
+
+Equivalent of the reference's ReferenceCounter (reference:
+src/ray/core_worker/reference_count.h): every object has exactly one owner
+(the worker whose task created it or that called put); the owner tracks
+  - local refs      (ObjectRef instances alive in the owner process),
+  - submitted refs  (pending tasks that take the object as an argument),
+  - borrower refs   (other workers holding deserialized copies of the ref),
+and releases the value from the store when all three reach zero.  Borrowers
+report their local count reaching zero back to the owner asynchronously
+(mirrors the reference's WaitForRefRemoved long-poll protocol, simplified to a
+single release message over the control plane).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+from ray_tpu.core.ids import ObjectID
+
+
+class _Ref:
+    __slots__ = ("local", "submitted", "borrowers", "owned", "lineage_pinned")
+
+    def __init__(self, owned: bool):
+        self.local = 0
+        self.submitted = 0
+        self.borrowers: Set[str] = set()
+        self.owned = owned
+        self.lineage_pinned = False
+
+    def out_of_scope(self) -> bool:
+        return self.local <= 0 and self.submitted <= 0 and not self.borrowers
+
+
+class ReferenceCounter:
+    def __init__(self, on_release: Optional[Callable[[ObjectID], None]] = None):
+        self._lock = threading.Lock()
+        self._refs: Dict[ObjectID, _Ref] = {}
+        self._on_release = on_release
+        self.enabled = True
+
+    # --- owner-side ---
+
+    def add_owned_object(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(oid, _Ref(owned=True))
+            ref.owned = True
+
+    def add_local_ref(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._refs.setdefault(oid, _Ref(owned=False)).local += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        self._dec(oid, "local")
+
+    def add_submitted_task_ref(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._refs.setdefault(oid, _Ref(owned=False)).submitted += 1
+
+    def remove_submitted_task_ref(self, oid: ObjectID) -> None:
+        if not self.enabled:
+            return
+        self._dec(oid, "submitted")
+
+    def add_borrower(self, oid: ObjectID, borrower_addr: str) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref(owned=True)).borrowers.add(borrower_addr)
+
+    def remove_borrower(self, oid: ObjectID, borrower_addr: str) -> None:
+        release = None
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            ref.borrowers.discard(borrower_addr)
+            release = self._maybe_release_locked(oid, ref)
+        if release:
+            release()
+
+    def pin_lineage(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref:
+                ref.lineage_pinned = True
+
+    def local_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return ref.local if ref else 0
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def tracked_ids(self) -> Set[ObjectID]:
+        with self._lock:
+            return set(self._refs)
+
+    def is_in_scope(self, oid: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return ref is not None and not ref.out_of_scope()
+
+    # --- internals ---
+
+    def _dec(self, oid: ObjectID, kind: str) -> None:
+        release = None
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            if kind == "local":
+                ref.local -= 1
+            else:
+                ref.submitted -= 1
+            release = self._maybe_release_locked(oid, ref)
+        if release:
+            release()
+
+    def _maybe_release_locked(self, oid: ObjectID, ref: _Ref):
+        if not ref.out_of_scope():
+            return None
+        del self._refs[oid]
+        if ref.owned and self._on_release:
+            cb = self._on_release
+            return lambda: cb(oid)
+        return None

@@ -1,0 +1,147 @@
+"""Object serialization with zero-copy numpy/JAX array path.
+
+Equivalent of the reference's SerializationContext
+(reference: python/ray/_private/serialization.py) but laid out for the TPU
+data path: encoding uses pickle protocol 5 with out-of-band buffers, so large
+numpy arrays are written into shared memory (or a socket) without an
+intermediate copy and decoded as views directly over the mapped store memory.
+jax.Arrays are serialized via their host numpy form (``np.asarray``) — device
+residency is a property of where a value is *used* (mesh shardings), never of
+the wire format.
+
+Flat wire layout (little-endian), used for shm store slots and sockets:
+    u32 magic | u32 header_len | header bytes (cloudpickle, protocol 5)
+    u64 nbufs | (u64 len, buf bytes)*          -- 8-byte aligned each
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+import traceback
+from typing import Any, List, Tuple
+
+import cloudpickle
+import numpy as np
+
+from ray_tpu.exceptions import TaskError
+
+_MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 8
+
+# Buffers below this stay inline in the pickle stream; frame overhead wins.
+_OOB_MIN_BYTES = 512
+
+
+def _is_jax_array(value) -> bool:
+    cls = type(value)
+    return cls.__module__.startswith("jax") and cls.__name__ in ("ArrayImpl", "Array")
+
+
+def _restore_jax(host: np.ndarray):
+    import jax
+
+    return jax.numpy.asarray(host)
+
+
+def _jax_reduce(host: np.ndarray):
+    """Reconstructs a jax.Array from a (possibly out-of-band) numpy array."""
+    return (_restore_jax, (host,))
+
+
+class Serializer:
+    """Stateless encode/decode; one instance per worker."""
+
+    def serialize(self, value: Any) -> Tuple[bytes, List[memoryview]]:
+        """Returns (header_bytes, out_of_band_buffers)."""
+        buffers: List[memoryview] = []
+
+        def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+            view = pb.raw()
+            if view.nbytes < _OOB_MIN_BYTES:
+                return True  # keep small buffers inline
+            buffers.append(view)
+            return False  # emitted out-of-band
+
+        class _Pickler(cloudpickle.CloudPickler):
+            def reducer_override(self, obj):
+                if _is_jax_array(obj):
+                    return _jax_reduce(np.asarray(obj))
+                return NotImplemented
+
+        sio = io.BytesIO()
+        _Pickler(sio, protocol=5, buffer_callback=buffer_callback).dump(value)
+        return sio.getvalue(), buffers
+
+    def deserialize(self, header: bytes, buffers: List[memoryview]) -> Any:
+        return pickle.loads(header, buffers=buffers)
+
+    # --- flat wire form (for shm / sockets) ---
+
+    def encode_total_size(self, header: bytes, buffers: List[memoryview]) -> int:
+        total = 8 + _pad(len(header)) + 8
+        for b in buffers:
+            total += 8 + _pad(b.nbytes)
+        return total
+
+    def encode_into(self, dest: memoryview, header: bytes, buffers: List[memoryview]) -> int:
+        """Writes the flat wire form into dest; returns bytes written."""
+        off = 0
+        struct.pack_into("<II", dest, off, _MAGIC, len(header))
+        off += 8
+        dest[off : off + len(header)] = header
+        off += _pad(len(header))
+        struct.pack_into("<Q", dest, off, len(buffers))
+        off += 8
+        for b in buffers:
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            struct.pack_into("<Q", dest, off, flat.nbytes)
+            off += 8
+            dest[off : off + flat.nbytes] = flat
+            off += _pad(flat.nbytes)
+        return off
+
+    def encode(self, value: Any) -> bytes:
+        header, buffers = self.serialize(value)
+        out = bytearray(self.encode_total_size(header, buffers))
+        n = self.encode_into(memoryview(out), header, buffers)
+        return bytes(out[:n])
+
+    def decode(self, data) -> Any:
+        """Zero-copy decode: numpy results view into ``data``."""
+        if isinstance(data, (bytes, bytearray)):
+            data = memoryview(data)
+        magic, hlen = struct.unpack_from("<II", data, 0)
+        if magic != _MAGIC:
+            raise ValueError("corrupt object header")
+        off = 8
+        header = bytes(data[off : off + hlen])
+        off += _pad(hlen)
+        (nbufs,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        buffers: List[memoryview] = []
+        for _ in range(nbufs):
+            (blen,) = struct.unpack_from("<Q", data, off)
+            off += 8
+            buffers.append(data[off : off + blen])
+            off += _pad(blen)
+        return self.deserialize(header, buffers)
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def capture_exception(exc: BaseException) -> TaskError:
+    """Package a remote exception for transport to the get() site."""
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        cloudpickle.dumps(exc)
+        cause = exc
+    except Exception:
+        cause = None
+    return TaskError(type(exc).__name__, tb, cause)
+
+
+SERIALIZER = Serializer()

@@ -1,0 +1,53 @@
+"""Chrome-trace timeline export of per-task state events.
+
+Parity target: `ray timeline` (reference: python/ray/_private/state.py
+chrome_tracing_dump) fed by the task event buffer
+(src/ray/core_worker/task_event_buffer.h -> GcsTaskManager).
+Events are recorded into a bounded in-process ring buffer by the runtimes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=cfg.task_events_buffer_size)
+
+
+def record_event(name: str, category: str, start_ts: float, end_ts: float,
+                 pid: int = 0, tid: int = 0, args: Optional[dict] = None) -> None:
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": start_ts * 1e6, "dur": (end_ts - start_ts) * 1e6,
+            "pid": pid, "tid": tid, "args": args or {},
+        })
+
+
+def record_instant(name: str, category: str = "event", args: Optional[dict] = None) -> None:
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "i", "ts": time.time() * 1e6,
+            "pid": 0, "tid": 0, "s": "g", "args": args or {},
+        })
+
+
+def dump_timeline(filename: Optional[str] = None):
+    with _lock:
+        events = list(_events)
+    if filename is None:
+        return events
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return filename
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

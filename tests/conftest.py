@@ -1,0 +1,47 @@
+"""Test fixtures.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference's analog is the
+fake multi-node cluster in python/ray/cluster_utils.py + mocked accelerator
+detection in tests/accelerators/test_tpu.py): real TPU hardware is never
+required for the suite.
+"""
+
+import os
+
+# Must be set before jax (imported transitively) initializes its backend.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RTPU_TPU_CHIPS", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_init():
+    import ray_tpu
+
+    ray_tpu.init(local_mode=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cluster_init():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    yield devices[:8]
