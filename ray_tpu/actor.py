@@ -76,6 +76,9 @@ class ActorClass:
     """Result of @ray_tpu.remote on a class."""
 
     def __init__(self, cls, default_options: Dict[str, Any]):
+        from ray_tpu.remote_function import validate_options
+
+        validate_options(default_options)
         self._cls = cls
         self._default_options = default_options
         functools.update_wrapper(self, cls, updated=[])

@@ -7,8 +7,11 @@ slices instead of table lookups:
 
     JobID   (4B)                         -- per driver/job
     ActorID (12B) = unique(8)  + job(4)  -- actor identity
-    TaskID  (16B) = unique(4)  + actor(12)
-    ObjectID(24B) = index(4)   + task(16) + flags(4)
+    TaskID  (20B) = unique(8)  + actor(12)
+    ObjectID(28B) = index(4)   + task(20) + flags(4)
+
+(8 random bytes of task uniqueness: collision probability stays negligible at
+billions of tasks; 4 bytes would hit birthday-bound collisions at ~10^4.)
 
 So ``ObjectID.task_id()`` and ``TaskID.actor_id()`` are pure slicing, which the
 lineage/ownership layers (ray_tpu/core/lineage.py, refcount.py) rely on in
@@ -134,8 +137,8 @@ class ActorID(BaseID):
 
 
 class TaskID(BaseID):
-    SIZE = 16
-    UNIQUE = 4
+    SIZE = 20
+    UNIQUE = 8
 
     @classmethod
     def for_task(cls, actor_id: ActorID) -> "TaskID":
@@ -158,7 +161,7 @@ _FLAG_STREAM = 0x2  # streaming-generator return
 
 
 class ObjectID(BaseID):
-    SIZE = 24
+    SIZE = 28
     _IDX = 4
 
     @classmethod

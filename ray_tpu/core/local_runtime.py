@@ -46,6 +46,20 @@ from ray_tpu.exceptions import (
 )
 
 _task_local = threading.local()
+_SENTINEL = object()
+
+
+def _restore_task_local(attr: str, prev) -> None:
+    """Restore a _task_local slot to its pre-task state. Deleting (rather than
+    setting None) lets current_task_id() fall back to the driver task id on
+    recycled pool threads."""
+    if prev is _SENTINEL:
+        try:
+            delattr(_task_local, attr)
+        except AttributeError:
+            pass
+    else:
+        setattr(_task_local, attr, prev)
 
 
 class _ActorState:
@@ -69,6 +83,10 @@ class _ActorState:
             max_workers=max_concurrency, thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
         )
         self.is_async = False
+        # Return ObjectIDs of submitted-but-unfinished calls; on kill these are
+        # failed with ActorDiedError so callers' get() never hangs.
+        self.pending_lock = threading.Lock()
+        self.pending_returns: Dict[Any, List[Any]] = {}
         self.loop = None  # asyncio loop for async actors
         self.seq_counter = itertools.count()
 
@@ -167,11 +185,12 @@ class LocalRuntime:
                 return
             try:
                 r_args, r_kwargs = self._resolve_args(args, kwargs)
+                prev = getattr(_task_local, "task_id", _SENTINEL)
                 _task_local.task_id = task_id
                 try:
                     result = func(*r_args, **r_kwargs)
                 finally:
-                    _task_local.task_id = None
+                    _restore_task_local("task_id", prev)
                 self._store_results(result, return_ids)
                 return
             except TaskError as te:
@@ -295,13 +314,15 @@ class LocalRuntime:
         def init():
             try:
                 r_args, r_kwargs = self._resolve_args(state.init_args, state.init_kwargs)
+                prev = getattr(_task_local, "actor_id", _SENTINEL)
                 _task_local.actor_id = actor_id
-                state.instance = cls(*r_args, **r_kwargs)
+                try:
+                    state.instance = cls(*r_args, **r_kwargs)
+                finally:
+                    _restore_task_local("actor_id", prev)
             except BaseException as e:  # noqa: BLE001
                 state.dead = True
                 state.death_reason = f"__init__ failed: {e!r}"
-            finally:
-                _task_local.actor_id = None
 
         state.pool.submit(init).result()  # creation is synchronous locally
         if state.dead:
@@ -344,8 +365,16 @@ class LocalRuntime:
                 self._put_return(oid, err, is_exception=True)
             return refs
 
+        with state.pending_lock:
+            state.pending_returns[task_id] = return_ids
+
+        def finish_pending():
+            with state.pending_lock:
+                state.pending_returns.pop(task_id, None)
+
         def run():
             if state.dead:
+                finish_pending()
                 err = ActorDiedError(actor_id, state.death_reason)
                 for oid in return_ids:
                     self._put_return(oid, err, is_exception=True)
@@ -371,9 +400,13 @@ class LocalRuntime:
                             err = capture_exception(e)
                             for oid in return_ids:
                                 self._put_return(oid, err, is_exception=True)
+                        finally:
+                            finish_pending()
 
                     fut.add_done_callback(_done)
                     return
+                prev_task = getattr(_task_local, "task_id", _SENTINEL)
+                prev_actor = getattr(_task_local, "actor_id", _SENTINEL)
                 _task_local.task_id = task_id
                 _task_local.actor_id = actor_id
                 try:
@@ -383,8 +416,8 @@ class LocalRuntime:
                     else:
                         result = method(*r_args, **r_kwargs)
                 finally:
-                    _task_local.task_id = None
-                    _task_local.actor_id = None
+                    _restore_task_local("task_id", prev_task)
+                    _restore_task_local("actor_id", prev_actor)
                 self._store_results(result, return_ids)
             except BaseException as e:  # noqa: BLE001
                 from ray_tpu.exceptions import RayTpuError
@@ -392,13 +425,23 @@ class LocalRuntime:
                 err = e if isinstance(e, RayTpuError) else capture_exception(e)
                 for oid in return_ids:
                     self._put_return(oid, err, is_exception=True)
+            finally:
+                finish_pending()
 
         if method_name == "__ray_terminate__":
+            finish_pending()
             self._kill_actor(actor_id, "terminated by user")
             for oid in return_ids:
                 self._put_return(oid, None)
             return refs
-        state.pool.submit(run)
+        try:
+            state.pool.submit(run)
+        except RuntimeError:
+            # Pool shut down by a concurrent kill — fail the refs, don't raise.
+            finish_pending()
+            err = ActorDiedError(actor_id, state.death_reason or "actor killed")
+            for oid in return_ids:
+                self._put_return(oid, err, is_exception=True)
         return refs
 
     def get_actor(self, name: str, namespace: str = "default") -> ActorID:
@@ -423,12 +466,21 @@ class LocalRuntime:
             state.dead = True
             state.death_reason = reason
             if state.name is not None:
-                self._named_actors.pop(("default", state.name), None)
                 for k in [k for k, v in self._named_actors.items() if v == actor_id]:
                     self._named_actors.pop(k, None)
             if state.loop is not None:
                 state.loop.call_soon_threadsafe(state.loop.stop)
         state.pool.shutdown(wait=False, cancel_futures=True)
+        # Queued calls were cancelled before storing anything; fail their
+        # return objects so pending get()s resolve with ActorDiedError.
+        # (_put_return keeps the first value, so a call that actually finished
+        # concurrently wins over this error.)
+        with state.pending_lock:
+            pending = [oid for oids in state.pending_returns.values() for oid in oids]
+            state.pending_returns.clear()
+        err = ActorDiedError(actor_id, reason)
+        for oid in pending:
+            self._put_return(oid, err, is_exception=True)
 
     def list_actors(self):
         with self._actors_lock:

@@ -1,0 +1,276 @@
+"""Llama-3-class decoder, TPU-first (pure-functional JAX pytree params).
+
+This is the flagship model for the framework's Train/Serve paths and the
+benchmark target from BASELINE.json ("Llama-3 8B ... pretrain + inference").
+The reference orchestrates torch models it does not own; here the model is
+native so that sharding, remat, and kernels are co-designed:
+
+- Parameters are a pytree with per-dimension *logical names*
+  (`param_logical_axes`) mapped to mesh axes by `parallel/mesh.py` —
+  fsdp/tp sharding is a table, not code.
+- Layers are stacked on a leading ``layers`` dim and executed with
+  `lax.scan` + `jax.checkpoint` (one compiled block, O(1) compile time in
+  depth, remat for HBM).
+- Attention dispatches to ring attention (`ops/ring_attention.py`) when the
+  mesh's ``sp`` axis > 1 — long context is a mesh shape, not a code change.
+- Decode runs against a preallocated KV cache with position-based masking
+  (static shapes; serving reuses the same block code).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax import lax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rotary import apply_rope
+from ray_tpu.parallel.mesh import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def param_count(self) -> int:
+        d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        attn = d * self.n_heads * self.head_dim * 2 + d * self.n_kv_heads * self.head_dim * 2
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * per_layer + d + head
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Training FLOPs/token: 6*N_matmul + attention quadratic term.
+
+        The input embedding table is a gather, not a matmul, so it is excluded
+        — unless tied, in which case the same table IS the output matmul.
+        """
+        s = seq_len or self.max_seq_len
+        gather_only = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        n_matmul = self.param_count() - gather_only
+        attn_flops = 12 * self.n_layers * self.d_model * s  # qk^T + pv, fwd+bwd
+        return 6 * n_matmul + attn_flops
+
+
+# Presets ------------------------------------------------------------------
+
+LLAMA3_8B = LlamaConfig()
+LLAMA3_1B = LlamaConfig(vocab_size=128256, d_model=2048, n_layers=16,
+                        n_heads=32, n_kv_heads=8, d_ff=8192)
+LLAMA3_70B = LlamaConfig(d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                         d_ff=28672)
+
+
+def tiny_config(**kw) -> LlamaConfig:
+    base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq_len=128, dtype=jnp.float32,
+                remat=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+# Parameter init + logical sharding ---------------------------------------
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Per-dimension logical names for every parameter (see
+    `parallel.mesh.DEFAULT_RULES` for the mapping to mesh axes)."""
+    tree = {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "ln_attn": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "ln_mlp": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "ln_out": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = ("embed", "vocab")
+    return tree
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    d, hd, h, kh, f, v, l = (cfg.d_model, cfg.head_dim, cfg.n_heads,
+                             cfg.n_kv_heads, cfg.d_ff, cfg.vocab_size,
+                             cfg.n_layers)
+    keys = jax.random.split(key, 8)
+    dt = cfg.dtype
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    params: Params = {
+        "embed": norm(keys[0], (v, d), d),
+        "blocks": {
+            "ln_attn": jnp.zeros((l, d), dt),
+            "wq": norm(keys[1], (l, d, h, hd), d),
+            "wk": norm(keys[2], (l, d, kh, hd), d),
+            "wv": norm(keys[3], (l, d, kh, hd), d),
+            "wo": norm(keys[4], (l, h, hd, d), h * hd),
+            "ln_mlp": jnp.zeros((l, d), dt),
+            "w_gate": norm(keys[5], (l, d, f), d),
+            "w_up": norm(keys[6], (l, d, f), d),
+            "w_down": norm(keys[7], (l, f, d), f),
+        },
+        "ln_out": jnp.zeros((d,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(jax.random.fold_in(key, 99), (d, v), d)
+    return params
+
+
+# Forward ------------------------------------------------------------------
+
+def _attention_dispatch(q, k, v, q_pos, kv_pos, cfg, mesh: Optional[Mesh]):
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return ring_attention(q, k, v, q_pos, kv_pos, mesh=mesh)
+    return causal_attention(q, k, v, q_positions=q_pos, kv_positions=kv_pos)
+
+
+def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
+           cache_kv=None, cache_index=None):
+    """One transformer block. Returns (x, new_kv | None)."""
+    h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = constrain(q, ("batch", "seq", "heads", None))
+    k = constrain(k, ("batch", "seq", "kv_heads", None))
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    new_kv = None
+    if cache_kv is not None:
+        ck, cv = cache_kv
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        new_kv = (ck, cv)
+        kv_len = ck.shape[1]
+        kv_pos = jnp.broadcast_to(jnp.arange(kv_len), (x.shape[0], kv_len))
+        kv_mask = kv_pos < (cache_index + k.shape[1])
+        attn = causal_attention(q, ck, cv, q_positions=positions,
+                                kv_positions=kv_pos, kv_mask=kv_mask)
+    else:
+        attn = _attention_dispatch(q, k, v, positions, positions, cfg, mesh)
+    attn = constrain(attn, ("batch", "seq", "heads", None))
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"]).astype(x.dtype)
+    x = constrain(x, ("batch", "seq", None))
+
+    h = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+    ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"]).astype(x.dtype)
+    return constrain(x, ("batch", "seq", None)), new_kv
+
+
+def forward(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
+            *, mesh: Optional[Mesh] = None,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Full-sequence forward: tokens [B,S] -> logits [B,S,V]."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", None))
+
+    def body(x, layer):
+        y, _ = _block(x, layer, positions, cfg, mesh)
+        return y, None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    x, _ = lax.scan(body, x, params["blocks"])
+
+    x = rms_norm(x, params["ln_out"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
+            *, mesh: Optional[Mesh] = None,
+            loss_mask: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, Dict]:
+    """Next-token cross entropy over tokens [B, S].
+
+    Targets are the left-shifted tokens with the final position masked out —
+    shapes stay [B, S] (no :-1 slicing) so the sequence length remains evenly
+    divisible by the ``sp`` mesh axis under sequence parallelism.
+    """
+    b, s = tokens.shape
+    logits = forward(params, tokens, cfg, mesh=mesh).astype(jnp.float32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    valid = (jnp.arange(s) < s - 1).astype(jnp.float32)[None, :]
+    if loss_mask is not None:
+        valid = valid * jnp.roll(loss_mask, -1, axis=1).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    loss = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)
+    return loss, {"loss": loss, "ppl_log": loss}
+
+
+# KV-cache decode (serving path) ------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> Dict[str, jnp.ndarray]:
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def forward_with_cache(params: Params, tokens: jnp.ndarray,
+                       cache: Dict[str, jnp.ndarray], cache_index,
+                       cfg: LlamaConfig) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Prefill-chunk or decode-step forward against a KV cache.
+
+    tokens [B, T] written at [cache_index, cache_index+T); returns logits for
+    those T positions plus the updated cache. ``cache_index`` may be traced.
+    """
+    b, t = tokens.shape
+    positions = cache_index + jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+
+    def body(x, layer_and_kv):
+        layer, ck, cv = layer_and_kv
+        y, new_kv = _block(x, layer, positions, cfg, None,
+                           cache_kv=(ck, cv), cache_index=cache_index)
+        return y, new_kv
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["ln_out"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return logits, {"k": new_k, "v": new_v}

@@ -1,0 +1,65 @@
+"""Causal grouped-query attention — XLA reference path.
+
+This is the portable implementation (CPU tests + TPU fallback). The hot TPU
+paths are `ops/pallas/flash_attention.py` (fused kernel) and
+`ops/ring_attention.py` (sequence-parallel over the ``sp`` mesh axis).
+
+Shapes follow [batch, seq, heads, head_dim] throughout ("BSHD").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads to match query heads for GQA: [B,S,K,D] -> [B,S,K*n,D]."""
+    if n_rep == 1:
+        return k
+    b, s, kh, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, kh, n_rep, d)).reshape(
+        b, s, kh * n_rep, d)
+
+
+def causal_attention(
+    q: jnp.ndarray,                 # [B, Sq, H, D]
+    k: jnp.ndarray,                 # [B, Sk, KH, D]
+    v: jnp.ndarray,                 # [B, Sk, KH, D]
+    *,
+    q_positions: Optional[jnp.ndarray] = None,   # [B, Sq] global positions
+    kv_positions: Optional[jnp.ndarray] = None,  # [B, Sk]
+    kv_mask: Optional[jnp.ndarray] = None,       # [B, Sk] valid-kv mask (decode)
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Softmax(QK^T)V with causal masking by *global position*.
+
+    Position-based masking (not index-based) makes the same function serve
+    full prefill, chunked prefill, and single-token decode against a KV cache.
+    """
+    b, sq, h, d = q.shape
+    kh = k.shape[2]
+    if h != kh:
+        rep = h // kh
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
+    if scale is None:
+        scale = d ** -0.5
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq), (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(k.shape[1]), (b, k.shape[1]))
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    causal = q_positions[:, None, :, None] >= kv_positions[:, None, None, :]
+    if kv_mask is not None:
+        causal = jnp.logical_and(causal, kv_mask[:, None, None, :])
+    logits = jnp.where(causal, logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out

@@ -1,0 +1,18 @@
+"""Normalization ops (RMSNorm) — fused-friendly formulations for XLA.
+
+Computation kept in fp32 regardless of input dtype (matches standard Llama
+practice); XLA fuses the normalize+scale into neighboring elementwise work.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    return (y * (1.0 + scale.astype(jnp.float32))).astype(dtype)

@@ -1,0 +1,29 @@
+"""Rotary position embeddings (RoPE), Llama-3 style (half-dim rotation)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 500000.0) -> jnp.ndarray:
+    """Inverse frequencies for each pair of rotated dims: [head_dim // 2]."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float = 500000.0) -> jnp.ndarray:
+    """Rotate ``x`` [..., seq, heads, head_dim] by per-position angles.
+
+    ``positions``: integer array broadcastable to [..., seq] — passing explicit
+    positions (rather than arange) keeps the same code path correct for
+    sequence-sharded (ring attention) and KV-cache decode cases.
+    """
+    dtype = x.dtype
+    freqs = rope_frequencies(x.shape[-1], theta)                # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs   # [..., seq, hd/2]
+    angles = angles[..., None, :]                               # [..., seq, 1, hd/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

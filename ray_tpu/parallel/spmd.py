@@ -1,0 +1,83 @@
+"""SPMD training-step construction: sharded init + jitted train step.
+
+This is the TPU-native execution model replacing the reference's per-worker
+torch DDP wiring (reference `train/_internal/backend_executor.py:69` +
+`train/torch/config.py:94-163`): ONE compiled XLA program over a Mesh instead
+of N processes exchanging NCCL messages. Gradient reductions, fsdp
+all-gathers/reduce-scatters, tp collectives, and ring-attention ppermutes are
+all emitted by XLA from sharding annotations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.parallel.mesh import param_shardings
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Token batches: batch over dp+fsdp, sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def sharded_init(cfg: llama.LlamaConfig, mesh: Mesh, key: jax.Array,
+                 tx: optax.GradientTransformation) -> TrainState:
+    """Initialize params directly INTO their shards (no host-side full copy —
+    required for models larger than one host's HBM)."""
+    shardings = param_shardings(mesh, llama.param_logical_axes(cfg))
+    p_init = jax.jit(functools.partial(llama.init_params, cfg),
+                     out_shardings=shardings)
+    params = p_init(key)
+    # Optimizer state mirrors param shapes; XLA propagates the input shardings.
+    opt_state = jax.jit(tx.init)(params)
+    step = jnp.zeros((), jnp.int32)
+    return TrainState(step, params, opt_state)
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig, mesh: Mesh, tx: optax.GradientTransformation,
+) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Returns jitted (state, tokens [B,S]) -> (state, metrics). Buffers are
+    donated, so the step is in-place in HBM."""
+
+    def step_fn(state: TrainState, tokens: jnp.ndarray):
+        (loss, metrics), grads = jax.value_and_grad(
+            llama.loss_fn, has_aux=True)(state.params, tokens, cfg, mesh=mesh)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        metrics = dict(metrics, grad_norm=gnorm)
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def make_eval_step(cfg: llama.LlamaConfig, mesh: Mesh):
+    def eval_fn(params, tokens):
+        loss, metrics = llama.loss_fn(params, tokens, cfg, mesh=mesh)
+        return metrics
+    return jax.jit(eval_fn)
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                      warmup: int = 100, decay_steps: int = 10000,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, decay_steps,
+                                               end_value=lr * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )

@@ -17,6 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("RTPU_TPU_CHIPS", "0")
 
+import jax  # noqa: E402
+
+# The axon TPU plugin force-appends itself to jax_platforms at import time,
+# which silently puts "CPU" tests on the real chip (nondeterministic bf16
+# matmuls). Pin the platform list before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -418,3 +418,52 @@ def test_nested_task_saturation_no_deadlock():
 
     refs = [parent.remote(i) for i in range(64)]
     assert ray_tpu.get(refs, timeout=30) == [i + 1 for i in range(64)]
+
+
+def test_kill_fails_queued_calls():
+    """Queued method calls on a killed actor resolve with ActorDiedError
+    instead of hanging (reference semantics: RayActorError on kill)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.exceptions import ActorDiedError
+
+    @ray_tpu.remote
+    class Slow:
+        def work(self, t):
+            _time.sleep(t)
+            return "done"
+
+    a = Slow.remote()
+    r1 = a.work.remote(5.0)
+    r2 = a.work.remote(0.0)  # queued behind r1 (max_concurrency=1)
+    _time.sleep(0.2)
+    ray_tpu.kill(a)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(r2, timeout=10)
+
+
+def test_kill_does_not_unregister_same_name_other_namespace():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a1 = A.options(name="x", namespace="ns1").remote()
+    a2 = A.options(name="x").remote()  # default namespace
+    ray_tpu.kill(a1)
+    h = ray_tpu.get_actor("x")  # default-namespace actor must survive
+    assert ray_tpu.get(h.ping.remote(), timeout=10) == "pong"
+
+
+def test_actor_options_validated():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        pass
+
+    with pytest.raises(ValueError):
+        A.options(num_cpu=2)  # typo must raise, not be silently dropped
