@@ -1,0 +1,112 @@
+"""Benchmark: Llama-class pretrain step on the available TPU chip(s).
+
+Prints ONE JSON line:
+  {"metric": "train_mfu_llama1b", "value": <MFU>, "unit": "mfu",
+   "vs_baseline": <MFU / 0.40>, ...extras}
+
+The north-star target from BASELINE.json is >=40% MFU on Llama-class
+pretrain (reference has no TPU/LLM numbers checked in; 0.40 is the target
+ratio denominator). Extras report tokens/s/chip for context.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Peak dense bf16 FLOP/s per chip by device kind substring.
+PEAK_FLOPS = [
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("cpu", 1e12),  # nominal, CI fallback
+]
+
+
+def peak_flops_for(device_kind: str) -> float:
+    dk = device_kind.lower()
+    for key, val in PEAK_FLOPS:
+        if key in dk:
+            return val
+    return 197e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import spmd
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    kind = devices[0].device_kind
+
+    if on_tpu:
+        cfg = llama.LLAMA3_1B
+        batch, seq = 8, 2048
+        cfg = llama.LlamaConfig(
+            **{**cfg.__dict__, "max_seq_len": seq}
+        )
+        warmup, iters = 2, 10
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        batch, seq = 4, 256
+        warmup, iters = 1, 3
+
+    mesh = make_mesh(MeshSpec(fsdp=n_chips), devices) if n_chips > 1 else \
+        make_mesh(MeshSpec(), devices[:1])
+    tx = spmd.default_optimizer(lr=1e-4)
+
+    with jax.sharding.set_mesh(mesh):
+        state = spmd.sharded_init(cfg, mesh, jax.random.PRNGKey(0), tx)
+        step = spmd.make_train_step(cfg, mesh, tx)
+        rng = np.random.default_rng(0)
+        tokens = jax.device_put(
+            rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+            spmd.data_sharding(mesh),
+        )
+        # NOTE: through the remote-TPU tunnel, block_until_ready is not a
+        # reliable execution barrier — only a host fetch is. Fetch the loss
+        # scalar once per timed region (per-fetch overhead ~75ms, amortized
+        # over `iters` steps).
+        for _ in range(warmup):
+            state, metrics = step(state, tokens)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, tokens)
+        loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    tokens_per_s = batch * seq * iters / dt
+    tokens_per_s_chip = tokens_per_s / n_chips
+    flops_tok = cfg.flops_per_token(seq)
+    mfu = tokens_per_s_chip * flops_tok / peak_flops_for(kind)
+
+    print(json.dumps({
+        "metric": "train_mfu_llama1b",
+        "value": round(mfu, 4),
+        "unit": "mfu",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_s_per_chip": round(tokens_per_s_chip, 1),
+        "step_time_s": round(dt / iters, 4),
+        "device": kind,
+        "n_chips": n_chips,
+        "config": "llama3-1b" if on_tpu else "tiny-cpu",
+        "batch": batch,
+        "seq": seq,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -373,6 +373,7 @@ class LocalRuntime:
                 state.pending_returns.pop(task_id, None)
 
         def run():
+            still_pending = False
             if state.dead:
                 finish_pending()
                 err = ActorDiedError(actor_id, state.death_reason)
@@ -404,6 +405,12 @@ class LocalRuntime:
                             finish_pending()
 
                     fut.add_done_callback(_done)
+                    # The call stays pending until the coroutine resolves —
+                    # _done owns finish_pending(); the sync path's finally
+                    # below must not drain it while the coroutine is in
+                    # flight (kill() could then never fail these refs and a
+                    # concurrent get() would hang forever).
+                    still_pending = True
                     return
                 prev_task = getattr(_task_local, "task_id", _SENTINEL)
                 prev_actor = getattr(_task_local, "actor_id", _SENTINEL)
@@ -426,7 +433,8 @@ class LocalRuntime:
                 for oid in return_ids:
                     self._put_return(oid, err, is_exception=True)
             finally:
-                finish_pending()
+                if not still_pending:
+                    finish_pending()
 
         if method_name == "__ray_terminate__":
             finish_pending()

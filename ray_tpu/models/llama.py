@@ -28,7 +28,7 @@ from jax import lax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.attention import causal_attention, full_causal_attention
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.rotary import apply_rope
@@ -50,6 +50,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # jax.checkpoint policy name: "nothing" = full per-layer remat (lowest
+    # HBM — backward recomputes the block from its input), "dots" = save
+    # non-batch matmul outputs (faster bwd, +O(layers*S*d_ff) HBM).
+    remat_policy: str = "nothing"
     tie_embeddings: bool = False
 
     @property
@@ -152,14 +156,31 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
 
 # Forward ------------------------------------------------------------------
 
-def _attention_dispatch(q, k, v, q_pos, kv_pos, cfg, mesh: Optional[Mesh]):
+def _remat_policy(cfg: LlamaConfig):
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy != "nothing":
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                         "expected 'nothing' or 'dots'")
+    return jax.checkpoint_policies.nothing_saveable
+
+
+
+def _attention_dispatch(q, k, v, q_pos, kv_pos, cfg, mesh: Optional[Mesh],
+                        standard_positions: bool = False):
+    """``standard_positions`` is a STATIC flag set by the caller when positions
+    are the plain [0..S) arange — that (and only that) unlocks the fused TPU
+    kernel's built-in causal mask; custom positions (packed documents, chunked
+    prefill) keep explicit position-based masking."""
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         return ring_attention(q, k, v, q_pos, kv_pos, mesh=mesh)
-    return causal_attention(q, k, v, q_positions=q_pos, kv_positions=kv_pos)
+    if standard_positions:
+        return full_causal_attention(q, k, v)
+    return full_causal_attention(q, k, v, q_positions=q_pos, kv_positions=kv_pos)
 
 
 def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
-           cache_kv=None, cache_index=None):
+           cache_kv=None, cache_index=None, standard_positions: bool = False):
     """One transformer block. Returns (x, new_kv | None)."""
     h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
@@ -182,7 +203,8 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
         attn = causal_attention(q, ck, cv, q_positions=positions,
                                 kv_positions=kv_pos, kv_mask=kv_mask)
     else:
-        attn = _attention_dispatch(q, k, v, positions, positions, cfg, mesh)
+        attn = _attention_dispatch(q, k, v, positions, positions, cfg, mesh,
+                                   standard_positions=standard_positions)
     attn = constrain(attn, ("batch", "seq", "heads", None))
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"]).astype(x.dtype)
     x = constrain(x, ("batch", "seq", None))
@@ -199,45 +221,81 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
             *, mesh: Optional[Mesh] = None,
             positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Full-sequence forward: tokens [B,S] -> logits [B,S,V]."""
+    x = forward_hidden(params, tokens, cfg, mesh=mesh, positions=positions)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
+                   *, mesh: Optional[Mesh] = None,
+                   positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Tokens [B,S] -> final normed hidden states [B,S,D] (no LM head)."""
     b, s = tokens.shape
+    standard = positions is None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", None))
 
     def body(x, layer):
-        y, _ = _block(x, layer, positions, cfg, mesh)
+        y, _ = _block(x, layer, positions, cfg, mesh,
+                      standard_positions=standard)
         return y, None
 
     if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
     x, _ = lax.scan(body, x, params["blocks"])
-
-    x = rms_norm(x, params["ln_out"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head)
-    return constrain(logits, ("batch", "seq", "vocab"))
+    return rms_norm(x, params["ln_out"], cfg.norm_eps)
 
 
 def loss_fn(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
             *, mesh: Optional[Mesh] = None,
-            loss_mask: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, Dict]:
+            loss_mask: Optional[jnp.ndarray] = None,
+            logits_chunk: int = 512) -> Tuple[jnp.ndarray, Dict]:
     """Next-token cross entropy over tokens [B, S].
 
     Targets are the left-shifted tokens with the final position masked out —
     shapes stay [B, S] (no :-1 slicing) so the sequence length remains evenly
     divisible by the ``sp`` mesh axis under sequence parallelism.
+
+    The [B,S,V] logits are never materialized: cross-entropy runs in sequence
+    chunks of ``logits_chunk`` under `jax.checkpoint`, so peak HBM holds one
+    [B,C,V] chunk (fwd AND bwd — the chunk logits are recomputed from the
+    hidden states in the backward pass). At V=128k this is the difference
+    between fitting on a chip and an OOM.
     """
     b, s = tokens.shape
-    logits = forward(params, tokens, cfg, mesh=mesh).astype(jnp.float32)
+    x = forward_hidden(params, tokens, cfg, mesh=mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     valid = (jnp.arange(s) < s - 1).astype(jnp.float32)[None, :]
     if loss_mask is not None:
         valid = valid * jnp.roll(loss_mask, -1, axis=1).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * valid
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+    def chunk_nll(args):
+        xc, tc = args  # [B,C,D], [B,C]
+        logits = jnp.einsum("bcd,dv->bcv", xc, head).astype(jnp.float32)
+        logits = constrain(logits, ("batch", "seq", "vocab"))
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return logz - gold  # [B,C]
+
+    if s > logits_chunk:
+        # Pad the ragged tail (padded positions are already invalid in
+        # `valid`, so they contribute nothing) — NEVER fall back to the
+        # full [B,S,V] materialization the chunking exists to avoid.
+        pad = (-s) % logits_chunk
+        xs_p = jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+        ts_p = jnp.pad(targets, ((0, 0), (0, pad))) if pad else targets
+        n = (s + pad) // logits_chunk
+        xs = xs_p.reshape(b, n, logits_chunk, -1).swapaxes(0, 1)
+        ts = ts_p.reshape(b, n, logits_chunk).swapaxes(0, 1)
+        nll = lax.map(jax.checkpoint(chunk_nll), (xs, ts))
+        nll = nll.swapaxes(0, 1).reshape(b, s + pad)[:, :s]
+    else:
+        nll = chunk_nll((x, targets))
+    nll = nll * valid
     loss = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)
     return loss, {"loss": loss, "ppl_log": loss}
 

@@ -63,3 +63,133 @@ def causal_attention(
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out
+
+
+def online_softmax_update(q, k, v, q_pos, k_pos, m, l, o, scale):
+    """One flash-style online-softmax accumulation step against a K/V block.
+
+    The single shared implementation for the blockwise scan (below) and the
+    ring-attention ppermute loop (`ops/ring_attention.py`). GQA-aware: k/v may
+    have fewer heads ([B,Sk,KH,D]); they are expanded here, AFTER any
+    inter-chip transfer, so ring hops move only the un-repeated KV bytes.
+
+    q: [B,Sq,H,D]; accumulators m,l: [B,H,Sq] fp32, o: [B,H,Sq,D] fp32.
+    """
+    h, kh = q.shape[2], k.shape[2]
+    if h != kh:
+        k = repeat_kv(k, h // kh)
+        v = repeat_kv(v, h // kh)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = q_pos[:, None, :, None] >= k_pos[:, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def blockwise_attention(
+    q: jnp.ndarray,                 # [B, Sq, H, D]
+    k: jnp.ndarray,                 # [B, Sk, KH, D]
+    v: jnp.ndarray,                 # [B, Sk, KH, D]
+    *,
+    q_positions: jnp.ndarray,       # [B, Sq]
+    kv_positions: jnp.ndarray,      # [B, Sk]
+    scale: Optional[float] = None,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """Flash-style online-softmax attention, scanning KV in blocks.
+
+    Never materializes the [Sq, Sk] score matrix: peak temp is
+    [B, H, Sq, block_k]. Portable (CPU tests, TPU fallback when the Pallas
+    kernel does not apply); numerics match `causal_attention`.
+    """
+    import jax
+    from jax import lax
+
+    b, sq, h, d = q.shape
+    sk, kh = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    if sk % block_k or sk < block_k:
+        # Ragged tail: fall back to the dense path.
+        return causal_attention(q, k, v, q_positions=q_positions,
+                                kv_positions=kv_positions, scale=scale)
+    n_blocks = sk // block_k
+    kb = k.reshape(b, n_blocks, block_k, kh, d).swapaxes(0, 1)
+    vb = v.reshape(b, n_blocks, block_k, kh, d).swapaxes(0, 1)
+    pb = kv_positions.reshape(b, n_blocks, block_k).swapaxes(0, 1)
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+
+    def step(carry, blk):
+        m, l, o = carry
+        kc, vc, kp = blk
+        m, l, o = online_softmax_update(q, kc, vc, q_positions, kp,
+                                        m, l, o, scale)
+        return (m, l, o), None
+
+    (m, l, o), _ = lax.scan(
+        jax.checkpoint(step), (m0, l0, o0), (kb, vb, pb))
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _default_positions(q_positions, kv_positions, b, sq, sk) -> bool:
+    """True iff positions are the standard full-sequence arange (the only
+    pattern the fused TPU kernel's `causal=True` flag encodes)."""
+    if q_positions is None and kv_positions is None:
+        return sq == sk
+    return False
+
+
+def full_causal_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *,
+    q_positions: Optional[jnp.ndarray] = None,
+    kv_positions: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Training-path attention dispatcher (full sequence, causal).
+
+    TPU: fused Pallas flash kernel (jax.experimental.pallas.ops.tpu) — no
+    [Sq,Sk] materialization, fwd+bwd kernels. Elsewhere / ragged shapes:
+    blockwise online-softmax scan, then dense for short sequences.
+    """
+    import jax
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    on_tpu = jax.devices()[0].platform == "tpu"
+    standard = _default_positions(q_positions, kv_positions, b, sq, sk)
+    if on_tpu and standard and sq >= 256 and sq % 128 == 0 and d % 128 == 0:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _tpu_flash,
+        )
+
+        kh = k.shape[2]
+        if h != kh:
+            k = repeat_kv(k, h // kh)
+            v = repeat_kv(v, h // kh)
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        out = _tpu_flash(qt, kt, vt, causal=True, sm_scale=scale)
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq), (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(sk), (b, sk))
+    if sk >= 1024:
+        return blockwise_attention(q, k, v, q_positions=q_positions,
+                                   kv_positions=kv_positions, scale=scale)
+    return causal_attention(q, k, v, q_positions=q_positions,
+                            kv_positions=kv_positions, scale=scale)

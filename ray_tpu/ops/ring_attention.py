@@ -27,34 +27,19 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_tpu.ops.attention import NEG_INF, repeat_kv
-
-
-def _block_update(q, k, v, q_pos, k_pos, m, l, o, scale):
-    """One online-softmax accumulation step against a rotated K/V block."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    mask = q_pos[:, None, :, None] >= k_pos[:, None, None, :]
-    s = jnp.where(mask, s, NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B,H,Sq]
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])
-    p = jnp.where(mask, p, 0.0)
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-    return m_new, l_new, o_new
+from ray_tpu.ops.attention import NEG_INF, online_softmax_update
 
 
 def _ring_attention_local(q, k, v, q_pos, k_pos, *, axis_name: str,
                           scale: Optional[float] = None):
     """Per-shard body (runs inside shard_map). Shapes are the LOCAL shard:
-    q [B, Sq, H, D], k/v [B, Sk, KH, D], q_pos/k_pos [B, S*]."""
+    q [B, Sq, H, D], k/v [B, Sk, KH, D], q_pos/k_pos [B, S*].
+
+    K/V rotate around the ring UN-repeated ([…,KH,D]); GQA expansion to the
+    full query-head count happens inside `online_softmax_update`, after the
+    ppermute — so each ICI hop carries only KH/H of the naive bytes.
+    """
     n = lax.psum(1, axis_name)
-    h, kh = q.shape[2], k.shape[2]
-    if h != kh:
-        k = repeat_kv(k, h // kh)
-        v = repeat_kv(v, h // kh)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, sq, heads, d = q.shape
@@ -70,7 +55,7 @@ def _ring_attention_local(q, k, v, q_pos, k_pos, *, axis_name: str,
 
     def step(_, carry):
         m, l, o, kc, vc, kpc = carry
-        m, l, o = _block_update(q, kc, vc, q_pos, kpc, m, l, o, scale)
+        m, l, o = online_softmax_update(q, kc, vc, q_pos, kpc, m, l, o, scale)
         # Rotate K/V (and their global positions) one hop around the ring.
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)

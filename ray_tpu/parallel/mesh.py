@@ -123,15 +123,12 @@ def named_sharding(mesh: Mesh, names: Sequence[Optional[str]],
 def constrain(x, names: Sequence[Optional[str]],
               rules: Optional[Dict[str, Optional[object]]] = None):
     """`with_sharding_constraint` by logical dimension names (no-op outside jit
-    over a mesh)."""
-    try:
-        return jax.lax.with_sharding_constraint(x, logical_spec(names, rules))
-    except (ValueError, RuntimeError) as e:
-        # Only a missing mesh context makes the constraint a no-op; real spec
-        # errors (rank mismatch, unknown axis) must surface.
-        if "mesh" in str(e).lower():
-            return x
-        raise
+    over a mesh). Real spec errors (rank mismatch, unknown axis) surface —
+    the no-mesh case is detected explicitly, not by matching error text."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or getattr(mesh, "empty", False) or not mesh.shape_tuple:
+        return x
+    return jax.lax.with_sharding_constraint(x, logical_spec(names, rules))
 
 
 def param_shardings(mesh: Mesh, logical_tree,

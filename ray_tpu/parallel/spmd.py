@@ -33,13 +33,26 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
 
 
+def _with_mesh_context(mesh: Mesh, fn):
+    """Wrap a jitted callable so tracing always sees ``mesh`` as the ambient
+    abstract mesh — `constrain()`'s PartitionSpec annotations then apply
+    regardless of whether the caller entered `jax.sharding.set_mesh`."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 def sharded_init(cfg: llama.LlamaConfig, mesh: Mesh, key: jax.Array,
                  tx: optax.GradientTransformation) -> TrainState:
     """Initialize params directly INTO their shards (no host-side full copy —
     required for models larger than one host's HBM)."""
     shardings = param_shardings(mesh, llama.param_logical_axes(cfg))
-    p_init = jax.jit(functools.partial(llama.init_params, cfg),
-                     out_shardings=shardings)
+    p_init = _with_mesh_context(mesh, jax.jit(
+        functools.partial(llama.init_params, cfg), out_shardings=shardings))
     params = p_init(key)
     # Optimizer state mirrors param shapes; XLA propagates the input shardings.
     opt_state = jax.jit(tx.init)(params)
@@ -62,14 +75,14 @@ def make_train_step(
         metrics = dict(metrics, grad_norm=gnorm)
         return TrainState(state.step + 1, params, opt_state), metrics
 
-    return jax.jit(step_fn, donate_argnums=(0,))
+    return _with_mesh_context(mesh, jax.jit(step_fn, donate_argnums=(0,)))
 
 
 def make_eval_step(cfg: llama.LlamaConfig, mesh: Mesh):
     def eval_fn(params, tokens):
         loss, metrics = llama.loss_fn(params, tokens, cfg, mesh=mesh)
         return metrics
-    return jax.jit(eval_fn)
+    return _with_mesh_context(mesh, jax.jit(eval_fn))
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,

@@ -467,3 +467,25 @@ def test_actor_options_validated():
 
     with pytest.raises(ValueError):
         A.options(num_cpu=2)  # typo must raise, not be silently dropped
+
+
+def test_kill_async_actor_with_inflight_call_fails_refs():
+    """Killing an async actor while a coroutine is awaiting must fail the
+    in-flight call's refs (not hang): the pending entry stays registered
+    until the coroutine actually resolves."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Sleeper:
+        async def sleep(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return "done"
+
+    a = Sleeper.remote()
+    ref = a.sleep.remote(30.0)
+    time.sleep(0.3)  # let the coroutine start awaiting
+    ray_tpu.kill(a)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(ref, timeout=5)
