@@ -1,0 +1,39 @@
+"""Build the native components (g++ -O2 -shared) into ray_tpu/_cpp/*.so.
+
+Run directly (`python ray_tpu/_cpp/build.py`) or let
+`ray_tpu.core.shm_store.ensure_built()` invoke it lazily on first use.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+TARGETS = [
+    ("shm_store.cc", "libshm_store.so", ["-lpthread", "-lrt"]),
+]
+
+
+def build(verbose: bool = True) -> list[str]:
+    built = []
+    for src, out, libs in TARGETS:
+        src_p = os.path.join(HERE, src)
+        out_p = os.path.join(HERE, out)
+        if (os.path.exists(out_p)
+                and os.path.getmtime(out_p) >= os.path.getmtime(src_p)):
+            built.append(out_p)
+            continue
+        cmd = ["g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+               "-o", out_p, src_p] + libs
+        if verbose:
+            print("+", " ".join(cmd), file=sys.stderr)
+        subprocess.run(cmd, check=True)
+        built.append(out_p)
+    return built
+
+
+if __name__ == "__main__":
+    build()

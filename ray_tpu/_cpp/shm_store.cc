@@ -1,0 +1,536 @@
+// rtpu shm object store: the per-node object plane (plasma-equivalent).
+//
+// Design parity with the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.h:55,
+//  object_lifecycle_manager.h:101, eviction_policy.h:105), re-architected
+// for the TPU era instead of ported: plasma is a *server process* speaking a
+// flatbuffer protocol over a unix socket (reference plasma/plasma.fbs), which
+// costs a socket round-trip per create/get/seal. Here the store is a plain
+// POSIX shm segment that every worker process on the node maps directly;
+// operations take a process-shared robust mutex and touch the header table
+// in-place. Zero RPCs, zero copies on the hot path — get() returns an
+// offset into the same mapping the creator wrote through. Host RAM is the
+// staging area for TPU HBM, so the store doubles as the iter_batches
+// device-prefetch source.
+//
+// Layout:  [StoreHeader | slot table | data arena]
+//   - slot table: open-addressed (linear probe) on the 28-byte ObjectID
+//   - arena: first-fit free list with boundary-tag coalescing
+//   - eviction: LRU over sealed refcount-0 objects (clock via header tick)
+//   - crash safety: PTHREAD_MUTEX_ROBUST — a worker dying mid-section marks
+//     the mutex inconsistent; the next locker repairs and continues.
+//
+// Built by ray_tpu/_cpp/build.py (g++ -O2 -shared), consumed via ctypes from
+// ray_tpu/core/shm_store.py.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055534852ULL;  // "RTPUSHR"
+constexpr int kKeySize = 28;
+constexpr uint8_t kEmpty = 0;
+constexpr uint8_t kCreated = 1;
+constexpr uint8_t kSealed = 2;
+constexpr uint8_t kTombstone = 3;  // slot freed; probe chains continue past
+
+// Arena block header (boundary tags for O(1) coalescing).
+struct BlockHeader {
+  uint64_t size;       // payload size (bytes, 64-aligned)
+  uint64_t prev_size;  // payload size of physically-previous block (0 = first)
+  uint32_t free_;      // 1 if on free list
+  uint32_t pad_;
+  uint64_t next_free;  // offset of next free block (0 = end)
+  uint64_t prev_free;  // offset of prev free block (0 = head)
+};
+constexpr uint64_t kBlockHdr = sizeof(BlockHeader);
+
+struct Slot {
+  uint8_t key[kKeySize];
+  uint8_t state;
+  uint8_t pad[3];
+  int32_t refcount;
+  uint64_t offset;     // data offset within segment (to payload)
+  uint64_t data_size;  // user-visible size
+  uint64_t lru_tick;
+};
+
+struct StoreHeader {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t n_slots;
+  uint64_t slot_table_off;
+  uint64_t arena_off;
+  uint64_t arena_size;
+  uint64_t used_bytes;
+  uint64_t n_objects;
+  uint64_t lru_clock;
+  uint64_t free_head;  // offset of first free block (0 = none)
+  uint64_t n_evictions;
+  uint64_t create_waiters;
+  pthread_mutex_t mutex;
+  pthread_cond_t seal_cond;
+};
+
+struct Handle {
+  uint8_t* base;
+  uint64_t size;
+  StoreHeader* hdr;
+};
+
+inline Slot* slot_table(Handle* h) {
+  return reinterpret_cast<Slot*>(h->base + h->hdr->slot_table_off);
+}
+
+inline uint64_t align64(uint64_t n) { return (n + 63) & ~uint64_t(63); }
+
+uint64_t fnv1a(const uint8_t* key) {
+  uint64_t hsh = 1469598103934665603ULL;
+  for (int i = 0; i < kKeySize; i++) {
+    hsh ^= key[i];
+    hsh *= 1099511628211ULL;
+  }
+  return hsh;
+}
+
+class Locker {
+ public:
+  explicit Locker(Handle* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->hdr->mutex);
+    if (rc == EOWNERDEAD) {
+      // Previous owner died inside a critical section. Repair: the header
+      // table is always left structurally valid between individual field
+      // writes (see ordering notes in create/seal), so consistent-mark is
+      // safe.
+      pthread_mutex_consistent(&h_->hdr->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&h_->hdr->mutex); }
+
+ private:
+  Handle* h_;
+};
+
+// -------- arena allocator (first-fit free list, boundary-tag coalesce) ----
+
+inline BlockHeader* block_at(Handle* h, uint64_t payload_off) {
+  return reinterpret_cast<BlockHeader*>(h->base + payload_off - kBlockHdr);
+}
+
+inline uint64_t next_payload_off(Handle* h, uint64_t payload_off) {
+  BlockHeader* b = block_at(h, payload_off);
+  uint64_t next = payload_off + b->size + kBlockHdr;
+  if (next >= h->hdr->arena_off + h->hdr->arena_size) return 0;
+  return next;
+}
+
+inline uint64_t prev_payload_off(Handle* h, uint64_t payload_off) {
+  BlockHeader* b = block_at(h, payload_off);
+  if (b->prev_size == 0 && payload_off == h->hdr->arena_off + kBlockHdr)
+    return 0;
+  return payload_off - kBlockHdr - b->prev_size;
+}
+
+void freelist_remove(Handle* h, uint64_t off) {
+  BlockHeader* b = block_at(h, off);
+  if (b->prev_free)
+    block_at(h, b->prev_free)->next_free = b->next_free;
+  else
+    h->hdr->free_head = b->next_free;
+  if (b->next_free) block_at(h, b->next_free)->prev_free = b->prev_free;
+  b->next_free = b->prev_free = 0;
+  b->free_ = 0;
+}
+
+void freelist_push(Handle* h, uint64_t off) {
+  BlockHeader* b = block_at(h, off);
+  b->free_ = 1;
+  b->prev_free = 0;
+  b->next_free = h->hdr->free_head;
+  if (h->hdr->free_head) block_at(h, h->hdr->free_head)->prev_free = off;
+  h->hdr->free_head = off;
+}
+
+// Split block at `off` so its payload is exactly `want` (aligned); push
+// remainder to the free list.
+void split_block(Handle* h, uint64_t off, uint64_t want) {
+  BlockHeader* b = block_at(h, off);
+  uint64_t spare = b->size - want;
+  if (spare < kBlockHdr + 64) return;  // too small to split
+  uint64_t rem_off = off + want + kBlockHdr;
+  BlockHeader* rem = block_at(h, rem_off);
+  rem->size = spare - kBlockHdr;
+  rem->prev_size = want;
+  rem->free_ = 0;
+  rem->next_free = rem->prev_free = 0;
+  b->size = want;
+  uint64_t after = next_payload_off(h, rem_off);
+  if (after) block_at(h, after)->prev_size = rem->size;
+  freelist_push(h, rem_off);
+}
+
+// Returns payload offset or 0.
+uint64_t arena_alloc(Handle* h, uint64_t want) {
+  want = align64(want ? want : 1);
+  uint64_t off = h->hdr->free_head;
+  while (off) {
+    BlockHeader* b = block_at(h, off);
+    if (b->size >= want) {
+      freelist_remove(h, off);
+      split_block(h, off, want);
+      h->hdr->used_bytes += block_at(h, off)->size + kBlockHdr;
+      return off;
+    }
+    off = b->next_free;
+  }
+  return 0;
+}
+
+void arena_free(Handle* h, uint64_t off) {
+  BlockHeader* b = block_at(h, off);
+  h->hdr->used_bytes -= b->size + kBlockHdr;
+  // Coalesce with next.
+  uint64_t next = next_payload_off(h, off);
+  if (next && block_at(h, next)->free_) {
+    freelist_remove(h, next);
+    b->size += block_at(h, next)->size + kBlockHdr;
+    uint64_t after = next_payload_off(h, off);
+    if (after) block_at(h, after)->prev_size = b->size;
+  }
+  // Coalesce with prev.
+  uint64_t prev = prev_payload_off(h, off);
+  if (prev && block_at(h, prev)->free_) {
+    BlockHeader* pb = block_at(h, prev);
+    freelist_remove(h, prev);
+    pb->size += b->size + kBlockHdr;
+    uint64_t after = next_payload_off(h, prev);
+    if (after) block_at(h, after)->prev_size = pb->size;
+    off = prev;
+  }
+  freelist_push(h, off);
+}
+
+// -------- slot table ------------------------------------------------------
+
+Slot* find_slot(Handle* h, const uint8_t* key) {
+  Slot* table = slot_table(h);
+  uint64_t n = h->hdr->n_slots;
+  uint64_t i = fnv1a(key) % n;
+  for (uint64_t probes = 0; probes < n; probes++) {
+    Slot* s = &table[i];
+    if (s->state == kEmpty) return nullptr;
+    if (s->state != kTombstone && memcmp(s->key, key, kKeySize) == 0) return s;
+    i = (i + 1) % n;
+  }
+  return nullptr;
+}
+
+Slot* find_insert_slot(Handle* h, const uint8_t* key) {
+  Slot* table = slot_table(h);
+  uint64_t n = h->hdr->n_slots;
+  uint64_t i = fnv1a(key) % n;
+  Slot* first_tomb = nullptr;
+  for (uint64_t probes = 0; probes < n; probes++) {
+    Slot* s = &table[i];
+    if (s->state == kEmpty) return first_tomb ? first_tomb : s;
+    if (s->state == kTombstone) {
+      if (!first_tomb) first_tomb = s;
+    } else if (memcmp(s->key, key, kKeySize) == 0) {
+      return nullptr;  // exists
+    }
+    i = (i + 1) % n;
+  }
+  return first_tomb;  // table full of live+tombstones; may still reuse tomb
+}
+
+// Evict LRU sealed refcount-0 objects until at least `need` bytes could be
+// allocated (or nothing evictable remains). Returns 1 if anything evicted.
+int evict_for(Handle* h, uint64_t need) {
+  int evicted_any = 0;
+  for (;;) {
+    if (arena_alloc(h, 0)) {
+      // probe: cheap check — try the actual allocation in caller
+    }
+    // Find LRU candidate.
+    Slot* table = slot_table(h);
+    Slot* lru = nullptr;
+    for (uint64_t i = 0; i < h->hdr->n_slots; i++) {
+      Slot* s = &table[i];
+      if (s->state == kSealed && s->refcount == 0) {
+        if (!lru || s->lru_tick < lru->lru_tick) lru = s;
+      }
+    }
+    if (!lru) return evicted_any;
+    arena_free(h, lru->offset);
+    lru->state = kTombstone;
+    h->hdr->n_objects--;
+    h->hdr->n_evictions++;
+    evicted_any = 1;
+    // Enough contiguous room now?
+    uint64_t off = arena_alloc(h, need);
+    if (off) {
+      arena_free(h, off);
+      return 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + initialize a store segment. Fails if it already exists unless
+// unlink_existing. Returns handle or null.
+void* rtpu_store_create(const char* name, uint64_t segment_size,
+                        uint64_t n_slots, int unlink_existing, int populate) {
+  if (unlink_existing) shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)segment_size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  // Optional MAP_POPULATE prefaults the segment at creation so first-touch
+  // page faults never throttle the put path (cold: ~0.05 GB/s, prefaulted:
+  // memcpy-bound ~4 GB/s) — but costs seconds/GB up front, so the Python
+  // side defaults to a background prefault thread instead.
+  int flags = MAP_SHARED | (populate ? MAP_POPULATE : 0);
+  void* base =
+      mmap(nullptr, segment_size, PROT_READ | PROT_WRITE, flags, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+
+  auto* hdr = reinterpret_cast<StoreHeader*>(base);
+  memset(hdr, 0, sizeof(StoreHeader));
+  hdr->segment_size = segment_size;
+  hdr->n_slots = n_slots;
+  hdr->slot_table_off = align64(sizeof(StoreHeader));
+  uint64_t table_bytes = align64(n_slots * sizeof(Slot));
+  hdr->arena_off = hdr->slot_table_off + table_bytes;
+  hdr->arena_size = segment_size - hdr->arena_off;
+  memset(reinterpret_cast<uint8_t*>(base) + hdr->slot_table_off, 0,
+         table_bytes);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&hdr->seal_cond, &ca);
+
+  auto* h = new Handle{reinterpret_cast<uint8_t*>(base), segment_size, hdr};
+  // One giant free block spanning the arena.
+  uint64_t first = hdr->arena_off + kBlockHdr;
+  BlockHeader* b = block_at(h, first);
+  b->size = hdr->arena_size - kBlockHdr;
+  b->prev_size = 0;
+  b->free_ = 0;
+  b->next_free = b->prev_free = 0;
+  freelist_push(h, first);
+  hdr->magic = kMagic;  // last: marks init complete for openers
+  return h;
+}
+
+void* rtpu_store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto* hdr = reinterpret_cast<StoreHeader*>(base);
+  if (hdr->magic != kMagic) {
+    munmap(base, st.st_size);
+    return nullptr;
+  }
+  return new Handle{reinterpret_cast<uint8_t*>(base), (uint64_t)st.st_size,
+                    hdr};
+}
+
+void rtpu_store_close(void* hp) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  munmap(h->base, h->size);
+  delete h;
+}
+
+void rtpu_store_unlink(const char* name) { shm_unlink(name); }
+
+uint8_t* rtpu_store_base(void* hp) {
+  return reinterpret_cast<Handle*>(hp)->base;
+}
+
+// Reserve space for an object. Returns payload offset, or 0 on:
+//   errno_out = 1 (already exists), 2 (out of memory even after eviction),
+//               3 (slot table full).
+uint64_t rtpu_obj_create(void* hp, const uint8_t* key, uint64_t data_size,
+                         int* errno_out) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  *errno_out = 0;
+  if (find_slot(h, key)) {
+    *errno_out = 1;
+    return 0;
+  }
+  uint64_t off = arena_alloc(h, data_size);
+  if (!off) {
+    evict_for(h, align64(data_size ? data_size : 1));
+    off = arena_alloc(h, data_size);
+    if (!off) {
+      *errno_out = 2;
+      return 0;
+    }
+  }
+  Slot* s = find_insert_slot(h, key);
+  if (!s) {
+    arena_free(h, off);
+    *errno_out = 3;
+    return 0;
+  }
+  memcpy(s->key, key, kKeySize);
+  s->refcount = 0;
+  s->offset = off;
+  s->data_size = data_size;
+  s->lru_tick = ++h->hdr->lru_clock;
+  s->state = kCreated;  // last: slot visible only when fully written
+  h->hdr->n_objects++;
+  return off;
+}
+
+int rtpu_obj_seal(void* hp, const uint8_t* key) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  Slot* s = find_slot(h, key);
+  if (!s || s->state != kCreated) return -1;
+  s->state = kSealed;
+  pthread_cond_broadcast(&h->hdr->seal_cond);
+  return 0;
+}
+
+// Blocking get: waits up to timeout_ms (-1 = forever, 0 = nonblocking) for
+// the object to be sealed. On success pins (refcount++) and fills
+// offset/size. Returns 0 ok, -1 timeout/missing.
+int rtpu_obj_get(void* hp, const uint8_t* key, int64_t timeout_ms,
+                 uint64_t* offset, uint64_t* size) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec++;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  for (;;) {
+    Slot* s = find_slot(h, key);
+    if (s && s->state == kSealed) {
+      s->refcount++;
+      s->lru_tick = ++h->hdr->lru_clock;
+      *offset = s->offset;
+      *size = s->data_size;
+      return 0;
+    }
+    if (timeout_ms == 0) return -1;
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&h->hdr->seal_cond, &h->hdr->mutex);
+    } else {
+      rc = pthread_cond_timedwait(&h->hdr->seal_cond, &h->hdr->mutex,
+                                  &deadline);
+    }
+    if (rc == ETIMEDOUT) return -1;
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->hdr->mutex);
+  }
+}
+
+int rtpu_obj_release(void* hp, const uint8_t* key) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  Slot* s = find_slot(h, key);
+  if (!s || s->refcount <= 0) return -1;
+  s->refcount--;
+  return 0;
+}
+
+// Delete: free immediately if unpinned; pinned objects are freed on the
+// last release... by design we simply refuse (caller retries/abandons —
+// the distributed refcounter only deletes when it believes refs are gone).
+int rtpu_obj_delete(void* hp, const uint8_t* key) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  Slot* s = find_slot(h, key);
+  if (!s) return -1;
+  if (s->refcount > 0) return -2;
+  arena_free(h, s->offset);
+  s->state = kTombstone;
+  h->hdr->n_objects--;
+  return 0;
+}
+
+int rtpu_obj_contains(void* hp, const uint8_t* key) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  Slot* s = find_slot(h, key);
+  return (s && s->state == kSealed) ? 1 : 0;
+}
+
+// Abort an in-progress create (creator failed before seal).
+int rtpu_obj_abort(void* hp, const uint8_t* key) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  Slot* s = find_slot(h, key);
+  if (!s || s->state != kCreated) return -1;
+  arena_free(h, s->offset);
+  s->state = kTombstone;
+  h->hdr->n_objects--;
+  return 0;
+}
+
+uint64_t rtpu_store_size(void* hp) {
+  return reinterpret_cast<Handle*>(hp)->size;
+}
+
+// Fault the whole segment in without touching contents (safe concurrently
+// with writers — pages are populated, not modified). Called from a
+// background thread by the creator so puts never pay first-touch faults.
+int rtpu_store_prefault(void* hp) {
+#ifdef MADV_POPULATE_WRITE
+  auto* h = reinterpret_cast<Handle*>(hp);
+  return madvise(h->base, h->size, MADV_POPULATE_WRITE);
+#else
+  return -1;
+#endif
+}
+
+void rtpu_store_stats(void* hp, uint64_t* used, uint64_t* capacity,
+                      uint64_t* n_objects, uint64_t* n_evictions) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  *used = h->hdr->used_bytes;
+  *capacity = h->hdr->arena_size;
+  *n_objects = h->hdr->n_objects;
+  *n_evictions = h->hdr->n_evictions;
+}
+
+}  // extern "C"

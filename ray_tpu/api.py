@@ -61,26 +61,15 @@ def init(
 
             rt = LocalRuntime(num_cpus=num_cpus)
         else:
-            try:
-                from ray_tpu.core.cluster_runtime import ClusterRuntime
-            except ImportError:
-                # Cluster runtime not built yet: degrade to the in-process
-                # runtime so single-node workflows keep working.
-                import warnings
+            from ray_tpu.core.cluster_runtime import ClusterRuntime
 
-                warnings.warn("cluster runtime unavailable; using local mode")
-                from ray_tpu.core.local_runtime import LocalRuntime
-
-                rt = LocalRuntime(num_cpus=num_cpus)
-                runtime_context.set_runtime(rt)
-                return rt
-            rt = ClusterRuntime.create(
+            rt = ClusterRuntime(
                 address=address,
                 num_cpus=num_cpus,
                 num_tpus=num_tpus,
                 resources=resources,
+                object_store_memory=object_store_memory,
                 labels=labels,
-                namespace=namespace,
             )
         runtime_context.set_runtime(rt)
         return rt

@@ -1,0 +1,651 @@
+"""Cluster head: control plane (GCS-lite).
+
+Parity target: the reference's GCS server (reference:
+src/ray/gcs/gcs_server/gcs_server.h with GcsNodeManager :45-ish,
+GcsActorManager gcs_actor_manager.h:324, GcsPlacementGroupManager
+gcs_placement_group_manager.h:228, GcsKvManager, GcsHealthCheckManager,
+pubsub), re-designed as one threaded RPC service over the framed protocol:
+
+- node registry + resource views (heartbeat-refreshed) + health checks
+- cluster-level scheduling: hybrid pack/spread node picking with spillback
+  (the node manager can still reject; callers re-pick with an exclude list)
+- actor directory + lifecycle state machine (PENDING -> ALIVE -> RESTARTING
+  -> DEAD) with head-driven creation so restarts replay the creation spec,
+  mirroring GcsActorManager's ownership of the actor state machine
+- placement groups: bundle reservation against node resource views
+  (STRICT_PACK / PACK / SPREAD / STRICT_SPREAD)
+- internal KV + pubsub channels (ACTOR, NODE, LOG) over server->client push
+
+TPU awareness: node resources carry "TPU" + slice labels; the scheduler
+treats TPU-resource requests as slice-exclusive (one lease per host) per
+`tpu_slice_exclusive`, the analog of TPU_VISIBLE_CHIPS isolation in the
+reference (python/ray/_private/accelerators/tpu.py:154).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
+
+class _TransientReservationFailure(Exception):
+    """A node rejected a bundle after local re-check; retry placement."""
+
+
+# Actor states (reference: src/ray/design_docs/actor_states.rst)
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, address: str, resources: Dict[str, float],
+                 labels: Dict[str, str], store_name: str):
+        self.node_id = node_id
+        self.address = address
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels)
+        self.store_name = store_name
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+
+    def view(self) -> Dict[str, Any]:
+        return {"node_id": self.node_id, "address": self.address,
+                "alive": self.alive, "resources": dict(self.total),
+                "available": dict(self.available), "labels": dict(self.labels),
+                "store_name": self.store_name}
+
+
+class ActorInfo:
+    def __init__(self, actor_id: bytes, name: Optional[str], namespace: str,
+                 spec_blob: bytes, max_restarts: int, resources: Dict[str, float]):
+        self.actor_id = actor_id
+        self.name = name
+        self.namespace = namespace
+        self.spec_blob = spec_blob  # serialized (cls, args, kwargs, opts)
+        self.max_restarts = max_restarts
+        self.restart_count = 0
+        self.resources = resources
+        self.state = PENDING
+        self.worker_addr: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.death_reason = ""
+        self.cond = threading.Condition()
+
+
+class HeadServer:
+    """All control-plane state + RPC handlers. One instance per cluster."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._actors: Dict[bytes, ActorInfo] = {}
+        self._named: Dict[Tuple[str, str], bytes] = {}
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._object_dir: Dict[bytes, Set[str]] = {}
+        self._pgs: Dict[bytes, Dict[str, Any]] = {}
+        self._subscribers: Dict[str, List[Any]] = {}  # channel -> [conn]
+        self._job_counter = 1
+        self._spread_rr = 0
+        self._pool = ClientPool()
+        self._server = RpcServer(self, host, port).start()
+        self.address = self._server.address
+        self._stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="head-health")
+        self._health_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._server.stop()
+        self._pool.close_all()
+
+    # ------------------------------------------------------------- publish
+
+    def _publish(self, channel: str, payload: Any) -> None:
+        with self._lock:
+            subs = list(self._subscribers.get(channel, ()))
+        for conn in subs:
+            try:
+                conn.notify("pubsub", channel, payload)
+            except Exception:
+                pass
+
+    def rpc_subscribe(self, conn, channel: str):
+        with self._lock:
+            self._subscribers.setdefault(channel, []).append(conn)
+        return True
+
+    def on_peer_disconnect(self, conn) -> None:
+        with self._lock:
+            for subs in self._subscribers.values():
+                if conn in subs:
+                    subs.remove(conn)
+
+    # ------------------------------------------------------------- nodes
+
+    def rpc_register_node(self, conn, node_id: str, address: str,
+                          resources: Dict[str, float], labels: Dict[str, str],
+                          store_name: str):
+        with self._lock:
+            self._nodes[node_id] = NodeInfo(node_id, address, resources,
+                                            labels, store_name)
+        self._publish("NODE", {"event": "added", "node_id": node_id})
+        return True
+
+    def rpc_heartbeat(self, conn, node_id: str, available: Dict[str, float]):
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return False
+            n.last_heartbeat = time.monotonic()
+            n.available = dict(available)
+            if not n.alive:
+                n.alive = True  # node recovered
+        return True
+
+    def rpc_drain_node(self, conn, node_id: str):
+        """Graceful removal (autoscaler downscale)."""
+        with self._lock:
+            n = self._nodes.pop(node_id, None)
+        if n is not None:
+            self._publish("NODE", {"event": "removed", "node_id": node_id})
+        return True
+
+    def rpc_list_nodes(self, conn):
+        with self._lock:
+            return [n.view() for n in self._nodes.values()]
+
+    def rpc_cluster_resources(self, conn):
+        with self._lock:
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0) + v
+            return total, avail
+
+    def _health_loop(self) -> None:
+        period = cfg.health_check_period_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold * period
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            dead_nodes = []
+            with self._lock:
+                for n in self._nodes.values():
+                    if n.alive and now - n.last_heartbeat > threshold:
+                        n.alive = False
+                        dead_nodes.append(n.node_id)
+            for node_id in dead_nodes:
+                self._publish("NODE", {"event": "dead", "node_id": node_id})
+                self._on_node_dead(node_id)
+
+    def _on_node_dead(self, node_id: str) -> None:
+        with self._lock:
+            victims = [a for a in self._actors.values()
+                       if a.node_id == node_id and a.state == ALIVE]
+        for a in victims:
+            self._actor_died(a, f"node {node_id} died", try_restart=True)
+
+    # ------------------------------------------------------------- scheduling
+
+    def _score_nodes(self, resources: Dict[str, float],
+                     exclude: Set[str]) -> List[NodeInfo]:
+        """Hybrid policy (reference: raylet/scheduling/policy/
+        hybrid_scheduling_policy.cc): prefer packing onto already-used
+        feasible nodes until utilization crosses `scheduler_spread_threshold`,
+        then prefer the least-utilized feasible node."""
+        with self._lock:
+            feasible = []
+            for n in self._nodes.values():
+                if not n.alive or n.node_id in exclude:
+                    continue
+                if all(n.available.get(k, 0) >= v
+                       for k, v in resources.items() if v > 0):
+                    feasible.append(n)
+            if not feasible:
+                return []
+
+            def util(n: NodeInfo) -> float:
+                us = [1 - n.available.get(k, 0) / t
+                      for k, t in n.total.items() if t > 0]
+                return max(us) if us else 0.0
+
+            thresh = cfg.scheduler_spread_threshold
+            below = [n for n in feasible if util(n) < thresh]
+            if below:
+                # Pack: highest-utilization node still under threshold.
+                below.sort(key=lambda n: (-util(n), n.node_id))
+                return below
+            feasible.sort(key=lambda n: (util(n), n.node_id))
+            return feasible
+
+    def rpc_pick_node(self, conn, resources: Dict[str, float],
+                      strategy: Optional[Dict[str, Any]] = None,
+                      exclude: Optional[List[str]] = None):
+        """Returns (node_id, address, store_name) or None (infeasible now)."""
+        exclude_set = set(exclude or ())
+        strategy = strategy or {}
+        kind = strategy.get("kind")
+        with self._lock:
+            if kind == "node_affinity":
+                n = self._nodes.get(strategy["node_id"])
+                if n and n.alive:
+                    return n.node_id, n.address, n.store_name
+                if not strategy.get("soft", False):
+                    return None
+            elif kind == "placement_group":
+                pg = self._pgs.get(strategy["pg_id"])
+                if pg is None:
+                    return None
+                idx = strategy.get("bundle_index", -1)
+                nodes = ([pg["bundle_nodes"][idx]] if idx >= 0
+                         else list(dict.fromkeys(pg["bundle_nodes"])))
+                for node_id in nodes:
+                    n = self._nodes.get(node_id)
+                    if n and n.alive and node_id not in exclude_set:
+                        return n.node_id, n.address, n.store_name
+                return None
+            elif kind == "spread":
+                # True round-robin: the head's availability view lags
+                # heartbeats, so utilization-ranking alone would send a
+                # burst of spread tasks to one node.
+                feasible = self._score_nodes(resources, exclude_set)
+                feasible.sort(key=lambda n: n.node_id)
+                if feasible:
+                    n = feasible[self._spread_rr % len(feasible)]
+                    self._spread_rr += 1
+                    return n.node_id, n.address, n.store_name
+                return None
+        ranked = self._score_nodes(resources, exclude_set)
+        if not ranked:
+            return None
+        n = ranked[0]
+        return n.node_id, n.address, n.store_name
+
+    # ------------------------------------------------------------- actors
+
+    @blocking_rpc
+    def rpc_register_actor(self, conn, actor_id: bytes, name: Optional[str],
+                           namespace: str, spec_blob: bytes, max_restarts: int,
+                           resources: Dict[str, float],
+                           get_if_exists: bool = False,
+                           strategy: Optional[Dict[str, Any]] = None):
+        """Register + schedule + create. Returns ("created", None) /
+        ("exists", actor_id) / raises on name conflict or placement failure."""
+        with self._lock:
+            if name is not None:
+                key = (namespace, name)
+                existing = self._named.get(key)
+                if existing is not None:
+                    if get_if_exists:
+                        return "exists", existing
+                    raise ValueError(f"actor name '{name}' already taken")
+                self._named[(namespace, name)] = actor_id
+            info = ActorInfo(actor_id, name, namespace, spec_blob,
+                             max_restarts, resources)
+            info.strategy = strategy
+            self._actors[actor_id] = info
+        try:
+            self._create_actor_on_some_node(info)
+        except BaseException:
+            with self._lock:
+                self._actors.pop(actor_id, None)
+                if name is not None:
+                    self._named.pop((namespace, name), None)
+            raise
+        return "created", None
+
+    def _create_actor_on_some_node(self, info: ActorInfo) -> None:
+        """Head-driven creation (mirrors GcsActorScheduler): lease a worker,
+        push the creation spec, wait for registration."""
+        exclude: Set[str] = set()
+        deadline = time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 3
+        while True:
+            picked = self.rpc_pick_node(None, info.resources,
+                                        getattr(info, "strategy", None),
+                                        list(exclude))
+            if picked is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"no feasible node for actor (resources="
+                        f"{info.resources})")
+                # A denial may be transient (leases lingering): retry the
+                # full node set after a pause rather than excluding forever.
+                exclude.clear()
+                time.sleep(0.05)
+                continue
+            node_id, node_addr, _ = picked
+            node = self._pool.get(node_addr)
+            lease = node.call("request_lease", info.resources, True,
+                              timeout=cfg.lease_timeout_ms / 1000.0)
+            if lease is None:
+                exclude.add(node_id)
+                continue
+            worker_addr, lease_id = lease
+            worker = self._pool.get(worker_addr)
+            try:
+                worker.call("create_actor", info.actor_id, info.spec_blob,
+                            lease_id, timeout=None)
+            except BaseException:
+                node.notify("return_lease", lease_id)
+                raise
+            with self._lock:
+                info.state = ALIVE
+                info.worker_addr = worker_addr
+                info.node_id = node_id
+            with info.cond:
+                info.cond.notify_all()
+            self._publish("ACTOR", {"actor_id": info.actor_id,
+                                    "state": ALIVE,
+                                    "address": worker_addr})
+            return
+
+    @blocking_rpc
+    def rpc_wait_actor_address(self, conn, actor_id: bytes,
+                               timeout: float = 30.0):
+        """Blocks until the actor is ALIVE (returns address) or DEAD
+        (returns ("DEAD", reason))."""
+        info = self._actors.get(actor_id)
+        if info is None:
+            return "DEAD", "unknown actor"
+        deadline = time.monotonic() + timeout
+        with info.cond:
+            while info.state not in (ALIVE, DEAD):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return "PENDING", None
+                info.cond.wait(remaining)
+        if info.state == ALIVE:
+            return "ALIVE", info.worker_addr
+        return "DEAD", info.death_reason
+
+    def rpc_actor_died(self, conn, actor_id: bytes, reason: str):
+        info = self._actors.get(actor_id)
+        if info is not None and info.state != DEAD:
+            self._actor_died(info, reason, try_restart=True)
+        return True
+
+    def _actor_died(self, info: ActorInfo, reason: str,
+                    try_restart: bool) -> None:
+        restart = try_restart and info.restart_count < info.max_restarts
+        with self._lock:
+            info.state = RESTARTING if restart else DEAD
+            info.worker_addr = None
+            info.death_reason = reason
+            if not restart and info.name is not None:
+                self._named.pop((info.namespace, info.name), None)
+        self._publish("ACTOR", {"actor_id": info.actor_id, "state": info.state,
+                                "reason": reason})
+        if restart:
+            info.restart_count += 1
+            threading.Thread(target=self._restart_actor, args=(info,),
+                             daemon=True).start()
+        else:
+            with info.cond:
+                info.cond.notify_all()
+
+    def _restart_actor(self, info: ActorInfo) -> None:
+        try:
+            self._create_actor_on_some_node(info)
+        except BaseException as e:  # noqa: BLE001
+            with self._lock:
+                info.state = DEAD
+                info.death_reason = f"restart failed: {e!r}"
+                if info.name is not None:
+                    self._named.pop((info.namespace, info.name), None)
+            with info.cond:
+                info.cond.notify_all()
+            self._publish("ACTOR", {"actor_id": info.actor_id, "state": DEAD,
+                                    "reason": info.death_reason})
+
+    def rpc_worker_dead_at(self, conn, worker_addr: Optional[str]):
+        """Node manager reports a dead worker process by address: fail (or
+        restart) any actors that lived there."""
+        if not worker_addr:
+            return True
+        with self._lock:
+            victims = [a for a in self._actors.values()
+                       if a.worker_addr == worker_addr and a.state == ALIVE]
+        for a in victims:
+            self._actor_died(a, "worker process died", try_restart=True)
+        return True
+
+    @blocking_rpc
+    def rpc_kill_actor(self, conn, actor_id: bytes, no_restart: bool = True):
+        info = self._actors.get(actor_id)
+        if info is None:
+            return False
+        if no_restart:
+            info.max_restarts = info.restart_count  # disable further restarts
+        addr = info.worker_addr
+        if addr:
+            try:
+                self._pool.get(addr).notify("kill_actor", actor_id)
+            except Exception:
+                pass
+        self._actor_died(info, "killed via ray_tpu.kill", try_restart=not no_restart)
+        return True
+
+    def rpc_get_named_actor(self, conn, name: str, namespace: str):
+        with self._lock:
+            aid = self._named.get((namespace, name))
+            if aid is None:
+                return None
+            info = self._actors[aid]
+            return aid, info.spec_blob
+
+    def rpc_get_actor_info(self, conn, actor_id: bytes):
+        info = self._actors.get(actor_id)
+        if info is None:
+            return None
+        return {"state": info.state, "address": info.worker_addr,
+                "name": info.name, "restarts": info.restart_count,
+                "reason": info.death_reason}
+
+    def rpc_list_actors(self, conn):
+        with self._lock:
+            return [{"actor_id": a.actor_id.hex(), "name": a.name,
+                     "state": a.state, "node_id": a.node_id,
+                     "dead": a.state == DEAD}
+                    for a in self._actors.values()]
+
+    # ------------------------------------------------------------- objects
+
+    def rpc_object_added(self, conn, oid: bytes, node_id: str):
+        with self._lock:
+            self._object_dir.setdefault(oid, set()).add(node_id)
+        return True
+
+    def rpc_object_removed(self, conn, oid: bytes, node_id: str):
+        with self._lock:
+            locs = self._object_dir.get(oid)
+            if locs:
+                locs.discard(node_id)
+                if not locs:
+                    del self._object_dir[oid]
+        return True
+
+    def rpc_object_locations(self, conn, oid: bytes):
+        with self._lock:
+            node_ids = list(self._object_dir.get(oid, ()))
+            return [(nid, self._nodes[nid].address)
+                    for nid in node_ids
+                    if nid in self._nodes and self._nodes[nid].alive]
+
+    # ------------------------------------------------------------- KV
+
+    def rpc_kv_put(self, conn, ns: str, key: bytes, value: bytes,
+                   overwrite: bool = True):
+        with self._lock:
+            k = (ns, key)
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = value
+        return True
+
+    def rpc_kv_get(self, conn, ns: str, key: bytes):
+        with self._lock:
+            return self._kv.get((ns, key))
+
+    def rpc_kv_del(self, conn, ns: str, key: bytes):
+        with self._lock:
+            return self._kv.pop((ns, key), None) is not None
+
+    def rpc_kv_keys(self, conn, ns: str, prefix: bytes = b""):
+        with self._lock:
+            return [k for (n, k) in self._kv if n == ns and k.startswith(prefix)]
+
+    # ------------------------------------------------------------- PGs
+
+    @blocking_rpc
+    def rpc_create_pg(self, conn, pg_id: bytes, bundles: List[Dict[str, float]],
+                      strategy: str, name: str):
+        """Reserve bundle resources on nodes. 2-phase-lite: reservation
+        happens against the head's resource view and is pushed to node
+        managers (prepare+commit in one RPC; they re-check locally)."""
+        deadline = time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 3
+        while True:
+            with self._lock:
+                nodes = [n for n in self._nodes.values() if n.alive]
+                placement = _place_bundles(bundles, strategy, nodes)
+            reserved = []
+            if placement is not None:
+                try:
+                    for idx, (bundle, node) in enumerate(
+                            zip(bundles, placement)):
+                        ok = self._pool.get(node.address).call(
+                            "reserve_bundle", pg_id, idx, bundle,
+                            timeout=10.0)
+                        if not ok:
+                            raise _TransientReservationFailure()
+                        reserved.append((node, idx, bundle))
+                    break  # all bundles reserved
+                except BaseException as e:
+                    for node, idx, bundle in reserved:
+                        try:
+                            self._pool.get(node.address).notify(
+                                "release_bundle", pg_id, idx)
+                        except Exception:
+                            pass
+                    if not isinstance(e, _TransientReservationFailure):
+                        raise
+            # Transiently infeasible (lingering leases show as used in the
+            # heartbeat view, or a node re-checked and rejected): retry.
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"placement group infeasible: {strategy} {bundles}")
+            time.sleep(0.1)
+        with self._lock:
+            self._pgs[pg_id] = {"bundles": bundles, "strategy": strategy,
+                                "name": name,
+                                "bundle_nodes": [n.node_id for n in placement],
+                                "state": "CREATED"}
+        return True
+
+    def rpc_remove_pg(self, conn, pg_id: bytes):
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+        if pg is None:
+            return False
+        for idx, node_id in enumerate(pg["bundle_nodes"]):
+            with self._lock:
+                n = self._nodes.get(node_id)
+            if n is not None:
+                try:
+                    self._pool.get(n.address).notify("release_bundle", pg_id, idx)
+                except Exception:
+                    pass
+        return True
+
+    def rpc_pg_table(self, conn):
+        with self._lock:
+            return {pg_id.hex(): dict(v) for pg_id, v in self._pgs.items()}
+
+    def rpc_pg_ready(self, conn, pg_id: bytes):
+        with self._lock:
+            return pg_id in self._pgs
+
+    # ------------------------------------------------------------- misc
+
+    def rpc_new_job_id(self, conn):
+        with self._lock:
+            self._job_counter += 1
+            return self._job_counter
+
+    def rpc_ping(self, conn):
+        return "pong"
+
+
+def _place_bundles(bundles: List[Dict[str, float]], strategy: str,
+                   nodes: List[NodeInfo]) -> Optional[List[NodeInfo]]:
+    """Bundle placement policies (reference: raylet/scheduling/policy/
+    bundle_scheduling_policy.cc)."""
+    avail = {n.node_id: dict(n.available) for n in nodes}
+    by_id = {n.node_id: n for n in nodes}
+
+    def fits(node_id: str, bundle: Dict[str, float]) -> bool:
+        a = avail[node_id]
+        return all(a.get(k, 0) >= v for k, v in bundle.items() if v > 0)
+
+    def take(node_id: str, bundle: Dict[str, float]) -> None:
+        a = avail[node_id]
+        for k, v in bundle.items():
+            a[k] = a.get(k, 0) - v
+
+    if strategy == "STRICT_PACK":
+        for n in nodes:
+            snapshot = dict(avail[n.node_id])
+            ok = True
+            for b in bundles:
+                if fits(n.node_id, b):
+                    take(n.node_id, b)
+                else:
+                    ok = False
+                    break
+            if ok:
+                return [n] * len(bundles)
+            avail[n.node_id] = snapshot
+        return None
+    if strategy == "STRICT_SPREAD":
+        if len(bundles) > len(nodes):
+            return None
+        placement, used = [], set()
+        for b in bundles:
+            cand = [n for n in nodes
+                    if n.node_id not in used and fits(n.node_id, b)]
+            if not cand:
+                return None
+            cand.sort(key=lambda n: n.node_id)
+            placement.append(cand[0])
+            used.add(cand[0].node_id)
+            take(cand[0].node_id, b)
+        return placement
+    # PACK (soft) / SPREAD (soft): greedy with preference.
+    placement = []
+    for b in bundles:
+        cand = [n for n in nodes if fits(n.node_id, b)]
+        if not cand:
+            return None
+        if strategy == "SPREAD":
+            counts = {n.node_id: 0 for n in nodes}
+            for p in placement:
+                counts[p.node_id] += 1
+            cand.sort(key=lambda n: (counts[n.node_id], n.node_id))
+        else:  # PACK
+            counts = {n.node_id: 0 for n in nodes}
+            for p in placement:
+                counts[p.node_id] += 1
+            cand.sort(key=lambda n: (-counts[n.node_id], n.node_id))
+        placement.append(cand[0])
+        take(cand[0].node_id, b)
+    return placement

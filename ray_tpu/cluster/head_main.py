@@ -1,0 +1,34 @@
+"""Head process entry: `python -m ray_tpu.cluster.head_main --port 0`.
+
+Prints "ADDRESS <host:port>" on stdout once serving (parent parses it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ray_tpu.cluster.head import HeadServer
+
+
+def main() -> None:
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    head = HeadServer(args.host, args.port)
+    print(f"ADDRESS {head.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        head.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Node-manager process entry:
+`python -m ray_tpu.cluster.node_main --head-addr H --resources JSON`.
+
+Prints "ADDRESS <host:port> NODE <node_id> STORE <name>" once serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import uuid
+
+from ray_tpu.cluster.node_manager import NodeManager
+
+
+def main() -> None:
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
+    p = argparse.ArgumentParser()
+    p.add_argument("--head-addr", required=True)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--object-store-bytes", type=int, default=None)
+    args = p.parse_args()
+
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.core.resources import detect_node_resources
+
+    resources = json.loads(args.resources)
+    if not resources:
+        nr = detect_node_resources()
+        resources = nr.total.to_dict()
+        labels = dict(nr.labels)
+    else:
+        labels = {}
+    labels.update(json.loads(args.labels))
+    node_id = args.node_id or uuid.uuid4().hex
+    store_bytes = args.object_store_bytes or cfg.object_store_memory_bytes
+    nm = NodeManager(args.head_addr, node_id, resources, labels, store_bytes)
+    print(f"ADDRESS {nm.address} NODE {node_id} STORE {nm.store_name}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        nm.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,378 @@
+"""Framed RPC substrate for the control plane (DCN traffic).
+
+Parity target: the reference's gRPC layer (reference: src/ray/rpc/
+grpc_server.h, retryable_grpc_client.h, rpc_chaos.h) re-designed small:
+length-prefixed pickled frames over TCP, a threaded server (one reader thread
+per peer — control-plane fan-in is O(workers/node), not O(tasks)), and a
+thread-safe client with request pipelining (many in-flight calls multiplexed
+over one socket, matched by request id).
+
+Frame: u32 len | payload. Payload = Serializer-encoded tuple
+    (req_id, method, args)        request  (req_id > 0)
+    (0, method, args)             one-way notify
+    (-req_id, ok: bool, result)   response
+
+Chaos injection (`rpc_chaos_failure_prob` flag) drops requests/responses to
+exercise retry paths, mirroring RAY_testing_rpc_failure.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.serialization import SERIALIZER
+
+_LEN = struct.Struct("<I")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler raised; .cause carries the remote exception object."""
+
+    def __init__(self, cause):
+        super().__init__(repr(cause))
+        self.cause = cause
+
+
+def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        try:
+            b = sock.recv(min(n, 1 << 20))
+        except OSError:
+            return None
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    return _recv_exact(sock, _LEN.unpack(hdr)[0])
+
+
+def _chaos_drop() -> bool:
+    p = cfg.rpc_chaos_failure_prob
+    return p > 0 and random.random() < p
+
+
+# --------------------------------------------------------------------------
+# Server
+# --------------------------------------------------------------------------
+
+
+class RpcServer:
+    """Threaded frame server. ``handler_obj`` methods named ``rpc_<method>``
+    are callable remotely; each gets (conn, *args) where conn is the
+    per-connection context (usable for push-back / peer identity)."""
+
+    def __init__(self, handler_obj: Any, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.handler_obj = handler_obj
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one thread per peer connection
+                conn = PeerConnection(self.request, outer)
+                try:
+                    outer._on_connect(conn)
+                    while True:
+                        frame = _recv_frame(self.request)
+                        if frame is None:
+                            return
+                        outer._dispatch(conn, frame)
+                finally:
+                    outer._on_disconnect(conn)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address = "%s:%d" % self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"rpc-server-{self.address}")
+        self._conn_hooks = []
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+    def _on_connect(self, conn: "PeerConnection") -> None:
+        pass
+
+    def _on_disconnect(self, conn: "PeerConnection") -> None:
+        hook = getattr(self.handler_obj, "on_peer_disconnect", None)
+        if hook is not None:
+            try:
+                hook(conn)
+            except Exception:
+                pass
+
+    def _dispatch(self, conn: "PeerConnection", frame: bytes) -> None:
+        req_id, method, args = SERIALIZER.decode(frame)
+        if _chaos_drop():
+            return  # request lost
+        fn = getattr(self.handler_obj, "rpc_" + method, None)
+
+        def run():
+            try:
+                if fn is None:
+                    raise RpcError(f"no such rpc method: {method}")
+                result = fn(conn, *args)
+                ok = True
+            except BaseException as e:  # noqa: BLE001
+                result, ok = e, False
+            if req_id > 0 and not _chaos_drop():
+                try:
+                    conn.send_raw(SERIALIZER.encode((-req_id, ok, result)))
+                except Exception:
+                    pass
+
+        # Fast handlers run inline; blocking ones (marked) get a thread so
+        # one slow call can't head-of-line-block the peer's other requests.
+        if getattr(fn, "_rpc_blocking", False):
+            threading.Thread(target=run, daemon=True,
+                             name=f"rpc-{method}").start()
+        else:
+            run()
+
+
+def blocking_rpc(fn: Callable) -> Callable:
+    """Mark an rpc_ handler as potentially blocking (gets its own thread)."""
+    fn._rpc_blocking = True
+    return fn
+
+
+class PeerConnection:
+    """Server-side view of one connected peer."""
+
+    def __init__(self, sock: socket.socket, server: RpcServer):
+        self.sock = sock
+        self.server = server
+        self.send_lock = threading.Lock()
+        self.peer_info: Dict[str, Any] = {}  # set by register handlers
+
+    def send_raw(self, payload: bytes) -> None:
+        _send_frame(self.sock, payload, self.send_lock)
+
+    def notify(self, method: str, *args) -> None:
+        """Server->client push (client must run a ClientListener)."""
+        self.send_raw(SERIALIZER.encode((0, method, args)))
+
+
+# --------------------------------------------------------------------------
+# Client
+# --------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Thread-safe client: many in-flight requests over one socket.
+
+    ``on_push`` (optional) handles server->client notify frames
+    (method, args). Reconnects are NOT transparent: callers use
+    `retrying_call` for idempotent methods.
+    """
+
+    def __init__(self, address: str, on_push: Optional[Callable] = None,
+                 connect_timeout: Optional[float] = None,
+                 on_close: Optional[Callable] = None):
+        host, port = address.rsplit(":", 1)
+        self.address = address
+        self._on_close = on_close
+        self._sock = socket.create_connection(
+            (host, int(port)),
+            timeout=connect_timeout or cfg.rpc_connect_timeout_s)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._req_counter = itertools.count(1)
+        self._pending: Dict[int, "_Waiter"] = {}
+        self._pending_lock = threading.Lock()
+        self._on_push = on_push
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{address}")
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            frame = _recv_frame(self._sock)
+            if frame is None:
+                break
+            rid, a, b = SERIALIZER.decode(frame)
+            if rid == 0:
+                if self._on_push is not None:
+                    try:
+                        self._on_push(a, b)
+                    except Exception:
+                        pass
+                continue
+            with self._pending_lock:
+                waiter = self._pending.pop(-rid, None)
+            if waiter is not None:
+                waiter.set(a, b)
+        # Connection died: fail all waiters.
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for w in pending.values():
+            w.fail(ConnectionLost(self.address))
+        if self._on_close is not None and not self._closed:
+            try:
+                self._on_close(self)
+            except Exception:
+                pass
+
+    def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
+        rid = next(self._req_counter)
+        waiter = _Waiter()
+        with self._pending_lock:
+            if self._closed:
+                raise ConnectionLost(self.address)
+            self._pending[rid] = waiter
+        try:
+            _send_frame(self._sock, SERIALIZER.encode((rid, method, args)),
+                        self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise ConnectionLost(f"{self.address}: {e}") from e
+        return waiter.wait(timeout)
+
+    def notify(self, method: str, *args) -> None:
+        _send_frame(self._sock, SERIALIZER.encode((0, method, args)),
+                    self._send_lock)
+
+    def retrying_call(self, method: str, *args,
+                      timeout: Optional[float] = None) -> Any:
+        """For idempotent methods: retry on timeouts/connection loss (chaos
+        tolerance). Reconnects the socket between attempts."""
+        attempts = cfg.rpc_retry_max_attempts
+        delay = cfg.rpc_retry_delay_ms / 1000.0
+        per_try = timeout if timeout is not None else 5.0
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                return self.call(method, *args, timeout=per_try)
+            except (TimeoutError, ConnectionLost) as e:
+                last = e
+                if isinstance(e, ConnectionLost):
+                    try:
+                        self.reconnect()
+                    except OSError:
+                        pass
+                time.sleep(delay * (2 ** i))
+        raise last  # type: ignore[misc]
+
+    def reconnect(self) -> None:
+        host, port = self.address.rsplit(":", 1)
+        old = self._sock
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=cfg.rpc_connect_timeout_s)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            old.close()
+        except OSError:
+            pass
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{self.address}")
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _Waiter:
+    __slots__ = ("_event", "_ok", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._ok = None
+        self._result = None
+        self._exc = None
+
+    def set(self, ok: bool, result: Any) -> None:
+        self._ok, self._result = ok, result
+        self._event.set()
+
+    def fail(self, exc: Exception) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc call timed out")
+        if self._exc is not None:
+            raise self._exc
+        if not self._ok:
+            if isinstance(self._result, BaseException):
+                raise self._result
+            raise RemoteError(self._result)
+        return self._result
+
+
+class ClientPool:
+    """Caches one RpcClient per address (process-wide)."""
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str, on_push: Optional[Callable] = None,
+            on_close: Optional[Callable] = None) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(address)
+            if c is None or c._closed:
+                c = RpcClient(address, on_push=on_push, on_close=on_close)
+                self._clients[address] = c
+            return c
+
+    def invalidate(self, address: str) -> None:
+        with self._lock:
+            c = self._clients.pop(address, None)
+        if c is not None:
+            c.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()

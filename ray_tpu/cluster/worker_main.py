@@ -1,0 +1,327 @@
+"""Worker process entry point + task/actor execution engine.
+
+Parity target: the reference's default worker + task receiver (reference:
+python/ray/_private/workers/default_worker.py, core worker TaskReceiver
+src/ray/core_worker/transport/task_receiver.cc:36, ActorSchedulingQueue, and
+execute_task in python/ray/_raylet.pyx:1716): connects to its node manager +
+head, embeds a full ClusterCore (so nested ray_tpu.get/put/remote inside
+tasks go through the cluster), and executes pushed tasks/actor methods.
+
+Execution semantics match the single-process runtime: normal tasks run on a
+small pool; each hosted actor gets ordered execution with max_concurrency
+threads (async actors get an asyncio loop); results go back to the OWNER via
+task_done pushes — small values inline, big ones sealed into the node's shm
+store with a location stub.
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import runtime_context
+from ray_tpu.core.cluster_core import ClusterCore
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.serialization import SERIALIZER, capture_exception
+from ray_tpu.cluster.protocol import ClientPool
+from ray_tpu.exceptions import ActorDiedError, RayTpuError, TaskError
+
+
+class _HostedActor:
+    def __init__(self, actor_id: ActorID, instance: Any, max_concurrency: int,
+                 is_async: bool):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.is_async = is_async
+        self.lock = threading.Lock()
+        self.pool = ThreadPoolExecutor(
+            max_workers=max_concurrency,
+            thread_name_prefix=f"actor-{actor_id.hex()[:8]}")
+        self.loop = None
+        self.next_seq = 0
+        self.seq_cond = threading.Condition()
+        self.dead = False
+
+
+class WorkerRuntime(ClusterCore):
+    """ClusterCore + execution-side RPC handlers."""
+
+    def __init__(self, head_addr: str, node_addr: str, node_id: str,
+                 store_name: str, worker_id_hex: str):
+        super().__init__(head_addr, node_addr, node_id, store_name,
+                         JobID.from_int(1), is_driver=False)
+        self._exec_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="task-exec")
+        self._hosted: Dict[ActorID, _HostedActor] = {}
+        self._hosted_lock = threading.Lock()
+        self._owner_pool = ClientPool()
+        # The runtime must be installed BEFORE registration: a lease can
+        # arrive (and a task execute) the instant the node manager sees us.
+        runtime_context.set_runtime(self)
+        self.node.call("register_worker", worker_id_hex, self.owner_addr,
+                       timeout=10)
+
+    # ---------------------------------------------------------------- tasks
+
+    def rpc_push_task(self, conn, spec_blob: bytes):
+        self._exec_pool.submit(self._execute_task, spec_blob)
+        return True
+
+    def _execute_task(self, spec_blob: bytes) -> None:
+        spec = SERIALIZER.decode(spec_blob)
+        task_id = TaskID(spec["task_id"])
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        owner = spec["owner_addr"]
+        attempt = 0
+        while True:
+            try:
+                args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+            except TaskError as te:
+                self._send_results(owner, task_id, return_ids,
+                                   error=te)
+                return
+            except BaseException as e:  # noqa: BLE001
+                self._send_results(owner, task_id, return_ids,
+                                   error=capture_exception(e))
+                return
+            prev = runtime_context.set_worker_context({
+                "task_id": task_id, "actor_id": None,
+                "resources": spec.get("resources", {})})
+            try:
+                result = spec["func"](*args, **kwargs)
+                self._send_results(owner, task_id, return_ids, value=result)
+                return
+            except TaskError as te:
+                self._send_results(owner, task_id, return_ids, error=te)
+                return
+            except BaseException as e:  # noqa: BLE001
+                attempt += 1
+                if spec.get("retry_exceptions") and attempt <= spec.get(
+                        "max_retries", 0):
+                    time.sleep(cfg.task_retry_delay_ms / 1000.0)
+                    continue
+                self._send_results(owner, task_id, return_ids,
+                                   error=capture_exception(e))
+                return
+            finally:
+                runtime_context.set_worker_context(prev)
+
+    def _resolve_args(self, args, kwargs):
+        def res(a):
+            if isinstance(a, ObjectRef):
+                return self.get(a)
+            return a
+
+        return [res(a) for a in args], {k: res(v) for k, v in kwargs.items()}
+
+    def _send_results(self, owner: str, task_id: TaskID,
+                      return_ids: List[ObjectID], value: Any = None,
+                      error: Optional[Exception] = None,
+                      actor_ctx: Optional[Tuple[bytes, int]] = None) -> None:
+        results: List[Tuple[bytes, str, Any]] = []
+        if error is not None:
+            for oid in return_ids:
+                results.append((oid.binary(), "error", error))
+        else:
+            n = len(return_ids)
+            vals: List[Any]
+            if n == 0:
+                vals = []
+            elif n == 1:
+                vals = [value]
+            else:
+                vals = (list(value) if isinstance(value, (tuple, list))
+                        else [value])
+                if len(vals) != n:
+                    err = capture_exception(ValueError(
+                        f"task declared {n} returns, produced {len(vals)}"))
+                    return self._send_results(owner, task_id, return_ids,
+                                              error=err, actor_ctx=actor_ctx)
+            for oid, v in zip(return_ids, vals):
+                header, buffers = SERIALIZER.serialize(v)
+                total = SERIALIZER.encode_total_size(header, buffers)
+                if total <= cfg.object_store_inline_max_bytes:
+                    flat = bytearray(total)
+                    SERIALIZER.encode_into(memoryview(flat), header, buffers)
+                    results.append((oid.binary(), "value", bytes(flat)))
+                else:
+                    self._put_plasma(oid, header, buffers)
+                    results.append((oid.binary(), "in_store", None))
+        try:
+            client = self._owner_pool.get(owner)
+            if actor_ctx is not None:
+                actor_id_bytes, seq = actor_ctx
+                client.notify("actor_call_done", actor_id_bytes, seq,
+                              task_id.binary(), results)
+            else:
+                client.notify("task_done", task_id.binary(), results)
+        except Exception:
+            # Owner gone: results are orphaned; large ones stay in the store
+            # until the owner's death GC reclaims them (best effort round 1).
+            pass
+
+    # ---------------------------------------------------------------- actors
+
+    from ray_tpu.cluster.protocol import blocking_rpc as _brpc
+
+    @_brpc
+    def rpc_create_actor(self, conn, actor_id_bytes: bytes, spec_blob: bytes,
+                         lease_id: str):
+        """Synchronous creation (head waits): instantiate + take over."""
+        spec = SERIALIZER.decode(spec_blob)
+        actor_id = ActorID(actor_id_bytes)
+        cls = spec["cls"]
+        is_async = any(inspect.iscoroutinefunction(m)
+                       for _, m in inspect.getmembers(
+                           cls, inspect.isfunction))
+        max_conc = spec["max_concurrency"]
+        if is_async and max_conc == 1:
+            max_conc = 1000
+        args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+        prev = runtime_context.set_worker_context({
+            "task_id": TaskID.for_task(actor_id), "actor_id": actor_id,
+            "resources": {}})
+        try:
+            instance = cls(*args, **kwargs)
+        finally:
+            runtime_context.set_worker_context(prev)
+        hosted = _HostedActor(actor_id, instance, max_conc, is_async)
+        if is_async:
+            self._start_actor_loop(hosted)
+        with self._hosted_lock:
+            self._hosted[actor_id] = hosted
+        self.node.notify("mark_actor_host", lease_id)
+        return True
+
+    def _start_actor_loop(self, hosted: _HostedActor) -> None:
+        import asyncio
+
+        ready = threading.Event()
+
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            hosted.loop = loop
+            asyncio.set_event_loop(loop)
+            ready.set()
+            loop.run_forever()
+
+        threading.Thread(target=run_loop, daemon=True,
+                         name=f"actor-loop-{hosted.actor_id.hex()[:8]}").start()
+        ready.wait()
+
+    def rpc_push_actor_task(self, conn, blob: bytes, seq: int):
+        spec = SERIALIZER.decode(blob)
+        actor_id = ActorID(spec["actor_id"])
+        with self._hosted_lock:
+            hosted = self._hosted.get(actor_id)
+        task_id = TaskID(spec["task_id"])
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        owner = spec["owner_addr"]
+        if hosted is None or hosted.dead:
+            self._send_results(owner, task_id, return_ids,
+                               error=ActorDiedError(actor_id, "actor not "
+                                                    "hosted here"),
+                               actor_ctx=(spec["actor_id"], seq))
+            return True
+        hosted.pool.submit(self._execute_actor_task, hosted, spec, seq)
+        return True
+
+    def _execute_actor_task(self, hosted: _HostedActor, spec: Dict, seq: int) -> None:
+        task_id = TaskID(spec["task_id"])
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        owner = spec["owner_addr"]
+        actor_ctx = (spec["actor_id"], seq)
+        try:
+            args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+            method = getattr(hosted.instance, spec["method"])
+            if inspect.iscoroutinefunction(method):
+                import asyncio
+
+                fut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), hosted.loop)
+
+                def _done(f):
+                    try:
+                        self._send_results(owner, task_id, return_ids,
+                                           value=f.result(),
+                                           actor_ctx=actor_ctx)
+                    except BaseException as e:  # noqa: BLE001
+                        self._send_results(owner, task_id, return_ids,
+                                           error=capture_exception(e),
+                                           actor_ctx=actor_ctx)
+
+                fut.add_done_callback(_done)
+                return
+            prev = runtime_context.set_worker_context({
+                "task_id": task_id, "actor_id": hosted.actor_id,
+                "resources": {}})
+            try:
+                if hosted.max_concurrency == 1:
+                    with hosted.lock:
+                        result = method(*args, **kwargs)
+                else:
+                    result = method(*args, **kwargs)
+            finally:
+                runtime_context.set_worker_context(prev)
+            self._send_results(owner, task_id, return_ids, value=result,
+                               actor_ctx=actor_ctx)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, RayTpuError) else capture_exception(e)
+            self._send_results(owner, task_id, return_ids, error=err,
+                               actor_ctx=actor_ctx)
+
+    def rpc_kill_actor(self, conn, actor_id_bytes: bytes):
+        actor_id = ActorID(actor_id_bytes)
+        with self._hosted_lock:
+            hosted = self._hosted.pop(actor_id, None)
+        if hosted is not None:
+            hosted.dead = True
+            hosted.pool.shutdown(wait=False, cancel_futures=True)
+            if hosted.loop is not None:
+                hosted.loop.call_soon_threadsafe(hosted.loop.stop)
+        # The worker process hosting an actor exits on kill (the lease dies
+        # with it; the node manager reaps and reports).
+        if hosted is not None:
+            threading.Thread(target=self._exit_soon, daemon=True).start()
+        return True
+
+    def _exit_soon(self) -> None:
+        time.sleep(0.1)
+        import os
+
+        os._exit(0)
+
+
+def main() -> None:
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
+    p = argparse.ArgumentParser()
+    p.add_argument("--node-addr", required=True)
+    p.add_argument("--head-addr", required=True)
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--store-name", required=True)
+    p.add_argument("--worker-id", required=True)
+    args = p.parse_args()
+
+    WorkerRuntime(args.head_addr, args.node_addr, args.node_id,
+                  args.store_name, args.worker_id)  # installs itself
+    try:
+        while True:  # serve until parent kills us
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,938 @@
+"""Shared cluster-mode runtime core: embedded in the driver AND every worker.
+
+Parity target: the reference's CoreWorker (reference:
+src/ray/core_worker/core_worker.h:166 — SubmitTask :853, CreateActor :878,
+SubmitActorTask :935, Put :466, Get :642, Wait :682 — plus
+transport/normal_task_submitter.h:74 lease-based submission with lease reuse,
+transport/actor_task_submitter.h:75 ordered per-actor queues, and the
+ownership model of reference_count.h). Re-designed over the framed RPC plane:
+
+- every process runs an RPC server: it is the OWNER endpoint for objects it
+  creates (serves gets, receives task_done pushes) and, for workers, the
+  task-execution endpoint
+- normal tasks: head picks a node (hybrid policy + spillback), the node
+  leases a worker, the task is pushed DIRECTLY to the worker; leases are
+  cached per scheduling key and reused while tasks are in flight (the
+  OnWorkerIdle pattern), released after an idle linger
+- small results ride the task_done push (owner memory store); large results
+  are sealed into the executing node's shm store and pulled on demand
+- actor calls go direct to the actor's worker with sequence numbers; on
+  connection loss the submitter consults the head: RESTARTING -> wait and
+  resubmit pending calls to the new address, DEAD -> fail with
+  ActorDiedError
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import runtime_context
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID,
+                              PlacementGroupID, TaskID, WorkerID)
+from ray_tpu.core.memory_store import MemoryStore, PlasmaStub
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.core.serialization import SERIALIZER, capture_exception
+from ray_tpu.core.shm_store import ShmObjectExistsError, ShmStore
+from ray_tpu.core.task_spec import PlacementGroupSpec
+from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
+                                      RpcServer, blocking_rpc)
+from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
+                                WorkerCrashedError)
+
+_LEASE_LINGER_S = 1.0
+
+
+class _Lease:
+    __slots__ = ("worker_addr", "lease_id", "node_addr", "inflight",
+                 "release_at", "broken")
+
+    def __init__(self, worker_addr: str, lease_id: str, node_addr: str):
+        self.worker_addr = worker_addr
+        self.lease_id = lease_id
+        self.node_addr = node_addr
+        self.inflight = 0
+        self.release_at = 0.0
+        self.broken = False
+
+
+class _InflightTask:
+    __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
+                 "sched_key", "resources", "strategy", "name", "sys_retries")
+
+    def __init__(self, spec_blob, return_ids, worker_addr, retries_left,
+                 sched_key, resources, strategy, name):
+        self.spec_blob = spec_blob
+        self.return_ids = return_ids
+        self.worker_addr = worker_addr
+        self.retries_left = retries_left
+        self.sched_key = sched_key
+        self.resources = resources
+        self.strategy = strategy
+        self.name = name
+        self.sys_retries = None  # lazily set from config on first failure
+
+
+class _KeyQueue:
+    """Per-scheduling-key submission state: pending tasks + leased workers."""
+
+    __slots__ = ("key", "queue", "leases", "dispatcher_running",
+                 "pending_lease_requests", "wake", "lease_fail_deadline")
+
+    def __init__(self, key: tuple):
+        import collections
+
+        self.key = key
+        self.queue = collections.deque()
+        self.leases: List[_Lease] = []
+        self.dispatcher_running = False
+        self.pending_lease_requests = 0
+        self.wake = threading.Event()
+        self.lease_fail_deadline = None
+
+
+class _ActorConn:
+    """Submitter-side state for one remote actor."""
+
+    __slots__ = ("actor_id", "address", "seq", "pending", "lock", "dead",
+                 "death_reason")
+
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.address: Optional[str] = None
+        self.seq = itertools.count()
+        self.pending: Dict[int, tuple] = {}  # seq -> (method, blob, return_ids)
+        self.lock = threading.Lock()
+        self.dead = False
+        self.death_reason = ""
+
+
+class ClusterCore:
+    """Runtime-interface implementation for cluster mode."""
+
+    is_cluster = True
+
+    def __init__(self, head_addr: str, node_addr: str, node_id: str,
+                 store_name: str, job_id: JobID, is_driver: bool = True):
+        self.job_id = job_id
+        self.node_id = node_id
+        self.worker_id = WorkerID.from_random()
+        self.is_driver = is_driver
+        self.head_addr = head_addr
+        self.node_addr = node_addr
+
+        self.memory_store = MemoryStore()
+        self.refcount = ReferenceCounter(on_release=self._release_object)
+        self.store = ShmStore.open(store_name)
+        self._driver_task_id = TaskID.for_driver(job_id)
+        self._put_counter = itertools.count(1)
+
+        self._pool = ClientPool()
+        self.head = RpcClient(head_addr)
+        self.node = RpcClient(node_addr)
+        self._server = RpcServer(self).start()
+        self.owner_addr = self._server.address
+
+        self._key_queues: Dict[tuple, _KeyQueue] = {}
+        self._lease_lock = threading.Lock()
+        self._inflight: Dict[bytes, _InflightTask] = {}  # task_id -> info
+        self._inflight_lock = threading.Lock()
+        self._actors: Dict[ActorID, _ActorConn] = {}
+        self._actors_lock = threading.Lock()
+        self._actor_classes: Dict[ActorID, Any] = {}
+        self._pgs: Dict[PlacementGroupID, PlacementGroupSpec] = {}
+        self._cancelled: set = set()
+        self._shutdown_flag = False
+        self._lease_reaper = threading.Thread(
+            target=self._lease_reaper_loop, daemon=True, name="lease-reaper")
+        self._lease_reaper.start()
+
+    # ------------------------------------------------------------------ refs
+
+    def resolve_record(self, rec) -> Any:
+        if rec.is_exception:
+            raise rec.value
+        if rec.in_plasma:
+            return self._read_plasma(rec.value.object_id, timeout=None)
+        return rec.value
+
+    def register_ready_callback(self, oid: ObjectID, cb: Callable) -> None:
+        self.memory_store.get_async(oid, cb)
+
+    def on_ref_deserialized(self, oid: ObjectID, owner_addr: Optional[str]) -> None:
+        # Borrow registration: tell the owner we hold a reference.
+        if owner_addr and owner_addr != self.owner_addr:
+            try:
+                self._pool.get(owner_addr).notify(
+                    "add_borrower", oid.binary(), self.owner_addr)
+            except Exception:
+                pass
+
+    def _release_object(self, oid: ObjectID) -> None:
+        self.memory_store.delete([oid])
+        if self.store.delete(oid):
+            try:
+                self.head.notify("object_removed", oid.binary(), self.node_id)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ put/get
+
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id(), next(self._put_counter))
+        self.refcount.add_owned_object(oid)
+        if isinstance(value, TaskError):
+            self.memory_store.put(oid, value, is_exception=True)
+            return ObjectRef(oid, self.owner_addr)
+        header, buffers = SERIALIZER.serialize(value)
+        total = SERIALIZER.encode_total_size(header, buffers)
+        if total <= cfg.object_store_inline_max_bytes:
+            self.memory_store.put(oid, value)
+        else:
+            self._put_plasma(oid, header, buffers)
+            self.memory_store.put(oid, PlasmaStub(oid))
+        return ObjectRef(oid, self.owner_addr)
+
+    def _put_plasma(self, oid: ObjectID, header: bytes, buffers) -> None:
+        total = SERIALIZER.encode_total_size(header, buffers)
+        try:
+            mv = self.store.create_buffer(oid, total)
+        except ShmObjectExistsError:
+            return
+        try:
+            SERIALIZER.encode_into(mv, header, buffers)
+        except BaseException:
+            self.store.abort(oid)
+            raise
+        self.store.seal(oid)
+        try:
+            self.head.notify("object_added", oid.binary(), self.node_id)
+        except Exception:
+            pass
+
+    def _read_plasma(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        buf = self.store.get(oid, timeout_ms=0)
+        if buf is None:
+            # Not local: ask the node manager to pull it here.
+            t_ms = int((timeout or 600.0) * 1000)
+            ok = self.node.call("pull_object", oid.binary(), t_ms,
+                                timeout=(timeout or 600.0) + 5)
+            if not ok:
+                raise GetTimeoutError(f"object {oid.hex()} unavailable")
+            buf = self.store.get(oid, timeout_ms=t_ms)
+            if buf is None:
+                raise GetTimeoutError(f"object {oid.hex()} unavailable")
+        try:
+            return SERIALIZER.decode(buf.buffer)
+        finally:
+            # NOTE: zero-copy numpy views would dangle after release; decode
+            # copies via pickle buffers unless the consumer opted into
+            # pinned reads (Data library does, holding the pin).
+            buf.release()
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef, got {type(r).__name__}")
+        out = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for r in ref_list:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            out.append(self._get_one(r, remaining))
+        return out[0] if single else out
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        oid = ref.id()
+        owner = ref.owner_address
+        if owner is None or owner == self.owner_addr:
+            recs = self.memory_store.get([oid], timeout)
+            return self.resolve_record(recs[0])
+        # Borrowed ref: if the bytes are already in the local shm store (or
+        # pullable), prefer that; else ask the owner.
+        if self.store.contains(oid):
+            return self._read_plasma(oid, timeout)
+        t = timeout if timeout is not None else 600.0
+        try:
+            kind, payload = self._pool.get(owner).call(
+                "get_object", oid.binary(), t, timeout=t + 5)
+        except ConnectionLost:
+            raise WorkerCrashedError(
+                f"owner of {oid.hex()} died") from None
+        if kind == "value":
+            return SERIALIZER.decode(payload)
+        if kind == "error":
+            raise payload
+        if kind == "in_store":
+            return self._read_plasma(oid, timeout)
+        if kind == "timeout":
+            raise GetTimeoutError(f"timed out waiting for {oid.hex()}")
+        raise RuntimeError(f"unexpected get_object reply {kind}")
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        if len(set(r.id() for r in refs)) != len(refs):
+            raise ValueError("wait() requires unique object refs")
+        local = [r for r in refs
+                 if r.owner_address in (None, self.owner_addr)]
+        remote = [r for r in refs if r not in local]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready_ids = set()
+        while True:
+            ready_ids |= self.memory_store.wait(
+                [r.id() for r in local], num_returns, 0)
+            for r in remote:
+                if r.id() in ready_ids:
+                    continue
+                if self.store.contains(r.id()):
+                    ready_ids.add(r.id())
+                else:
+                    try:
+                        kind, _ = self._pool.get(r.owner_address).call(
+                            "get_object", r.id().binary(), 0, timeout=5)
+                        if kind in ("value", "in_store", "error"):
+                            ready_ids.add(r.id())
+                    except Exception:
+                        pass
+            if len(ready_ids) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id() in ready_ids and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    # -------------------------------------------------------------- owner RPC
+
+    @blocking_rpc
+    def rpc_get_object(self, conn, oid_bytes: bytes, timeout: float):
+        """Serve a get() for an object I own."""
+        oid = ObjectID(oid_bytes)
+        try:
+            recs = self.memory_store.get([oid], timeout if timeout else None)
+        except GetTimeoutError:
+            return "timeout", None
+        rec = recs[0]
+        if rec.is_exception:
+            return "error", rec.value
+        if rec.in_plasma:
+            return "in_store", None
+        return "value", SERIALIZER.encode(rec.value)
+
+    def rpc_add_borrower(self, conn, oid_bytes: bytes, borrower: str):
+        self.refcount.add_borrower(ObjectID(oid_bytes), borrower)
+        return True
+
+    def rpc_remove_borrower(self, conn, oid_bytes: bytes, borrower: str):
+        self.refcount.remove_borrower(ObjectID(oid_bytes), borrower)
+        return True
+
+    def rpc_task_done(self, conn, task_id_bytes: bytes,
+                      results: List[Tuple[bytes, str, Any]]):
+        """Completion push from the executing worker.
+        results: [(oid_bytes, kind, payload)] kind in value|error|in_store."""
+        with self._inflight_lock:
+            info = self._inflight.pop(task_id_bytes, None)
+        for oid_bytes, kind, payload in results:
+            oid = ObjectID(oid_bytes)
+            if kind == "value":
+                self.memory_store.put(oid, SERIALIZER.decode(payload))
+            elif kind == "error":
+                self.memory_store.put(oid, payload, is_exception=True)
+            else:
+                self.memory_store.put(oid, PlasmaStub(oid))
+        if info is not None:
+            self._lease_task_finished(info.sched_key, info.worker_addr)
+        return True
+
+    def rpc_ping(self, conn):
+        return "pong"
+
+    # ------------------------------------------------------------------ tasks
+
+    def current_task_id(self) -> TaskID:
+        ctx = runtime_context.current_worker_context()
+        return ctx.get("task_id") or self._driver_task_id
+
+    def current_actor_id(self) -> Optional[ActorID]:
+        return runtime_context.current_worker_context().get("actor_id")
+
+    def current_resources(self) -> Dict[str, float]:
+        return runtime_context.current_worker_context().get("resources", {})
+
+    def submit_task(self, func: Callable, args: Sequence, kwargs: Dict,
+                    num_returns: int = 1, resources=None, max_retries: int = 0,
+                    retry_exceptions: bool = False, scheduling_strategy=None,
+                    name: str = "", runtime_env=None) -> List[ObjectRef]:
+        resources = _as_resource_dict(resources)
+        resources.setdefault("CPU", 1.0)
+        task_id = TaskID.for_task(ActorID.nil_for_job(self.job_id))
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        for oid in return_ids:
+            self.refcount.add_owned_object(oid)
+        refs = [ObjectRef(oid, self.owner_addr) for oid in return_ids]
+
+        strategy = _strategy_dict(scheduling_strategy)
+        spec_blob = SERIALIZER.encode({
+            "task_id": task_id.binary(),
+            "func": func,
+            "args": tuple(args),
+            "kwargs": dict(kwargs),
+            "return_ids": [o.binary() for o in return_ids],
+            "owner_addr": self.owner_addr,
+            "name": name or getattr(func, "__name__", "task"),
+            "resources": resources,
+            "retry_exceptions": retry_exceptions,
+            "max_retries": max_retries,
+        })
+        sched_key = _sched_key(func, resources, strategy)
+        info = _InflightTask(spec_blob, return_ids, None,
+                             max_retries if retry_exceptions else 0,
+                             sched_key, resources, strategy,
+                             name or getattr(func, "__name__", "task"))
+        self._enqueue_task(task_id.binary(), info)
+        return refs
+
+    # ---- per-scheduling-key dispatch (reference: NormalTaskSubmitter's
+    # per-SchedulingKey worker-lease pools + backlog, lease reuse via
+    # OnWorkerIdle, rate-limited lease requests) ----
+
+    def _enqueue_task(self, task_id_bytes: bytes, info: _InflightTask) -> None:
+        key = info.sched_key
+        with self._lease_lock:
+            kq = self._key_queues.get(key)
+            if kq is None:
+                kq = self._key_queues[key] = _KeyQueue(key)
+            kq.queue.append((task_id_bytes, info))
+            if not kq.dispatcher_running:
+                kq.dispatcher_running = True
+                threading.Thread(target=self._dispatch_loop, args=(kq,),
+                                 daemon=True,
+                                 name=f"dispatch-{key[0][:24]}").start()
+            else:
+                kq.wake.set()
+
+    def _dispatch_loop(self, kq: "_KeyQueue") -> None:
+        """One dispatcher per scheduling key while work exists: drains the
+        queue onto leased workers in bursts (pipelined up to 4/worker).
+        Lease acquisition runs on BACKGROUND threads (bounded by
+        `max_pending_lease_requests_per_scheduling_key`) so slow lease
+        grants / worker spawns never stall the push path."""
+        while True:
+            batch: List[Tuple[tuple, _Lease]] = []
+            with self._lease_lock:
+                while kq.queue:
+                    lease = None
+                    for l in kq.leases:
+                        if not l.broken and l.inflight < 4:
+                            lease = l
+                            break
+                    if lease is None:
+                        break
+                    lease.inflight += 1
+                    batch.append((kq.queue.popleft(), lease))
+                queue_len = len(kq.queue)
+                sample = kq.queue[0][1] if kq.queue else None
+            for (task_id_bytes, info), lease in batch:
+                self._push_to_lease(task_id_bytes, info, lease, kq)
+            if sample is not None:
+                self._maybe_request_leases(kq, sample, queue_len)
+            if not batch:
+                with self._lease_lock:
+                    # Exit when nothing is queued and no HEALTHY lease has
+                    # work in flight (a broken lease's stuck counters must
+                    # not keep the dispatcher spinning — its tasks were
+                    # already re-enqueued or failed by the conn-lost hook).
+                    done = (not kq.queue
+                            and not kq.pending_lease_requests
+                            and all(l.inflight <= 0 or l.broken
+                                    for l in kq.leases))
+                    if done:
+                        kq.dispatcher_running = False
+                        return
+                kq.wake.wait(0.25)
+                kq.wake.clear()
+
+    def _maybe_request_leases(self, kq: "_KeyQueue", sample: _InflightTask,
+                              queue_len: int) -> None:
+        """Spawn background lease requesters if the queue outruns capacity."""
+        with self._lease_lock:
+            capacity = sum(4 - l.inflight for l in kq.leases
+                           if not l.broken) + kq.pending_lease_requests * 4
+            want = 0
+            while (capacity + want * 4 < queue_len
+                   and kq.pending_lease_requests + want
+                   < cfg.max_pending_lease_requests_per_scheduling_key):
+                want += 1
+            kq.pending_lease_requests += want
+            if sample.strategy is None and kq.lease_fail_deadline is None:
+                kq.lease_fail_deadline = (
+                    time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 6)
+        for _ in range(want):
+            threading.Thread(target=self._lease_requester,
+                             args=(kq, sample), daemon=True).start()
+
+    def _lease_requester(self, kq: "_KeyQueue",
+                         sample: _InflightTask) -> None:
+        try:
+            lease = self._request_new_lease(sample.resources, sample.strategy)
+        finally:
+            with self._lease_lock:
+                kq.pending_lease_requests -= 1
+        if lease is not None:
+            with self._lease_lock:
+                kq.leases.append(lease)
+                kq.lease_fail_deadline = None
+            kq.wake.set()
+            return
+        # Infeasible right now. If nothing is making progress for too long,
+        # fail what's queued instead of spinning forever.
+        with self._lease_lock:
+            has_live = any(not l.broken for l in kq.leases)
+            deadline = kq.lease_fail_deadline
+        if (not has_live and deadline is not None
+                and time.monotonic() > deadline):
+            self._fail_queued(kq, TimeoutError(
+                f"no feasible node for {sample.resources}"))
+        else:
+            time.sleep(0.05)
+            kq.wake.set()
+
+    def _push_to_lease(self, task_id_bytes: bytes, info: _InflightTask,
+                       lease: _Lease, kq: "_KeyQueue") -> None:
+        info.worker_addr = lease.worker_addr
+        with self._inflight_lock:
+            self._inflight[task_id_bytes] = info
+        try:
+            worker = self._pool.get(lease.worker_addr,
+                                    on_close=self._on_worker_conn_lost)
+            worker.notify("push_task", info.spec_blob)
+        except BaseException:
+            with self._inflight_lock:
+                self._inflight.pop(task_id_bytes, None)
+            lease.broken = True
+            with self._lease_lock:
+                kq.queue.appendleft((task_id_bytes, info))
+
+    def _fail_queued(self, kq: "_KeyQueue", exc: Exception) -> None:
+        err = capture_exception(exc)
+        with self._lease_lock:
+            tasks = list(kq.queue)
+            kq.queue.clear()
+        for _, info in tasks:
+            for oid in info.return_ids:
+                self.memory_store.put(oid, err, is_exception=True)
+
+    def _request_new_lease(self, resources: Dict[str, float],
+                           strategy) -> Optional[_Lease]:
+        """One head pick + node lease round trip; None if infeasible now."""
+        exclude: List[str] = []
+        for _ in range(4):  # a few spillback hops per attempt
+            try:
+                picked = self.head.call("pick_node", resources, strategy,
+                                        exclude, timeout=10)
+            except (ConnectionLost, TimeoutError):
+                return None
+            if picked is None:
+                return None
+            node_id, node_addr, _ = picked
+            pg = None
+            if strategy and strategy.get("kind") == "placement_group":
+                pg = (strategy["pg_id"], strategy.get("bundle_index", -1))
+                if pg[1] < 0:
+                    pg = None
+            try:
+                granted = self._pool.get(node_addr).call(
+                    "request_lease", resources, True, pg,
+                    timeout=cfg.lease_timeout_ms / 1000.0 + 5)
+            except (ConnectionLost, TimeoutError):
+                exclude.append(node_id)
+                continue
+            if granted is None:
+                exclude.append(node_id)
+                continue
+            worker_addr, lease_id = granted
+            return _Lease(worker_addr, lease_id, node_addr)
+        return None
+
+    def _on_worker_conn_lost(self, client: RpcClient) -> None:
+        """A worker connection died: fail/retry its inflight tasks, mark its
+        actors dead-pending-head-confirmation."""
+        addr = client.address
+        victims = []
+        with self._inflight_lock:
+            for tid, info in list(self._inflight.items()):
+                if info.worker_addr == addr:
+                    victims.append((tid, info))
+                    del self._inflight[tid]
+        with self._lease_lock:
+            for kq in self._key_queues.values():
+                for l in kq.leases:
+                    if l.worker_addr == addr:
+                        l.broken = True
+        # System failure: normal tasks are resubmitted through the queue
+        # (bounded by their per-task sys_retries counter).
+        for tid, info in victims:
+            if info.sched_key and info.sched_key[0] == "actor":
+                continue  # actor calls handled by _handle_actor_conn_lost
+            if info.sys_retries is None:
+                info.sys_retries = cfg.task_max_retries_default
+            info.sys_retries -= 1
+            if info.sys_retries < 0:
+                err = capture_exception(WorkerCrashedError(
+                    f"worker at {addr} died executing {info.name}"))
+                for oid in info.return_ids:
+                    self.memory_store.put(oid, err, is_exception=True)
+            else:
+                self._enqueue_task(tid, info)
+        with self._actors_lock:
+            conns = [c for c in self._actors.values() if c.address == addr]
+        for c in conns:
+            threading.Thread(target=self._handle_actor_conn_lost, args=(c,),
+                             daemon=True).start()
+
+    # ------------------------------------------------------------------ leases
+
+    def _lease_task_finished(self, sched_key: tuple, worker_addr: str) -> None:
+        with self._lease_lock:
+            kq = self._key_queues.get(sched_key)
+            if kq is None:
+                return
+            for l in kq.leases:
+                if l.worker_addr == worker_addr and l.inflight > 0:
+                    l.inflight -= 1
+                    if l.inflight <= 0:
+                        l.release_at = time.monotonic() + _LEASE_LINGER_S
+                    break
+            kq.wake.set()
+
+    def _lease_reaper_loop(self) -> None:
+        """Returns idle leases to their node managers after the linger."""
+        while not self._shutdown_flag:
+            time.sleep(0.2)
+            now = time.monotonic()
+            to_release = []
+            with self._lease_lock:
+                for key, kq in list(self._key_queues.items()):
+                    keep = []
+                    for l in kq.leases:
+                        if l.broken or (l.inflight <= 0 and l.release_at
+                                        and now >= l.release_at):
+                            to_release.append(l)
+                        else:
+                            keep.append(l)
+                    kq.leases[:] = keep
+                    if (not kq.leases and not kq.queue
+                            and not kq.dispatcher_running):
+                        self._key_queues.pop(key, None)
+            for l in to_release:
+                if not l.broken:
+                    try:
+                        self._pool.get(l.node_addr).notify(
+                            "return_lease", l.lease_id)
+                    except Exception:
+                        pass
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True):
+        self._cancelled.add(ref.id().task_id())
+        # Best effort: no preemption of running tasks in round 1.
+
+    # ------------------------------------------------------------------ actors
+
+    def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
+                     namespace: str = "default", max_concurrency: int = 1,
+                     max_restarts: int = 0, resources=None, lifetime=None,
+                     scheduling_strategy=None, get_if_exists: bool = False,
+                     runtime_env=None) -> ActorID:
+        resources = _as_resource_dict(resources)
+        resources.setdefault("CPU", 1.0)
+        actor_id = ActorID.of(self.job_id)
+        spec_blob = SERIALIZER.encode({
+            "cls": cls, "args": tuple(args), "kwargs": dict(kwargs),
+            "max_concurrency": max_concurrency,
+            "owner_addr": self.owner_addr,
+        })
+        status, existing = self.head.call(
+            "register_actor", actor_id.binary(), name, namespace, spec_blob,
+            max_restarts, resources, get_if_exists,
+            _strategy_dict(scheduling_strategy), timeout=None)
+        if status == "exists":
+            return ActorID(existing)
+        self._actor_classes[actor_id] = cls
+        return actor_id
+
+    def _actor_conn(self, actor_id: ActorID) -> _ActorConn:
+        with self._actors_lock:
+            conn = self._actors.get(actor_id)
+            if conn is None:
+                conn = _ActorConn(actor_id)
+                self._actors[actor_id] = conn
+            return conn
+
+    def _resolve_actor_address(self, conn: _ActorConn,
+                               timeout: float = 60.0) -> Optional[str]:
+        if conn.address is not None:
+            return conn.address
+        state, payload = self.head.call("wait_actor_address",
+                                        conn.actor_id.binary(), timeout,
+                                        timeout=timeout + 5)
+        if state == "ALIVE":
+            conn.address = payload
+            return payload
+        if state == "DEAD":
+            conn.dead = True
+            conn.death_reason = payload
+            return None
+        return None
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
+                          kwargs, num_returns: int = 1) -> List[ObjectRef]:
+        task_id = TaskID.for_task(actor_id)
+        return_ids = [ObjectID.for_task_return(task_id, i)
+                      for i in range(num_returns)]
+        for oid in return_ids:
+            self.refcount.add_owned_object(oid)
+        refs = [ObjectRef(oid, self.owner_addr) for oid in return_ids]
+        conn = self._actor_conn(actor_id)
+
+        if method_name == "__ray_terminate__":
+            self.kill_actor(actor_id, no_restart=True)
+            for oid in return_ids:
+                self.memory_store.put(oid, None)
+            return refs
+
+        blob = SERIALIZER.encode({
+            "task_id": task_id.binary(),
+            "actor_id": actor_id.binary(),
+            "method": method_name,
+            "args": tuple(args), "kwargs": dict(kwargs),
+            "return_ids": [o.binary() for o in return_ids],
+            "owner_addr": self.owner_addr,
+        })
+        threading.Thread(target=self._push_actor_task,
+                         args=(conn, task_id.binary(), blob, return_ids),
+                         daemon=True).start()
+        return refs
+
+    def _push_actor_task(self, conn: _ActorConn, task_id_bytes: bytes,
+                         blob: bytes, return_ids: List[ObjectID]) -> None:
+        seq = next(conn.seq)
+        with conn.lock:
+            conn.pending[seq] = (task_id_bytes, blob, return_ids)
+        addr = self._resolve_actor_address(conn)
+        if addr is None:
+            self._fail_actor_call(conn, seq)
+            return
+        with self._inflight_lock:
+            self._inflight[task_id_bytes] = _InflightTask(
+                blob, return_ids, addr, 0, ("actor", conn.actor_id), {},
+                None, "actor_task")
+        try:
+            self._pool.get(addr, on_close=self._on_worker_conn_lost).notify(
+                "push_actor_task", blob, seq)
+        except (ConnectionLost, OSError):
+            self._handle_actor_conn_lost(conn)
+
+    def _fail_actor_call(self, conn: _ActorConn, seq: int) -> None:
+        with conn.lock:
+            entry = conn.pending.pop(seq, None)
+        if entry is None:
+            return
+        task_id_bytes, _, return_ids = entry
+        with self._inflight_lock:
+            self._inflight.pop(task_id_bytes, None)
+        err = ActorDiedError(conn.actor_id, conn.death_reason or "actor died")
+        for oid in return_ids:
+            self.memory_store.put(oid, err, is_exception=True)
+
+    def rpc_actor_call_done(self, conn_ctx, actor_id_bytes: bytes, seq: int,
+                            task_id_bytes: bytes,
+                            results: List[Tuple[bytes, str, Any]]):
+        aconn = self._actor_conn(ActorID(actor_id_bytes))
+        with aconn.lock:
+            aconn.pending.pop(seq, None)
+        return self.rpc_task_done(conn_ctx, task_id_bytes, results)
+
+    def _handle_actor_conn_lost(self, conn: _ActorConn) -> None:
+        """Connection to the actor's worker died: consult the head."""
+        stale_addr = conn.address
+        conn.address = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                info = self.head.call("get_actor_info",
+                                      conn.actor_id.binary(), timeout=10)
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if info is None:
+                conn.dead = True
+                conn.death_reason = "unknown actor"
+                break
+            if info["state"] == "ALIVE" and info["address"]:
+                if info["address"] == stale_addr:
+                    # Head hasn't noticed the death yet; keep polling.
+                    time.sleep(0.2)
+                    continue
+                conn.address = info["address"]
+                # Reference semantics: actor-task retries default to 0 —
+                # calls that may already have executed are FAILED, not
+                # replayed against the restarted instance (a poison call
+                # would kill every incarnation). New calls go to the new
+                # address.
+                conn.death_reason = ("actor restarted; in-flight calls "
+                                     "failed (max_task_retries=0)")
+                with conn.lock:
+                    seqs = list(conn.pending)
+                for seq in seqs:
+                    self._fail_actor_call(conn, seq)
+                return
+            if info["state"] == "DEAD":
+                conn.dead = True
+                conn.death_reason = info["reason"] or "actor died"
+                break
+            time.sleep(0.2)  # PENDING/RESTARTING: wait
+        with conn.lock:
+            seqs = list(conn.pending)
+        for seq in seqs:
+            self._fail_actor_call(conn, seq)
+
+    def get_actor(self, name: str, namespace: str = "default") -> ActorID:
+        found = self.head.call("get_named_actor", name, namespace, timeout=10)
+        if found is None:
+            raise ValueError(f"no actor named '{name}' in namespace "
+                             f"'{namespace}'")
+        aid, spec_blob = found
+        actor_id = ActorID(aid)
+        if actor_id not in self._actor_classes:
+            self._actor_classes[actor_id] = SERIALIZER.decode(spec_blob)["cls"]
+        return actor_id
+
+    def actor_class_of(self, actor_id: ActorID):
+        return self._actor_classes.get(actor_id)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        try:
+            self.head.call("kill_actor", actor_id.binary(), no_restart,
+                           timeout=10)
+        except Exception:
+            pass
+        conn = self._actor_conn(actor_id)
+        conn.dead = True
+        conn.death_reason = "killed via ray_tpu.kill"
+        conn.address = None
+        with conn.lock:
+            seqs = list(conn.pending)
+        for seq in seqs:
+            self._fail_actor_call(conn, seq)
+
+    def list_actors(self):
+        return self.head.call("list_actors", timeout=10)
+
+    # ------------------------------------------------------------------ pgs
+
+    def create_placement_group(self, spec: PlacementGroupSpec) -> None:
+        self.head.call("create_pg", spec.pg_id.binary(),
+                       [b.resources.to_dict() for b in spec.bundles],
+                       spec.strategy, spec.name, timeout=30)
+        self._pgs[spec.pg_id] = spec
+
+    def placement_group_ready(self, pg_id: PlacementGroupID,
+                              timeout=None) -> bool:
+        return bool(self.head.call("pg_ready", pg_id.binary(), timeout=10))
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        self.head.call("remove_pg", pg_id.binary(), timeout=10)
+        self._pgs.pop(pg_id, None)
+
+    def placement_group_table(self):
+        return self.head.call("pg_table", timeout=10)
+
+    # ------------------------------------------------------------------ misc
+
+    def nodes(self):
+        return self.head.call("list_nodes", timeout=10)
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total, _ = self.head.call("cluster_resources", timeout=10)
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        _, avail = self.head.call("cluster_resources", timeout=10)
+        return avail
+
+    def shutdown(self) -> None:
+        if self._shutdown_flag:
+            return
+        self._shutdown_flag = True
+        self._server.stop()
+        self._pool.close_all()
+        for c in (self.head, self.node):
+            try:
+                c.close()
+            except Exception:
+                pass
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        runtime_context.set_runtime(None)
+
+
+def _as_resource_dict(resources) -> Dict[str, float]:
+    if resources is None:
+        return {}
+    if hasattr(resources, "to_dict"):
+        return dict(resources.to_dict())
+    return dict(resources)
+
+
+def _strategy_dict(strategy) -> Optional[Dict[str, Any]]:
+    """Normalize a scheduling strategy object/string to the wire dict."""
+    if strategy is None:
+        return None
+    if isinstance(strategy, dict):
+        return strategy
+    if isinstance(strategy, str):
+        if strategy == "SPREAD":
+            return {"kind": "spread"}
+        if strategy == "DEFAULT":
+            return None
+        raise ValueError(f"unknown scheduling strategy {strategy!r}")
+    kind = type(strategy).__name__
+    if kind == "PlacementGroupSchedulingStrategy":
+        return {"kind": "placement_group",
+                "pg_id": strategy.placement_group.id.binary(),
+                "bundle_index":
+                    getattr(strategy, "placement_group_bundle_index", -1)}
+    if kind == "NodeAffinitySchedulingStrategy":
+        return {"kind": "node_affinity", "node_id": strategy.node_id,
+                "soft": getattr(strategy, "soft", False)}
+    raise ValueError(f"unknown scheduling strategy {strategy!r}")
+
+
+_spread_rr_counter = itertools.count()
+
+
+def _sched_key(func, resources: Dict[str, float], strategy) -> tuple:
+    fid = getattr(func, "__qualname__", repr(func))
+    strat_part = (tuple(sorted((strategy or {}).items(),
+                               key=lambda kv: str(kv[0])))
+                  if strategy else None)
+    if strategy and strategy.get("kind") == "spread":
+        # Spread tasks must NOT share worker leases (lease reuse would pack
+        # them); rotate across a few keys so each requests its own lease.
+        strat_part = strat_part + (("rr", next(_spread_rr_counter) % 8),)
+    return (fid, tuple(sorted(resources.items())), strat_part)

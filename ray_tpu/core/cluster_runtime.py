@@ -1,0 +1,197 @@
+"""Driver-side cluster runtime: boots head + node processes and connects.
+
+Parity target: the reference's Node/process-launcher path (reference:
+python/ray/_private/node.py:37 start_head_processes :1407,
+services.py start_gcs_server :1445 / start_raylet :1523) — collapsed to two
+subprocess kinds (head, node manager) plus the in-driver ClusterCore.
+
+Also provides `Cluster` (the fake multi-node test harness, parity with
+python/ray/cluster_utils.py:135 add_node :202): extra node managers are
+plain local processes with caller-chosen fake resources, so multi-node
+scheduling/transfer paths run on one machine.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import runtime_context
+from ray_tpu.core.cluster_core import ClusterCore
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.ids import JobID
+
+
+def _spawn(args: List[str], log_name: str) -> subprocess.Popen:
+    os.makedirs(cfg.log_dir, exist_ok=True)
+    logf = open(os.path.join(cfg.log_dir, log_name), "ab", buffering=0)
+    env = dict(os.environ)
+    # Children must import ray_tpu from wherever the driver imported it
+    # (repo checkouts aren't pip-installed).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(args, stdout=subprocess.PIPE, stderr=logf,
+                            env=env, cwd=os.getcwd(),
+                            preexec_fn=_die_with_parent)
+
+
+def _die_with_parent():
+    """PR_SET_PDEATHSIG: the child gets SIGTERM if the driver dies, so a
+    SIGKILL'd driver never leaks a cluster."""
+    try:
+        import ctypes
+
+        ctypes.CDLL("libc.so.6").prctl(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
+    except Exception:
+        pass
+
+
+def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float) -> Dict[str, str]:
+    """Reads stdout lines until one starting with `tag` appears; returns the
+    space-separated key/value pairs of that line."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"process exited rc={proc.returncode} before "
+                               f"printing {tag}")
+        line = proc.stdout.readline().decode()
+        if not line:
+            time.sleep(0.01)
+            continue
+        parts = line.strip().split()
+        if parts and parts[0] == tag:
+            out = {}
+            for i in range(0, len(parts) - 1, 2):
+                out[parts[i]] = parts[i + 1]
+            return out
+    raise TimeoutError(f"timed out waiting for {tag} line")
+
+
+class NodeProc:
+    def __init__(self, proc: subprocess.Popen, address: str, node_id: str,
+                 store_name: str):
+        self.proc = proc
+        self.address = address
+        self.node_id = node_id
+        self.store_name = store_name
+
+
+def start_node_process(head_addr: str, resources: Optional[Dict[str, float]],
+                       labels: Optional[Dict[str, str]] = None,
+                       object_store_bytes: Optional[int] = None,
+                       timeout: float = 30.0) -> NodeProc:
+    args = [sys.executable, "-m", "ray_tpu.cluster.node_main",
+            "--head-addr", head_addr,
+            "--resources", json.dumps(resources or {}),
+            "--labels", json.dumps(labels or {})]
+    if object_store_bytes:
+        args += ["--object-store-bytes", str(object_store_bytes)]
+    proc = _spawn(args, f"node-{int(time.time()*1000)%100000}.log")
+    info = _read_tagged_line(proc, "ADDRESS", timeout)
+    return NodeProc(proc, info["ADDRESS"], info["NODE"], info["STORE"])
+
+
+class ClusterRuntime(ClusterCore):
+    """The driver's runtime: owns the head/node subprocesses it started."""
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 address: Optional[str] = None):
+        self._procs: List[subprocess.Popen] = []
+        self._nodes: List[NodeProc] = []
+        if address is None:
+            head_proc = _spawn(
+                [sys.executable, "-m", "ray_tpu.cluster.head_main"],
+                "head.log")
+            self._procs.append(head_proc)
+            head_addr = _read_tagged_line(head_proc, "ADDRESS", 30)["ADDRESS"]
+
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            node = start_node_process(
+                head_addr, res or None, labels,
+                object_store_memory or cfg.object_store_memory_bytes)
+            self._procs.append(node.proc)
+            self._nodes.append(node)
+            self._owns_cluster = True
+        else:
+            # Connect to an existing cluster: join as driver on a new node?
+            # Round 1: drivers must run on a machine with a node manager;
+            # we start a zero-resource "driver node" for the object plane.
+            head_addr = address
+            node = start_node_process(head_addr, {"CPU": 0.0}, labels,
+                                      object_store_memory
+                                      or cfg.object_store_memory_bytes)
+            self._procs.append(node.proc)
+            self._nodes.append(node)
+            self._owns_cluster = False
+
+        super().__init__(head_addr, node.address, node.node_id,
+                         node.store_name, JobID.from_int(1), is_driver=True)
+        job_int = self.head.call("new_job_id", timeout=10)
+        self.job_id = JobID.from_int(job_int)
+        atexit.register(self.shutdown)
+
+    def add_node(self, num_cpus: float = 1.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_bytes: Optional[int] = None) -> NodeProc:
+        """Test/scale-out hook: boot another (possibly fake-resource) node."""
+        res = dict(resources or {})
+        res.setdefault("CPU", num_cpus)
+        node = start_node_process(self.head_addr, res, labels,
+                                  object_store_bytes or (256 << 20))
+        self._procs.append(node.proc)
+        self._nodes.append(node)
+        return node
+
+    def remove_node(self, node: NodeProc) -> None:
+        try:
+            self.head.call("drain_node", node.node_id, timeout=5)
+        except Exception:
+            pass
+        node.proc.terminate()
+        try:
+            node.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            node.proc.kill()
+        if node in self._nodes:
+            self._nodes.remove(node)
+
+    def kill_node(self, node: NodeProc) -> None:
+        """Chaos hook: SIGKILL a node manager (health check must notice)."""
+        node.proc.kill()
+        if node in self._nodes:
+            self._nodes.remove(node)
+
+    def shutdown(self) -> None:
+        if getattr(self, "_shutdown_flag", False):
+            return
+        try:
+            atexit.unregister(self.shutdown)
+        except Exception:
+            pass
+        super().shutdown()
+        for p in self._procs:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 5
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -11,6 +11,27 @@ from typing import Optional
 _lock = threading.Lock()
 _runtime = None
 
+# Per-execution-thread task context for cluster workers (task_id, actor_id,
+# resources) — set by the worker's execution loop around user code.
+_worker_ctx = threading.local()
+
+
+def current_worker_context() -> dict:
+    return getattr(_worker_ctx, "ctx", {})
+
+
+def set_worker_context(ctx: Optional[dict]):
+    """Returns the previous context; pass it back to restore."""
+    prev = getattr(_worker_ctx, "ctx", None)
+    if ctx is None:
+        try:
+            del _worker_ctx.ctx
+        except AttributeError:
+            pass
+    else:
+        _worker_ctx.ctx = ctx
+    return prev
+
 
 def get_runtime():
     return _runtime

@@ -68,7 +68,9 @@ class Serializer:
             def reducer_override(self, obj):
                 if _is_jax_array(obj):
                     return _jax_reduce(np.asarray(obj))
-                return NotImplemented
+                # Delegate: CloudPickler's own reducer_override implements
+                # by-value pickling of __main__/unimportable functions.
+                return super().reducer_override(obj)
 
         sio = io.BytesIO()
         _Pickler(sio, protocol=5, buffer_callback=buffer_callback).dump(value)

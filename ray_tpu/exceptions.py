@@ -26,6 +26,19 @@ class TaskError(RayTpuError):
         self.cause = cause
         super().__init__(f"task failed with {exc_type_name}:\n{cause_repr}")
 
+    def __reduce__(self):
+        # Exception's default reduce would replay __init__ with the formatted
+        # message as the only argument; rebuild from the real fields (the
+        # cause may itself be unpicklable — drop it then).
+        try:
+            import cloudpickle
+
+            cloudpickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = None
+        return (TaskError, (self.exc_type_name, self.cause_repr, cause))
+
 
 class ActorError(RayTpuError):
     """Base for actor-related failures."""

@@ -24,7 +24,18 @@ import jax  # noqa: E402
 # matmuls). Pin the platform list before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
 
+import glob  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Reap object-store segments leaked by SIGKILL'd clusters of previous runs
+# (node ids are fresh uuids per cluster, so names never collide with live
+# clusters of THIS run, which start after this executes).
+for _stale in glob.glob("/dev/shm/rtpu_store_*"):
+    try:
+        os.unlink(_stale)
+    except OSError:
+        pass
 
 
 @pytest.fixture
