@@ -257,9 +257,6 @@ Slot* find_insert_slot(Handle* h, const uint8_t* key) {
 int evict_for(Handle* h, uint64_t need) {
   int evicted_any = 0;
   for (;;) {
-    if (arena_alloc(h, 0)) {
-      // probe: cheap check — try the actual allocation in caller
-    }
     // Find LRU candidate.
     Slot* table = slot_table(h);
     Slot* lru = nullptr;

@@ -326,8 +326,11 @@ class HeadServer:
                 continue
             node_id, node_addr, _ = picked
             node = self._pool.get(node_addr)
+            # Client timeout must exceed the node's own worker-pop timeout:
+            # giving up first abandons a lease the node is about to grant —
+            # a permanent resource leak (nobody knows the lease id).
             lease = node.call("request_lease", info.resources, True,
-                              timeout=cfg.lease_timeout_ms / 1000.0)
+                              timeout=cfg.lease_timeout_ms / 1000.0 + 10)
             if lease is None:
                 exclude.add(node_id)
                 continue

@@ -224,13 +224,21 @@ class RpcClient:
         self._pending_lock = threading.Lock()
         self._on_push = on_push
         self._closed = False
-        self._reader = threading.Thread(target=self._read_loop, daemon=True,
-                                        name=f"rpc-client-{address}")
-        self._reader.start()
+        self._alive = True
+        self._start_reader(self._sock)
 
-    def _read_loop(self) -> None:
+    def _start_reader(self, sock: socket.socket) -> None:
+        threading.Thread(target=self._read_loop, args=(sock,), daemon=True,
+                         name=f"rpc-client-{self.address}").start()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        """Reader bound to one socket generation. A reconnect() superseded
+        reader exits silently: it must neither steal frames from the new
+        socket nor fail waiters registered on the fresh connection."""
         while not self._closed:
-            frame = _recv_frame(self._sock)
+            if sock is not self._sock:
+                return  # superseded by reconnect(); new reader owns state
+            frame = _recv_frame(sock)
             if frame is None:
                 break
             rid, a, b = SERIALIZER.decode(frame)
@@ -245,8 +253,12 @@ class RpcClient:
                 waiter = self._pending.pop(-rid, None)
             if waiter is not None:
                 waiter.set(a, b)
-        # Connection died: fail all waiters.
+        # Connection died: fail waiters — but only if we are still the
+        # CURRENT reader (reconnect() already failed/migrated the old ones).
         with self._pending_lock:
+            if sock is not self._sock:
+                return
+            self._alive = False
             pending, self._pending = self._pending, {}
         for w in pending.values():
             w.fail(ConnectionLost(self.address))
@@ -270,7 +282,13 @@ class RpcClient:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise ConnectionLost(f"{self.address}: {e}") from e
-        return waiter.wait(timeout)
+        try:
+            return waiter.wait(timeout)
+        except TimeoutError:
+            # Drop the stale waiter so a late reply doesn't pile up state.
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise
 
     def notify(self, method: str, *args) -> None:
         _send_frame(self._sock, SERIALIZER.encode((0, method, args)),
@@ -299,18 +317,23 @@ class RpcClient:
 
     def reconnect(self) -> None:
         host, port = self.address.rsplit(":", 1)
-        old = self._sock
-        self._sock = socket.create_connection(
+        new_sock = socket.create_connection(
             (host, int(port)), timeout=cfg.rpc_connect_timeout_s)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        new_sock.settimeout(None)
+        new_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._pending_lock:
+            old = self._sock
+            self._sock = new_sock  # supersede the old reader atomically
+            self._alive = True
+            # Requests in flight on the old socket will never be answered.
+            pending, self._pending = self._pending, {}
+        for w in pending.values():
+            w.fail(ConnectionLost(f"{self.address}: reconnected"))
         try:
             old.close()
         except OSError:
             pass
-        self._reader = threading.Thread(target=self._read_loop, daemon=True,
-                                        name=f"rpc-client-{self.address}")
-        self._reader.start()
+        self._start_reader(new_sock)
 
     def close(self) -> None:
         self._closed = True
@@ -360,9 +383,15 @@ class ClientPool:
             on_close: Optional[Callable] = None) -> RpcClient:
         with self._lock:
             c = self._clients.get(address)
-            if c is None or c._closed:
+            if c is None or c._closed or not c._alive:
+                # A client whose socket died (reader exited) must not be
+                # handed out again: replace it with a fresh connection.
                 c = RpcClient(address, on_push=on_push, on_close=on_close)
                 self._clients[address] = c
+            elif on_close is not None and c._on_close is None:
+                # Upgrade: a later caller may care about conn-loss events on
+                # a connection first opened by a caller that didn't.
+                c._on_close = on_close
             return c
 
     def invalidate(self, address: str) -> None:

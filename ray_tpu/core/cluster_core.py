@@ -57,7 +57,11 @@ class _Lease:
         self.lease_id = lease_id
         self.node_addr = node_addr
         self.inflight = 0
-        self.release_at = 0.0
+        # A lease is born with a linger deadline: a grant that lands AFTER
+        # the queue drained (slow worker spawn raced the burst) must still be
+        # returned to its node — release_at=0 here used to mean "never",
+        # permanently leaking the lease's CPUs and starving the cluster.
+        self.release_at = time.monotonic() + _LEASE_LINGER_S
         self.broken = False
 
 
@@ -97,17 +101,27 @@ class _KeyQueue:
 
 
 class _ActorConn:
-    """Submitter-side state for one remote actor."""
+    """Submitter-side state for one remote actor.
 
-    __slots__ = ("actor_id", "address", "seq", "pending", "lock", "dead",
-                 "death_reason")
+    Ordering contract (reference: sequential_actor_submit_queue.h): calls
+    from one submitter execute in submission order. Seq numbers are assigned
+    synchronously in submit_actor_task, and ONE sender thread per actor
+    drains the outbound queue in seq order over a single TCP connection —
+    frame order on the socket IS execution-submission order on the worker."""
+
+    __slots__ = ("actor_id", "address", "next_seq", "outbound", "pending",
+                 "lock", "sender_running", "dead", "death_reason")
 
     def __init__(self, actor_id: ActorID):
+        import collections
+
         self.actor_id = actor_id
         self.address: Optional[str] = None
-        self.seq = itertools.count()
-        self.pending: Dict[int, tuple] = {}  # seq -> (method, blob, return_ids)
+        self.next_seq = 0
+        self.outbound = collections.deque()  # (seq, task_id_bytes, blob, rids)
+        self.pending: Dict[int, tuple] = {}  # seq -> (tid, blob, return_ids)
         self.lock = threading.Lock()
+        self.sender_running = False
         self.dead = False
         self.death_reason = ""
 
@@ -227,13 +241,12 @@ class ClusterCore:
             buf = self.store.get(oid, timeout_ms=t_ms)
             if buf is None:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
-        try:
-            return SERIALIZER.decode(buf.buffer)
-        finally:
-            # NOTE: zero-copy numpy views would dangle after release; decode
-            # copies via pickle buffers unless the consumer opted into
-            # pinned reads (Data library does, holding the pin).
-            buf.release()
+        # Zero-copy decode: views are taken over memoryview(buf), whose
+        # exporter is the PinnedBuffer itself — every deserialized numpy
+        # array transitively keeps the pin alive, so LRU eviction can never
+        # reuse the arena block under live user data. The pin drops when the
+        # last view is garbage-collected (PinnedBuffer.__buffer__).
+        return SERIALIZER.decode(memoryview(buf))
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -278,48 +291,98 @@ class ClusterCore:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
+        """Event-driven wait: owned refs register memory-store callbacks;
+        borrowed refs long-poll their owner (one `wait_object` RPC per ref,
+        not a poll-per-tick storm — the reference's Wait is likewise
+        subscription-based, core_worker.h:682)."""
         if len(set(r.id() for r in refs)) != len(refs):
             raise ValueError("wait() requires unique object refs")
-        local = [r for r in refs
-                 if r.owner_address in (None, self.owner_addr)]
-        remote = [r for r in refs if r not in local]
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready_ids = set()
-        while True:
-            ready_ids |= self.memory_store.wait(
-                [r.id() for r in local], num_returns, 0)
-            for r in remote:
-                if r.id() in ready_ids:
-                    continue
-                if self.store.contains(r.id()):
-                    ready_ids.add(r.id())
-                else:
-                    try:
-                        kind, _ = self._pool.get(r.owner_address).call(
-                            "get_object", r.id().binary(), 0, timeout=5)
-                        if kind in ("value", "in_store", "error"):
-                            ready_ids.add(r.id())
-                    except Exception:
-                        pass
-            if len(ready_ids) >= num_returns:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.005)
+        cv = threading.Condition()
+        ready_ids: set = set()
+        waiting = True
+
+        def mark(oid: ObjectID) -> None:
+            with cv:
+                ready_ids.add(oid)
+                cv.notify_all()
+
+        registered: List[Tuple[ObjectID, Any]] = []
+        remote_by_owner: Dict[str, List[ObjectID]] = {}
+        for r in refs:
+            oid = r.id()
+            if r.owner_address in (None, self.owner_addr):
+                cb = lambda rec, o=oid: mark(o)  # noqa: E731
+                self.memory_store.get_async(oid, cb)
+                registered.append((oid, cb))
+            elif self.store.contains(oid):
+                mark(oid)
+            else:
+                remote_by_owner.setdefault(r.owner_address, []).append(oid)
+        for owner, oids in remote_by_owner.items():
+            # One long-poll thread per OWNER covering all its refs (not one
+            # per ref): a wait over 1k refs costs O(owners) RPCs per poll.
+            threading.Thread(
+                target=self._wait_remote_loop,
+                args=(owner, oids, deadline, mark, lambda: waiting),
+                daemon=True, name="wait-remote").start()
+        try:
+            with cv:
+                while len(ready_ids) < num_returns:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        break
+                    cv.wait(remaining)
+                snapshot = set(ready_ids)
+        finally:
+            waiting = False
+            for oid, cb in registered:
+                self.memory_store.remove_callback(oid, cb)
         ready, not_ready = [], []
         for r in refs:
-            (ready if r.id() in ready_ids and len(ready) < num_returns
+            (ready if r.id() in snapshot and len(ready) < num_returns
              else not_ready).append(r)
         return ready, not_ready
+
+    def _wait_remote_loop(self, owner: str, oids: List[ObjectID],
+                          deadline: Optional[float], mark,
+                          still_waiting) -> None:
+        pending = set(oids)
+        while still_waiting() and pending:
+            for oid in [o for o in pending if self.store.contains(o)]:
+                mark(oid)
+                pending.discard(oid)
+            if not pending:
+                return
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return
+            # Short poll chunks keep orphaned threads (wait() returned early)
+            # from pinning an owner-side handler thread for long.
+            poll = 5.0 if remaining is None else min(remaining, 5.0)
+            try:
+                ready = self._pool.get(owner).call(
+                    "wait_objects", [o.binary() for o in pending], poll,
+                    timeout=poll + 5)
+                for ob in ready:
+                    oid = ObjectID(ob)
+                    mark(oid)
+                    pending.discard(oid)
+            except Exception:
+                time.sleep(0.2)
 
     # -------------------------------------------------------------- owner RPC
 
     @blocking_rpc
     def rpc_get_object(self, conn, oid_bytes: bytes, timeout: float):
-        """Serve a get() for an object I own."""
+        """Serve a get() for an object I own. timeout=0 is a non-blocking
+        readiness probe; only timeout=None blocks indefinitely."""
         oid = ObjectID(oid_bytes)
         try:
-            recs = self.memory_store.get([oid], timeout if timeout else None)
+            recs = self.memory_store.get(
+                [oid], None if timeout is None else timeout)
         except GetTimeoutError:
             return "timeout", None
         rec = recs[0]
@@ -328,6 +391,26 @@ class ClusterCore:
         if rec.in_plasma:
             return "in_store", None
         return "value", SERIALIZER.encode(rec.value)
+
+    @blocking_rpc
+    def rpc_wait_object(self, conn, oid_bytes: bytes, timeout: float):
+        """Long-poll readiness probe for an object I own (serves remote
+        wait()); never ships the value."""
+        try:
+            self.memory_store.get([ObjectID(oid_bytes)], timeout)
+            return True
+        except GetTimeoutError:
+            return False
+
+    @blocking_rpc
+    def rpc_wait_objects(self, conn, oid_bytes_list: List[bytes],
+                         timeout: float):
+        """Batched long-poll: returns the (possibly empty) subset of the
+        given owned objects that are ready, blocking until at least one is
+        or the timeout lapses."""
+        oids = [ObjectID(b) for b in oid_bytes_list]
+        ready = self.memory_store.wait(oids, 1, timeout)
+        return [o.binary() for o in ready]
 
     def rpc_add_borrower(self, conn, oid_bytes: bytes, borrower: str):
         self.refcount.add_borrower(ObjectID(oid_bytes), borrower)
@@ -721,29 +804,63 @@ class ClusterCore:
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": self.owner_addr,
         })
-        threading.Thread(target=self._push_actor_task,
-                         args=(conn, task_id.binary(), blob, return_ids),
-                         daemon=True).start()
+        # Seq assignment + enqueue are synchronous with the caller: two
+        # sequential .remote() calls CANNOT be reordered (the sender thread
+        # drains in seq order).
+        with conn.lock:
+            seq = conn.next_seq
+            conn.next_seq += 1
+            conn.pending[seq] = (task_id.binary(), blob, return_ids)
+            conn.outbound.append((seq, task_id.binary(), blob, return_ids))
+            start_sender = not conn.sender_running
+            if start_sender:
+                conn.sender_running = True
+        if start_sender:
+            threading.Thread(target=self._actor_sender_loop, args=(conn,),
+                             daemon=True,
+                             name=f"actor-send-{actor_id.hex()[:8]}").start()
         return refs
 
-    def _push_actor_task(self, conn: _ActorConn, task_id_bytes: bytes,
-                         blob: bytes, return_ids: List[ObjectID]) -> None:
-        seq = next(conn.seq)
-        with conn.lock:
-            conn.pending[seq] = (task_id_bytes, blob, return_ids)
-        addr = self._resolve_actor_address(conn)
-        if addr is None:
-            self._fail_actor_call(conn, seq)
-            return
-        with self._inflight_lock:
-            self._inflight[task_id_bytes] = _InflightTask(
-                blob, return_ids, addr, 0, ("actor", conn.actor_id), {},
-                None, "actor_task")
-        try:
-            self._pool.get(addr, on_close=self._on_worker_conn_lost).notify(
-                "push_actor_task", blob, seq)
-        except (ConnectionLost, OSError):
-            self._handle_actor_conn_lost(conn)
+    def _actor_sender_loop(self, conn: _ActorConn) -> None:
+        """Single per-actor sender: resolves the address once, then pushes
+        queued calls in seq order over one pooled connection. Any failure
+        fails THAT call and moves on — the sender thread itself must never
+        die with sender_running stuck True (that would wedge the actor)."""
+        while True:
+            with conn.lock:
+                if not conn.outbound:
+                    conn.sender_running = False
+                    return
+                seq, task_id_bytes, blob, return_ids = conn.outbound.popleft()
+                # A conn-loss handler may have failed this seq while it was
+                # still queued (actor died/restarted before we sent it):
+                # failed-then-executed would duplicate side effects on the
+                # new incarnation, so never send a seq no longer pending.
+                if seq not in conn.pending:
+                    continue
+            try:
+                if conn.dead:
+                    self._fail_actor_call(conn, seq)
+                    continue
+                try:
+                    addr = self._resolve_actor_address(conn)
+                except Exception:
+                    addr = None
+                if addr is None:
+                    self._fail_actor_call(conn, seq)
+                    continue
+                with self._inflight_lock:
+                    self._inflight[task_id_bytes] = _InflightTask(
+                        blob, return_ids, addr, 0, ("actor", conn.actor_id),
+                        {}, None, "actor_task")
+                try:
+                    self._pool.get(
+                        addr, on_close=self._on_worker_conn_lost).notify(
+                            "push_actor_task", blob, seq)
+                except (ConnectionLost, OSError):
+                    self._handle_actor_conn_lost(conn)
+            except BaseException:  # noqa: BLE001 — keep the sender alive
+                self._fail_actor_call(conn, seq)
 
     def _fail_actor_call(self, conn: _ActorConn, seq: int) -> None:
         with conn.lock:

@@ -66,6 +66,21 @@ class MemoryStore:
                 return
         callback(rec)
 
+    def remove_callback(self, object_id: ObjectID,
+                        callback: Callable[[_Record], None]) -> None:
+        """Deregister a pending get_async callback (e.g. wait() timed out):
+        without this, poll-style wait loops would accumulate one closure per
+        call until the object finally arrives."""
+        with self._lock:
+            cbs = self._callbacks.get(object_id)
+            if cbs is not None:
+                try:
+                    cbs.remove(callback)
+                except ValueError:
+                    pass
+                if not cbs:
+                    del self._callbacks[object_id]
+
     def get(
         self,
         object_ids: List[ObjectID],

@@ -80,7 +80,14 @@ class ShmStoreFullError(Exception):
 
 class PinnedBuffer:
     """Zero-copy view of a sealed object; releases its pin when closed /
-    garbage-collected. Holding one keeps the object unevictable."""
+    garbage-collected. Holding one keeps the object unevictable.
+
+    Implements the buffer protocol: ``memoryview(pinned_buffer)`` (and every
+    slice derived from it, and every numpy array deserialized over those
+    slices) keeps THIS object alive, so the pin is only dropped once no view
+    into the shm segment remains. This is how zero-copy ``get()`` stays safe
+    against LRU eviction reusing the arena block (the reference ties plasma
+    buffer lifetime to the python object the same way)."""
 
     def __init__(self, store: "ShmStore", key: bytes, mv: memoryview):
         self._store = store
@@ -94,6 +101,9 @@ class PinnedBuffer:
             self._released = True
             self.buffer = None
             self._finalizer()
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return memoryview(self.buffer)
 
     def __len__(self):
         return len(self.buffer)
