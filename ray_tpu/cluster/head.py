@@ -281,8 +281,12 @@ class HeadServer:
                            get_if_exists: bool = False,
                            strategy: Optional[Dict[str, Any]] = None):
         """Register + schedule + create. Returns ("created", None) /
-        ("exists", actor_id) / raises on name conflict or placement failure."""
+        ("exists", actor_id) / raises on name conflict or placement failure.
+        Idempotent on actor_id: a retried registration (lost reply) must not
+        double-create."""
         with self._lock:
+            if actor_id in self._actors:
+                return "created", None  # duplicate request; creation underway
             if name is not None:
                 key = (namespace, name)
                 existing = self._named.get(key)
@@ -325,22 +329,35 @@ class HeadServer:
                 time.sleep(0.05)
                 continue
             node_id, node_addr, _ = picked
+            import uuid as _uuid
+
             node = self._pool.get(node_addr)
             # Client timeout must exceed the node's own worker-pop timeout:
             # giving up first abandons a lease the node is about to grant —
-            # a permanent resource leak (nobody knows the lease id).
-            lease = node.call("request_lease", info.resources, True,
-                              timeout=cfg.lease_timeout_ms / 1000.0 + 10)
+            # a permanent resource leak (nobody knows the lease id). The
+            # req_id makes retries return the SAME grant.
+            try:
+                lease = node.retrying_call(
+                    "request_lease", info.resources, True, None,
+                    _uuid.uuid4().hex,
+                    timeout=cfg.lease_timeout_ms / 1000.0 + 10)
+            except Exception:
+                exclude.add(node_id)
+                continue
             if lease is None:
                 exclude.add(node_id)
                 continue
             worker_addr, lease_id = lease
             worker = self._pool.get(worker_addr)
             try:
-                worker.call("create_actor", info.actor_id, info.spec_blob,
-                            lease_id, timeout=None)
+                # Worker-side create_actor is idempotent (hosted check).
+                worker.retrying_call("create_actor", info.actor_id,
+                                     info.spec_blob, lease_id, timeout=60)
             except BaseException:
-                node.notify("return_lease", lease_id)
+                try:
+                    node.retrying_call("return_lease", lease_id, timeout=5)
+                except Exception:
+                    pass
                 raise
             with self._lock:
                 info.state = ALIVE
@@ -433,7 +450,10 @@ class HeadServer:
         addr = info.worker_addr
         if addr:
             try:
-                self._pool.get(addr).notify("kill_actor", actor_id)
+                # Acked: a chaos-dropped kill would leave a zombie actor
+                # holding its lease while the head reports DEAD.
+                self._pool.get(addr).retrying_call("kill_actor", actor_id,
+                                                   timeout=5)
             except Exception:
                 pass
         self._actor_died(info, "killed via ray_tpu.kill", try_restart=not no_restart)
@@ -515,7 +535,36 @@ class HeadServer:
                       strategy: str, name: str):
         """Reserve bundle resources on nodes. 2-phase-lite: reservation
         happens against the head's resource view and is pushed to node
-        managers (prepare+commit in one RPC; they re-check locally)."""
+        managers (prepare+commit in one RPC; they re-check locally).
+        Idempotent on pg_id: a retried create returns once the original
+        attempt lands (or re-runs placement if it failed)."""
+        with self._lock:
+            if pg_id in self._pgs:
+                return True  # duplicate request (reply was lost)
+            if not hasattr(self, "_pgs_creating"):
+                self._pgs_creating = {}
+            ev = self._pgs_creating.get(pg_id)
+            am_creator = ev is None
+            if am_creator:
+                ev = self._pgs_creating[pg_id] = threading.Event()
+        if not am_creator:
+            # A concurrent duplicate: wait for the original attempt, and
+            # surface ITS failure as an error (not a silent False the
+            # caller would mistake for success).
+            ev.wait(cfg.lease_timeout_ms / 1000.0 * 3 + 5)
+            with self._lock:
+                if pg_id in self._pgs:
+                    return True
+            raise RuntimeError("placement group creation failed")
+        try:
+            return self._create_pg_inner(pg_id, bundles, strategy, name)
+        finally:
+            ev.set()
+            with self._lock:
+                self._pgs_creating.pop(pg_id, None)
+
+    def _create_pg_inner(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                         strategy: str, name: str):
         deadline = time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 3
         while True:
             with self._lock:
@@ -526,7 +575,7 @@ class HeadServer:
                 try:
                     for idx, (bundle, node) in enumerate(
                             zip(bundles, placement)):
-                        ok = self._pool.get(node.address).call(
+                        ok = self._pool.get(node.address).retrying_call(
                             "reserve_bundle", pg_id, idx, bundle,
                             timeout=10.0)
                         if not ok:
@@ -536,8 +585,8 @@ class HeadServer:
                 except BaseException as e:
                     for node, idx, bundle in reserved:
                         try:
-                            self._pool.get(node.address).notify(
-                                "release_bundle", pg_id, idx)
+                            self._pool.get(node.address).retrying_call(
+                                "release_bundle", pg_id, idx, timeout=5)
                         except Exception:
                             pass
                     if not isinstance(e, _TransientReservationFailure):
@@ -565,7 +614,8 @@ class HeadServer:
                 n = self._nodes.get(node_id)
             if n is not None:
                 try:
-                    self._pool.get(n.address).notify("release_bundle", pg_id, idx)
+                    self._pool.get(n.address).retrying_call(
+                        "release_bundle", pg_id, idx, timeout=5)
                 except Exception:
                     pass
         return True

@@ -66,6 +66,11 @@ class Lease:
         self.worker = worker
         self.resources = resources
         self.pg = pg
+        # >0 while the leased worker is blocked in get()/wait(): its
+        # resources are temporarily returned to the pool so nested tasks can
+        # schedule (reference: NotifyDirectCallTaskBlocked — without this,
+        # N blocked parents over N CPUs deadlock their own children).
+        self.blocked = 0
 
 
 class NodeManager:
@@ -83,19 +88,33 @@ class NodeManager:
         self._lock = threading.RLock()
         self._idle_cv = threading.Condition(self._lock)
         self._spawning = 0
-        self._max_concurrent_spawns = 2
+        self._max_concurrent_spawns = 4
+        # FIFO worker handoff: lease requests queue here and are served
+        # oldest-first when a worker registers or is returned — a racing
+        # herd of cv-waiters would let a hot scheduling key starve nested
+        # tasks' lease requests indefinitely.
+        import collections
+
+        self._worker_waiters = collections.deque()
+        self._lease_grant_order = collections.deque()
         self._workers: Dict[str, WorkerProc] = {}
         self._idle: List[WorkerProc] = []
         self._leases: Dict[str, Lease] = {}
         self._bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._bundle_avail: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        # Idempotency cache: lease request id -> [done_event, grant], claimed
+        # BEFORE the worker pop so a retry arriving mid-flight waits for the
+        # original outcome instead of double-acquiring. Evicted oldest-first.
+        self._lease_grants: Dict[str, list] = {}
+        self._lease_grant_order: "collections.deque" = None  # set below
         self._pool = ClientPool()
         self._server = RpcServer(self, host).start()
         self.address = self._server.address
         self._stop = threading.Event()
         self._head = RpcClient(head_addr)
-        self._head.call("register_node", node_id, self.address, resources,
-                        labels, self.store_name)
+        self._head.retrying_call("register_node", node_id, self.address,
+                                 resources, labels, self.store_name,
+                                 timeout=10)
         # Workers MUST be spawned from one long-lived thread: PDEATHSIG is
         # delivered when the spawning *thread* exits, and lease handlers run
         # on per-request threads.
@@ -164,16 +183,22 @@ class NodeManager:
     def _on_worker_dead(self, w: WorkerProc) -> None:
         with self._lock:
             lease = self._leases.pop(w.lease_id, None) if w.lease_id else None
-            if lease is not None:
+            if lease is not None and lease.blocked == 0:
                 self._release_resources(lease)
         # The worker may have hosted actors: the head tracks actor->address,
         # workers report their hosted actors at registration; simplest robust
         # path is "head notices via actor_died from the caller"; we also
         # proactively report by address.
-        try:
-            self._head.notify("worker_dead_at", w.address)
-        except Exception:
-            pass
+        def report():
+            try:
+                # Acked: a lost death report would stall actor-restart FSMs.
+                self._head.retrying_call("worker_dead_at", w.address,
+                                         timeout=5)
+            except Exception:
+                pass
+
+        # Off the heartbeat thread: retries must not delay liveness pings.
+        threading.Thread(target=report, daemon=True).start()
 
     def _reap_loop(self) -> None:
         ttl = cfg.worker_pool_idle_ttl_s
@@ -246,34 +271,58 @@ class NodeManager:
     def rpc_register_worker(self, conn, worker_id: str, address: str):
         """A freshly-spawned worker joins the idle pool (leases claim workers
         from the pool only — a slow spawn is never killed for missing a
-        deadline; it serves the next lease instead)."""
+        deadline; it serves the next lease instead). Idempotent: a retried
+        registration must not enter the idle pool twice (double-lease)."""
         with self._idle_cv:
             w = self._workers.get(worker_id)
             if w is None:
                 return False
+            if w.ready.is_set():
+                return True  # duplicate (retry after lost ack)
             w.address = address
             w.ready.set()
             self._spawning = max(0, self._spawning - 1)
-            w.idle_since = time.monotonic()
-            self._idle.append(w)
+            self._hand_worker(w)
+            # Demand still outstrips supply: keep the spawn pipeline full.
+            if (self._worker_waiters
+                    and self._spawning < self._max_concurrent_spawns):
+                self._spawning += 1
+                self._spawn_worker()
             self._idle_cv.notify_all()
         return True
 
     def _pop_worker(self, timeout: float) -> Optional[WorkerProc]:
-        """Claim an idle worker, spawning more (bounded concurrency — worker
-        startup is CPU-heavy) while demand outstrips the pool."""
-        deadline = time.monotonic() + timeout
+        """Claim an idle worker FIFO-fairly, spawning more (bounded
+        concurrency — worker startup is CPU-heavy) while demand outstrips
+        the pool."""
+        ev = threading.Event()
+        slot: List[Optional[WorkerProc]] = [None]
         with self._idle_cv:
-            while True:
-                if self._idle:
-                    return self._idle.pop()
-                if self._spawning < self._max_concurrent_spawns:
-                    self._spawning += 1
-                    self._spawn_worker()
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return None
-                self._idle_cv.wait(min(remaining, 0.25))
+            if self._idle and not self._worker_waiters:
+                return self._idle.pop()
+            self._worker_waiters.append((ev, slot))
+            if self._spawning < self._max_concurrent_spawns:
+                self._spawning += 1
+                self._spawn_worker()
+        if ev.wait(timeout):
+            return slot[0]
+        with self._idle_cv:
+            try:
+                self._worker_waiters.remove((ev, slot))
+            except ValueError:
+                pass  # handed a worker concurrently with our timeout
+            return slot[0]
+
+    def _hand_worker(self, w: WorkerProc) -> None:
+        """Give an available worker to the oldest waiter, else idle it.
+        Caller must hold the lock."""
+        while self._worker_waiters:
+            ev, slot = self._worker_waiters.popleft()
+            slot[0] = w
+            ev.set()
+            return
+        w.idle_since = time.monotonic()
+        self._idle.append(w)
 
     # ------------------------------------------------------------ leases
 
@@ -300,8 +349,41 @@ class NodeManager:
     @blocking_rpc
     def rpc_request_lease(self, conn, resources: Dict[str, float],
                           wait_ready: bool = True,
-                          pg: Optional[Tuple[bytes, int]] = None):
-        """Returns (worker_addr, lease_id) or None if infeasible (spillback)."""
+                          pg: Optional[Tuple[bytes, int]] = None,
+                          req_id: Optional[str] = None):
+        """Returns (worker_addr, lease_id) or None if infeasible (spillback).
+        `req_id` makes retries idempotent: the memo is CLAIMED before the
+        (slow) worker pop, so a retry arriving mid-flight waits for the
+        original outcome instead of double-acquiring resources."""
+        entry = None
+        am_owner = True
+        if req_id is not None:
+            with self._lock:
+                entry = self._lease_grants.get(req_id)
+                if entry is None:
+                    entry = self._lease_grants[req_id] = [threading.Event(),
+                                                          None]
+                    self._lease_grant_order.append(req_id)
+                    while len(self._lease_grant_order) > 4096:
+                        old = self._lease_grant_order.popleft()
+                        self._lease_grants.pop(old, None)
+                else:
+                    am_owner = False
+            if not am_owner:
+                # Duplicate (retry) racing the original: wait for ITS result.
+                entry[0].wait(cfg.lease_timeout_ms / 1000.0 + 5)
+                return entry[1]
+        grant = None
+        try:
+            grant = self._do_request_lease(resources, pg)
+        finally:
+            if entry is not None:
+                entry[1] = grant
+                entry[0].set()
+        return grant
+
+    def _do_request_lease(self, resources: Dict[str, float],
+                          pg: Optional[Tuple[bytes, int]]):
         with self._lock:
             if not self._try_acquire(resources, pg):
                 return None
@@ -323,13 +405,51 @@ class NodeManager:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 return False
-            self._release_resources(lease)
+            if lease.blocked == 0:  # blocked leases already released
+                self._release_resources(lease)
             w = lease.worker
             w.lease_id = None
             if (w.worker_id in self._workers and not w.is_actor_host
                     and w.proc.poll() is None):
-                w.idle_since = time.monotonic()
-                self._idle.append(w)
+                self._hand_worker(w)
+        return True
+
+    def _lease_for_worker_addr(self, addr: str) -> Optional[Lease]:
+        for l in self._leases.values():
+            if l.worker is not None and l.worker.address == addr:
+                return l
+        return None
+
+    def rpc_worker_blocked(self, conn, worker_addr: str):
+        """The leased worker entered a blocking get()/wait(): return its
+        resources to the pool so nested work can schedule here."""
+        with self._lock:
+            lease = self._lease_for_worker_addr(worker_addr)
+            if lease is None:
+                return False
+            lease.blocked += 1
+            if lease.blocked == 1:
+                self._release_resources(lease)
+        return True
+
+    def rpc_worker_unblocked(self, conn, worker_addr: str):
+        """Blocking call finished: re-debit (may transiently oversubscribe —
+        self-corrects when the lease is returned)."""
+        with self._lock:
+            lease = self._lease_for_worker_addr(worker_addr)
+            if lease is None:
+                return False
+            if lease.blocked == 0:
+                # The matching worker_blocked notify was lost: nothing was
+                # credited, so debiting here would leak capacity for good.
+                return True
+            lease.blocked -= 1
+            if lease.blocked == 0:
+                pool = (self._bundle_avail.get(lease.pg)
+                        if lease.pg is not None else self.available)
+                if pool is not None:
+                    for k, v in lease.resources.items():
+                        pool[k] = pool.get(k, 0) - v
         return True
 
     def rpc_mark_actor_host(self, conn, lease_id: str):
@@ -346,6 +466,8 @@ class NodeManager:
     def rpc_reserve_bundle(self, conn, pg_id: bytes, idx: int,
                            bundle: Dict[str, float]):
         with self._lock:
+            if (pg_id, idx) in self._bundles:
+                return True  # idempotent: retried reservation
             if not all(self.available.get(k, 0) >= v
                        for k, v in bundle.items() if v > 0):
                 return False

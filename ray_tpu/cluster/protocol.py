@@ -268,9 +268,14 @@ class RpcClient:
             except Exception:
                 pass
 
-    def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
+    def call_async(self, method: str, *args) -> "_Waiter":
+        """Fire a request and return its waiter without blocking: callers
+        pipeline many requests then collect acks (the dispatcher's push path
+        needs in-flight depth without one thread per push)."""
         rid = next(self._req_counter)
         waiter = _Waiter()
+        waiter._rid = rid
+        waiter._client = self
         with self._pending_lock:
             if self._closed:
                 raise ConnectionLost(self.address)
@@ -282,12 +287,16 @@ class RpcClient:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise ConnectionLost(f"{self.address}: {e}") from e
+        return waiter
+
+    def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
+        waiter = self.call_async(method, *args)
         try:
             return waiter.wait(timeout)
         except TimeoutError:
             # Drop the stale waiter so a late reply doesn't pile up state.
             with self._pending_lock:
-                self._pending.pop(rid, None)
+                self._pending.pop(waiter._rid, None)
             raise
 
     def notify(self, method: str, *args) -> None:
@@ -344,13 +353,15 @@ class RpcClient:
 
 
 class _Waiter:
-    __slots__ = ("_event", "_ok", "_result", "_exc")
+    __slots__ = ("_event", "_ok", "_result", "_exc", "_rid", "_client")
 
     def __init__(self):
         self._event = threading.Event()
         self._ok = None
         self._result = None
         self._exc = None
+        self._rid = 0
+        self._client = None
 
     def set(self, ok: bool, result: Any) -> None:
         self._ok, self._result = ok, result

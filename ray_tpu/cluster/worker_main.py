@@ -35,6 +35,19 @@ from ray_tpu.cluster.protocol import ClientPool
 from ray_tpu.exceptions import ActorDiedError, RayTpuError, TaskError
 
 
+class _OrderState:
+    """Per-(actor, submitter) in-order delivery: buffers out-of-order seqs
+    (a chaos-dropped push retried late must not execute after its successor)
+    and dedups retries. Parity: the reference's ActorSchedulingQueue +
+    sequence_no/client_processed_up_to (task_receiver.cc:36)."""
+
+    __slots__ = ("expected", "buf")
+
+    def __init__(self):
+        self.expected: Optional[int] = None
+        self.buf: Dict[int, Any] = {}
+
+
 class _HostedActor:
     def __init__(self, actor_id: ActorID, instance: Any, max_concurrency: int,
                  is_async: bool):
@@ -47,8 +60,8 @@ class _HostedActor:
             max_workers=max_concurrency,
             thread_name_prefix=f"actor-{actor_id.hex()[:8]}")
         self.loop = None
-        self.next_seq = 0
-        self.seq_cond = threading.Condition()
+        self.order: Dict[str, _OrderState] = {}  # owner_addr -> state
+        self.order_lock = threading.Lock()
         self.dead = False
 
 
@@ -64,16 +77,34 @@ class WorkerRuntime(ClusterCore):
         self._hosted: Dict[ActorID, _HostedActor] = {}
         self._hosted_lock = threading.Lock()
         self._owner_pool = ClientPool()
+        # Dedup for retried pushes (the submitter retries an unacked push;
+        # at-least-once delivery + this set = exactly-once execution here).
+        import collections
+
+        self._seen_tasks: set = set()
+        self._seen_order = collections.deque()
+        self._seen_lock = threading.Lock()
         # The runtime must be installed BEFORE registration: a lease can
         # arrive (and a task execute) the instant the node manager sees us.
         runtime_context.set_runtime(self)
-        self.node.call("register_worker", worker_id_hex, self.owner_addr,
-                       timeout=10)
+        self.node.retrying_call("register_worker", worker_id_hex,
+                                self.owner_addr, timeout=10)
+
+    def _seen_before(self, task_id_bytes: bytes) -> bool:
+        with self._seen_lock:
+            if task_id_bytes in self._seen_tasks:
+                return True
+            self._seen_tasks.add(task_id_bytes)
+            self._seen_order.append(task_id_bytes)
+            if len(self._seen_order) > 20_000:
+                self._seen_tasks.discard(self._seen_order.popleft())
+            return False
 
     # ---------------------------------------------------------------- tasks
 
-    def rpc_push_task(self, conn, spec_blob: bytes):
-        self._exec_pool.submit(self._execute_task, spec_blob)
+    def rpc_push_task(self, conn, task_id_bytes: bytes, spec_blob: bytes):
+        if not self._seen_before(task_id_bytes):
+            self._exec_pool.submit(self._execute_task, spec_blob)
         return True
 
     def _execute_task(self, spec_blob: bytes) -> None:
@@ -157,13 +188,18 @@ class WorkerRuntime(ClusterCore):
                     self._put_plasma(oid, header, buffers)
                     results.append((oid.binary(), "in_store", None))
         try:
+            # Acked + retried: a chaos-dropped completion would otherwise
+            # leave the owner waiting forever. Owner-side handlers are
+            # idempotent (memory-store puts are first-write-wins, inflight
+            # pop guards the lease decrement).
             client = self._owner_pool.get(owner)
             if actor_ctx is not None:
                 actor_id_bytes, seq = actor_ctx
-                client.notify("actor_call_done", actor_id_bytes, seq,
-                              task_id.binary(), results)
+                client.retrying_call("actor_call_done", actor_id_bytes, seq,
+                                     task_id.binary(), results, timeout=10)
             else:
-                client.notify("task_done", task_id.binary(), results)
+                client.retrying_call("task_done", task_id.binary(), results,
+                                     timeout=10)
         except Exception:
             # Owner gone: results are orphaned; large ones stay in the store
             # until the owner's death GC reclaims them (best effort round 1).
@@ -176,9 +212,33 @@ class WorkerRuntime(ClusterCore):
     @_brpc
     def rpc_create_actor(self, conn, actor_id_bytes: bytes, spec_blob: bytes,
                          lease_id: str):
-        """Synchronous creation (head waits): instantiate + take over."""
-        spec = SERIALIZER.decode(spec_blob)
+        """Synchronous creation (head waits): instantiate + take over.
+        Idempotent: a retried creation (lost ack OR a retry racing a slow
+        __init__) must not re-run __init__."""
         actor_id = ActorID(actor_id_bytes)
+        with self._hosted_lock:
+            if actor_id in self._hosted:
+                return True
+            if not hasattr(self, "_creating_actors"):
+                self._creating_actors = {}
+            ev = self._creating_actors.get(actor_id)
+            am_creator = ev is None
+            if am_creator:
+                ev = self._creating_actors[actor_id] = threading.Event()
+        if not am_creator:
+            ev.wait(600)
+            with self._hosted_lock:
+                return actor_id in self._hosted
+        try:
+            return self._create_actor_inner(actor_id, spec_blob, lease_id)
+        finally:
+            ev.set()
+            with self._hosted_lock:
+                self._creating_actors.pop(actor_id, None)
+
+    def _create_actor_inner(self, actor_id: ActorID, spec_blob: bytes,
+                            lease_id: str):
+        spec = SERIALIZER.decode(spec_blob)
         cls = spec["cls"]
         is_async = any(inspect.iscoroutinefunction(m)
                        for _, m in inspect.getmembers(
@@ -199,7 +259,7 @@ class WorkerRuntime(ClusterCore):
             self._start_actor_loop(hosted)
         with self._hosted_lock:
             self._hosted[actor_id] = hosted
-        self.node.notify("mark_actor_host", lease_id)
+        self.node.retrying_call("mark_actor_host", lease_id, timeout=5)
         return True
 
     def _start_actor_loop(self, hosted: _HostedActor) -> None:
@@ -218,7 +278,13 @@ class WorkerRuntime(ClusterCore):
                          name=f"actor-loop-{hosted.actor_id.hex()[:8]}").start()
         ready.wait()
 
-    def rpc_push_actor_task(self, conn, blob: bytes, seq: int):
+    def rpc_push_actor_task(self, conn, blob: bytes, seq: int,
+                            min_pending: int = 0):
+        """At-least-once delivery in: dedup + per-submitter seq buffering
+        out. `min_pending` is the submitter's smallest still-pending seq —
+        everything below it was completed or failed elsewhere, so the
+        expected-seq horizon starts there (a fresh incarnation never waits
+        for seqs that predate it)."""
         spec = SERIALIZER.decode(blob)
         actor_id = ActorID(spec["actor_id"])
         with self._hosted_lock:
@@ -232,7 +298,28 @@ class WorkerRuntime(ClusterCore):
                                                     "hosted here"),
                                actor_ctx=(spec["actor_id"], seq))
             return True
-        hosted.pool.submit(self._execute_actor_task, hosted, spec, seq)
+        with hosted.order_lock:
+            st = hosted.order.get(owner)
+            if st is None:
+                st = hosted.order[owner] = _OrderState()
+            if st.expected is None:
+                st.expected = min_pending
+            else:
+                st.expected = max(st.expected, min_pending)
+            # Seqs below the horizon were completed/failed at the submitter:
+            # drop any stale buffered ones so the scan below can't stall.
+            for s in [s for s in st.buf if s < st.expected]:
+                del st.buf[s]
+            if seq < st.expected or seq in st.buf:
+                return True  # duplicate of an executed/buffered push
+            st.buf[seq] = spec
+            runnable = []
+            while st.expected in st.buf:
+                s = st.expected
+                runnable.append((st.buf.pop(s), s))
+                st.expected += 1
+        for sp, s in runnable:
+            hosted.pool.submit(self._execute_actor_task, hosted, sp, s)
         return True
 
     def _execute_actor_task(self, hosted: _HostedActor, spec: Dict, seq: int) -> None:

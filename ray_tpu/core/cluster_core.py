@@ -109,8 +109,8 @@ class _ActorConn:
     drains the outbound queue in seq order over a single TCP connection —
     frame order on the socket IS execution-submission order on the worker."""
 
-    __slots__ = ("actor_id", "address", "next_seq", "outbound", "pending",
-                 "lock", "sender_running", "dead", "death_reason")
+    __slots__ = ("actor_id", "address", "next_seq", "outbound", "unacked",
+                 "pending", "lock", "sender_running", "dead", "death_reason")
 
     def __init__(self, actor_id: ActorID):
         import collections
@@ -119,11 +119,18 @@ class _ActorConn:
         self.address: Optional[str] = None
         self.next_seq = 0
         self.outbound = collections.deque()  # (seq, task_id_bytes, blob, rids)
+        self.unacked = collections.deque()   # [seq, tid, blob, waiter, tries, deadline]
         self.pending: Dict[int, tuple] = {}  # seq -> (tid, blob, return_ids)
         self.lock = threading.Lock()
         self.sender_running = False
         self.dead = False
         self.death_reason = ""
+
+    def min_pending(self) -> int:
+        """Smallest seq still awaiting completion — the ordered-execution
+        horizon shipped with every push (see worker _OrderState)."""
+        with self.lock:
+            return min(self.pending) if self.pending else self.next_seq
 
 
 class ClusterCore:
@@ -162,11 +169,48 @@ class ClusterCore:
         self._pgs: Dict[PlacementGroupID, PlacementGroupSpec] = {}
         self._cancelled: set = set()
         self._shutdown_flag = False
+        # Push-ack tracking: every push_task is an acked call collected off
+        # the dispatch hot path; unacked pushes are retried (worker-side
+        # task-id dedup makes retries exactly-once per worker).
+        import collections
+
+        self._push_acks = collections.deque()
+        self._push_ack_event = threading.Event()
+        threading.Thread(target=self._push_ack_loop, daemon=True,
+                         name="push-acks").start()
         self._lease_reaper = threading.Thread(
             target=self._lease_reaper_loop, daemon=True, name="lease-reaper")
         self._lease_reaper.start()
 
     # ------------------------------------------------------------------ refs
+
+    def _blocked_scope(self):
+        """Context manager: while a WORKER task blocks in get()/wait(), its
+        lease's resources are handed back to the node so nested tasks can
+        schedule (reference: CoreWorker's NotifyDirectCallTaskBlocked —
+        without it, N blocked parents over N CPUs deadlock their children).
+        No-op on drivers and outside task context."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            active = (not self.is_driver and runtime_context
+                      .current_worker_context().get("task_id") is not None)
+            if active:
+                try:
+                    self.node.notify("worker_blocked", self.owner_addr)
+                except Exception:
+                    active = False
+            try:
+                yield
+            finally:
+                if active:
+                    try:
+                        self.node.notify("worker_unblocked", self.owner_addr)
+                    except Exception:
+                        pass
+
+        return scope()
 
     def resolve_record(self, rec) -> Any:
         if rec.is_exception:
@@ -232,13 +276,34 @@ class ClusterCore:
     def _read_plasma(self, oid: ObjectID, timeout: Optional[float]) -> Any:
         buf = self.store.get(oid, timeout_ms=0)
         if buf is None:
-            # Not local: ask the node manager to pull it here.
-            t_ms = int((timeout or 600.0) * 1000)
-            ok = self.node.call("pull_object", oid.binary(), t_ms,
-                                timeout=(timeout or 600.0) + 5)
+            # Not local: ask the node manager to pull it here. Short pull
+            # rounds (idempotent) rather than one long blocking RPC, so a
+            # chaos-dropped request costs seconds, not the whole timeout.
+            deadline = time.monotonic() + (timeout if timeout is not None
+                                           else 600.0)
+            ok = False
+            with self._blocked_scope():
+                while not ok and time.monotonic() < deadline:
+                    try:
+                        ok = bool(self.node.call("pull_object", oid.binary(),
+                                                 5000, timeout=8))
+                    except ConnectionLost:
+                        # Dead socket fails instantly — back off + reconnect
+                        # or this loop becomes a hot spin for the full
+                        # deadline.
+                        time.sleep(0.2)
+                        try:
+                            self.node.reconnect()
+                        except OSError:
+                            pass
+                        ok = False
+                    except TimeoutError:
+                        ok = False
+                    if not ok and self.store.contains(oid):
+                        ok = True
             if not ok:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
-            buf = self.store.get(oid, timeout_ms=t_ms)
+            buf = self.store.get(oid, timeout_ms=5000)
             if buf is None:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
         # Zero-copy decode: views are taken over memoryview(buf), whose
@@ -266,27 +331,47 @@ class ClusterCore:
         oid = ref.id()
         owner = ref.owner_address
         if owner is None or owner == self.owner_addr:
-            recs = self.memory_store.get([oid], timeout)
+            if self.memory_store.contains(oid):  # fast path: no RPCs
+                recs = self.memory_store.get([oid], 0)
+            else:
+                with self._blocked_scope():
+                    recs = self.memory_store.get([oid], timeout)
             return self.resolve_record(recs[0])
         # Borrowed ref: if the bytes are already in the local shm store (or
-        # pullable), prefer that; else ask the owner.
+        # pullable), prefer that; else ask the owner. Short poll rounds: a
+        # chaos-dropped request/reply is retried instead of failing the get.
         if self.store.contains(oid):
             return self._read_plasma(oid, timeout)
-        t = timeout if timeout is not None else 600.0
-        try:
-            kind, payload = self._pool.get(owner).call(
-                "get_object", oid.binary(), t, timeout=t + 5)
-        except ConnectionLost:
-            raise WorkerCrashedError(
-                f"owner of {oid.hex()} died") from None
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._blocked_scope():
+            return self._get_borrowed(ref, oid, owner, deadline, timeout)
+
+    def _get_borrowed(self, ref: ObjectRef, oid: ObjectID, owner: str,
+                      deadline: Optional[float],
+                      timeout: Optional[float]) -> Any:
+        while True:
+            t = 10.0 if deadline is None else min(
+                10.0, deadline - time.monotonic())
+            if t <= 0:
+                raise GetTimeoutError(f"timed out waiting for {oid.hex()}")
+            try:
+                kind, payload = self._pool.get(owner).call(
+                    "get_object", oid.binary(), t, timeout=t + 5)
+            except ConnectionLost:
+                raise WorkerCrashedError(
+                    f"owner of {oid.hex()} died") from None
+            except TimeoutError:
+                continue  # dropped in transit; owner-side get is idempotent
+            if kind == "timeout":
+                continue  # not ready yet; loop until our own deadline
+            break
         if kind == "value":
             return SERIALIZER.decode(payload)
         if kind == "error":
             raise payload
         if kind == "in_store":
             return self._read_plasma(oid, timeout)
-        if kind == "timeout":
-            raise GetTimeoutError(f"timed out waiting for {oid.hex()}")
         raise RuntimeError(f"unexpected get_object reply {kind}")
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
@@ -327,7 +412,7 @@ class ClusterCore:
                 args=(owner, oids, deadline, mark, lambda: waiting),
                 daemon=True, name="wait-remote").start()
         try:
-            with cv:
+            with self._blocked_scope(), cv:
                 while len(ready_ids) < num_returns:
                     remaining = (None if deadline is None
                                  else deadline - time.monotonic())
@@ -600,13 +685,74 @@ class ClusterCore:
         try:
             worker = self._pool.get(lease.worker_addr,
                                     on_close=self._on_worker_conn_lost)
-            worker.notify("push_task", info.spec_blob)
+            waiter = worker.call_async("push_task", task_id_bytes,
+                                       info.spec_blob)
+            self._push_acks.append(
+                [waiter, task_id_bytes, info, lease, kq, 0,
+                 time.monotonic() + 10.0])
+            self._push_ack_event.set()
         except BaseException:
             with self._inflight_lock:
                 self._inflight.pop(task_id_bytes, None)
             lease.broken = True
             with self._lease_lock:
                 kq.queue.appendleft((task_id_bytes, info))
+
+    def _push_ack_loop(self) -> None:
+        """Collects push acks asynchronously (pipelining stays intact) and
+        retries unacked pushes: an ack or request lost to chaos must not
+        strand the task."""
+        import collections
+
+        while not self._shutdown_flag:
+            try:
+                if not self._push_acks:
+                    self._push_ack_event.wait(0.2)
+                    self._push_ack_event.clear()
+                    continue
+                entry = self._push_acks.popleft()
+                waiter, tid, info, lease, kq, attempts, deadline = entry
+                if not waiter._event.is_set():
+                    if time.monotonic() < deadline:
+                        self._push_acks.append(entry)
+                        # Snapshot: dispatchers append concurrently, and
+                        # iterating the live deque would raise and kill this
+                        # thread (stranding every future unacked push).
+                        if all(not e[0]._event.is_set()
+                               for e in list(self._push_acks)):
+                            time.sleep(0.01)
+                        continue
+                    self._retry_push(entry)
+                    continue
+                try:
+                    waiter.wait(0)
+                except BaseException:
+                    self._retry_push(entry)
+            except BaseException:  # noqa: BLE001 — ack loop must survive
+                time.sleep(0.05)
+
+    def _retry_push(self, entry) -> None:
+        waiter, tid, info, lease, kq, attempts, deadline = entry
+        with self._inflight_lock:
+            if tid not in self._inflight:
+                return  # completed or already handled by conn-loss hook
+        if attempts < 3 and not lease.broken:
+            try:
+                worker = self._pool.get(lease.worker_addr,
+                                        on_close=self._on_worker_conn_lost)
+                w2 = worker.call_async("push_task", tid, info.spec_blob)
+                self._push_acks.append(
+                    [w2, tid, info, lease, kq, attempts + 1,
+                     time.monotonic() + 10.0])
+                return
+            except BaseException:
+                pass
+        # Give up on this worker: re-route through the queue.
+        with self._inflight_lock:
+            if self._inflight.pop(tid, None) is None:
+                return
+        lease.broken = True
+        self._enqueue_task(tid, info)
 
     def _fail_queued(self, kq: "_KeyQueue", exc: Exception) -> None:
         err = capture_exception(exc)
@@ -619,12 +765,14 @@ class ClusterCore:
 
     def _request_new_lease(self, resources: Dict[str, float],
                            strategy) -> Optional[_Lease]:
-        """One head pick + node lease round trip; None if infeasible now."""
+        """One head pick + node lease round trip; None if infeasible now.
+        Both RPCs are retry-safe: pick_node is read-only, request_lease is
+        idempotent via the per-attempt req_id (the node caches the grant)."""
         exclude: List[str] = []
         for _ in range(4):  # a few spillback hops per attempt
             try:
-                picked = self.head.call("pick_node", resources, strategy,
-                                        exclude, timeout=10)
+                picked = self.head.retrying_call(
+                    "pick_node", resources, strategy, exclude, timeout=10)
             except (ConnectionLost, TimeoutError):
                 return None
             if picked is None:
@@ -635,9 +783,10 @@ class ClusterCore:
                 pg = (strategy["pg_id"], strategy.get("bundle_index", -1))
                 if pg[1] < 0:
                     pg = None
+            req_id = uuid.uuid4().hex
             try:
-                granted = self._pool.get(node_addr).call(
-                    "request_lease", resources, True, pg,
+                granted = self._pool.get(node_addr).retrying_call(
+                    "request_lease", resources, True, pg, req_id,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 5)
             except (ConnectionLost, TimeoutError):
                 exclude.append(node_id)
@@ -722,8 +871,10 @@ class ClusterCore:
             for l in to_release:
                 if not l.broken:
                     try:
-                        self._pool.get(l.node_addr).notify(
-                            "return_lease", l.lease_id)
+                        # Acked + retried: a lost return would leak the
+                        # lease's resources on the node forever.
+                        self._pool.get(l.node_addr).retrying_call(
+                            "return_lease", l.lease_id, timeout=5)
                     except Exception:
                         pass
 
@@ -747,10 +898,10 @@ class ClusterCore:
             "max_concurrency": max_concurrency,
             "owner_addr": self.owner_addr,
         })
-        status, existing = self.head.call(
+        status, existing = self.head.retrying_call(
             "register_actor", actor_id.binary(), name, namespace, spec_blob,
             max_restarts, resources, get_if_exists,
-            _strategy_dict(scheduling_strategy), timeout=None)
+            _strategy_dict(scheduling_strategy), timeout=120)
         if status == "exists":
             return ActorID(existing)
         self._actor_classes[actor_id] = cls
@@ -768,16 +919,30 @@ class ClusterCore:
                                timeout: float = 60.0) -> Optional[str]:
         if conn.address is not None:
             return conn.address
-        state, payload = self.head.call("wait_actor_address",
-                                        conn.actor_id.binary(), timeout,
-                                        timeout=timeout + 5)
-        if state == "ALIVE":
-            conn.address = payload
-            return payload
-        if state == "DEAD":
-            conn.dead = True
-            conn.death_reason = payload
-            return None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            # Short long-poll rounds (read-only, retry-safe under chaos).
+            try:
+                state, payload = self.head.call(
+                    "wait_actor_address", conn.actor_id.binary(), 10.0,
+                    timeout=15)
+            except ConnectionLost:
+                time.sleep(0.2)  # dead socket fails instantly: no hot spin
+                try:
+                    self.head.reconnect()
+                except OSError:
+                    pass
+                continue
+            except TimeoutError:
+                continue
+            if state == "ALIVE":
+                conn.address = payload
+                return payload
+            if state == "DEAD":
+                conn.dead = True
+                conn.death_reason = payload
+                return None
+            # PENDING: keep waiting until our own deadline.
         return None
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
@@ -822,45 +987,88 @@ class ClusterCore:
         return refs
 
     def _actor_sender_loop(self, conn: _ActorConn) -> None:
-        """Single per-actor sender: resolves the address once, then pushes
-        queued calls in seq order over one pooled connection. Any failure
-        fails THAT call and moves on — the sender thread itself must never
-        die with sender_running stuck True (that would wedge the actor)."""
+        """Single per-actor sender: pushes queued calls in seq order
+        (pipelined, acked) over one pooled connection, then services unacked
+        pushes — an ack lost to chaos is retried (the worker dedups and
+        re-orders via the min_pending horizon). Any failure fails THAT call
+        and moves on — the sender thread itself must never die with
+        sender_running stuck True (that would wedge the actor)."""
         while True:
             with conn.lock:
-                if not conn.outbound:
+                if not conn.outbound and not conn.unacked:
                     conn.sender_running = False
                     return
-                seq, task_id_bytes, blob, return_ids = conn.outbound.popleft()
-                # A conn-loss handler may have failed this seq while it was
+                item = conn.outbound.popleft() if conn.outbound else None
+                # A conn-loss handler may have failed a seq while it was
                 # still queued (actor died/restarted before we sent it):
                 # failed-then-executed would duplicate side effects on the
                 # new incarnation, so never send a seq no longer pending.
-                if seq not in conn.pending:
+                if item is not None and item[0] not in conn.pending:
                     continue
             try:
-                if conn.dead:
-                    self._fail_actor_call(conn, seq)
+                if item is not None:
+                    self._send_actor_push(conn, item[0], item[1], item[2], 0)
+                    # Opportunistically reap acked heads to bound unacked.
+                    while conn.unacked and conn.unacked[0][3]._event.is_set():
+                        self._settle_actor_ack(conn, conn.unacked.popleft())
                     continue
-                try:
-                    addr = self._resolve_actor_address(conn)
-                except Exception:
-                    addr = None
-                if addr is None:
-                    self._fail_actor_call(conn, seq)
-                    continue
-                with self._inflight_lock:
-                    self._inflight[task_id_bytes] = _InflightTask(
-                        blob, return_ids, addr, 0, ("actor", conn.actor_id),
-                        {}, None, "actor_task")
-                try:
-                    self._pool.get(
-                        addr, on_close=self._on_worker_conn_lost).notify(
-                            "push_actor_task", blob, seq)
-                except (ConnectionLost, OSError):
-                    self._handle_actor_conn_lost(conn)
+                entry = conn.unacked[0]
+                if entry[3]._event.wait(0.05):
+                    conn.unacked.popleft()
+                    self._settle_actor_ack(conn, entry)
+                elif time.monotonic() > entry[5]:
+                    conn.unacked.popleft()
+                    self._resend_actor_push(conn, entry)
             except BaseException:  # noqa: BLE001 — keep the sender alive
-                self._fail_actor_call(conn, seq)
+                if item is not None:
+                    self._fail_actor_call(conn, item[0])
+
+    def _send_actor_push(self, conn: _ActorConn, seq: int, task_id_bytes,
+                         blob, tries: int) -> None:
+        if conn.dead:
+            self._fail_actor_call(conn, seq)
+            return
+        try:
+            addr = self._resolve_actor_address(conn)
+        except Exception:
+            addr = None
+        if addr is None:
+            self._fail_actor_call(conn, seq)
+            return
+        with conn.lock:
+            entry = conn.pending.get(seq)
+        if entry is None:
+            return
+        with self._inflight_lock:
+            self._inflight[task_id_bytes] = _InflightTask(
+                blob, entry[2], addr, 0, ("actor", conn.actor_id),
+                {}, None, "actor_task")
+        try:
+            waiter = self._pool.get(
+                addr, on_close=self._on_worker_conn_lost).call_async(
+                    "push_actor_task", blob, seq, conn.min_pending())
+            conn.unacked.append(
+                [seq, task_id_bytes, blob, waiter, tries,
+                 time.monotonic() + 10.0])
+        except (ConnectionLost, OSError):
+            self._handle_actor_conn_lost(conn)
+
+    def _settle_actor_ack(self, conn: _ActorConn, entry) -> None:
+        try:
+            entry[3].wait(0)
+        except BaseException:
+            self._resend_actor_push(conn, entry)
+
+    def _resend_actor_push(self, conn: _ActorConn, entry) -> None:
+        seq, task_id_bytes, blob, _, tries, _ = entry
+        with conn.lock:
+            still_pending = seq in conn.pending
+        if not still_pending:
+            return
+        if tries >= 4:
+            self._fail_actor_call(conn, seq)
+            return
+        self._send_actor_push(conn, seq, task_id_bytes, blob, tries + 1)
 
     def _fail_actor_call(self, conn: _ActorConn, seq: int) -> None:
         with conn.lock:
@@ -889,8 +1097,8 @@ class ClusterCore:
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline:
             try:
-                info = self.head.call("get_actor_info",
-                                      conn.actor_id.binary(), timeout=10)
+                info = self.head.retrying_call("get_actor_info",
+                                               conn.actor_id.binary(), timeout=10)
             except Exception:
                 time.sleep(0.5)
                 continue
@@ -927,7 +1135,7 @@ class ClusterCore:
             self._fail_actor_call(conn, seq)
 
     def get_actor(self, name: str, namespace: str = "default") -> ActorID:
-        found = self.head.call("get_named_actor", name, namespace, timeout=10)
+        found = self.head.retrying_call("get_named_actor", name, namespace, timeout=10)
         if found is None:
             raise ValueError(f"no actor named '{name}' in namespace "
                              f"'{namespace}'")
@@ -942,8 +1150,8 @@ class ClusterCore:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         try:
-            self.head.call("kill_actor", actor_id.binary(), no_restart,
-                           timeout=10)
+            self.head.retrying_call("kill_actor", actor_id.binary(), no_restart,
+                                     timeout=10)
         except Exception:
             pass
         conn = self._actor_conn(actor_id)
@@ -956,38 +1164,42 @@ class ClusterCore:
             self._fail_actor_call(conn, seq)
 
     def list_actors(self):
-        return self.head.call("list_actors", timeout=10)
+        return self.head.retrying_call("list_actors", timeout=10)
 
     # ------------------------------------------------------------------ pgs
 
     def create_placement_group(self, spec: PlacementGroupSpec) -> None:
-        self.head.call("create_pg", spec.pg_id.binary(),
-                       [b.resources.to_dict() for b in spec.bundles],
-                       spec.strategy, spec.name, timeout=30)
+        ok = self.head.retrying_call(
+            "create_pg", spec.pg_id.binary(),
+            [b.resources.to_dict() for b in spec.bundles],
+            spec.strategy, spec.name, timeout=30)
+        if not ok:
+            raise RuntimeError(
+                f"placement group creation failed: {spec.strategy}")
         self._pgs[spec.pg_id] = spec
 
     def placement_group_ready(self, pg_id: PlacementGroupID,
                               timeout=None) -> bool:
-        return bool(self.head.call("pg_ready", pg_id.binary(), timeout=10))
+        return bool(self.head.retrying_call("pg_ready", pg_id.binary(), timeout=10))
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
-        self.head.call("remove_pg", pg_id.binary(), timeout=10)
+        self.head.retrying_call("remove_pg", pg_id.binary(), timeout=10)
         self._pgs.pop(pg_id, None)
 
     def placement_group_table(self):
-        return self.head.call("pg_table", timeout=10)
+        return self.head.retrying_call("pg_table", timeout=10)
 
     # ------------------------------------------------------------------ misc
 
     def nodes(self):
-        return self.head.call("list_nodes", timeout=10)
+        return self.head.retrying_call("list_nodes", timeout=10)
 
     def cluster_resources(self) -> Dict[str, float]:
-        total, _ = self.head.call("cluster_resources", timeout=10)
+        total, _ = self.head.retrying_call("cluster_resources", timeout=10)
         return total
 
     def available_resources(self) -> Dict[str, float]:
-        _, avail = self.head.call("cluster_resources", timeout=10)
+        _, avail = self.head.retrying_call("cluster_resources", timeout=10)
         return avail
 
     def shutdown(self) -> None:

@@ -140,7 +140,7 @@ class ClusterRuntime(ClusterCore):
 
         super().__init__(head_addr, node.address, node.node_id,
                          node.store_name, JobID.from_int(1), is_driver=True)
-        job_int = self.head.call("new_job_id", timeout=10)
+        job_int = self.head.retrying_call("new_job_id", timeout=10)
         self.job_id = JobID.from_int(job_int)
         atexit.register(self.shutdown)
 

@@ -1,0 +1,122 @@
+"""Load + fault-injection tests for the cluster plane.
+
+Parity model: the reference's stress suites and RPC chaos flag
+(reference: release/nightly_tests/stress_tests/, src/ray/rpc/rpc_chaos.h,
+python/ray/_private/test_utils.py:1512 killer actors): the runtime must stay
+correct when RPCs are randomly dropped and when load far exceeds worker
+count.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+
+@pytest.fixture()
+def fresh_cluster():
+    rt = ray_tpu.init(num_cpus=4, object_store_memory=128 << 20)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_stress_many_tasks_with_nesting(fresh_cluster):
+    """500 tasks over 4 CPUs, a quarter of them submitting nested tasks."""
+
+    @ray_tpu.remote
+    def leaf(i):
+        return i * 2
+
+    @ray_tpu.remote
+    def mid(i):
+        if i % 4 == 0:
+            return ray_tpu.get(leaf.remote(i), timeout=60)
+        return i * 2
+
+    refs = [mid.remote(i) for i in range(500)]
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == [i * 2 for i in range(500)]
+
+
+def test_stress_actor_call_storm(fresh_cluster):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, k):
+            self.total += k
+            return self.total
+
+        def total_(self):
+            return self.total
+
+    actors = [Acc.remote() for _ in range(4)]
+    refs = [a.add.remote(1) for _ in range(250) for a in actors]
+    ray_tpu.get(refs, timeout=120)
+    totals = ray_tpu.get([a.total_.remote() for a in actors], timeout=60)
+    assert totals == [250] * 4
+
+
+class TestChaos:
+    """Every control RPC has a 5% chance of being dropped (request or
+    reply); the retry/idempotency layer must still produce exact results."""
+
+    @pytest.fixture()
+    def chaos_cluster(self):
+        os.environ["RTPU_RPC_CHAOS_FAILURE_PROB"] = "0.05"
+        cfg.set("rpc_chaos_failure_prob", 0.05)
+        try:
+            rt = ray_tpu.init(num_cpus=4, object_store_memory=128 << 20)
+            yield rt
+        finally:
+            ray_tpu.shutdown()
+            os.environ.pop("RTPU_RPC_CHAOS_FAILURE_PROB", None)
+            cfg.set("rpc_chaos_failure_prob", 0.0)
+
+    def test_tasks_survive_chaos(self, chaos_cluster):
+        @ray_tpu.remote
+        def sq(i):
+            return i * i
+
+        refs = [sq.remote(i) for i in range(60)]
+        assert ray_tpu.get(refs, timeout=180) == [i * i for i in range(60)]
+
+    def test_actor_state_exact_under_chaos(self, chaos_cluster):
+        """At-least-once delivery + worker dedup = exactly-once execution:
+        the counter must be EXACT despite retries everywhere."""
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+            def get(self):
+                return self.n
+
+        c = Counter.remote()
+        refs = [c.inc.remote() for _ in range(100)]
+        ray_tpu.get(refs, timeout=180)
+        assert ray_tpu.get(c.get.remote(), timeout=60) == 100
+
+    def test_large_objects_under_chaos(self, chaos_cluster):
+        import numpy as np
+
+        @ray_tpu.remote
+        def make(n):
+            return np.arange(n)
+
+        @ray_tpu.remote
+        def total(x):
+            return int(x.sum())
+
+        refs = [total.remote(make.remote(200_000)) for _ in range(8)]
+        expect = sum(range(200_000))
+        assert ray_tpu.get(refs, timeout=180) == [expect] * 8
