@@ -106,7 +106,6 @@ class NodeManager:
         # BEFORE the worker pop so a retry arriving mid-flight waits for the
         # original outcome instead of double-acquiring. Evicted oldest-first.
         self._lease_grants: Dict[str, list] = {}
-        self._lease_grant_order: "collections.deque" = None  # set below
         self._pool = ClientPool()
         self._server = RpcServer(self, host).start()
         self.address = self._server.address

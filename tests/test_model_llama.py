@@ -155,3 +155,20 @@ def test_explicit_positions_route_position_masked_path():
         np.asarray(llama.forward(params, tokens, cfg, positions=pos)),
         np.asarray(llama.forward(params, tokens, cfg)),
         rtol=1e-5, atol=1e-5)
+
+
+def test_fused_kernel_gate_covers_llama_head_dims():
+    """The TPU flash-kernel dispatch must engage for every Llama-family
+    benchmarked config — round 1 shipped a gate requiring d % 128 == 0,
+    which silently excluded head_dim=64 (Llama-1B) from the fused path."""
+    from ray_tpu.models.llama import LLAMA3_1B, LLAMA3_8B, LLAMA3_70B
+    from ray_tpu.ops.attention import use_fused_kernel
+
+    for cfg in (LLAMA3_1B, LLAMA3_8B, LLAMA3_70B):
+        assert use_fused_kernel(True, True, 2048, cfg.head_dim), cfg
+    # Ragged/odd shapes still take the portable path.
+    assert not use_fused_kernel(True, True, 2048 + 17, 64)
+    assert not use_fused_kernel(True, True, 128, 64)      # too short
+    assert not use_fused_kernel(True, False, 2048, 64)    # packed positions
+    assert not use_fused_kernel(False, True, 2048, 64)    # CPU
+    assert not use_fused_kernel(True, True, 2048, 192)    # unpadded mid dim
