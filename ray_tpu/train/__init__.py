@@ -1,0 +1,26 @@
+"""ray_tpu.train: distributed training over TPU-owning actor gangs.
+
+Parity target: the reference's Ray Train surface (python/ray/train/__init__
+— Trainer/ScalingConfig/RunConfig/Checkpoint/report/get_context), rebuilt
+TPU-first: workers form a JAX multi-controller SPMD program (pjit over a
+global mesh) instead of a torch DDP process group, and checkpoints are
+resharddable pytrees instead of torch state dicts.
+"""
+
+from ray_tpu.train.backend_executor import BackendExecutor, TrainWorkerError
+from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
+    "DataParallelTrainer", "FailureConfig", "JaxTrainer", "Result",
+    "RunConfig", "ScalingConfig", "TrainWorkerError", "WorkerGroup",
+    "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
+    "report", "save_pytree",
+]

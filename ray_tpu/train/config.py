@@ -1,0 +1,62 @@
+"""Train configuration dataclasses.
+
+Parity target: reference python/ray/air/config.py (ScalingConfig :170,
+RunConfig :614, FailureConfig :563, CheckpointConfig :484) — trimmed to the
+fields the TPU runtime acts on, plus TPU-first resource semantics:
+``use_tpu``/``tpus_per_worker`` lease whole TPU-owning worker slots (one
+JAX process per host, the multi-controller rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 1.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"   # PACK | SPREAD | STRICT_SPREAD
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", self.cpus_per_worker)
+        if self.use_tpu:
+            res.setdefault("TPU", self.tpus_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    # Group-level restarts from the latest checkpoint. <0 means unlimited.
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None          # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"        # "max" | "min"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None         # defaults to ~/ray_tpu_results
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+
+@dataclasses.dataclass
+class TrainContextConfig:
+    """Static facts handed to each train worker."""
+    world_size: int = 1
+    world_rank: int = 0
+    node_rank: int = 0
+    coordinator: Optional[str] = None          # jax.distributed coordinator
+    experiment_path: str = ""
+    trial_info: Optional[Dict[str, Any]] = None

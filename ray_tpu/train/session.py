@@ -1,0 +1,165 @@
+"""Per-worker training session: runs the user loop, synchronizes report().
+
+Parity target: reference python/ray/train/_internal/session.py (_TrainSession
+:112, report :405, module-level fns :672) — the user's
+``train_loop_per_worker`` runs on a daemon thread inside a train-worker
+actor; each ``report(metrics, checkpoint)`` hands one result to the driver
+and blocks until the driver has consumed the previous one (lockstep, queue
+depth 1, exactly the reference's backpressure).
+
+TPU-first difference: there is no torch process group to join — workers
+form one JAX multi-controller program. `world_size`/`world_rank` map to
+`jax.process_count()`/`jax.process_index()` when `jax.distributed` is live;
+on a single host they are the actor-group coordinates.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import TrainContextConfig
+
+
+class _Result:
+    __slots__ = ("metrics", "checkpoint_path", "done", "error")
+
+    def __init__(self, metrics=None, checkpoint_path=None, done=False,
+                 error=None):
+        self.metrics = metrics
+        self.checkpoint_path = checkpoint_path
+        self.done = done
+        self.error = error
+
+
+class TrainContext:
+    """What `ray_tpu.train.get_context()` returns inside a worker."""
+
+    def __init__(self, cfg: TrainContextConfig):
+        self._cfg = cfg
+
+    def get_world_size(self) -> int:
+        return self._cfg.world_size
+
+    def get_world_rank(self) -> int:
+        return self._cfg.world_rank
+
+    def get_node_rank(self) -> int:
+        return self._cfg.node_rank
+
+    def get_experiment_name(self) -> str:
+        return os.path.basename(self._cfg.experiment_path or "") or "experiment"
+
+    def get_trial_info(self) -> Optional[Dict[str, Any]]:
+        return self._cfg.trial_info
+
+
+class TrainSession:
+    """Owns the user-loop thread and the result handoff queue."""
+
+    def __init__(self, train_fn, config: Dict[str, Any],
+                 ctx_cfg: TrainContextConfig,
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
+        self._train_fn = train_fn
+        self._config = config
+        self._ctx = TrainContext(ctx_cfg)
+        self._ctx_cfg = ctx_cfg
+        self._start_checkpoint = checkpoint
+        self._dataset_shards = dataset_shards or {}
+        # Depth-1 handoff: report() blocks until the driver consumed it.
+        self._results: "queue.Queue[_Result]" = queue.Queue(maxsize=1)
+        self._finished = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        def runner():
+            global _session
+            _session = self
+            try:
+                takes_config = True
+                try:
+                    import inspect
+
+                    takes_config = len(
+                        inspect.signature(self._train_fn).parameters) > 0
+                except (TypeError, ValueError):
+                    pass
+                if takes_config:
+                    self._train_fn(self._config)
+                else:
+                    self._train_fn()
+                self._results.put(_Result(done=True))
+            except BaseException as e:  # surfaced to the driver, not lost
+                self._results.put(_Result(done=True, error=(
+                    e, traceback.format_exc())))
+            finally:
+                self._finished.set()
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="train-session")
+        self._thread.start()
+
+    def poll(self, timeout: float) -> Optional[_Result]:
+        """Driver-side: next result, or None if the loop hasn't reported."""
+        try:
+            return self._results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    # ---------------------------------------------------------- loop API
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self._results.put(_Result(
+            metrics=dict(metrics),
+            checkpoint_path=checkpoint.path if checkpoint else None))
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._start_checkpoint
+
+    def get_context(self) -> TrainContext:
+        return self._ctx
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self._dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(
+                f"no dataset shard named {name!r} was passed to the trainer "
+                f"(datasets={list(self._dataset_shards)})")
+        return shard
+
+
+# Module-level accessors (the public API surface inside a train loop).
+_session: Optional[TrainSession] = None
+
+
+def _require_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.report()/get_context() may only be called inside "
+            "a train_loop_per_worker launched by a Trainer")
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _require_session().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require_session().get_checkpoint()
+
+
+def get_context() -> TrainContext:
+    return _require_session().get_context()
+
+
+def get_dataset_shard(name: str = "train"):
+    return _require_session().get_dataset_shard(name)
