@@ -235,7 +235,16 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
     standard = positions is None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    # Lookup against a d-unsharded view of the table: the table is stored
+    # [vocab->tp, embed->fsdp], and a gather whose output is d-sharded cannot
+    # be resharded to batch/seq-sharded activations without XLA's
+    # "involuntary full rematerialization" (replicate-then-partition) on
+    # every step. Gathering the embed dim first (the same per-use all-gather
+    # ZeRO-3 applies to every weight) keeps the vocab-sharded gather
+    # efficient (mask + psum over tp) and makes the activation reshard a
+    # free local slice.
+    table = constrain(params["embed"], ("vocab", None))
+    x = jnp.take(table, tokens, axis=0).astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", None))
 
     def body(x, layer):

@@ -66,9 +66,11 @@ class BackendExecutor:
         self._group = WorkerGroup(self._scaling)
         self._group.start()
         if self._use_jax_distributed and self._scaling.num_workers > 1:
-            ips = ray_tpu.get([w.node_ip.remote()
-                               for w in self._group.workers])
-            coordinator = f"{ips[0]}:29876"
+            rank0 = self._group.workers[0]
+            ip, port = ray_tpu.get(
+                [rank0.node_ip.remote(), rank0.free_port.remote()])
+            coordinator = f"{ip}:{port}"
+            # Raises (fails fast) if any worker cannot join the world.
             ray_tpu.get([
                 w.setup_jax_distributed.remote(
                     coordinator, self._scaling.num_workers, rank)
@@ -105,11 +107,13 @@ class BackendExecutor:
             pending = [i for i in range(n) if slots[i] is None]
             if not pending:
                 break
-            for i in pending:
-                w = self._group.workers[i]
+            # One in-flight poll per pending worker, consumed together — a
+            # straggler never head-of-line-blocks fetching the others.
+            polls = [(i, self._group.workers[i].poll_result.remote(
+                poll_interval)) for i in pending]
+            for i, ref in polls:
                 try:
-                    r = ray_tpu.get(w.poll_result.remote(poll_interval),
-                                    timeout=poll_interval + 30)
+                    r = ray_tpu.get(ref, timeout=poll_interval + 30)
                 except ActorDiedError as e:
                     raise TrainWorkerError(i, f"actor died: {e}") from e
                 except GetTimeoutError as e:

@@ -87,6 +87,12 @@ def save_pytree(tree: Any, directory: str, *, name: str = "state") -> None:
     for i, leaf in enumerate(leaves):
         if hasattr(leaf, "addressable_data") or isinstance(leaf, np.ndarray) \
                 or hasattr(leaf, "__array__"):
+            if getattr(leaf, "is_fully_addressable", True) is False:
+                # Multi-host sharded array: np.asarray would raise on the
+                # non-addressable shards — gather the global value first.
+                from jax.experimental import multihost_utils
+
+                leaf = multihost_utils.process_allgather(leaf)
             arr = np.asarray(leaf)
             fname = f"{name}.{i}.npy"
             np.save(os.path.join(directory, fname), arr)

@@ -61,12 +61,18 @@ class CheckpointManager:
 
     def register(self, source_path: str,
                  metrics: Dict[str, Any]) -> TrackedCheckpoint:
-        """Move a worker-produced checkpoint dir into the experiment tree."""
+        """Move a worker-produced checkpoint dir into the experiment tree
+        (the source is CONSUMED — leaving it would leak one model copy in
+        /tmp per report)."""
         idx = self._next_index
         self._next_index += 1
         dest = os.path.join(self._dir, f"checkpoint_{idx:06d}")
         if os.path.abspath(source_path) != dest:
-            shutil.copytree(source_path, dest, dirs_exist_ok=True)
+            try:
+                shutil.move(source_path, dest)
+            except OSError:  # cross-device or source not removable: copy
+                shutil.copytree(source_path, dest, dirs_exist_ok=True)
+                shutil.rmtree(source_path, ignore_errors=True)
         with open(os.path.join(dest, ".metrics.json"), "w") as f:
             json.dump(_json_safe(metrics), f)
         tracked = TrackedCheckpoint(Checkpoint(dest), idx, metrics)
@@ -78,7 +84,9 @@ class CheckpointManager:
         attr = self._cfg.checkpoint_score_attribute
         if attr is None:
             return float(t.index)  # recency
-        v = float(t.metrics.get(attr, float("-inf")))
+        if attr not in t.metrics:
+            return float("-inf")   # unscored ranks worst under either order
+        v = float(t.metrics[attr])
         return v if self._cfg.checkpoint_score_order == "max" else -v
 
     def _prune(self) -> None:

@@ -31,10 +31,8 @@ class Result:
     path: str                                  # experiment directory
     error: Optional[Exception] = None
     metrics_dataframe: Optional[Any] = None    # history as list-of-dicts
-
-    @property
-    def best_checkpoints(self) -> List[Checkpoint]:
-        return list(self._best) if hasattr(self, "_best") else []
+    # Retained checkpoints with their metrics, best-scored first.
+    best_checkpoints: List[Any] = dataclasses.field(default_factory=list)
 
 
 class JaxTrainer:
@@ -93,8 +91,10 @@ class JaxTrainer:
                 shards = ds.streaming_split(n)
             elif hasattr(ds, "split"):
                 shards = ds.split(n)
-            else:  # static sequence: round-robin slices
-                shards = [list(ds)[i::n] for i in range(n)]
+            else:  # static sequence: round-robin slices (materialized ONCE —
+                # a generator would be exhausted by the first worker's slice)
+                items = list(ds)
+                shards = [items[i::n] for i in range(n)]
             for i in range(n):
                 per_worker[i][name] = shards[i]
         return per_worker
@@ -141,12 +141,14 @@ class JaxTrainer:
                 executor.shutdown()
 
         latest = manager.latest
+        ranked = sorted(manager.all(), key=manager._score, reverse=True)
         return Result(
             metrics=last_metrics,
             checkpoint=latest.checkpoint if latest else None,
             path=path,
             error=error,
             metrics_dataframe=history,
+            best_checkpoints=[(t.checkpoint, t.metrics) for t in ranked],
         )
 
 

@@ -29,12 +29,35 @@ class TrainWorkerActor:
     def node_ip(self) -> str:
         import socket
 
-        return socket.gethostbyname(socket.gethostname())
+        # Route-based discovery: the address another host would reach us on
+        # (gethostbyname(gethostname()) returns 127.0.1.1 on common
+        # /etc/hosts layouts, which breaks cross-host coordination).
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect(("8.8.8.8", 80))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            return socket.gethostbyname(socket.gethostname())
+
+    def free_port(self) -> int:
+        import socket
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
 
     def setup_jax_distributed(self, coordinator: str, num_processes: int,
                               process_id: int) -> bool:
         """Join the JAX multi-controller world (multi-host TPU pods). Single
-        -host groups skip this — their mesh is local devices only."""
+        -host groups skip this — their mesh is local devices only. Returns
+        False only for the benign already-initialized case (worker reuse);
+        real failures raise so the driver fails fast instead of silently
+        training on local-only meshes."""
         import jax
 
         try:
@@ -42,8 +65,10 @@ class TrainWorkerActor:
                 coordinator_address=coordinator,
                 num_processes=num_processes, process_id=process_id)
             return True
-        except (RuntimeError, ValueError):
-            return False  # already initialized (worker reuse)
+        except RuntimeError as e:
+            if "already initialized" in str(e).lower():
+                return False
+            raise
 
     def start_training(self, train_fn: Callable, config: Dict[str, Any],
                        ctx_cfg: TrainContextConfig,
