@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.task_spec import pg_key_from_strategy
 from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
 
 class _TransientReservationFailure(Exception):
@@ -313,16 +314,24 @@ class HeadServer:
         """Head-driven creation (mirrors GcsActorScheduler): lease a worker,
         push the creation spec, wait for registration."""
         exclude: Set[str] = set()
-        deadline = time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 3
+        # Generous: under load, worker spawn can eat a full lease-pop
+        # timeout per attempt, and an actor creation failing spuriously is
+        # far worse than it arriving late.
+        deadline = time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 6
         while True:
             picked = self.rpc_pick_node(None, info.resources,
                                         getattr(info, "strategy", None),
                                         list(exclude))
             if picked is None:
                 if time.monotonic() > deadline:
+                    with self._lock:
+                        view = {n.node_id[:8]: dict(n.available)
+                                for n in self._nodes.values() if n.alive}
                     raise RuntimeError(
                         f"no feasible node for actor (resources="
-                        f"{info.resources})")
+                        f"{info.resources}, strategy="
+                        f"{getattr(info, 'strategy', None)}, "
+                        f"availability={view})")
                 # A denial may be transient (leases lingering): retry the
                 # full node set after a pause rather than excluding forever.
                 exclude.clear()
@@ -332,13 +341,19 @@ class HeadServer:
             import uuid as _uuid
 
             node = self._pool.get(node_addr)
+            # PG-placed actors must debit their BUNDLE's reservation, not
+            # the node's main pool — otherwise every such actor costs its
+            # resources twice (once at PG reserve, once at lease) and
+            # starves the rest of the cluster. bundle_index -1 is resolved
+            # to a concrete bundle by the node.
+            pg = pg_key_from_strategy(getattr(info, "strategy", None))
             # Client timeout must exceed the node's own worker-pop timeout:
             # giving up first abandons a lease the node is about to grant —
             # a permanent resource leak (nobody knows the lease id). The
             # req_id makes retries return the SAME grant.
             try:
                 lease = node.retrying_call(
-                    "request_lease", info.resources, True, None,
+                    "request_lease", info.resources, True, pg,
                     _uuid.uuid4().hex,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 10)
             except Exception:

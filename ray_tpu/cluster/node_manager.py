@@ -326,20 +326,34 @@ class NodeManager:
     # ------------------------------------------------------------ leases
 
     def _try_acquire(self, resources: Dict[str, float],
-                     pg: Optional[Tuple[bytes, int]]) -> bool:
-        pool = (self._bundle_avail.get(pg) if pg is not None
-                else self.available)
-        if pool is None:
-            return False
-        if not all(pool.get(k, 0) >= v for k, v in resources.items() if v > 0):
-            return False
-        for k, v in resources.items():
-            pool[k] = pool.get(k, 0) - v
-        return True
+                     pg: Optional[Tuple[bytes, int]]):
+        """Debit `resources` from the main pool (pg=None) or a PG bundle.
+        bundle_index -1 means "any bundle of that group on this node" and
+        is resolved HERE (the node is the only party that knows per-bundle
+        remaining capacity). Returns the resolved pg key, "main", or None
+        if nothing fits — callers store the resolved key on the Lease so
+        release credits the same pool that was debited."""
+        if pg is None:
+            pools = [("main", self.available)]
+        elif pg[1] >= 0:
+            pools = [(pg, self._bundle_avail.get(pg))]
+        else:
+            pools = [(k, v) for k, v in self._bundle_avail.items()
+                     if k[0] == pg[0]]
+        for key, pool in pools:
+            if pool is None:
+                continue
+            if all(pool.get(k, 0) >= v
+                   for k, v in resources.items() if v > 0):
+                for k, v in resources.items():
+                    pool[k] = pool.get(k, 0) - v
+                return key
+        return None
 
     def _release_resources(self, lease: Lease) -> None:
-        pool = (self._bundle_avail.get(lease.pg) if lease.pg is not None
-                else self.available)
+        # lease.pg holds the RESOLVED pool key from _try_acquire.
+        pool = (self.available if lease.pg in (None, "main")
+                else self._bundle_avail.get(lease.pg))
         if pool is None:
             return
         for k, v in lease.resources.items():
@@ -384,16 +398,17 @@ class NodeManager:
     def _do_request_lease(self, resources: Dict[str, float],
                           pg: Optional[Tuple[bytes, int]]):
         with self._lock:
-            if not self._try_acquire(resources, pg):
+            resolved = self._try_acquire(resources, pg)
+            if resolved is None:
                 return None
         w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0)
         if w is None:
-            lease = Lease("", None, resources, pg)
+            lease = Lease("", None, resources, resolved)
             with self._lock:
                 self._release_resources(lease)
             return None
         lease_id = uuid.uuid4().hex
-        lease = Lease(lease_id, w, resources, pg)
+        lease = Lease(lease_id, w, resources, resolved)
         w.lease_id = lease_id
         with self._lock:
             self._leases[lease_id] = lease
@@ -444,8 +459,8 @@ class NodeManager:
                 return True
             lease.blocked -= 1
             if lease.blocked == 0:
-                pool = (self._bundle_avail.get(lease.pg)
-                        if lease.pg is not None else self.available)
+                pool = (self.available if lease.pg in (None, "main")
+                        else self._bundle_avail.get(lease.pg))
                 if pool is not None:
                     for k, v in lease.resources.items():
                         pool[k] = pool.get(k, 0) - v

@@ -39,7 +39,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.serialization import SERIALIZER, capture_exception
 from ray_tpu.core.shm_store import ShmObjectExistsError, ShmStore
-from ray_tpu.core.task_spec import PlacementGroupSpec
+from ray_tpu.core.task_spec import PlacementGroupSpec, pg_key_from_strategy
 from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
                                       RpcServer, blocking_rpc)
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
@@ -163,6 +163,17 @@ class ClusterCore:
         self._lease_lock = threading.Lock()
         self._inflight: Dict[bytes, _InflightTask] = {}  # task_id -> info
         self._inflight_lock = threading.Lock()
+        # task_id -> ObjectIDs passed as args: each holds a submitted-task
+        # ref until the task reaches a TERMINAL state (done or failed), so
+        # the caller dropping its local ObjectRef right after `.remote(ref)`
+        # cannot free an argument out from under the executing worker
+        # (reference: ReferenceCounter's submitted_task_ref_count).
+        self._submitted_args: Dict[bytes, List[ObjectID]] = {}
+        # (expiry, oid) transfer pins for owned refs serialized outbound;
+        # swept by the push-ack loop.
+        import collections as _collections
+
+        self._transfer_pins: "_collections.deque" = _collections.deque()
         self._actors: Dict[ActorID, _ActorConn] = {}
         self._actors_lock = threading.Lock()
         self._actor_classes: Dict[ActorID, Any] = {}
@@ -230,6 +241,25 @@ class ClusterCore:
                     "add_borrower", oid.binary(), self.owner_addr)
             except Exception:
                 pass
+
+    def pin_for_transfer(self, oid: ObjectID,
+                         owner_addr: Optional[str]) -> None:
+        """Owner-side: an owned ref is being serialized into an outbound
+        message. Hold a local ref for `transfer_pin_ttl_s` so the value
+        survives until the receiver's add_borrower registration lands
+        (simplified form of the reference's in-flight borrow accounting;
+        the TTL bounds the leak if the message or registration is lost)."""
+        if owner_addr is not None and owner_addr != self.owner_addr:
+            return
+        self.refcount.add_local_ref(oid)
+        self._transfer_pins.append(
+            (time.monotonic() + cfg.transfer_pin_ttl_s, oid))
+
+    def _sweep_transfer_pins(self) -> None:
+        now = time.monotonic()
+        while self._transfer_pins and self._transfer_pins[0][0] <= now:
+            _, oid = self._transfer_pins.popleft()
+            self.refcount.remove_local_ref(oid)
 
     def _release_object(self, oid: ObjectID) -> None:
         self.memory_store.delete([oid])
@@ -505,12 +535,30 @@ class ClusterCore:
         self.refcount.remove_borrower(ObjectID(oid_bytes), borrower)
         return True
 
+    def _register_submitted_args(self, task_id_bytes: bytes, args,
+                                 kwargs) -> None:
+        oids: List[ObjectID] = []
+        _scan_object_refs((args, kwargs), oids)
+        if not oids:
+            return
+        for oid in oids:
+            self.refcount.add_submitted_task_ref(oid)
+        with self._inflight_lock:
+            self._submitted_args[task_id_bytes] = oids
+
+    def _release_submitted_args(self, task_id_bytes: bytes) -> None:
+        with self._inflight_lock:
+            oids = self._submitted_args.pop(task_id_bytes, None)
+        for oid in oids or ():
+            self.refcount.remove_submitted_task_ref(oid)
+
     def rpc_task_done(self, conn, task_id_bytes: bytes,
                       results: List[Tuple[bytes, str, Any]]):
         """Completion push from the executing worker.
         results: [(oid_bytes, kind, payload)] kind in value|error|in_store."""
         with self._inflight_lock:
             info = self._inflight.pop(task_id_bytes, None)
+        self._release_submitted_args(task_id_bytes)
         for oid_bytes, kind, payload in results:
             oid = ObjectID(oid_bytes)
             if kind == "value":
@@ -569,6 +617,7 @@ class ClusterCore:
                              max_retries if retry_exceptions else 0,
                              sched_key, resources, strategy,
                              name or getattr(func, "__name__", "task"))
+        self._register_submitted_args(task_id.binary(), args, kwargs)
         self._enqueue_task(task_id.binary(), info)
         return refs
 
@@ -706,6 +755,9 @@ class ClusterCore:
 
         while not self._shutdown_flag:
             try:
+                # Every iteration — a continuously-busy dispatch queue must
+                # not stall pin expiry (pins would accumulate unboundedly).
+                self._sweep_transfer_pins()
                 if not self._push_acks:
                     self._push_ack_event.wait(0.2)
                     self._push_ack_event.clear()
@@ -759,9 +811,10 @@ class ClusterCore:
         with self._lease_lock:
             tasks = list(kq.queue)
             kq.queue.clear()
-        for _, info in tasks:
+        for tid, info in tasks:
             for oid in info.return_ids:
                 self.memory_store.put(oid, err, is_exception=True)
+            self._release_submitted_args(tid)
 
     def _request_new_lease(self, resources: Dict[str, float],
                            strategy) -> Optional[_Lease]:
@@ -778,11 +831,7 @@ class ClusterCore:
             if picked is None:
                 return None
             node_id, node_addr, _ = picked
-            pg = None
-            if strategy and strategy.get("kind") == "placement_group":
-                pg = (strategy["pg_id"], strategy.get("bundle_index", -1))
-                if pg[1] < 0:
-                    pg = None
+            pg = pg_key_from_strategy(strategy)
             req_id = uuid.uuid4().hex
             try:
                 granted = self._pool.get(node_addr).retrying_call(
@@ -826,6 +875,7 @@ class ClusterCore:
                     f"worker at {addr} died executing {info.name}"))
                 for oid in info.return_ids:
                     self.memory_store.put(oid, err, is_exception=True)
+                self._release_submitted_args(tid)
             else:
                 self._enqueue_task(tid, info)
         with self._actors_lock:
@@ -898,11 +948,21 @@ class ClusterCore:
             "max_concurrency": max_concurrency,
             "owner_addr": self.owner_addr,
         })
-        status, existing = self.head.retrying_call(
-            "register_actor", actor_id.binary(), name, namespace, spec_blob,
-            max_restarts, resources, get_if_exists,
-            _strategy_dict(scheduling_strategy), timeout=120)
+        # Constructor-arg refs must outlive this call: the head re-ships
+        # spec_blob on every actor RESTART, long after the caller's local
+        # refs are gone. Held until the actor is terminally dead.
+        self._register_submitted_args(b"actor-args:" + actor_id.binary(),
+                                      args, kwargs)
+        try:
+            status, existing = self.head.retrying_call(
+                "register_actor", actor_id.binary(), name, namespace,
+                spec_blob, max_restarts, resources, get_if_exists,
+                _strategy_dict(scheduling_strategy), timeout=120)
+        except BaseException:
+            self._release_submitted_args(b"actor-args:" + actor_id.binary())
+            raise
         if status == "exists":
+            self._release_submitted_args(b"actor-args:" + actor_id.binary())
             return ActorID(existing)
         self._actor_classes[actor_id] = cls
         return actor_id
@@ -969,6 +1029,7 @@ class ClusterCore:
             "return_ids": [o.binary() for o in return_ids],
             "owner_addr": self.owner_addr,
         })
+        self._register_submitted_args(task_id.binary(), args, kwargs)
         # Seq assignment + enqueue are synchronous with the caller: two
         # sequential .remote() calls CANNOT be reordered (the sender thread
         # drains in seq order).
@@ -1078,6 +1139,7 @@ class ClusterCore:
         task_id_bytes, _, return_ids = entry
         with self._inflight_lock:
             self._inflight.pop(task_id_bytes, None)
+        self._release_submitted_args(task_id_bytes)
         err = ActorDiedError(conn.actor_id, conn.death_reason or "actor died")
         for oid in return_ids:
             self.memory_store.put(oid, err, is_exception=True)
@@ -1105,6 +1167,8 @@ class ClusterCore:
             if info is None:
                 conn.dead = True
                 conn.death_reason = "unknown actor"
+                self._release_submitted_args(
+                    b"actor-args:" + conn.actor_id.binary())
                 break
             if info["state"] == "ALIVE" and info["address"]:
                 if info["address"] == stale_addr:
@@ -1127,6 +1191,8 @@ class ClusterCore:
             if info["state"] == "DEAD":
                 conn.dead = True
                 conn.death_reason = info["reason"] or "actor died"
+                self._release_submitted_args(
+                    b"actor-args:" + conn.actor_id.binary())
                 break
             time.sleep(0.2)  # PENDING/RESTARTING: wait
         with conn.lock:
@@ -1158,6 +1224,7 @@ class ClusterCore:
         conn.dead = True
         conn.death_reason = "killed via ray_tpu.kill"
         conn.address = None
+        self._release_submitted_args(b"actor-args:" + actor_id.binary())
         with conn.lock:
             seqs = list(conn.pending)
         for seq in seqs:
@@ -1218,6 +1285,24 @@ class ClusterCore:
         except Exception:
             pass
         runtime_context.set_runtime(None)
+
+
+def _scan_object_refs(obj, out: List[ObjectID], depth: int = 0) -> None:
+    """Collect ObjectIDs of every ObjectRef reachable through plain
+    containers in task args (bounded depth: refs buried deeper inside
+    arbitrary user objects are covered by borrower registration instead)."""
+    if depth > 6:
+        return
+    if isinstance(obj, ObjectRef):
+        out.append(obj.id())
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for v in obj:
+            _scan_object_refs(v, out, depth + 1)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _scan_object_refs(k, out, depth + 1)
+            _scan_object_refs(v, out, depth + 1)
 
 
 def _as_resource_dict(resources) -> Dict[str, float]:

@@ -107,6 +107,9 @@ _d("worker_pool_idle_ttl_s", float, 60.0, "idle worker reap time")
 _d("worker_niceness", int, 0, "niceness applied to spawned workers")
 
 # --- fault tolerance ---
+_d("transfer_pin_ttl_s", float, 30.0,
+   "owner-side lifetime extension for refs serialized into messages "
+   "(bridges the serialize -> add_borrower registration gap)")
 _d("task_max_retries_default", int, 3, "default retries for retriable tasks")
 _d("task_retry_delay_ms", int, 100, "backoff between task retries")
 _d("actor_max_restarts_default", int, 0, "default actor restarts")

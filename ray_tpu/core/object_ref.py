@@ -86,6 +86,13 @@ class ObjectRef:
     def __reduce__(self):
         # Serializing a ref transfers a borrow: the deserializer re-registers
         # a local reference on its side (ownership stays with the creator).
+        # The OWNER side must bridge the gap between "my last local ref
+        # died" and "the receiver's add_borrower arrived" — without a pin,
+        # returning a ref from an actor method frees the object before the
+        # caller can fetch it.
+        rt = _current_runtime()
+        if rt is not None and hasattr(rt, "pin_for_transfer"):
+            rt.pin_for_transfer(self._id, self._owner_addr)
         return (_deserialize_ref, (self._id.binary(), self._owner_addr))
 
     def __del__(self):

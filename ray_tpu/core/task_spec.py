@@ -138,3 +138,13 @@ class PlacementGroupSpec:
     name: str = ""
     # TPU-native: require all bundles to land inside one named ICI slice.
     slice_affine: bool = False
+
+
+def pg_key_from_strategy(strategy) -> "Optional[tuple]":
+    """Lease-protocol PG key (pg_id, bundle_index) from a wire strategy
+    dict; bundle_index -1 means "any bundle of the group" and is resolved
+    by the serving node (node_manager._try_acquire). None for non-PG
+    strategies."""
+    if strategy and strategy.get("kind") == "placement_group":
+        return (strategy["pg_id"], strategy.get("bundle_index", -1))
+    return None

@@ -1,0 +1,19 @@
+"""ray_tpu.data: streaming, block-distributed datasets.
+
+Parity target: the reference Ray Data surface (python/ray/data/__init__ —
+Dataset, read_*/from_* constructors) over the pull-based streaming executor
+in `_streaming.py`. Blocks are column dicts of numpy arrays living in the
+shm object store; `iter_batches(device_put=...)` prefetches onto TPU.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import (Dataset, MaterializedDataset,
+                                  StreamSplitIterator, from_items,
+                                  from_numpy, range, read_csv, read_json,
+                                  read_parquet)
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "Dataset",
+    "MaterializedDataset", "StreamSplitIterator", "from_items", "from_numpy",
+    "range", "read_csv", "read_json", "read_parquet",
+]

@@ -1,0 +1,103 @@
+"""Block model: the unit of distributed data.
+
+Parity target: reference python/ray/data/block.py (BlockAccessor :57-66).
+The reference's blocks are Arrow or pandas tables; here the native block
+format is a **column dict of numpy arrays** — the zero-copy format of the
+shm object store (core/serialization.py pickles numpy out-of-band) and the
+direct input to `jax.device_put`. Row dicts and scalars are accepted at the
+edges and normalized in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]          # column name -> [n, ...] array
+
+
+class BlockAccessor:
+    """Uniform view over a block (column dict)."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, dict):
+            raise TypeError(f"block must be a column dict, got {type(block)}")
+        self._b = block
+
+    @staticmethod
+    def normalize(data: Any) -> Block:
+        """Accept a column dict, a list of row dicts, a list of scalars, or
+        a numpy array; return the canonical column-dict block."""
+        if isinstance(data, dict):
+            return {k: np.asarray(v) for k, v in data.items()}
+        if isinstance(data, np.ndarray):
+            return {"data": data}
+        if isinstance(data, (list, tuple)):
+            if not data:
+                return {}
+            if isinstance(data[0], dict):
+                cols = {k: [] for k in data[0]}
+                for row in data:
+                    if row.keys() != cols.keys():
+                        raise ValueError(
+                            f"inconsistent row keys: {sorted(row)} vs "
+                            f"{sorted(cols)}")
+                    for k, v in row.items():
+                        cols[k].append(v)
+                return {k: np.asarray(v) for k, v in cols.items()}
+            return {"item": np.asarray(data)}
+        raise TypeError(f"cannot make a block from {type(data)}")
+
+    def num_rows(self) -> int:
+        if not self._b:
+            return 0
+        return len(next(iter(self._b.values())))
+
+    def size_bytes(self) -> int:
+        return sum(v.nbytes if hasattr(v, "nbytes") else 0
+                   for v in self._b.values())
+
+    def schema(self) -> Dict[str, Any]:
+        return {k: (v.dtype, v.shape[1:]) for k, v in self._b.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._b.items()}
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        n = self.num_rows()
+        keys = list(self._b)
+        for i in range(n):
+            yield {k: self._b[k][i] for k in keys}
+
+    def to_batch(self) -> Block:
+        return self._b
+
+    @staticmethod
+    def concat(blocks: Sequence[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+        if not blocks:
+            return {}
+        keys = blocks[0].keys()
+        for b in blocks:
+            if b.keys() != keys:
+                raise ValueError("cannot concat blocks with different columns")
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+class BlockMetadata:
+    """Driver-side facts about a block (the block itself stays in the
+    object store; reference keeps metadata on the driver the same way)."""
+
+    __slots__ = ("num_rows", "size_bytes", "input_files")
+
+    def __init__(self, num_rows: int, size_bytes: int,
+                 input_files: Optional[List[str]] = None):
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+        self.input_files = input_files or []
+
+    @staticmethod
+    def of(block: Block, files: Optional[List[str]] = None) -> "BlockMetadata":
+        acc = BlockAccessor(block)
+        return BlockMetadata(acc.num_rows(), acc.size_bytes(), files)
