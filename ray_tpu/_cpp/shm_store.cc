@@ -57,7 +57,8 @@ constexpr uint64_t kBlockHdr = sizeof(BlockHeader);
 struct Slot {
   uint8_t key[kKeySize];
   uint8_t state;
-  uint8_t pad[3];
+  uint8_t doomed;      // delete() hit a pinned object: dies at last release
+  uint8_t pad[2];
   int32_t refcount;
   uint64_t offset;     // data offset within segment (to payload)
   uint64_t data_size;  // user-visible size
@@ -77,6 +78,10 @@ struct StoreHeader {
   uint64_t free_head;  // offset of first free block (0 = none)
   uint64_t n_evictions;
   uint64_t create_waiters;
+  // 1 (default): create may destructively evict LRU sealed objects.
+  // 0: create fails with OOM instead — the client layer spills victims to
+  // disk first (node-wide policy: the flag lives in the shared header).
+  uint64_t auto_evict;
   pthread_mutex_t mutex;
   pthread_cond_t seal_cond;
 };
@@ -317,6 +322,7 @@ void* rtpu_store_create(const char* name, uint64_t segment_size,
   hdr->arena_size = segment_size - hdr->arena_off;
   memset(reinterpret_cast<uint8_t*>(base) + hdr->slot_table_off, 0,
          table_bytes);
+  hdr->auto_evict = 1;
 
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
@@ -371,6 +377,50 @@ void rtpu_store_close(void* hp) {
 
 void rtpu_store_unlink(const char* name) { shm_unlink(name); }
 
+// Node-wide eviction policy switch (lives in the shared header so every
+// mapping process obeys it). 0 = fail-with-OOM so the client layer can
+// spill to disk instead of destroying data.
+void rtpu_store_set_auto_evict(void* hp, int on) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  h->hdr->auto_evict = on ? 1 : 0;
+}
+
+// Select LRU sealed refcount-0 victims whose sizes sum to >= need (or until
+// none remain / max_keys reached). Copies their keys into keys_out
+// (kKeySize bytes each) WITHOUT removing them — the caller reads each out
+// to disk, then deletes it. Returns the number of keys written.
+int rtpu_store_spill_victims(void* hp, uint64_t need, uint8_t* keys_out,
+                             int max_keys) {
+  auto* h = reinterpret_cast<Handle*>(hp);
+  Locker lock(h);
+  if (max_keys > 256) max_keys = 256;
+  uint64_t chosen[256];
+  int count = 0;
+  uint64_t acc = 0;
+  Slot* table = slot_table(h);
+  while (count < max_keys && acc < need) {
+    Slot* best = nullptr;
+    uint64_t best_i = 0;
+    for (uint64_t i = 0; i < h->hdr->n_slots; i++) {
+      Slot* s = &table[i];
+      if (s->state != kSealed || s->refcount != 0) continue;
+      bool taken = false;
+      for (int j = 0; j < count; j++) {
+        if (chosen[j] == i) { taken = true; break; }
+      }
+      if (taken) continue;
+      if (!best || s->lru_tick < best->lru_tick) { best = s; best_i = i; }
+    }
+    if (!best) break;
+    chosen[count] = best_i;
+    memcpy(keys_out + (uint64_t)count * kKeySize, best->key, kKeySize);
+    acc += best->data_size;
+    count++;
+  }
+  return count;
+}
+
 uint8_t* rtpu_store_base(void* hp) {
   return reinterpret_cast<Handle*>(hp)->base;
 }
@@ -389,8 +439,10 @@ uint64_t rtpu_obj_create(void* hp, const uint8_t* key, uint64_t data_size,
   }
   uint64_t off = arena_alloc(h, data_size);
   if (!off) {
-    evict_for(h, align64(data_size ? data_size : 1));
-    off = arena_alloc(h, data_size);
+    if (h->hdr->auto_evict) {
+      evict_for(h, align64(data_size ? data_size : 1));
+      off = arena_alloc(h, data_size);
+    }
     if (!off) {
       *errno_out = 2;
       return 0;
@@ -441,7 +493,7 @@ int rtpu_obj_get(void* hp, const uint8_t* key, int64_t timeout_ms,
   }
   for (;;) {
     Slot* s = find_slot(h, key);
-    if (s && s->state == kSealed) {
+    if (s && s->state == kSealed && !s->doomed) {
       s->refcount++;
       s->lru_tick = ++h->hdr->lru_clock;
       *offset = s->offset;
@@ -461,26 +513,44 @@ int rtpu_obj_get(void* hp, const uint8_t* key, int64_t timeout_ms,
   }
 }
 
+// Returns 0 on plain release, 2 when this was the LAST pin of a doomed
+// object (now freed) — the caller must treat the object as deleted.
 int rtpu_obj_release(void* hp, const uint8_t* key) {
   auto* h = reinterpret_cast<Handle*>(hp);
   Locker lock(h);
   Slot* s = find_slot(h, key);
   if (!s || s->refcount <= 0) return -1;
   s->refcount--;
+  if (s->refcount == 0 && s->doomed) {
+    arena_free(h, s->offset);
+    s->state = kTombstone;
+    s->doomed = 0;
+    h->hdr->n_objects--;
+    return 2;
+  }
   return 0;
 }
 
 // Delete: free immediately if unpinned; pinned objects are freed on the
 // last release... by design we simply refuse (caller retries/abandons —
 // the distributed refcounter only deletes when it believes refs are gone).
+// Delete semantics with pins outstanding: the object is DOOMED — it reads
+// as absent immediately (get/contains miss it) and its memory is freed by
+// the LAST release. This closes the spill/consume race: a concurrent
+// spiller's pin cannot make a consumer's delete silently fail (the
+// spiller's release returns 2 so it can discard the spill file it wrote).
 int rtpu_obj_delete(void* hp, const uint8_t* key) {
   auto* h = reinterpret_cast<Handle*>(hp);
   Locker lock(h);
   Slot* s = find_slot(h, key);
   if (!s) return -1;
-  if (s->refcount > 0) return -2;
+  if (s->refcount > 0) {
+    s->doomed = 1;
+    return 0;
+  }
   arena_free(h, s->offset);
   s->state = kTombstone;
+  s->doomed = 0;
   h->hdr->n_objects--;
   return 0;
 }
@@ -489,7 +559,7 @@ int rtpu_obj_contains(void* hp, const uint8_t* key) {
   auto* h = reinterpret_cast<Handle*>(hp);
   Locker lock(h);
   Slot* s = find_slot(h, key);
-  return (s && s->state == kSealed) ? 1 : 0;
+  return (s && s->state == kSealed && !s->doomed) ? 1 : 0;
 }
 
 // Abort an in-progress create (creator failed before seal).

@@ -28,6 +28,12 @@ class ActorMethod:
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Author a compiled-DAG node (reference: ray.dag .bind syntax)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor methods cannot be called directly; use "

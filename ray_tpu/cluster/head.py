@@ -194,6 +194,13 @@ class HeadServer:
         with self._lock:
             victims = [a for a in self._actors.values()
                        if a.node_id == node_id and a.state == ALIVE]
+            # Object copies died with the node: a stale directory entry
+            # would make owners believe lost objects are still available
+            # (blocking lineage recovery) and make pullers dial a corpse.
+            for oid, nodes in list(self._object_dir.items()):
+                nodes.discard(node_id)
+                if not nodes:
+                    del self._object_dir[oid]
         for a in victims:
             self._actor_died(a, f"node {node_id} died", try_restart=True)
 

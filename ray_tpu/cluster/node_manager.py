@@ -547,8 +547,11 @@ class NodeManager:
         from ray_tpu.core.shm_store import ShmObjectExistsError
 
         chunk = cfg.object_transfer_chunk_bytes
-        client = self._pool.get(addr)
         try:
+            # Inside the try: connecting to a DEAD holder (post node death,
+            # pre directory cleanup) must read as "pull failed", not crash
+            # the pull RPC.
+            client = self._pool.get(addr)
             first = client.call("fetch_object", oid.binary(), 0, chunk, 0,
                                 timeout=max(1.0, deadline - time.monotonic()))
         except Exception:

@@ -98,6 +98,11 @@ class RpcServer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one thread per peer connection
+                # Replies are latency-critical small frames: without
+                # NODELAY, Nagle + delayed ACK can stall each response up
+                # to 40ms (clients already set it; servers must too).
+                self.request.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
                 conn = PeerConnection(self.request, outer)
                 try:
                     outer._on_connect(conn)

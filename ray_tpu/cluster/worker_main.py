@@ -327,6 +327,24 @@ class WorkerRuntime(ClusterCore):
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
         owner = spec["owner_addr"]
         actor_ctx = (spec["actor_id"], seq)
+        if spec["method"] == "__rtpu_dag_loop__":
+            # Compiled-DAG bootstrap (ray_tpu/dag/compiled_dag.py): run the
+            # shipped per-actor schedule on a dedicated thread — the actor
+            # keeps serving normal calls while the DAG loop blocks on
+            # channel reads.
+            from ray_tpu.dag.compiled_dag import run_actor_dag_loop
+
+            schedule = spec["args"][0]
+            stop = threading.Event()
+            hosted.dag_stops = getattr(hosted, "dag_stops", [])
+            hosted.dag_stops.append(stop)
+            threading.Thread(
+                target=run_actor_dag_loop,
+                args=(hosted.instance, schedule, stop), daemon=True,
+                name=f"dag-loop-{hosted.actor_id.hex()[:8]}").start()
+            self._send_results(owner, task_id, return_ids, value=True,
+                               actor_ctx=actor_ctx)
+            return
         try:
             args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
             method = getattr(hosted.instance, spec["method"])
@@ -372,6 +390,8 @@ class WorkerRuntime(ClusterCore):
             hosted = self._hosted.pop(actor_id, None)
         if hosted is not None:
             hosted.dead = True
+            for stop in getattr(hosted, "dag_stops", []):
+                stop.set()
             hosted.pool.shutdown(wait=False, cancel_futures=True)
             if hosted.loop is not None:
                 hosted.loop.call_soon_threadsafe(hosted.loop.stop)

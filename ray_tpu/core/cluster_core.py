@@ -174,6 +174,13 @@ class ClusterCore:
         import collections as _collections
 
         self._transfer_pins: "_collections.deque" = _collections.deque()
+        # Lineage-based recovery: creating-task specs per owned object
+        # (reference: task_manager.h:265 ResubmitTask).
+        from ray_tpu.core.lineage import LineageStore
+
+        self.lineage = LineageStore(cfg.max_lineage_bytes)
+        self._recovering: Dict[bytes, float] = {}  # task_id -> last attempt
+        self._recover_lock = threading.Lock()
         self._actors: Dict[ActorID, _ActorConn] = {}
         self._actors_lock = threading.Lock()
         self._actor_classes: Dict[ActorID, Any] = {}
@@ -260,6 +267,9 @@ class ClusterCore:
         while self._transfer_pins and self._transfer_pins[0][0] <= now:
             _, oid = self._transfer_pins.popleft()
             self.refcount.remove_local_ref(oid)
+        # Finalizer-queued decrements apply here even when the process is
+        # otherwise idle (ObjectRef.__del__ can only enqueue).
+        self.refcount.flush_deferred()
 
     def _release_object(self, oid: ObjectID) -> None:
         self.memory_store.delete([oid])
@@ -303,7 +313,8 @@ class ClusterCore:
         except Exception:
             pass
 
-    def _read_plasma(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+    def _read_plasma(self, oid: ObjectID, timeout: Optional[float],
+                     owner: Optional[str] = None) -> Any:
         buf = self.store.get(oid, timeout_ms=0)
         if buf is None:
             # Not local: ask the node manager to pull it here. Short pull
@@ -312,6 +323,7 @@ class ClusterCore:
             deadline = time.monotonic() + (timeout if timeout is not None
                                            else 600.0)
             ok = False
+            failed_pulls = 0
             with self._blocked_scope():
                 while not ok and time.monotonic() < deadline:
                     try:
@@ -331,6 +343,13 @@ class ClusterCore:
                         ok = False
                     if not ok and self.store.contains(oid):
                         ok = True
+                    if not ok:
+                        failed_pulls += 1
+                        if failed_pulls >= 2:
+                            # Every copy is likely gone (node death):
+                            # lineage recovery — owner resubmits the
+                            # creating task; borrowers ask the owner to.
+                            self._request_recovery(oid, owner)
             if not ok:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
             buf = self.store.get(oid, timeout_ms=5000)
@@ -401,7 +420,7 @@ class ClusterCore:
         if kind == "error":
             raise payload
         if kind == "in_store":
-            return self._read_plasma(oid, timeout)
+            return self._read_plasma(oid, timeout, owner=owner)
         raise RuntimeError(f"unexpected get_object reply {kind}")
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
@@ -488,6 +507,95 @@ class ClusterCore:
             except Exception:
                 time.sleep(0.2)
 
+    # --------------------------------------------------------- recovery
+
+    def _request_recovery(self, oid: ObjectID, owner: Optional[str]) -> None:
+        """Trigger re-creation of a lost object: locally if we own it,
+        else by asking the owner (which has the lineage)."""
+        if owner is None or owner == self.owner_addr:
+            self._maybe_recover_object(oid)
+            return
+        try:
+            self._pool.get(owner).notify("recover_object", oid.binary())
+        except Exception:
+            pass
+
+    def rpc_recover_object(self, conn, oid_bytes: bytes):
+        """Borrower-initiated recovery request for an object I own."""
+        self._maybe_recover_object(ObjectID(oid_bytes))
+        return True
+
+    def _maybe_recover_object(self, oid: ObjectID, _depth: int = 0) -> bool:
+        """Resubmit the creating task of a lost owned object (transitively
+        for its lost arguments). Rate-limited per task; returns True if a
+        resubmission happened or is already underway."""
+        if _depth > 16:
+            return False
+        found = self.lineage.for_object(oid)
+        if found is None:
+            return False
+        # Confirm the object is actually LOST (no live location) before
+        # re-executing: transient pull failures against a slow-but-alive
+        # holder must not duplicate a side-effecting task.
+        if _depth == 0 and self._object_available(oid):
+            return False
+        task_key, rec = found
+        now = time.monotonic()
+        with self._recover_lock:
+            last = self._recovering.get(task_key, 0.0)
+            if now - last < 30.0:
+                return True  # a recovery attempt is already in flight
+            self._recovering[task_key] = now
+            # Bounded memory: drop stale entries opportunistically.
+            if len(self._recovering) > 4096:
+                cutoff = now - 300.0
+                self._recovering = {k: v for k, v in
+                                    self._recovering.items() if v > cutoff}
+        # Recursive step: re-create lost owned args FIRST, so the
+        # resubmitted task's fetches can succeed (reference:
+        # object_recovery_manager.h pinning-or-reconstruct walk).
+        for arg in rec.arg_ids:
+            if not self._object_available(arg):
+                self._maybe_recover_object(arg, _depth + 1)
+        # Fresh task id: worker-side exactly-once dedup must not swallow
+        # the resubmission (the original id may have executed anywhere).
+        spec = SERIALIZER.decode(rec.spec_blob)
+        new_task_id = TaskID.for_task(ActorID.nil_for_job(self.job_id))
+        spec["task_id"] = new_task_id.binary()
+        new_blob = SERIALIZER.encode(spec)
+        info = _InflightTask(new_blob, rec.return_ids, None, 0,
+                             rec.sched_key, rec.resources, rec.strategy,
+                             rec.name + "[recovery]")
+        # Re-point the lineage mapping at the new spec so a SECOND loss
+        # recovers from the resubmitted task, and re-protect the args.
+        from ray_tpu.core.lineage import LineageRecord
+
+        self.lineage.record(new_task_id.binary(), LineageRecord(
+            new_blob, rec.sched_key, rec.resources, rec.strategy, rec.name,
+            rec.return_ids, rec.arg_ids))
+        for arg in rec.arg_ids:
+            self.refcount.add_submitted_task_ref(arg)
+        with self._inflight_lock:
+            self._submitted_args[new_task_id.binary()] = list(rec.arg_ids)
+        self._enqueue_task(new_task_id.binary(), info)
+        return True
+
+    def _object_available(self, oid: ObjectID) -> bool:
+        """Is an owned object's value still reachable somewhere?"""
+        if self.store.contains(oid):
+            return True
+        if self.memory_store.contains(oid):
+            recs = self.memory_store.get([oid], 0)
+            if not recs[0].in_plasma:
+                return True  # inline value lives in the owner itself
+            try:
+                locs = self.head.call("object_locations", oid.binary(),
+                                      timeout=5)
+            except Exception:
+                return True  # can't tell; assume fine (pull will retry)
+            return bool(locs)
+        return False
+
     # -------------------------------------------------------------- owner RPC
 
     @blocking_rpc
@@ -536,15 +644,16 @@ class ClusterCore:
         return True
 
     def _register_submitted_args(self, task_id_bytes: bytes, args,
-                                 kwargs) -> None:
+                                 kwargs) -> List[ObjectID]:
         oids: List[ObjectID] = []
         _scan_object_refs((args, kwargs), oids)
         if not oids:
-            return
+            return oids
         for oid in oids:
             self.refcount.add_submitted_task_ref(oid)
         with self._inflight_lock:
             self._submitted_args[task_id_bytes] = oids
+        return oids
 
     def _release_submitted_args(self, task_id_bytes: bytes) -> None:
         with self._inflight_lock:
@@ -617,7 +726,13 @@ class ClusterCore:
                              max_retries if retry_exceptions else 0,
                              sched_key, resources, strategy,
                              name or getattr(func, "__name__", "task"))
-        self._register_submitted_args(task_id.binary(), args, kwargs)
+        arg_ids = self._register_submitted_args(task_id.binary(), args,
+                                                kwargs)
+        from ray_tpu.core.lineage import LineageRecord
+
+        self.lineage.record(task_id.binary(), LineageRecord(
+            spec_blob, sched_key, resources, strategy, info.name,
+            return_ids, arg_ids))
         self._enqueue_task(task_id.binary(), info)
         return refs
 

@@ -115,6 +115,16 @@ class LocalRuntime:
         self._cancelled: set = set()
         self._shutdown = False
 
+        def _flush_loop():
+            while not self._shutdown:
+                time.sleep(0.2)
+                self.refcount.flush_deferred()
+
+        # Finalizer-queued ref decrements apply even when idle (see
+        # ReferenceCounter._deferred).
+        threading.Thread(target=_flush_loop, daemon=True,
+                         name="refcount-flush").start()
+
     # ------------------------------------------------------------------ refs
 
     def resolve_record(self, rec) -> Any:

@@ -36,14 +36,52 @@ class _Ref:
 
 class ReferenceCounter:
     def __init__(self, on_release: Optional[Callable[[ObjectID], None]] = None):
+        import collections
+
         self._lock = threading.Lock()
         self._refs: Dict[ObjectID, _Ref] = {}
         self._on_release = on_release
         self.enabled = True
+        # ObjectRef.__del__ may run INSIDE a locked section of this very
+        # counter (any allocation under the lock can trigger GC, which
+        # collects refs whose __del__ re-enters here — a guaranteed
+        # self-deadlock on a plain Lock). Finalizers therefore never take
+        # the lock: they append to this queue (deque.append is atomic) and
+        # decrements are applied by the next normal-context operation.
+        self._deferred: "collections.deque" = collections.deque()
+
+    def _apply_deferred_locked(self) -> list:
+        """Caller holds the lock. Returns release callbacks to run after
+        the lock is dropped."""
+        releases = []
+        while self._deferred:
+            try:
+                oid = self._deferred.popleft()
+            except IndexError:
+                break
+            ref = self._refs.get(oid)
+            if ref is None:
+                continue
+            ref.local -= 1
+            cb = self._maybe_release_locked(oid, ref)
+            if cb:
+                releases.append(cb)
+        return releases
+
+    def flush_deferred(self) -> None:
+        """Apply queued finalizer decrements (called from normal contexts:
+        periodic sweeps and every counter operation)."""
+        if not self._deferred:
+            return
+        with self._lock:
+            releases = self._apply_deferred_locked()
+        for cb in releases:
+            cb()
 
     # --- owner-side ---
 
     def add_owned_object(self, oid: ObjectID) -> None:
+        self.flush_deferred()
         with self._lock:
             ref = self._refs.setdefault(oid, _Ref(owned=True))
             ref.owned = True
@@ -51,23 +89,30 @@ class ReferenceCounter:
     def add_local_ref(self, oid: ObjectID) -> None:
         if not self.enabled:
             return
+        self.flush_deferred()
         with self._lock:
             self._refs.setdefault(oid, _Ref(owned=False)).local += 1
 
     def remove_local_ref(self, oid: ObjectID) -> None:
+        """Finalizer-safe: runs from ObjectRef.__del__ (possibly mid-GC
+        inside our own locked section, or inside ANY other subsystem's
+        lock), so it must not take locks or do IO — enqueue only; the next
+        normal-context counter operation or periodic sweep applies it."""
         if not self.enabled:
             return
-        self._dec(oid, "local")
+        self._deferred.append(oid)
 
     def add_submitted_task_ref(self, oid: ObjectID) -> None:
         if not self.enabled:
             return
+        self.flush_deferred()
         with self._lock:
             self._refs.setdefault(oid, _Ref(owned=False)).submitted += 1
 
     def remove_submitted_task_ref(self, oid: ObjectID) -> None:
         if not self.enabled:
             return
+        self.flush_deferred()
         self._dec(oid, "submitted")
 
     def add_borrower(self, oid: ObjectID, borrower_addr: str) -> None:

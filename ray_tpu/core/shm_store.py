@@ -57,6 +57,7 @@ def _load_lib():
                                      ctypes.c_int64,
                                      ctypes.POINTER(ctypes.c_uint64),
                                      ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_obj_release.restype = ctypes.c_int
         lib.rtpu_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -66,8 +67,17 @@ def _load_lib():
         lib.rtpu_store_prefault.argtypes = [ctypes.c_void_p]
         lib.rtpu_store_size.restype = ctypes.c_uint64
         lib.rtpu_store_size.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_set_auto_evict.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_int]
+        lib.rtpu_store_spill_victims.restype = ctypes.c_int
+        lib.rtpu_store_spill_victims.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
         _LIB = lib
         return lib
+
+
+_KEY_SIZE = 28  # must match kKeySize in shm_store.cc (== ObjectID bytes)
 
 
 class ShmObjectExistsError(Exception):
@@ -120,6 +130,23 @@ class ShmStore:
         # Object views are built per-get from this base pointer; offsets from
         # the store are segment-relative.
         self._base_ptr = self._lib.rtpu_store_base(self._h)
+        # Disk spilling (reference: local_object_manager.h:110 +
+        # external_storage.py): when enabled (config), memory pressure
+        # spills LRU sealed objects to per-store files instead of
+        # destructively evicting; reads transparently restore. The spill
+        # dir derives from the store name so every process mapping the
+        # segment (workers, node manager, driver) resolves the same files.
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+        self._spill_enabled = bool(_cfg.object_spilling_enabled)
+        self._spill_dir = os.path.join(_cfg.object_spilling_dir,
+                                       name.lstrip("/"))
+        if self._spill_enabled:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            if owner:
+                self._lib.rtpu_store_set_auto_evict(self._h, 0)
+        self.n_spilled = 0
+        self.n_restored = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -156,6 +183,10 @@ class ShmStore:
             self._h = None
             if self._owner:
                 self._lib.rtpu_store_unlink(self.name.encode())
+                if self._spill_enabled:
+                    import shutil
+
+                    shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     # -- raw segment access ------------------------------------------------
 
@@ -169,6 +200,85 @@ class ShmStore:
     def _key(oid: ObjectID) -> bytes:
         return oid.binary()
 
+    # -- spilling ----------------------------------------------------------
+
+    def _spill_path(self, key: bytes) -> str:
+        return os.path.join(self._spill_dir, key.hex() + ".bin")
+
+    def spill_for(self, need: int) -> bool:
+        """Write LRU sealed unpinned objects out to disk (then delete them
+        from the arena) until ~`need` bytes could be freed. Returns True if
+        anything was spilled."""
+        Buf = ctypes.c_uint8 * (256 * _KEY_SIZE)
+        keys_buf = Buf()
+        n = self._lib.rtpu_store_spill_victims(
+            self._h, max(need, 1), keys_buf, 256)
+        spilled = False
+        for i in range(n):
+            key = bytes(keys_buf[i * _KEY_SIZE:(i + 1) * _KEY_SIZE])
+            oid = ObjectID(key)
+            buf = self.get(oid, timeout_ms=0, _no_restore=True)
+            if buf is None:
+                continue  # raced: deleted/spilled by someone else
+            path = self._spill_path(key)
+            tmp = path + f".tmp{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(buf.buffer)
+                os.replace(tmp, path)  # atomic: readers see whole files only
+            finally:
+                buf.release()
+            self.spill_delete_only(oid)  # keep the file we just wrote
+            self.n_spilled += 1
+            spilled = True
+        return spilled
+
+    def _maybe_restore(self, oid: ObjectID) -> bool:
+        """Bring a spilled object back into the arena. True if present
+        afterwards (restored here or concurrently by another process)."""
+        if not self._spill_enabled:
+            return False
+        path = self._spill_path(self._key(oid))
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return False
+        try:
+            mv = self.create_buffer(oid, len(data))
+        except ShmObjectExistsError:
+            return True  # another process is restoring it; get() will wait
+        except ShmStoreFullError:
+            return False
+        try:
+            mv[:] = data
+        except BaseException:
+            self.abort(oid)
+            raise
+        self.seal(oid)
+        self.n_restored += 1
+        # Keep the file: it is the cheap insurance copy until delete().
+        return True
+
+    def _create_raw(self, key: bytes, total: int, what: str) -> int:
+        """rtpu_obj_create + spill-on-pressure retry loop."""
+        err = ctypes.c_int(0)
+        attempts = 0
+        while True:
+            off = self._lib.rtpu_obj_create(self._h, key, total,
+                                            ctypes.byref(err))
+            if off:
+                return off
+            if err.value == 1:
+                raise ShmObjectExistsError(key.hex())
+            if not self._spill_enabled or attempts >= 20 \
+                    or not self.spill_for(total):
+                raise ShmStoreFullError(
+                    f"store full ({what}: {total} bytes requested; "
+                    f"err={err.value}, spilling="
+                    f"{'on' if self._spill_enabled else 'off'})")
+            attempts += 1
+
     # -- object API --------------------------------------------------------
 
     def put_bytes(self, oid: ObjectID, payload) -> None:
@@ -177,14 +287,7 @@ class ShmStore:
         parts = payload if isinstance(payload, (list, tuple)) else [payload]
         total = sum(len(p) for p in parts)
         key = self._key(oid)
-        err = ctypes.c_int(0)
-        off = self._lib.rtpu_obj_create(self._h, key, total,
-                                        ctypes.byref(err))
-        if not off:
-            if err.value == 1:
-                raise ShmObjectExistsError(oid.hex())
-            raise ShmStoreFullError(
-                f"store full ({total} bytes requested; err={err.value})")
+        off = self._create_raw(key, total, "put_bytes")
         try:
             mv = self._view(off, total)
             pos = 0
@@ -200,13 +303,7 @@ class ShmStore:
 
     def create_buffer(self, oid: ObjectID, size: int) -> memoryview:
         """Two-phase create: returns a writable view; call seal() after."""
-        key = self._key(oid)
-        err = ctypes.c_int(0)
-        off = self._lib.rtpu_obj_create(self._h, key, size, ctypes.byref(err))
-        if not off:
-            if err.value == 1:
-                raise ShmObjectExistsError(oid.hex())
-            raise ShmStoreFullError(f"store full (err={err.value})")
+        off = self._create_raw(self._key(oid), size, "create_buffer")
         return self._view(off, size)
 
     def seal(self, oid: ObjectID) -> None:
@@ -215,14 +312,21 @@ class ShmStore:
     def abort(self, oid: ObjectID) -> None:
         self._lib.rtpu_obj_abort(self._h, self._key(oid))
 
-    def get(self, oid: ObjectID,
-            timeout_ms: int = 0) -> Optional[PinnedBuffer]:
-        """Pinned zero-copy read. None on timeout/missing."""
+    def get(self, oid: ObjectID, timeout_ms: int = 0,
+            _no_restore: bool = False) -> Optional[PinnedBuffer]:
+        """Pinned zero-copy read; transparently restores spilled objects.
+        None on timeout/missing."""
         key = self._key(oid)
         off = ctypes.c_uint64(0)
         size = ctypes.c_uint64(0)
-        rc = self._lib.rtpu_obj_get(self._h, key, timeout_ms,
+        rc = self._lib.rtpu_obj_get(self._h, key, 0,
                                     ctypes.byref(off), ctypes.byref(size))
+        if rc != 0 and not _no_restore and self._maybe_restore(oid):
+            rc = self._lib.rtpu_obj_get(self._h, key, timeout_ms or 5000,
+                                        ctypes.byref(off), ctypes.byref(size))
+        elif rc != 0 and timeout_ms != 0:
+            rc = self._lib.rtpu_obj_get(self._h, key, timeout_ms,
+                                        ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
         return PinnedBuffer(self, key, self._view(off.value, size.value))
@@ -240,13 +344,37 @@ class ShmStore:
 
     def _release_raw(self, key: bytes) -> None:
         if self._h:
-            self._lib.rtpu_obj_release(self._h, key)
+            rc = self._lib.rtpu_obj_release(self._h, key)
+            if rc == 2 and self._spill_enabled:
+                # Last pin of a DOOMED object (deleted while we held it):
+                # any spill file we or others wrote must not resurrect it.
+                try:
+                    os.unlink(self._spill_path(key))
+                except OSError:
+                    pass
 
     def delete(self, oid: ObjectID) -> bool:
+        """Remove the in-memory copy AND any spill file (a freed object must
+        not resurrect on a later read)."""
+        ok = self._lib.rtpu_obj_delete(self._h, self._key(oid)) == 0
+        if self._spill_enabled:
+            try:
+                os.unlink(self._spill_path(self._key(oid)))
+                ok = True
+            except OSError:
+                pass
+        return ok
+
+    def spill_delete_only(self, oid: ObjectID) -> bool:
+        """delete() semantics as used by spill_for: drop ONLY the arena
+        copy, keeping the spill file as the object's backing."""
         return self._lib.rtpu_obj_delete(self._h, self._key(oid)) == 0
 
     def contains(self, oid: ObjectID) -> bool:
-        return bool(self._lib.rtpu_obj_contains(self._h, self._key(oid)))
+        if bool(self._lib.rtpu_obj_contains(self._h, self._key(oid))):
+            return True
+        return (self._spill_enabled
+                and os.path.exists(self._spill_path(self._key(oid))))
 
     def stats(self) -> Tuple[int, int, int, int]:
         """(used_bytes, capacity, n_objects, n_evictions)."""

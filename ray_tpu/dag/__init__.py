@@ -1,0 +1,22 @@
+"""ray_tpu.dag: compiled multi-actor execution graphs (aDAG equivalent).
+
+Parity target: the reference's Compiled Graphs surface (python/ray/dag/ —
+InputNode/MultiOutputNode/.bind()/experimental_compile) re-designed for
+this runtime: schedules execute over shm channels with condvar wakeups
+instead of per-call RPC (see compiled_dag.py).
+"""
+
+from ray_tpu.dag.channel import (ChannelClosedError, ChannelTimeoutError,
+                                 ShmChannel)
+from ray_tpu.dag.communicator import (Communicator, CpuCommunicator,
+                                      JaxHostCommunicator)
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
+                                  MultiOutputNode)
+
+__all__ = [
+    "ChannelClosedError", "ChannelTimeoutError", "ClassMethodNode",
+    "Communicator", "CompiledDAG", "CompiledDAGRef", "CpuCommunicator",
+    "DAGNode", "InputNode", "JaxHostCommunicator", "MultiOutputNode",
+    "ShmChannel",
+]

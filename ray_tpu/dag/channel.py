@@ -1,0 +1,138 @@
+"""Shm-backed channels: the compiled DAG's data plane.
+
+Parity target: reference python/ray/experimental/channel/
+shared_memory_channel.py:151 (Channel over mutable plasma objects).
+Re-designed over this runtime's object plane: each (channel, seq) message
+is one immutable store object with a DETERMINISTIC id
+(sha224(channel_id || seq) — exactly the store's 28-byte key size), so
+writer and reader processes rendezvous with no coordination service.
+Consumption is deletion (the ack), and backpressure is the writer waiting
+for the message `capacity` slots back to be consumed. Wakeups ride the
+store's process-shared seal condvar — a compiled-DAG hop costs a shm write
++ condvar broadcast, not an RPC through the scheduler.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+from typing import Any, Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class ChannelClosedError(RuntimeError):
+    pass
+
+
+_STOP = b"\x00__rtpu_channel_stop__"
+
+
+def _msg_oid(channel_id: bytes, seq: int) -> ObjectID:
+    return ObjectID(hashlib.sha224(
+        channel_id + seq.to_bytes(8, "little")).digest())
+
+
+class ShmChannel:
+    """Single-writer single-reader ordered message channel.
+
+    Both ends construct it from the (serializable) channel_id; the store
+    handle comes from the hosting process's runtime. Same-node only — the
+    compiled DAG scheduler co-locates or falls back to the RPC path.
+    """
+
+    def __init__(self, channel_id: bytes, capacity: int = 8):
+        self.channel_id = channel_id
+        self.capacity = capacity
+        self._store = None
+
+    def _ensure_store(self):
+        if self._store is None:
+            from ray_tpu.core.runtime_context import require_runtime
+
+            self._store = require_runtime().store
+        return self._store
+
+    # ------------------------------------------------------------ writer
+
+    def write(self, value: Any, seq: int, timeout: Optional[float] = None,
+              _raw: Optional[bytes] = None) -> None:
+        store = self._ensure_store()
+        payload = _raw if _raw is not None else pickle.dumps(
+            ("ok", value), protocol=5)
+        # Backpressure: the slot `capacity` behind must have been consumed.
+        # Exponential backoff (0.5ms -> 10ms): contains() may stat the
+        # spill dir, and a tight poll would be a syscall storm per stalled
+        # writer.
+        if seq >= self.capacity:
+            old = _msg_oid(self.channel_id, seq - self.capacity)
+            deadline = None if timeout is None else time.monotonic() + timeout
+            pause = 0.0005
+            while store.contains(old):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ChannelTimeoutError(
+                        f"reader {self.capacity} messages behind")
+                time.sleep(pause)
+                pause = min(pause * 2, 0.01)
+        store.put_bytes(_msg_oid(self.channel_id, seq), payload)
+
+    def write_error(self, exc: BaseException, seq: int) -> None:
+        self.write(None, seq, _raw=pickle.dumps(("err", exc), protocol=5))
+
+    def write_stop(self, seq: int) -> None:
+        self.write(None, seq, _raw=pickle.dumps(("stop", None), protocol=5))
+
+    # ------------------------------------------------------------ reader
+
+    def read(self, seq: int, timeout: Optional[float] = None) -> Any:
+        """Blocking read of message `seq`; consumed (deleted) on return.
+        Raises the carried exception for error messages and
+        ChannelClosedError for stop sentinels."""
+        store = self._ensure_store()
+        oid = _msg_oid(self.channel_id, seq)
+        ms = -1 if timeout is None else max(1, int(timeout * 1000))
+        buf = store.get(oid, timeout_ms=ms)
+        if buf is None:
+            raise ChannelTimeoutError(
+                f"channel read timed out (seq={seq})")
+        try:
+            kind, value = pickle.loads(bytes(buf.buffer))
+        finally:
+            buf.release()
+        store.delete(oid)  # consumption ack: frees the writer's slot
+        if kind == "err":
+            raise value
+        if kind == "stop":
+            raise ChannelClosedError("channel closed")
+        return value
+
+    def wait_consumed(self, seq: int, timeout: float = 10.0) -> bool:
+        """Block until message `seq` has been consumed (teardown
+        handshake). True if consumed within the timeout."""
+        store = self._ensure_store()
+        oid = _msg_oid(self.channel_id, seq)
+        deadline = time.monotonic() + timeout
+        pause = 0.001
+        while store.contains(oid):
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(pause)
+            pause = min(pause * 2, 0.05)
+        return True
+
+    def drain(self, from_seq: int, span: int = 64) -> None:
+        """Best-effort cleanup of unconsumed messages (teardown)."""
+        store = self._ensure_store()
+        for seq in range(max(0, from_seq - span), from_seq + span):
+            try:
+                store.delete(_msg_oid(self.channel_id, seq))
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        return (ShmChannel, (self.channel_id, self.capacity))

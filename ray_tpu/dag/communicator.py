@@ -1,0 +1,103 @@
+"""Communicator ABC: pluggable tensor transport between DAG actors.
+
+Parity target: reference python/ray/experimental/channel/communicator.py:19
+(the backend-pluggable seam the compiled graphs use for NCCL p2p) +
+cpu_communicator.py (the test impl). TPU-first stance: INTRA-program tensor
+movement belongs to XLA collectives over the mesh (ray_tpu/parallel/) — a
+compiled SPMD step never routes tensors through host channels. The
+communicator covers the remaining cases: host-side handoff between separate
+JAX programs (e.g. pipeline stages owned by different actors on one host)
+and CPU-only tests.
+"""
+
+from __future__ import annotations
+
+import abc
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.channel import ShmChannel
+
+
+class Communicator(abc.ABC):
+    """Point-to-point send/recv among a fixed group of ranks."""
+
+    @abc.abstractmethod
+    def send(self, value: Any, peer_rank: int) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self, peer_rank: int) -> Any: ...
+
+    @abc.abstractmethod
+    def rank(self) -> int: ...
+
+    @abc.abstractmethod
+    def world_size(self) -> int: ...
+
+
+class CpuCommunicator(Communicator):
+    """Shm-channel mesh among n ranks on one node (tests / host handoff).
+
+    Construct ONE spec with `CpuCommunicator.create_group(n)`, pass the
+    per-rank communicators to the actors (they serialize by channel ids).
+    """
+
+    def __init__(self, my_rank: int, n: int,
+                 channels: Dict[tuple, ShmChannel]):
+        self._rank = my_rank
+        self._n = n
+        self._channels = channels
+        self._send_seq = {r: 0 for r in range(n)}
+        self._recv_seq = {r: 0 for r in range(n)}
+
+    @staticmethod
+    def create_group(n: int, capacity: int = 8) -> List["CpuCommunicator"]:
+        channels = {
+            (src, dst): ShmChannel(uuid.uuid4().bytes, capacity=capacity)
+            for src in range(n) for dst in range(n) if src != dst
+        }
+        return [CpuCommunicator(r, n, channels) for r in range(n)]
+
+    def send(self, value: Any, peer_rank: int) -> None:
+        seq = self._send_seq[peer_rank]
+        self._send_seq[peer_rank] += 1
+        self._channels[(self._rank, peer_rank)].write(value, seq)
+
+    def recv(self, peer_rank: int, timeout: Optional[float] = 60.0) -> Any:
+        seq = self._recv_seq[peer_rank]
+        self._recv_seq[peer_rank] += 1
+        return self._channels[(peer_rank, self._rank)].read(seq, timeout)
+
+    def rank(self) -> int:
+        return self._rank
+
+    def world_size(self) -> int:
+        return self._n
+
+    def __reduce__(self):
+        return (CpuCommunicator, (self._rank, self._n, self._channels))
+
+
+class JaxHostCommunicator(CpuCommunicator):
+    """Same transport, but values that are jax.Arrays are converted to
+    numpy for the channel hop and re-placed on the receiver's default
+    device — the host-handoff path between separately-compiled JAX programs
+    (single-host pipeline stages). Multi-chip tensor traffic inside one
+    program should use mesh collectives instead, never this."""
+
+    def send(self, value: Any, peer_rank: int) -> None:
+        import jax
+        import numpy as np
+
+        if isinstance(value, jax.Array):
+            value = np.asarray(value)
+        super().send(value, peer_rank)
+
+    def recv(self, peer_rank: int, timeout: Optional[float] = 60.0) -> Any:
+        import jax
+        import numpy as np
+
+        value = super().recv(peer_rank, timeout)
+        if isinstance(value, np.ndarray):
+            value = jax.device_put(value)
+        return value

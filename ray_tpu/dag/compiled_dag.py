@@ -1,0 +1,326 @@
+"""DAG compilation: bound graphs -> per-actor channel-driven schedules.
+
+Parity target: reference python/ray/dag/compiled_dag_node.py:767
+(_get_or_compile: topo-sort, channel allocation, per-actor executables)
++ dag_node_operation.py (per-actor op schedules). TPU-first reshape: the
+compiled DAG is the host-side repeated-step executor — ONE compile hands
+each actor its op list; each `execute()` costs channel writes/reads (shm +
+condvar), bypassing scheduler, leases, and per-call RPC entirely. This is
+the substrate pipeline-parallel training steps run on (parallel/pipeline.py
+shards the model; this layer moves the microbatch activations).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.channel import ChannelClosedError, ShmChannel
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
+                                  MultiOutputNode)
+
+_DAG_LOOP_METHOD = "__rtpu_dag_loop__"
+
+
+def _topo_order(root: DAGNode) -> List[DAGNode]:
+    seen: Dict[int, DAGNode] = {}
+    order: List[DAGNode] = []
+
+    def visit(n: DAGNode):
+        if n._dag_id in seen:
+            return
+        seen[n._dag_id] = n
+        for up in n.upstream():
+            visit(up)
+        order.append(n)
+
+    visit(root)
+    return order
+
+
+class CompiledDAGRef:
+    """Future for one execute() round's outputs."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._got = False
+        self._value = None
+
+    def get(self, timeout: Optional[float] = 60.0):
+        if not self._got:
+            outs, first_err = [], None
+            # Consume EVERY output channel for this seq even when one
+            # carries an error — an unconsumed sibling slot would stall
+            # its producer at seq+capacity forever.
+            for ch in self._dag._output_channels:
+                try:
+                    outs.append(ch.read(self._seq, timeout))
+                except BaseException as e:  # noqa: BLE001
+                    if first_err is None:
+                        first_err = e
+            self._got = True
+            if first_err is not None:
+                self._value = ("__err__", first_err)
+                raise first_err
+            self._value = outs[0] if len(outs) == 1 else outs
+        if isinstance(self._value, tuple) and len(self._value) == 2 \
+                and self._value[0] == "__err__":
+            raise self._value[1]
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, capacity: int = 8):
+        self._capacity = capacity
+        self._seq = 0
+        self._torn_down = False
+        self._lock = threading.Lock()
+        self._build(root)
+
+    # ------------------------------------------------------------ build
+
+    def _chan(self) -> ShmChannel:
+        return ShmChannel(uuid.uuid4().bytes, capacity=self._capacity)
+
+    def _build(self, root: DAGNode) -> None:
+        order = _topo_order(root)
+        multi = order[-1] if isinstance(order[-1], MultiOutputNode) else None
+        output_nodes = multi.outputs if multi else [root]
+        for n in order:
+            if isinstance(n, MultiOutputNode) and n is not multi:
+                raise ValueError("MultiOutputNode must be the DAG root")
+
+        inputs = [n for n in order if isinstance(n, InputNode)]
+        if len(inputs) > 1:
+            raise ValueError("a DAG takes exactly one InputNode")
+
+        # One channel per ARGUMENT SLOT (not per producer/consumer pair —
+        # binding the same upstream to two args needs two SPSC channels),
+        # plus one per driver-visible output. producer_outputs collects
+        # every channel a node must write.
+        self._input_channels: List[ShmChannel] = []
+        producer_outputs: Dict[int, List[ShmChannel]] = {}
+
+        def argspec(v):
+            if isinstance(v, InputNode):
+                ch = self._chan()
+                self._input_channels.append(ch)
+                return ("chan", ch)
+            if isinstance(v, DAGNode):
+                ch = self._chan()
+                producer_outputs.setdefault(v._dag_id, []).append(ch)
+                return ("chan", ch)
+            return ("const", v)
+
+        per_actor: Dict[bytes, List[Dict[str, Any]]] = {}
+        self._actors: Dict[bytes, Any] = {}
+        # First pass: ops + arg channels, in global topo order (preserves
+        # intra-actor dependency order; the reference's dag_node_operation
+        # applies the same per-actor restriction).
+        ops_by_node: Dict[int, Dict[str, Any]] = {}
+        for n in order:
+            if not isinstance(n, ClassMethodNode):
+                continue
+            key = n.actor.actor_id.binary()
+            self._actors[key] = n.actor
+            op = {
+                "method": n.method_name,
+                "args": [argspec(a) for a in n.args],
+                "kwargs": {k: argspec(v) for k, v in n.kwargs.items()},
+                "outputs": [],
+            }
+            ops_by_node[n._dag_id] = op
+            per_actor.setdefault(key, []).append(op)
+        self._output_channels = []
+        for out in output_nodes:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor-method nodes")
+            ch = self._chan()
+            self._output_channels.append(ch)
+            producer_outputs.setdefault(out._dag_id, []).append(ch)
+        # Second pass: attach collected output channels.
+        for node_id, op in ops_by_node.items():
+            op["outputs"] = producer_outputs.get(node_id, [])
+
+        self._validate_same_node()
+
+        # Ship each actor its schedule; the worker runs a dedicated loop
+        # thread (special method intercepted in worker_main).
+        import ray_tpu
+
+        ray_tpu.get([
+            handle._actor_method_call(
+                _DAG_LOOP_METHOD, (per_actor[key],), {}, 1)
+            for key, handle in self._actors.items()
+        ], timeout=60)
+
+    def _validate_same_node(self) -> None:
+        """Shm channels are same-node: refuse to compile a DAG whose actors
+        sit elsewhere (a silent cross-node hang is far worse than an
+        error; multi-node DAGs are a later milestone)."""
+        from ray_tpu.core.runtime_context import require_runtime
+
+        rt = require_runtime()
+        my_node = getattr(rt, "node_id", None)
+        lister = getattr(rt, "list_actors", None)
+        if my_node is None or lister is None:
+            return
+        try:
+            table = {a["actor_id"]: a for a in lister()}
+        except Exception:
+            return
+        for key in self._actors:
+            info = table.get(key.hex()) or table.get(key)
+            if info and info.get("node_id") not in (None, my_node):
+                raise ValueError(
+                    f"compiled DAGs require all actors on the driver's "
+                    f"node (shm channels): actor {key.hex()[:12]} is on "
+                    f"{info.get('node_id')!r}, driver on {my_node!r}. "
+                    f"Pin actors with NodeAffinitySchedulingStrategy.")
+
+    # ------------------------------------------------------------ execute
+
+    def execute(self, *args) -> CompiledDAGRef:
+        """One round: write the input to every input channel, return a ref
+        for the outputs. Rounds pipeline up to the channel capacity."""
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG was torn down")
+            seq = self._seq
+            self._seq += 1
+        value = args[0] if len(args) == 1 else args
+        for ch in self._input_channels:
+            ch.write(value, seq)
+        return CompiledDAGRef(self, seq)
+
+    def teardown(self) -> None:
+        with self._lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            seq = self._seq
+            self._seq += 1
+        for ch in self._input_channels:
+            try:
+                ch.write_stop(seq)
+            except Exception:
+                pass
+        # Handshake, not a sleep: wait for each loop to CONSUME its stop
+        # sentinel (deleting it mid-flight would leave the loop blocked on
+        # a message that will never exist), then clean leftover slots.
+        for ch in self._input_channels:
+            ch.wait_consumed(seq, timeout=10.0)
+        for ch in self._input_channels + self._output_channels:
+            ch.drain(seq + 1)
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def compile_dag(root: DAGNode, **kwargs) -> CompiledDAG:
+    return CompiledDAG(root, **kwargs)
+
+
+# ---------------------------------------------------------------- worker side
+
+def _read_interruptible(ch, seq: int, stop_event: threading.Event):
+    """Channel read that honors the kill switch: blocking in the store's
+    condvar with timeout=None would strand the loop thread past actor
+    death (ray_tpu.kill sets the event but cannot wake a condvar wait)."""
+    from ray_tpu.dag.channel import ChannelTimeoutError
+
+    while True:
+        try:
+            return ch.read(seq, timeout=0.5)
+        except ChannelTimeoutError:
+            if stop_event.is_set():
+                raise ChannelClosedError("actor stopping")
+
+
+def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
+                       stop_event: threading.Event) -> None:
+    """Executed on a dedicated thread inside the hosting worker: one
+    iteration per seq — read op inputs, call the method on the actor
+    instance, write outputs. Errors are forwarded downstream (the driver
+    raises them from the output channel); a stop sentinel propagates and
+    ends the loop."""
+    seq = 0
+    while not stop_event.is_set():
+        stopped = False
+        for op in schedule:
+            # Consume EVERY arg channel for this seq — skipping siblings
+            # after the first error/stop would leave unread slots that
+            # stall their producers at seq+capacity forever.
+            args, kwargs = [], {}
+            first_err, saw_stop = None, False
+            for kind, v in op["args"]:
+                if kind != "chan":
+                    args.append(v)
+                    continue
+                try:
+                    args.append(_read_interruptible(v, seq, stop_event))
+                except ChannelClosedError:
+                    saw_stop = True
+                    args.append(None)
+                except BaseException as e:  # noqa: BLE001
+                    first_err = first_err or e
+                    args.append(None)
+            for k, (kind, v) in op["kwargs"].items():
+                if kind != "chan":
+                    kwargs[k] = v
+                    continue
+                try:
+                    kwargs[k] = _read_interruptible(v, seq, stop_event)
+                except ChannelClosedError:
+                    saw_stop = True
+                    kwargs[k] = None
+                except BaseException as e:  # noqa: BLE001
+                    first_err = first_err or e
+                    kwargs[k] = None
+            if saw_stop:
+                for out in op["outputs"]:
+                    try:
+                        out.write_stop(seq)
+                    except Exception:
+                        pass
+                # Consume the REMAINING ops' input sentinels too — each
+                # input channel got its own stop, and teardown's
+                # wait_consumed handshake blocks until all are read.
+                idx = schedule.index(op)
+                for later in schedule[idx + 1:]:
+                    for kind, v in list(later["args"]) + list(
+                            later["kwargs"].values()):
+                        if kind != "chan":
+                            continue
+                        try:
+                            v.read(seq, timeout=0.5)
+                        except Exception:
+                            pass
+                    for out in later["outputs"]:
+                        try:
+                            out.write_stop(seq)
+                        except Exception:
+                            pass
+                stopped = True
+                break
+            if first_err is not None:
+                # An upstream error rode the channel in: forward it.
+                for out in op["outputs"]:
+                    out.write_error(first_err, seq)
+                continue
+            try:
+                result = getattr(instance, op["method"])(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — forwarded, not fatal
+                for out in op["outputs"]:
+                    out.write_error(e, seq)
+                continue
+            for out in op["outputs"]:
+                out.write(result, seq)
+        if stopped:
+            return
+        seq += 1

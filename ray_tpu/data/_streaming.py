@@ -59,20 +59,22 @@ class InputOperator(Operator):
     def execute(self, upstream) -> Iterator[RefBundle]:
         assert upstream is None
 
-        @ray_tpu.remote
-        def _read(task: Callable[[], Block]) -> Tuple[Block, BlockMetadata]:
+        # num_returns=2: the BLOCK stays in the executing worker's store —
+        # only the (tiny) metadata is fetched to the driver. Blocks move
+        # worker-to-worker via the object plane, never through the driver.
+        @ray_tpu.remote(num_returns=2)
+        def _read(task: Callable[[], Block]):
             block = BlockAccessor.normalize(task())
             return block, BlockMetadata.of(block)
 
         pending = collections.deque(self._tasks)
-        in_flight: List[ObjectRef] = []
+        in_flight: List[List[ObjectRef]] = []
         while pending or in_flight:
             while pending and len(in_flight) < self._parallelism:
                 in_flight.append(_read.remote(pending.popleft()))
             # Preserve input order: wait on the OLDEST in-flight read.
-            head = in_flight.pop(0)
-            block, meta = ray_tpu.get(head)
-            yield ray_tpu.put(block), meta
+            block_ref, meta_ref = in_flight.pop(0)
+            yield block_ref, ray_tpu.get(meta_ref)
 
 
 class TaskPoolMapOperator(Operator):
@@ -85,30 +87,35 @@ class TaskPoolMapOperator(Operator):
 
     def __init__(self, fn: Callable, *, batch_size: Optional[int] = None,
                  fn_kwargs: Optional[Dict[str, Any]] = None,
-                 concurrency: int = 4, name: str = "map_batches"):
+                 concurrency: int = 4, name: str = "map_batches",
+                 pass_index: bool = False):
         self._fn = fn
         self._kwargs = fn_kwargs or {}
         self._batch_size = batch_size
         self._concurrency = concurrency
         self.name = name
+        # pass_index: fn also receives `_block_index=` (per-block seeds etc).
+        self._pass_index = pass_index
 
     def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
         fn, kwargs, bs = self._fn, self._kwargs, self._batch_size
+        pass_index = self._pass_index
 
-        @ray_tpu.remote
-        def _transform(block: Block) -> Tuple[Block, BlockMetadata]:
-            out = _apply_batch_fn(block, fn, kwargs, bs)
+        @ray_tpu.remote(num_returns=2)
+        def _transform(block: Block, index: int):
+            kw = dict(kwargs, _block_index=index) if pass_index else kwargs
+            out = _apply_batch_fn(block, fn, kw, bs)
             return out, BlockMetadata.of(out)
 
         window: collections.deque = collections.deque()
-        for ref, _meta in upstream:
-            window.append(_transform.remote(ref))
+        for i, (ref, _meta) in enumerate(upstream):
+            window.append(_transform.remote(ref, i))
             if len(window) >= self._concurrency:
-                block, meta = ray_tpu.get(window.popleft())
-                yield ray_tpu.put(block), meta
+                block_ref, meta_ref = window.popleft()
+                yield block_ref, ray_tpu.get(meta_ref)
         while window:
-            block, meta = ray_tpu.get(window.popleft())
-            yield ray_tpu.put(block), meta
+            block_ref, meta_ref = window.popleft()
+            yield block_ref, ray_tpu.get(meta_ref)
 
 
 class ActorPoolMapOperator(Operator):
@@ -139,7 +146,7 @@ class ActorPoolMapOperator(Operator):
             def __init__(self):
                 self._fn = fn_cls(**ctor)
 
-            def transform(self, block: Block) -> Tuple[Block, BlockMetadata]:
+            def transform(self, block: Block):
                 out = _apply_batch_fn(block, self._fn, kwargs, bs)
                 return out, BlockMetadata.of(out)
 
@@ -152,17 +159,19 @@ class ActorPoolMapOperator(Operator):
         try:
             # Round-robin dispatch, FIFO completion (per-actor ordering is
             # guaranteed by the actor runtime, cross-actor by the window).
+            # num_returns=2 as above: blocks stay off the driver.
             window: collections.deque = collections.deque()
             i = 0
             for ref, _meta in upstream:
-                window.append(pool[i % len(pool)].transform.remote(ref))
+                window.append(pool[i % len(pool)].transform.options(
+                    num_returns=2).remote(ref))
                 i += 1
                 if len(window) >= 2 * len(pool):
-                    block, meta = ray_tpu.get(window.popleft())
-                    yield ray_tpu.put(block), meta
+                    block_ref, meta_ref = window.popleft()
+                    yield block_ref, ray_tpu.get(meta_ref)
             while window:
-                block, meta = ray_tpu.get(window.popleft())
-                yield ray_tpu.put(block), meta
+                block_ref, meta_ref = window.popleft()
+                yield block_ref, ray_tpu.get(meta_ref)
         finally:
             for a in pool:
                 try:

@@ -101,20 +101,42 @@ class Dataset:
 
         return self._with_op(DriverOperator(gen, name=f"limit({n})"))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Block-local shuffle + shuffled block order (the reference's full
-        exchange shuffle is a later milestone; this is its `local_shuffle`
-        tier, sufficient for training-epoch decorrelation)."""
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       block_window: int = 16) -> "Dataset":
+        """Block-local row shuffle (per-block seeds) + windowed block-order
+        shuffle (the reference's full exchange shuffle is a later
+        milestone; this is its `local_shuffle` tier, sufficient for
+        training-epoch decorrelation)."""
         rng_seed = seed
 
-        def batch_fn(batch: Block) -> Block:
+        def batch_fn(batch: Block, _block_index: int = 0) -> Block:
             acc = BlockAccessor(batch)
             n = acc.num_rows()
-            rng = np.random.default_rng(rng_seed)
+            # Distinct permutation per block — one shared seed would move
+            # row i identically in every block (structured, not shuffled).
+            rng = (np.random.default_rng([rng_seed, _block_index])
+                   if rng_seed is not None else np.random.default_rng())
             perm = rng.permutation(n)
             return {k: v[perm] for k, v in batch.items()}
 
-        return self._with_op(TaskPoolMapOperator(batch_fn, name="shuffle"))
+        ds = self._with_op(TaskPoolMapOperator(batch_fn, name="shuffle",
+                                               pass_index=True))
+
+        def reorder(upstream):
+            import random as _random
+
+            rng = _random.Random(rng_seed)
+            window = []
+            for bundle in upstream:
+                window.append(bundle)
+                if len(window) >= block_window:
+                    rng.shuffle(window)
+                    while len(window) > block_window // 2:
+                        yield window.pop()
+            rng.shuffle(window)
+            yield from window
+
+        return ds._with_op(DriverOperator(reorder, name="shuffle-order"))
 
     # ------------------------------------------------------------ execution
 
@@ -329,7 +351,8 @@ class StreamSplitIterator:
 
         buf: List[Block] = []
         buffered = 0
-        import jax  # deferred: device_put may be None on pure-host consumers
+        if device_put is not None:
+            import jax  # noqa: F401 — only device consumers need jax
 
         for block in blocks():
             n = BlockAccessor(block).num_rows()

@@ -1,8 +1,10 @@
-"""Causal grouped-query attention — XLA reference path.
+"""Causal grouped-query attention: dispatcher + portable paths.
 
-This is the portable implementation (CPU tests + TPU fallback). The hot TPU
-paths are `ops/pallas/flash_attention.py` (fused kernel) and
-`ops/ring_attention.py` (sequence-parallel over the ``sp`` mesh axis).
+`full_causal_attention` dispatches to the fused Pallas TPU flash kernel
+(jax.experimental.pallas.ops.tpu.flash_attention, with block sizes tuned
+for Llama shapes — see `use_fused_kernel`); the blockwise online-softmax
+scan below is the portable path (CPU tests, ragged shapes), and
+`ops/ring_attention.py` covers sequence parallelism over the ``sp`` axis.
 
 Shapes follow [batch, seq, heads, head_dim] throughout ("BSHD").
 """

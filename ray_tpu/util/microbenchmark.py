@@ -1,0 +1,196 @@
+"""Core-plane microbenchmark suite.
+
+Parity target: the reference's `ray microbenchmark` CLI
+(reference: python/ray/_private/ray_perf.py:93, scripts.py:1966) — the
+canonical perf gate for core changes. Run as:
+
+    python -m ray_tpu.util.microbenchmark [--out PERF.json] [--quick]
+
+Prints one line per metric and writes a JSON file comparing against the
+reference's checked-in 2.42.0 numbers (BASELINE.md's core table).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+# Reference release-2.42.0 microbenchmark numbers (ops/s) from BASELINE.md.
+BASELINE = {
+    "single_client_get_calls": 10_612,
+    "single_client_put_calls": 4_866,
+    "single_client_put_gigabytes": 18.52,
+    "single_client_tasks_sync": 1_013,
+    "single_client_tasks_async": 8_032,
+    "actor_calls_sync_1_1": 1_986,
+    "actor_calls_async_1_1": 8_107,
+    "actor_calls_async_n_n": 26_442,
+    "single_client_wait_1k_refs": 5.42,
+    "pg_create_removal_per_s": 749,
+}
+
+
+def timeit(name: str, fn: Callable[[], int], min_seconds: float = 2.0,
+           results: Dict[str, float] = None) -> float:
+    """fn runs one batch and returns the op count; loop for min_seconds."""
+    fn()  # warmup
+    total_ops = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < min_seconds:
+        total_ops += fn()
+    dt = time.perf_counter() - t0
+    rate = total_ops / dt
+    base = BASELINE.get(name)
+    suffix = f"  (ref {base:,.0f}; {rate / base:.2f}x)" if base else ""
+    print(f"{name:40s} {rate:12,.1f} /s{suffix}", flush=True)
+    if results is not None:
+        results[name] = rate
+    return rate
+
+
+def main(argv: List[str] = None) -> Dict[str, float]:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="write PERF json here")
+    parser.add_argument("--quick", action="store_true",
+                        help="0.5s per metric instead of 2s")
+    args = parser.parse_args(argv)
+    min_s = 0.5 if args.quick else 2.0
+
+    import ray_tpu
+
+    # Logical CPUs: this benchmarks control-plane throughput, not compute —
+    # a 1-core CI box must still be able to host the actor gangs below.
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    results: Dict[str, float] = {}
+
+    # ---------------- puts / gets --------------------------------------
+    small = b"x" * 1024
+
+    def put_small():
+        refs = [ray_tpu.put(small) for _ in range(100)]
+        del refs
+        return 100
+
+    timeit("single_client_put_calls", put_small, min_s, results)
+
+    cached_ref = ray_tpu.put(np.arange(1024))
+
+    def get_small():
+        for _ in range(100):
+            ray_tpu.get(cached_ref)
+        return 100
+
+    timeit("single_client_get_calls", get_small, min_s, results)
+
+    big = np.ones((128, 1024, 1024), dtype=np.uint8)  # 128 MB
+
+    def put_big():
+        ref = ray_tpu.put(big)
+        del ref
+        return big.nbytes
+
+    rate_bytes = timeit("single_client_put_bytes", put_big, min_s, {})
+    results["single_client_put_gigabytes"] = rate_bytes / (1 << 30)
+    base = BASELINE["single_client_put_gigabytes"]
+    print(f"{'single_client_put_gigabytes':40s} "
+          f"{results['single_client_put_gigabytes']:12.2f} GB/s  "
+          f"(ref {base}; {results['single_client_put_gigabytes']/base:.2f}x)",
+          flush=True)
+
+    # ---------------- tasks --------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    def tasks_sync():
+        for _ in range(20):
+            ray_tpu.get(nop.remote())
+        return 20
+
+    timeit("single_client_tasks_sync", tasks_sync, min_s, results)
+
+    def tasks_async():
+        ray_tpu.get([nop.remote() for _ in range(200)])
+        return 200
+
+    timeit("single_client_tasks_async", tasks_async, min_s, results)
+
+    # ---------------- actors -------------------------------------------
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, payload=b""):
+            return payload
+
+    actor = Echo.remote()
+    ray_tpu.get(actor.ping.remote())
+
+    def actor_sync():
+        for _ in range(20):
+            ray_tpu.get(actor.ping.remote())
+        return 20
+
+    timeit("actor_calls_sync_1_1", actor_sync, min_s, results)
+
+    def actor_async():
+        ray_tpu.get([actor.ping.remote() for _ in range(200)])
+        return 200
+
+    timeit("actor_calls_async_1_1", actor_async, min_s, results)
+
+    n_pairs = 4
+    actors = [Echo.remote() for _ in range(n_pairs)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+
+    def actor_async_nn():
+        refs = []
+        for a in actors:
+            refs.extend(a.ping.remote() for _ in range(50))
+        ray_tpu.get(refs)
+        return len(refs)
+
+    timeit("actor_calls_async_n_n", actor_async_nn, min_s, results)
+
+    # ---------------- wait over many refs ------------------------------
+    refs_1k = [ray_tpu.put(i) for i in range(1000)]
+
+    def wait_1k():
+        ready, _ = ray_tpu.wait(refs_1k, num_returns=1000, timeout=30)
+        assert len(ready) == 1000
+        return 1
+
+    timeit("single_client_wait_1k_refs", wait_1k, min_s, results)
+
+    # ---------------- placement groups ---------------------------------
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    def pg_cycle():
+        for _ in range(5):
+            pg = placement_group([{"CPU": 0.01}])
+            pg.ready(timeout=10)
+            remove_placement_group(pg)
+        return 5
+
+    timeit("pg_create_removal_per_s", pg_cycle, min_s, results)
+
+    # ---------------- report -------------------------------------------
+    report = {
+        "metrics": {k: round(v, 2) for k, v in results.items()},
+        "vs_baseline": {
+            k: round(results[k] / BASELINE[k], 3)
+            for k in results if k in BASELINE
+        },
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}")
+    return report
+
+
+if __name__ == "__main__":
+    main()
