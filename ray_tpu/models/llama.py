@@ -274,8 +274,18 @@ def loss_fn(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
     hidden states in the backward pass). At V=128k this is the difference
     between fitting on a chip and an OOM.
     """
-    b, s = tokens.shape
     x = forward_hidden(params, tokens, cfg, mesh=mesh)
+    return loss_from_hidden(params, x, tokens, cfg, loss_mask=loss_mask,
+                            logits_chunk=logits_chunk)
+
+
+def loss_from_hidden(params: Params, x: jnp.ndarray, tokens: jnp.ndarray,
+                     cfg: LlamaConfig, *,
+                     loss_mask: Optional[jnp.ndarray] = None,
+                     logits_chunk: int = 512) -> Tuple[jnp.ndarray, Dict]:
+    """Chunked next-token CE given final hidden states [B,S,D] (shared by
+    the dense and pipeline forwards)."""
+    b, s = tokens.shape
     targets = jnp.roll(tokens, -1, axis=1)
     valid = (jnp.arange(s) < s - 1).astype(jnp.float32)[None, :]
     if loss_mask is not None:
