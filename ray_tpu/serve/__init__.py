@@ -1,0 +1,32 @@
+"""ray_tpu.serve: model serving — deployments, routing, batching, LLM.
+
+Parity target: the reference Ray Serve surface (python/ray/serve/__init__
+— deployment/run/get_deployment_handle/batch) over this runtime's actors:
+a reconciling controller, pow-2 routed replica sets, dynamic request
+batching, an HTTP ingress, and a native TPU continuous-batching LLM
+engine (the reference delegates that part to vLLM; serve/llm.py here).
+"""
+
+from ray_tpu.serve.api import (Deployment, DeploymentHandle,
+                               DeploymentResponse, delete, deployment,
+                               get_deployment_handle, run, shutdown,
+                               status)
+from ray_tpu.serve.batching import batch
+
+__all__ = [
+    "Deployment", "DeploymentHandle", "DeploymentResponse", "batch",
+    "delete", "deployment", "get_deployment_handle", "run", "shutdown",
+    "status", "start_http",
+]
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0):
+    """Start an HTTP ingress actor; returns (handle, port)."""
+    import ray_tpu
+    from ray_tpu.serve._private.proxy import HTTPProxyActor
+
+    actor = ray_tpu.remote(HTTPProxyActor).options(
+        max_concurrency=16).remote(host, port)
+    # The port is assigned inside the actor; fetch it.
+    addr = ray_tpu.get(actor.address.remote(), timeout=60)
+    return actor, int(addr.rsplit(":", 1)[1])

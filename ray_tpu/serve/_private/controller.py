@@ -1,0 +1,251 @@
+"""Serve controller: the reconciling control plane for deployments.
+
+Parity target: reference python/ray/serve/_private/controller.py
+(ServeController :84) + deployment_state.py (DeploymentState.update :2662)
++ autoscaling_state.py (:262): a single named actor owns the target state
+(deployment -> config), continuously reconciles running replicas toward
+it, and answers routing queries. Autoscaling compares each deployment's
+mean ongoing requests per replica to its target and nudges the replica
+count (reference autoscaling_policy.py:12).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "rtpu-serve-controller"
+
+
+class ServeController:
+    def __init__(self):
+        import ray_tpu  # inside the actor process
+
+        self._ray = ray_tpu
+        self._lock = threading.RLock()
+        # Serializes whole reconcile passes: deploy() and the background
+        # loop reconciling the same deployment concurrently would both
+        # observe the deficit and double-create replicas.
+        self._reconcile_mutex = threading.Lock()
+        # name -> {config..., replicas: [ActorHandle], version}
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._shutdown = False
+        threading.Thread(target=self._reconcile_loop, daemon=True,
+                         name="serve-reconcile").start()
+
+    # ------------------------------------------------------------- deploy
+
+    def deploy(self, name: str, cls, init_args: tuple,
+               init_kwargs: Dict[str, Any], config: Dict[str, Any]) -> bool:
+        """Create/update a deployment. Blocks until the initial replica set
+        is running (reference serve.run semantics)."""
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                d = self._deployments[name] = {
+                    "cls": cls, "init_args": init_args,
+                    "init_kwargs": init_kwargs, "config": dict(config),
+                    "replicas": [], "version": 0, "last_scale": 0.0,
+                }
+            else:
+                d.update(cls=cls, init_args=init_args,
+                         init_kwargs=init_kwargs, config=dict(config))
+                d["version"] += 1
+                # Code/config changed: replace the replica set.
+                self._stop_replicas(d["replicas"])
+                d["replicas"] = []
+        self._reconcile_once(name)
+        return True
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            d = self._deployments.pop(name, None)
+        if d:
+            self._stop_replicas(d["replicas"])
+        return d is not None
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            self._shutdown = True
+            deps = list(self._deployments.values())
+            self._deployments.clear()
+        for d in deps:
+            self._stop_replicas(d["replicas"])
+        return True
+
+    def _stop_replicas(self, replicas: List[Any],
+                       drain_timeout_s: float = 10.0) -> None:
+        """Drain then kill (reference: graceful replica shutdown) — an
+        immediate kill would fail every in-flight request on the victim.
+        Draining runs on background threads so control calls never block
+        on slow requests."""
+
+        def drain_and_kill(r):
+            deadline = time.time() + drain_timeout_s
+            while time.time() < deadline:
+                try:
+                    if self._ray.get(r.queue_len.remote(), timeout=5) == 0:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.25)
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass
+
+        for r in replicas:
+            threading.Thread(target=drain_and_kill, args=(r,),
+                             daemon=True).start()
+
+    # ---------------------------------------------------------- reconcile
+
+    def _desired_replicas(self, d: Dict[str, Any]) -> int:
+        with self._lock:
+            cfg = dict(d["config"])
+            replicas = list(d["replicas"])
+        n = cfg.get("num_replicas", 1)
+        auto = cfg.get("autoscaling_config")
+        if not auto:
+            return n
+        # Autoscaling: mean ongoing per replica vs target (RPCs below run
+        # WITHOUT the routing lock).
+        if not replicas:
+            return max(1, auto.get("min_replicas", 1))
+        try:
+            lens = self._ray.get(
+                [r.queue_len.remote() for r in replicas], timeout=5)
+        except Exception:
+            return len(replicas)
+        target = max(auto.get("target_ongoing_requests", 2), 1e-6)
+        desired = int(round(len(replicas) * (sum(lens) / len(lens))
+                            / target)) if lens else len(replicas)
+        lo = auto.get("min_replicas", 1)
+        hi = auto.get("max_replicas", max(lo, len(replicas)))
+        return min(max(desired, lo), hi)
+
+    def _reconcile_once(self, name: str) -> None:
+        with self._reconcile_mutex:
+            self._reconcile_once_locked(name)
+
+    def _reconcile_once_locked(self, name: str) -> None:
+        from ray_tpu.serve._private.replica import ReplicaActor
+
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                return
+            version = d["version"]
+        # The desired-count computation may RPC the replicas (queue
+        # lengths): it must run OUTSIDE the routing lock or every
+        # get_replicas/status call stalls behind it each reconcile tick.
+        desired = self._desired_replicas(d)
+        with self._lock:
+            if self._deployments.get(name) is not d \
+                    or d["version"] != version:
+                return  # redeployed underneath us; next tick handles it
+            current = len(d["replicas"])
+            cfg = d["config"]
+            to_add = desired - current
+            # Hysteresis: autoscaling changes at most once per 5s.
+            if cfg.get("autoscaling_config") and to_add != 0:
+                if time.time() - d["last_scale"] < 5.0:
+                    return
+                d["last_scale"] = time.time()
+            cls, args, kwargs = d["cls"], d["init_args"], d["init_kwargs"]
+            res = dict(cfg.get("ray_actor_options", {}))
+        if to_add > 0:
+            actor_cls = self._ray.remote(ReplicaActor)
+            opts = {"num_cpus": res.get("num_cpus", 1)}
+            if res.get("resources"):
+                opts["resources"] = res["resources"]
+            # Headroom beyond user requests: health_check/queue_len control
+            # RPCs must never starve behind a saturated request pool (a
+            # busy replica would read as dead exactly under load).
+            opts["max_concurrency"] = (res.get("max_concurrency")
+                                       or cfg.get("max_ongoing_requests", 8)
+                                       ) + 4
+            new = [actor_cls.options(**opts).remote(cls, args, kwargs)
+                   for _ in range(to_add)]
+            # Readiness barrier.
+            self._ray.get([r.health_check.remote() for r in new],
+                          timeout=120)
+            with self._lock:
+                d2 = self._deployments.get(name)
+                if d2 is d:
+                    d["replicas"].extend(new)
+                else:
+                    self._stop_replicas(new)
+        elif to_add < 0:
+            with self._lock:
+                victims = d["replicas"][to_add:]
+                del d["replicas"][to_add:]
+            self._stop_replicas(victims)
+
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(1.0)
+            for name in list(self._deployments):
+                try:
+                    self._reconcile_once(name)
+                except Exception:
+                    pass
+            self._check_replica_health()
+
+    def _check_replica_health(self) -> None:
+        """Dead replicas are pruned; reconcile replaces them next tick."""
+        with self._lock:
+            items = [(n, list(d["replicas"]))
+                     for n, d in self._deployments.items()]
+        for name, replicas in items:
+            dead = []
+            for r in replicas:
+                try:
+                    self._ray.get(r.health_check.remote(), timeout=10)
+                except Exception:
+                    dead.append(r)
+            if dead:
+                with self._lock:
+                    d = self._deployments.get(name)
+                    if d:
+                        d["replicas"] = [r for r in d["replicas"]
+                                         if r not in dead]
+                # Kill pruned replicas: a half-dead process left running
+                # would leak its lease/worker forever.
+                for r in dead:
+                    try:
+                        self._ray.kill(r)
+                    except Exception:
+                        pass
+
+    # ------------------------------------------------------------ routing
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(f"no deployment named {name!r}")
+            return list(d["replicas"])
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                n: {"num_replicas": len(d["replicas"]),
+                    "version": d["version"], "config": dict(d["config"])}
+                for n, d in self._deployments.items()
+            }
+
+    def status(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(name)
+            replicas = list(d["replicas"])
+        metrics = []
+        for r in replicas:
+            try:
+                metrics.append(self._ray.get(r.metrics.remote(), timeout=5))
+            except Exception:
+                metrics.append(None)
+        return {"replicas": len(replicas), "metrics": metrics}

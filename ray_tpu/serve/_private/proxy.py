@@ -1,0 +1,90 @@
+"""HTTP ingress: JSON-over-HTTP routed to deployment handles.
+
+Parity target: reference python/ray/serve/proxy.py (ProxyActor :1129,
+HTTPProxy :752) trimmed to the -lite surface: a proxy actor runs a
+threaded stdlib HTTP server; `POST /<deployment>` with a JSON body calls
+the deployment (pow-2 routed) and returns the JSON result. `GET
+/-/healthz` for liveness, `GET /-/routes` lists deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        from ray_tpu.serve import api as serve_api
+
+        handles: Dict[str, Any] = {}
+        get_handle = serve_api.get_deployment_handle
+        list_status = serve_api.status
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/-/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/-/routes":
+                    try:
+                        return self._send(200, list_status())
+                    except Exception as e:
+                        return self._send(500, {"error": str(e)})
+                return self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[0]
+                if not name:
+                    return self._send(404, {"error": "no deployment in path"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    return self._send(400, {"error": f"bad json: {e}"})
+                try:
+                    h = handles.get(name)
+                    if h is None:
+                        h = handles[name] = get_handle(name)
+                    result = h.remote(payload).result(timeout=120)
+                    return self._send(200, {"result": result})
+                except Exception as e:  # noqa: BLE001 — surfaced as 500
+                    # The controller's KeyError arrives wrapped as a
+                    # remote TaskError; match it by message for the 404.
+                    if "no deployment named" in str(e) or \
+                            isinstance(e, KeyError):
+                        handles.pop(name, None)
+                        return self._send(404, {"error": f"no deployment "
+                                                f"{name!r}"})
+                    return self._send(500, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serve-http").start()
+
+    def address(self) -> str:
+        import socket
+
+        return f"{socket.gethostbyname('localhost')}:{self.port}"
+
+    def healthy(self) -> bool:
+        return True
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        return True

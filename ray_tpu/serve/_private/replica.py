@@ -1,0 +1,71 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Parity target: reference python/ray/serve/_private/replica.py (ReplicaActor
+:883, UserCallableWrapper :1125) — constructs the user class once, serves
+`handle_request`, and tracks its own ongoing-request gauge (the signal the
+pow-2 router and the autoscaler consume).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class ReplicaActor:
+    def __init__(self, cls, init_args: tuple, init_kwargs: Dict[str, Any]):
+        self._callable = cls(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._started = time.time()
+        # Request-rate window for autoscaling decisions.
+        self._window: list = []
+
+    def handle_request(self, method: str, args: tuple,
+                       kwargs: Dict[str, Any]):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+            now = time.time()
+            self._window.append(now)
+            if len(self._window) > 1000:
+                self._window = self._window[-500:]
+        try:
+            target = (self._callable if method == "__call__"
+                      else getattr(self._callable, method))
+            if method == "__call__" and not callable(self._callable):
+                raise TypeError(
+                    f"{type(self._callable).__name__} is not callable; "
+                    f"route to a named method instead")
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            now = time.time()
+            recent = [t for t in self._window if now - t < 10.0]
+            return {"ongoing": self._ongoing, "total": self._total,
+                    "rps_10s": len(recent) / 10.0,
+                    "uptime_s": now - self._started}
+
+    def reconfigure(self, user_config: Any) -> bool:
+        """Push a config update without restarting (reference: the
+        `reconfigure` user hook)."""
+        hook = getattr(self._callable, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+            return True
+        return False
+
+    def health_check(self) -> bool:
+        hook = getattr(self._callable, "check_health", None)
+        if hook is not None:
+            hook()
+        return True

@@ -1,0 +1,70 @@
+"""Client-side router: power-of-two-choices replica selection.
+
+Parity target: reference python/ray/serve/_private/replica_scheduler/
+pow_2_scheduler.py:52 — sample two replicas, send to the one with the
+shorter queue. Queue lengths are the CALLER's local in-flight view plus a
+periodically refreshed replica-reported gauge (the reference streams
+queue-len reports the same way; a per-call queue-len RPC would double the
+request latency).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Router:
+    def __init__(self, controller, deployment: str,
+                 refresh_interval_s: float = 2.0):
+        self._controller = controller
+        self._deployment = deployment
+        self._refresh_s = refresh_interval_s
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []
+        self._inflight: Dict[Any, int] = {}
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < self._refresh_s \
+                    and self._replicas:
+                return
+            self._last_refresh = now
+        replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._deployment),
+            timeout=30)
+        with self._lock:
+            self._replicas = replicas
+            self._inflight = {r: self._inflight.get(r, 0)
+                              for r in replicas}
+
+    def choose(self):
+        """Pow-2: two random candidates, fewer local in-flight wins."""
+        self._refresh()
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self._deployment!r} has no replicas")
+            if len(self._replicas) == 1:
+                choice = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                choice = (a if self._inflight.get(a, 0)
+                          <= self._inflight.get(b, 0) else b)
+            self._inflight[choice] = self._inflight.get(choice, 0) + 1
+            return choice
+
+    def done(self, replica) -> None:
+        with self._lock:
+            if replica in self._inflight and self._inflight[replica] > 0:
+                self._inflight[replica] -= 1
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._last_refresh = 0.0

@@ -1,0 +1,196 @@
+"""Public Serve API: deployments, handles, run/shutdown.
+
+Parity target: reference python/ray/serve/api.py (serve.deployment :306,
+serve.run :499) + handle.py (DeploymentHandle). The controller is a named
+actor; handles route with pow-2 over its replica sets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve._private.router import Router
+
+_lock = threading.Lock()
+
+
+def _get_or_start_controller():
+    with _lock:
+        try:
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            pass
+        actor_cls = ray_tpu.remote(ServeController)
+        return actor_cls.options(
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=16,
+            num_cpus=1).remote()
+
+
+class DeploymentResponse:
+    """Future for one routed request (reference: DeploymentResponse)."""
+
+    def __init__(self, ref, router: Router, replica,
+                 retry: Optional[Callable[[], "DeploymentResponse"]] = None):
+        self._ref = ref
+        self._router = router
+        self._replica = replica
+        self._retry = retry
+        self._done = False
+
+    def result(self, timeout: Optional[float] = 60.0):
+        from ray_tpu.exceptions import ActorDiedError
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except ActorDiedError:
+            # The routed replica died under us: refresh the set and replay
+            # ONCE on a live replica (reference routers reroute the same
+            # way; a dead-actor error never raises at .remote() time in
+            # this runtime, only here).
+            self._router.invalidate()
+            if self._retry is None:
+                raise
+            retry, self._retry = self._retry, None
+            return retry().result(timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router.done(self._replica)
+
+    # Allow passing responses straight into downstream .remote() calls.
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    """Routes calls to a deployment's replicas (pow-2 choices)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+        self._controller = _get_or_start_controller()
+        self._router = Router(self._controller, deployment_name)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h._name = self._name
+        h._method = method_name
+        h._controller = self._controller
+        h._router = self._router
+        return h
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(item)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica = self._router.choose()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        # One replay budget for a dead-replica result (submission itself
+        # never raises for dead actors in this runtime).
+        return DeploymentResponse(
+            ref, self._router, replica,
+            retry=lambda: self._route_once(args, kwargs))
+
+    def _route_once(self, args, kwargs) -> DeploymentResponse:
+        replica = self._router.choose()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, self._router, replica)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method))
+
+
+class Deployment:
+    """The object @serve.deployment produces; .bind() attaches init args."""
+
+    def __init__(self, cls: type, name: str, config: Dict[str, Any]):
+        self._cls = cls
+        self.name = name
+        self._config = config
+        self._init_args: tuple = ()
+        self._init_kwargs: Dict[str, Any] = {}
+
+    def options(self, **overrides) -> "Deployment":
+        d = Deployment(self._cls, overrides.pop("name", self.name),
+                       {**self._config, **overrides})
+        d._init_args = self._init_args
+        d._init_kwargs = self._init_kwargs
+        return d
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = Deployment(self._cls, self.name, dict(self._config))
+        d._init_args = args
+        d._init_kwargs = kwargs
+        return d
+
+
+def deployment(_cls: Optional[type] = None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_ongoing_requests: int = 8,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               user_config: Any = None):
+    """@serve.deployment decorator (class-based deployments)."""
+
+    def wrap(cls: type) -> Deployment:
+        cfg = {
+            "num_replicas": num_replicas,
+            "max_ongoing_requests": max_ongoing_requests,
+            "autoscaling_config": autoscaling_config,
+            "ray_actor_options": ray_actor_options or {},
+            "user_config": user_config,
+        }
+        return Deployment(cls, name or cls.__name__, cfg)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+def run(target: Deployment, *, name: Optional[str] = None,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy (or update) and return a handle (reference serve.run :499)."""
+    if not isinstance(target, Deployment):
+        raise TypeError("serve.run expects a Deployment "
+                        "(apply @serve.deployment and .bind() first)")
+    controller = _get_or_start_controller()
+    dep_name = name or target.name
+    ray_tpu.get(controller.deploy.remote(
+        dep_name, target._cls, target._init_args, target._init_kwargs,
+        target._config), timeout=180)
+    return DeploymentHandle(dep_name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_start_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = _get_or_start_controller()
+    ray_tpu.get(controller.delete.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    with _lock:
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            return
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass

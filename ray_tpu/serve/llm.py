@@ -1,0 +1,259 @@
+"""LLM serving: TPU continuous batching over the Llama KV-cache decoder.
+
+Parity target: the reference delegates LLM serving to vLLM
+(reference python/ray/serve/llm.py:26-48 VLLMDeployment); on TPU that
+cannot be assumed (SURVEY M9), so the engine is native:
+
+- STATIC shapes throughout (XLA compiles once per prompt-length bucket):
+  a fixed pool of `max_batch` slots shares one [L, B, max_len, KH, HD]
+  KV cache in HBM.
+- Continuous batching: every engine tick admits waiting requests into
+  free slots (bucket-padded prefill) and advances ALL active slots one
+  decode step in a single batched forward — new requests join between
+  ticks, finished ones free their slot immediately (no head-of-line
+  blocking on the longest generation).
+- Decode runs per-slot positions via vmap over the batch dim, so slots
+  at different sequence offsets advance together.
+
+Wrap `LLMEngine` in a `@serve.deployment` (see `build_llm_deployment`) to
+get routed, autoscaled replicas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    prompt_ids: List[int]
+    max_new_tokens: int = 32
+    eos_id: Optional[int] = None
+    future: Future = dataclasses.field(default_factory=Future)
+    # engine state
+    slot: int = -1
+    generated: List[int] = dataclasses.field(default_factory=list)
+    length: int = 0   # tokens currently in the KV cache for this slot
+
+
+def _bucket(n: int, buckets: List[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+class LLMEngine:
+    """The slot-based continuous-batching decode engine."""
+
+    def __init__(self, cfg=None, params=None, *, max_batch: int = 4,
+                 max_len: int = 512,
+                 prompt_buckets: Optional[List[int]] = None,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        self._jax, self._jnp, self._llama = jax, jnp, llama
+        self.cfg = cfg or llama.tiny_config(max_seq_len=max_len)
+        self.params = (params if params is not None
+                       else llama.init_params(self.cfg,
+                                              jax.random.PRNGKey(seed)))
+        self.max_batch = max_batch
+        self.max_len = min(max_len, self.cfg.max_seq_len)
+        self.buckets = prompt_buckets or [32, 64, 128]
+        self.cache = llama.init_kv_cache(self.cfg, max_batch, self.max_len)
+
+        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._free = list(range(max_batch))
+        self._active: List[GenerationRequest] = []
+        self._shutdown = False
+        self._jit_prefill: Dict[int, Any] = {}
+        self._jit_decode = None
+        self._build_fns()
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        daemon=True, name="llm-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------- compile
+
+    def _build_fns(self) -> None:
+        jax, jnp, llama = self._jax, self._jnp, self._llama
+        cfg = self.cfg
+
+        def prefill(params, cache, tokens, slot):
+            """tokens [1, Pb] written into slot's rows at [0, Pb)."""
+            row = {k: jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=1)
+                   for k, v in cache.items()}
+            logits, new_row = llama.forward_with_cache(
+                params, tokens, row, 0, cfg)
+            cache = {k: jax.lax.dynamic_update_slice_in_dim(
+                cache[k], new_row[k], slot, axis=1) for k in cache}
+            return logits, cache
+
+        self._prefill_fn = jax.jit(prefill)
+
+        def decode(params, cache, tokens, lengths):
+            """One step for every slot: tokens [B,1], lengths [B]."""
+
+            def one(cache_row, tok, idx):
+                # vmap stripped the batch dim; the model wants [L,1,...].
+                row = {k: v[:, None] for k, v in cache_row.items()}
+                logits, new_row = llama.forward_with_cache(
+                    params, tok[None], row, idx, cfg)
+                return logits[0, -1], {k: v[:, 0]
+                                       for k, v in new_row.items()}
+
+            logits, new_cache = jax.vmap(
+                one, in_axes=({"k": 1, "v": 1}, 0, 0),
+                out_axes=(0, {"k": 1, "v": 1}))(cache, tokens, lengths)
+            next_ids = jnp.argmax(logits, axis=-1)
+            return next_ids, new_cache
+
+        self._decode_fn = jax.jit(decode)
+
+    # ------------------------------------------------------------- public
+
+    def generate(self, prompt_ids: List[int], max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None,
+                 timeout: float = 300.0) -> Dict[str, Any]:
+        """Blocking generation (replicas call this per request; batching
+        happens inside the engine across concurrent callers)."""
+        req = GenerationRequest(list(prompt_ids), max_new_tokens, eos_id)
+        if not req.prompt_ids:
+            raise ValueError("empty prompt")
+        if not all(isinstance(t, (int, np.integer))
+                   and 0 <= t < self.cfg.vocab_size
+                   for t in req.prompt_ids):
+            raise ValueError("prompt_ids must be ints in [0, vocab_size)")
+        if len(req.prompt_ids) + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        self._queue.put(req)
+        return req.future.result(timeout=timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"active": len(self._active), "free_slots": len(self._free),
+                "waiting": self._queue.qsize()}
+
+    def close(self) -> None:
+        self._shutdown = True
+
+    # ------------------------------------------------------------- engine
+
+    def _admit(self) -> None:
+        jnp = self._jnp
+        while self._free and not self._queue.empty():
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            slot = self._free.pop()
+            req.slot = slot
+            try:
+                plen = len(req.prompt_ids)
+                pb = _bucket(plen, [b for b in self.buckets
+                                    if b <= self.max_len] + [self.max_len])
+                padded = np.zeros((1, pb), np.int32)
+                padded[0, :plen] = req.prompt_ids
+                logits, self.cache = self._prefill_fn(
+                    self.params, self.cache, jnp.asarray(padded), slot)
+                # First generated token: from the LAST REAL prompt pos.
+                first = int(np.argmax(np.asarray(logits)[0, plen - 1]))
+            except BaseException as e:  # noqa: BLE001 — one bad request
+                # must not kill the engine thread (every later request
+                # would hang on a dead engine).
+                self._free.append(slot)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            req.generated.append(first)
+            req.length = plen
+            self._active.append(req)
+            self._maybe_finish(req, first)
+
+    def _maybe_finish(self, req: GenerationRequest, last_tok: int) -> bool:
+        done = (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and last_tok == req.eos_id)
+                or req.length + 1 >= self.max_len)
+        if done and req in self._active:
+            self._active.remove(req)
+            self._free.append(req.slot)
+            if not req.future.done():
+                req.future.set_result({
+                    "token_ids": req.generated,
+                    "num_generated": len(req.generated),
+                })
+        return done
+
+    def _engine_loop(self) -> None:
+        jnp = self._jnp
+        while not self._shutdown:
+            self._admit()
+            if not self._active:
+                try:
+                    req = self._queue.get(timeout=0.1)
+                    self._queue.put(req)  # admit on next tick
+                except queue.Empty:
+                    pass
+                continue
+            # One batched decode step for every slot (inactive slots chew
+            # on stale state; their outputs are ignored).
+            tokens = np.zeros((self.max_batch, 1), np.int32)
+            lengths = np.zeros((self.max_batch,), np.int32)
+            for req in self._active:
+                tokens[req.slot, 0] = req.generated[-1]
+                lengths[req.slot] = req.length
+            try:
+                next_ids, self.cache = self._decode_fn(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(lengths))
+                next_ids = np.asarray(next_ids)
+            except BaseException as e:  # noqa: BLE001 — fail all waiters
+                for req in list(self._active):
+                    self._active.remove(req)
+                    self._free.append(req.slot)
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                continue
+            for req in list(self._active):
+                tok = int(next_ids[req.slot])
+                req.length += 1
+                req.generated.append(tok)
+                self._maybe_finish(req, tok)
+
+
+def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
+                         use_tpu: bool = False, engine_kwargs=None):
+    """A ready-to-run @serve.deployment wrapping LLMEngine."""
+    from ray_tpu.serve import api as serve_api
+
+    engine_kwargs = engine_kwargs or {}
+
+    class LLMServer:
+        def __init__(self, **kw):
+            self.engine = LLMEngine(**kw)
+
+        def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+            return self.engine.generate(
+                request["prompt_ids"],
+                max_new_tokens=request.get("max_new_tokens", 32),
+                eos_id=request.get("eos_id"))
+
+        def stats(self):
+            return self.engine.stats()
+
+    opts: Dict[str, Any] = {}
+    if use_tpu:
+        opts["resources"] = {"TPU": 1.0}
+    dep = serve_api.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        max_ongoing_requests=16, ray_actor_options=opts)
+    return dep.bind(**engine_kwargs)
