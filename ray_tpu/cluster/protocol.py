@@ -81,6 +81,36 @@ def _chaos_drop() -> bool:
     return p > 0 and random.random() < p
 
 
+# Per-method handler accounting (reference: common/event_stats.h — the
+# structural defense for event-loop discipline). Cheap enough to default
+# on: one dict update per RPC. Read at call time so _system_config /env
+# overrides work like every other flag.
+def _stats_on() -> bool:
+    return bool(cfg.event_stats_enabled)
+
+
+_event_stats: dict = {}
+_event_stats_lock = threading.Lock()
+
+
+def _record_event_stat(method: str, seconds: float, ok: bool) -> None:
+    with _event_stats_lock:
+        s = _event_stats.get(method)
+        if s is None:
+            s = _event_stats[method] = {"count": 0, "errors": 0,
+                                        "total_s": 0.0, "max_s": 0.0}
+        s["count"] += 1
+        if not ok:
+            s["errors"] += 1
+        s["total_s"] += seconds
+        s["max_s"] = max(s["max_s"], seconds)
+
+
+def get_event_stats() -> dict:
+    with _event_stats_lock:
+        return {m: dict(s) for m, s in _event_stats.items()}
+
+
 # --------------------------------------------------------------------------
 # Server
 # --------------------------------------------------------------------------
@@ -154,6 +184,7 @@ class RpcServer:
         fn = getattr(self.handler_obj, "rpc_" + method, None)
 
         def run():
+            t0 = time.monotonic() if _stats_on() else 0.0
             try:
                 if fn is None:
                     raise RpcError(f"no such rpc method: {method}")
@@ -161,6 +192,8 @@ class RpcServer:
                 ok = True
             except BaseException as e:  # noqa: BLE001
                 result, ok = e, False
+            if _stats_on():
+                _record_event_stat(method, time.monotonic() - t0, ok)
             if req_id > 0 and not _chaos_drop():
                 try:
                     conn.send_raw(SERIALIZER.encode((-req_id, ok, result)))

@@ -84,6 +84,11 @@ class WorkerRuntime(ClusterCore):
         self._seen_tasks: set = set()
         self._seen_order = collections.deque()
         self._seen_lock = threading.Lock()
+        # Cooperative cancellation: ids cancelled before execution start
+        # are skipped (running user code is never preempted — reference
+        # semantics for non-force cancel). FIFO-bounded like _seen_tasks.
+        self._cancelled: set = set()
+        self._cancelled_order = collections.deque()
         # The runtime must be installed BEFORE registration: a lease can
         # arrive (and a task execute) the instant the node manager sees us.
         runtime_context.set_runtime(self)
@@ -112,27 +117,46 @@ class WorkerRuntime(ClusterCore):
         task_id = TaskID(spec["task_id"])
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
         owner = spec["owner_addr"]
+        name = spec.get("name", "task")
+        t_start = time.time()
+
+        def span():
+            # Every terminal send carries a span — failed tasks are the
+            # ones operators most need to see in timeline/list_tasks.
+            return (t_start, time.time(), name)
+
         attempt = 0
         while True:
             try:
                 args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
             except TaskError as te:
                 self._send_results(owner, task_id, return_ids,
-                                   error=te)
+                                   error=te, span=span())
                 return
             except BaseException as e:  # noqa: BLE001
                 self._send_results(owner, task_id, return_ids,
-                                   error=capture_exception(e))
+                                   error=capture_exception(e), span=span())
+                return
+            if task_id.binary() in self._cancelled:
+                from ray_tpu.exceptions import TaskCancelledError
+
+                self._send_results(owner, task_id, return_ids,
+                                   error=TaskCancelledError(
+                                       f"task {name} cancelled"),
+                                   span=span())
                 return
             prev = runtime_context.set_worker_context({
                 "task_id": task_id, "actor_id": None,
                 "resources": spec.get("resources", {})})
+            t_start = time.time()
             try:
                 result = spec["func"](*args, **kwargs)
-                self._send_results(owner, task_id, return_ids, value=result)
+                self._send_results(owner, task_id, return_ids, value=result,
+                                   span=span())
                 return
             except TaskError as te:
-                self._send_results(owner, task_id, return_ids, error=te)
+                self._send_results(owner, task_id, return_ids, error=te,
+                                   span=span())
                 return
             except BaseException as e:  # noqa: BLE001
                 attempt += 1
@@ -141,7 +165,7 @@ class WorkerRuntime(ClusterCore):
                     time.sleep(cfg.task_retry_delay_ms / 1000.0)
                     continue
                 self._send_results(owner, task_id, return_ids,
-                                   error=capture_exception(e))
+                                   error=capture_exception(e), span=span())
                 return
             finally:
                 runtime_context.set_worker_context(prev)
@@ -157,7 +181,8 @@ class WorkerRuntime(ClusterCore):
     def _send_results(self, owner: str, task_id: TaskID,
                       return_ids: List[ObjectID], value: Any = None,
                       error: Optional[Exception] = None,
-                      actor_ctx: Optional[Tuple[bytes, int]] = None) -> None:
+                      actor_ctx: Optional[Tuple[bytes, int]] = None,
+                      span: Optional[Tuple[float, float, str]] = None) -> None:
         results: List[Tuple[bytes, str, Any]] = []
         if error is not None:
             for oid in return_ids:
@@ -196,10 +221,11 @@ class WorkerRuntime(ClusterCore):
             if actor_ctx is not None:
                 actor_id_bytes, seq = actor_ctx
                 client.retrying_call("actor_call_done", actor_id_bytes, seq,
-                                     task_id.binary(), results, timeout=10)
+                                     task_id.binary(), results, span,
+                                     timeout=10)
             else:
                 client.retrying_call("task_done", task_id.binary(), results,
-                                     timeout=10)
+                                     span, timeout=10)
         except Exception:
             # Owner gone: results are orphaned; large ones stay in the store
             # until the owner's death GC reclaims them (best effort round 1).
@@ -369,6 +395,7 @@ class WorkerRuntime(ClusterCore):
             prev = runtime_context.set_worker_context({
                 "task_id": task_id, "actor_id": hosted.actor_id,
                 "resources": {}})
+            t_exec = time.time()
             try:
                 if hosted.max_concurrency == 1:
                     with hosted.lock:
@@ -378,11 +405,24 @@ class WorkerRuntime(ClusterCore):
             finally:
                 runtime_context.set_worker_context(prev)
             self._send_results(owner, task_id, return_ids, value=result,
-                               actor_ctx=actor_ctx)
+                               actor_ctx=actor_ctx,
+                               span=(t_exec, time.time(),
+                                     f"actor.{spec['method']}"))
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, RayTpuError) else capture_exception(e)
             self._send_results(owner, task_id, return_ids, error=err,
                                actor_ctx=actor_ctx)
+
+    def rpc_cancel_task(self, conn, task_id_bytes: bytes):
+        """Cooperative cancel: a task that has not started is skipped; a
+        running one completes (no preemption, reference non-force cancel)."""
+        self._cancelled.add(task_id_bytes)
+        self._cancelled_order.append(task_id_bytes)
+        while len(self._cancelled_order) > 4096:
+            # Oldest-first eviction: set.pop() would drop arbitrary marks,
+            # possibly the one just added.
+            self._cancelled.discard(self._cancelled_order.popleft())
+        return True
 
     def rpc_kill_actor(self, conn, actor_id_bytes: bytes):
         actor_id = ActorID(actor_id_bytes)

@@ -181,11 +181,20 @@ class ClusterCore:
         self.lineage = LineageStore(cfg.max_lineage_bytes)
         self._recovering: Dict[bytes, float] = {}  # task_id -> last attempt
         self._recover_lock = threading.Lock()
+        # Observability: recent completions ring (util.state.list_tasks).
+        self._recent_tasks: "_collections.deque" = _collections.deque(
+            maxlen=512)
         self._actors: Dict[ActorID, _ActorConn] = {}
         self._actors_lock = threading.Lock()
         self._actor_classes: Dict[ActorID, Any] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupSpec] = {}
+        # Cancelled task ids: consulted at (re)dispatch so a cancel issued
+        # while the task was in flight sticks across worker-crash
+        # re-enqueues. FIFO-bounded.
+        import collections as _c
+
         self._cancelled: set = set()
+        self._cancelled_order: "_c.deque" = _c.deque()
         self._shutdown_flag = False
         # Push-ack tracking: every push_task is an acked call collected off
         # the dispatch hot path; unacked pushes are retried (worker-side
@@ -294,6 +303,10 @@ class ClusterCore:
         else:
             self._put_plasma(oid, header, buffers)
             self.memory_store.put(oid, PlasmaStub(oid))
+        from ray_tpu.util import metrics
+
+        metrics.OBJECTS_PUT.inc()
+        metrics.PUT_BYTES.inc(total)
         return ObjectRef(oid, self.owner_addr)
 
     def _put_plasma(self, oid: ObjectID, header: bytes, buffers) -> None:
@@ -662,12 +675,29 @@ class ClusterCore:
             self.refcount.remove_submitted_task_ref(oid)
 
     def rpc_task_done(self, conn, task_id_bytes: bytes,
-                      results: List[Tuple[bytes, str, Any]]):
+                      results: List[Tuple[bytes, str, Any]],
+                      span: Optional[Tuple[float, float, str]] = None):
         """Completion push from the executing worker.
-        results: [(oid_bytes, kind, payload)] kind in value|error|in_store."""
+        results: [(oid_bytes, kind, payload)] kind in value|error|in_store;
+        span: (exec_start, exec_end, name) for timeline/metrics."""
         with self._inflight_lock:
             info = self._inflight.pop(task_id_bytes, None)
         self._release_submitted_args(task_id_bytes)
+        status = ("error" if any(k == "error" for _o, k, _p in results)
+                  else "ok")
+        if span is not None:
+            from ray_tpu.util import metrics, timeline
+
+            t0, t1, name = span
+            timeline.record_event(name, "task", t0, t1,
+                                  args={"task_id": task_id_bytes.hex()[:12],
+                                        "status": status})
+            metrics.TASKS_FINISHED.inc()
+            metrics.TASK_EXEC_SECONDS.observe(max(0.0, t1 - t0))
+            self._recent_tasks.append({
+                "task_id": task_id_bytes.hex(), "name": name,
+                "duration_s": round(t1 - t0, 6), "status": status,
+                "end_ts": t1})
         for oid_bytes, kind, payload in results:
             oid = ObjectID(oid_bytes)
             if kind == "value":
@@ -726,6 +756,9 @@ class ClusterCore:
                              max_retries if retry_exceptions else 0,
                              sched_key, resources, strategy,
                              name or getattr(func, "__name__", "task"))
+        from ray_tpu.util import metrics
+
+        metrics.TASKS_SUBMITTED.inc()
         arg_ids = self._register_submitted_args(task_id.binary(), args,
                                                 kwargs)
         from ray_tpu.core.lineage import LineageRecord
@@ -843,6 +876,18 @@ class ClusterCore:
 
     def _push_to_lease(self, task_id_bytes: bytes, info: _InflightTask,
                        lease: _Lease, kq: "_KeyQueue") -> None:
+        # A cancel must survive re-dispatch (worker-crash re-enqueue) and
+        # the queue-pop -> inflight-insert window: last check before push.
+        if TaskID(task_id_bytes) in self._cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            err = TaskCancelledError(f"task {info.name} cancelled")
+            for oid in info.return_ids:
+                self.memory_store.put(oid, err, is_exception=True)
+            self._release_submitted_args(task_id_bytes)
+            # Undo this dispatch round's inflight++ (handles linger too).
+            self._lease_task_finished(info.sched_key, lease.worker_addr)
+            return
         info.worker_addr = lease.worker_addr
         with self._inflight_lock:
             self._inflight[task_id_bytes] = info
@@ -1045,8 +1090,42 @@ class ClusterCore:
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
-        self._cancelled.add(ref.id().task_id())
-        # Best effort: no preemption of running tasks in round 1.
+        """Cancel the task that produces `ref`: queued tasks are failed
+        with TaskCancelledError immediately; dispatched ones get a
+        cooperative cancel RPC to their worker (skipped if not yet
+        started; running user code is never preempted — reference
+        non-force semantics, core_worker Cancel path)."""
+        from ray_tpu.exceptions import TaskCancelledError
+
+        task_id = ref.id().task_id()
+        self._cancelled.add(task_id)
+        self._cancelled_order.append(task_id)
+        while len(self._cancelled_order) > 8192:
+            old = self._cancelled_order.popleft()
+            self._cancelled.discard(old)
+        tid_bytes = task_id.binary()
+        # Still queued? Remove + fail its returns.
+        with self._lease_lock:
+            for kq in self._key_queues.values():
+                for entry in list(kq.queue):
+                    if entry[0] == tid_bytes:
+                        kq.queue.remove(entry)
+                        err = TaskCancelledError(
+                            f"task {entry[1].name} cancelled")
+                        for oid in entry[1].return_ids:
+                            self.memory_store.put(oid, err,
+                                                  is_exception=True)
+                        self._release_submitted_args(tid_bytes)
+                        return
+        # Dispatched: tell the worker not to start it.
+        with self._inflight_lock:
+            info = self._inflight.get(tid_bytes)
+        if info is not None and info.worker_addr:
+            try:
+                self._pool.get(info.worker_addr).notify(
+                    "cancel_task", tid_bytes)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ actors
 
@@ -1145,6 +1224,9 @@ class ClusterCore:
             "owner_addr": self.owner_addr,
         })
         self._register_submitted_args(task_id.binary(), args, kwargs)
+        from ray_tpu.util import metrics
+
+        metrics.ACTOR_CALLS.inc()
         # Seq assignment + enqueue are synchronous with the caller: two
         # sequential .remote() calls CANNOT be reordered (the sender thread
         # drains in seq order).
@@ -1261,11 +1343,12 @@ class ClusterCore:
 
     def rpc_actor_call_done(self, conn_ctx, actor_id_bytes: bytes, seq: int,
                             task_id_bytes: bytes,
-                            results: List[Tuple[bytes, str, Any]]):
+                            results: List[Tuple[bytes, str, Any]],
+                            span: Optional[Tuple[float, float, str]] = None):
         aconn = self._actor_conn(ActorID(actor_id_bytes))
         with aconn.lock:
             aconn.pending.pop(seq, None)
-        return self.rpc_task_done(conn_ctx, task_id_bytes, results)
+        return self.rpc_task_done(conn_ctx, task_id_bytes, results, span)
 
     def _handle_actor_conn_lost(self, conn: _ActorConn) -> None:
         """Connection to the actor's worker died: consult the head."""

@@ -18,6 +18,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -143,6 +144,50 @@ class ClusterRuntime(ClusterCore):
         job_int = self.head.retrying_call("new_job_id", timeout=10)
         self.job_id = JobID.from_int(job_int)
         atexit.register(self.shutdown)
+        if cfg.log_to_driver:
+            from ray_tpu.util.log_monitor import LogMonitor
+
+            self._log_monitor = LogMonitor(cfg.log_dir)
+            self._log_monitor.start()
+        if cfg.metrics_report_period_ms > 0:
+            threading.Thread(target=self._metrics_report_loop, daemon=True,
+                             name="metrics-report").start()
+
+    # --------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: bytes, *, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        return self.head.retrying_call("kv_put", namespace, key.encode(),
+                                       data, overwrite, timeout=10)
+
+    def kv_get(self, key: str, *, namespace: str = "default"):
+        return self.head.retrying_call("kv_get", namespace, key.encode(),
+                                       timeout=10)
+
+    def kv_del(self, key: str, *, namespace: str = "default") -> bool:
+        return self.head.retrying_call("kv_del", namespace, key.encode(),
+                                       timeout=10)
+
+    def kv_keys(self, prefix: str = "", *,
+                namespace: str = "default") -> List[str]:
+        keys = self.head.retrying_call("kv_keys", namespace,
+                                       prefix.encode(), timeout=10)
+        return [k.decode() for k in keys]
+
+    def _metrics_report_loop(self) -> None:
+        """Publish this process's metric registry to the head KV
+        (reference: per-node metrics agents pushing to Prometheus)."""
+        from ray_tpu.util.metrics import prometheus_text
+
+        period = cfg.metrics_report_period_ms / 1000.0
+        while not self._shutdown_flag:
+            time.sleep(period)
+            try:
+                self.kv_put(f"metrics/{self.node_id[:12]}",
+                            prometheus_text().encode())
+            except Exception:
+                pass
 
     def add_node(self, num_cpus: float = 1.0,
                  resources: Optional[Dict[str, float]] = None,
@@ -183,6 +228,8 @@ class ClusterRuntime(ClusterCore):
             atexit.unregister(self.shutdown)
         except Exception:
             pass
+        if getattr(self, "_log_monitor", None) is not None:
+            self._log_monitor.stop()  # else init/shutdown cycles double-ship
         super().shutdown()
         for p in self._procs:
             try:

@@ -1,0 +1,19 @@
+"""ray_tpu.tune: hyperparameter search over concurrent trial actors.
+
+Parity target: the reference Ray Tune surface (python/ray/tune/__init__ —
+Tuner/TuneConfig/report/search spaces/schedulers), orchestration-only over
+this runtime's actors: trials run the user trainable under a report
+session; ASHA prunes losers at successive-halving rungs.
+"""
+
+from ray_tpu.train.session import report  # trials share the session API
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
+
+__all__ = [
+    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
+    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
+    "report", "uniform",
+]

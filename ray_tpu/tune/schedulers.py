@@ -1,0 +1,78 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Parity target: reference python/ray/tune/schedulers/async_hyperband.py
+(ASHAScheduler) — asynchronous successive halving: at each rung
+(iteration r, r*eta, r*eta^2, ...) a trial survives only if its metric is
+in the top 1/eta of results recorded AT that rung so far.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, iteration: int,
+                  metrics: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_batch(self, results) -> Dict[str, str]:
+        return {trial_id: CONTINUE for trial_id, _i, _m in results}
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.eta = reduction_factor
+        self.max_t = max_t
+        # rung iteration -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = {}
+        r = grace_period
+        self._rung_levels = []
+        while r < max_t:
+            self._rung_levels.append(r)
+            r *= reduction_factor
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metrics: Dict[str, Any]) -> str:
+        return self.on_batch([(trial_id, iteration, metrics)])[trial_id]
+
+    def on_batch(self, results) -> Dict[str, str]:
+        """Batch-synchronous halving: record EVERY result of the round at
+        its rung first, then judge each against the updated cutoff — a
+        lockstep tuner feeding results one-by-one would otherwise prune by
+        arrival order, not by score."""
+        decisions: Dict[str, str] = {}
+        judge = []
+        for trial_id, iteration, metrics in results:
+            if iteration >= self.max_t:
+                decisions[trial_id] = STOP
+                continue
+            if iteration not in self._rung_levels:
+                decisions[trial_id] = CONTINUE
+                continue
+            score = self._score(metrics)
+            rung = self._rungs.setdefault(iteration, [])
+            rung.append(score)
+            judge.append((trial_id, iteration, score))
+        for trial_id, iteration, score in judge:
+            rung = sorted(self._rungs[iteration], reverse=True)
+            # Top 1/eta of everything recorded at this rung survives
+            # (ceil: a 2-entry rung at eta=2 keeps 1, a 4-entry keeps 2).
+            k = max(1, -(-len(rung) // self.eta))
+            decisions[trial_id] = (CONTINUE if score >= rung[k - 1]
+                                   else STOP)
+        return decisions

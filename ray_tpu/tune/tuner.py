@@ -1,0 +1,218 @@
+"""Tuner: concurrent trial orchestration over actors.
+
+Parity target: reference python/ray/tune/tuner.py (Tuner.fit :344) +
+execution/tune_controller.py (:666 step loop): trials are actors running
+the user trainable with a report session; the controller caps concurrency,
+feeds every report to the scheduler, stops losers early, and collects a
+ResultGrid. Function trainables call `ray_tpu.tune.report(metrics)` per
+iteration (same session machinery as ray_tpu.train).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.config import TrainContextConfig
+from ray_tpu.train.session import TrainSession
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"                   # "max" | "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[Any] = None     # FIFOScheduler | ASHAScheduler
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Optional[Dict[str, Any]]       # last reported
+    history: List[Dict[str, Any]]
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[TrialResult]:
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("specify metric= (none set in TuneConfig)")
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise RuntimeError("no trial reported the metric "
+                               f"{metric!r}")
+        key = lambda r: float(r.metrics[metric])  # noqa: E731
+        return (max if mode == "max" else min)(scored, key=key)
+
+    def get_dataframe(self) -> List[Dict[str, Any]]:
+        return [dict(r.metrics or {}, trial_id=r.trial_id, **{
+            f"config/{k}": v for k, v in r.config.items()})
+            for r in self._results]
+
+
+class TrialActor:
+    """Hosts one trial: the trainable runs under a report session."""
+
+    def __init__(self):
+        self._session: Optional[TrainSession] = None
+
+    def start(self, trainable: Callable, config: Dict[str, Any],
+              trial_id: str) -> None:
+        ctx = TrainContextConfig(world_size=1, world_rank=0,
+                                 experiment_path=trial_id,
+                                 trial_info={"trial_id": trial_id,
+                                             "config": config})
+
+        def runner(cfg):
+            out = trainable(cfg)
+            # Return-style trainables: a returned dict is the final report.
+            if isinstance(out, dict):
+                from ray_tpu.train.session import _require_session
+
+                _require_session().report(out)
+
+        self._session = TrainSession(runner, config, ctx)
+        self._session.start()
+
+    def poll(self, timeout: float = 1.0):
+        r = self._session.poll(timeout)
+        if r is None:
+            return None
+        if r.done:
+            out = {"done": True}
+            if r.error is not None:
+                exc, tb = r.error
+                out["error"] = f"{type(exc).__name__}: {exc}"
+            return out
+        return {"done": False, "metrics": r.metrics}
+
+
+@dataclasses.dataclass
+class _Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    actor: Any = None
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    iteration: int = 0
+    done: bool = False
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[Any] = None):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+        self._run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        scheduler = cfg.scheduler or sched_mod.FIFOScheduler()
+        variants = generate_variants(self._space, cfg.num_samples, cfg.seed)
+        trials = [_Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", v)
+                  for i, v in enumerate(variants)]
+        pending = list(trials)
+        running: List[_Trial] = []
+        actor_cls = ray_tpu.remote(TrialActor)
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                t = pending.pop(0)
+                try:
+                    t.actor = actor_cls.options(num_cpus=1).remote()
+                    ray_tpu.get(t.actor.start.remote(
+                        self._trainable, t.config, t.trial_id), timeout=120)
+                except Exception as e:
+                    # Cluster can't host another concurrent trial right
+                    # now: requeue and run at the concurrency that fits —
+                    # unless nothing at all is running (then it never
+                    # will; fail the trial instead of spinning).
+                    if t.actor is not None:
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:
+                            pass
+                        t.actor = None
+                    if running:
+                        pending.insert(0, t)
+                        break
+                    t.done = True
+                    t.error = f"could not schedule trial: {e}"
+                    continue
+                running.append(t)
+            polls = [(t, t.actor.poll.remote(1.0)) for t in running]
+            round_results = []
+            for t, ref in polls:
+                try:
+                    r = ray_tpu.get(ref, timeout=60)
+                except Exception as e:
+                    t.done, t.error = True, f"trial actor died: {e}"
+                    continue
+                if r is None:
+                    continue
+                if r.get("done"):
+                    t.done = True
+                    t.error = r.get("error")
+                    continue
+                t.iteration += 1
+                t.history.append(r["metrics"])
+                round_results.append((t, r["metrics"]))
+            # Whole round to the scheduler at once (batch-synchronous):
+            # the lockstep polling order must not decide rung survival.
+            if round_results:
+                decisions = scheduler.on_batch(
+                    [(t.trial_id, t.iteration, m)
+                     for t, m in round_results])
+                for t, _m in round_results:
+                    if decisions.get(t.trial_id) == sched_mod.STOP:
+                        t.done = True
+                        t.stopped_early = True
+            for t in [t for t in running if t.done]:
+                running.remove(t)
+                try:
+                    ray_tpu.kill(t.actor)
+                except Exception:
+                    pass
+
+        results = [TrialResult(
+            trial_id=t.trial_id, config=t.config,
+            metrics=t.history[-1] if t.history else None,
+            history=t.history, error=t.error,
+            stopped_early=t.stopped_early) for t in trials]
+        return ResultGrid(results, cfg.metric, cfg.mode)
