@@ -113,9 +113,10 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = require_runtime()
         opts = self._default_options
-        resources = _resources_from_options(opts)
+        resources, defaulted = _resources_from_options(opts)
         actor_id = rt.create_actor(
             self._cls, args, kwargs,
+            release_resources=defaulted,
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             max_concurrency=opts.get("max_concurrency", 1),
@@ -134,6 +135,10 @@ class ActorClass:
 
 
 def _resources_from_options(opts: Dict[str, Any]):
+    """Returns (resources, defaulted). `defaulted` drives the reference's
+    actor resource semantics: an actor with no explicit resources costs
+    1 CPU to schedule its creation but holds 0 while alive (the node
+    releases the lease's resources at mark_actor_host)."""
     from ray_tpu.core.resources import ResourceSet
 
     d: Dict[str, float] = dict(opts.get("resources") or {})
@@ -145,6 +150,7 @@ def _resources_from_options(opts: Dict[str, Any]):
         d["TPU"] = float(opts["num_tpus"])
     if opts.get("memory") is not None:
         d["memory"] = float(opts["memory"])
-    if not d:
-        d["CPU"] = 1.0  # actor default parity: 1 CPU for creation, 0 for methods
-    return ResourceSet.from_dict(d)
+    defaulted = not d
+    if defaulted:
+        d["CPU"] = 1.0
+    return ResourceSet.from_dict(d), defaulted

@@ -221,21 +221,31 @@ class HeadServer:
                        for k, v in resources.items() if v > 0):
                     feasible.append(n)
             if not feasible:
-                return []
-
-            def util(n: NodeInfo) -> float:
-                us = [1 - n.available.get(k, 0) / t
-                      for k, t in n.total.items() if t > 0]
-                return max(us) if us else 0.0
+                # Saturated-but-feasible fallback: pick by TOTAL capacity so
+                # the lease request queues at the node (which blocks until
+                # resources free — reference: tasks queue at the raylet)
+                # instead of the submitter churning pick_node every 50ms.
+                by_total = [n for n in self._nodes.values()
+                            if n.alive and n.node_id not in exclude
+                            and all(n.total.get(k, 0) >= v
+                                    for k, v in resources.items() if v > 0)]
+                by_total.sort(key=lambda n: (self._util(n), n.node_id))
+                return by_total
 
             thresh = cfg.scheduler_spread_threshold
-            below = [n for n in feasible if util(n) < thresh]
+            below = [n for n in feasible if self._util(n) < thresh]
             if below:
                 # Pack: highest-utilization node still under threshold.
-                below.sort(key=lambda n: (-util(n), n.node_id))
+                below.sort(key=lambda n: (-self._util(n), n.node_id))
                 return below
-            feasible.sort(key=lambda n: (util(n), n.node_id))
+            feasible.sort(key=lambda n: (self._util(n), n.node_id))
             return feasible
+
+    @staticmethod
+    def _util(n: NodeInfo) -> float:
+        us = [1 - n.available.get(k, 0) / t
+              for k, t in n.total.items() if t > 0]
+        return max(us) if us else 0.0
 
     def rpc_pick_node(self, conn, resources: Dict[str, float],
                       strategy: Optional[Dict[str, Any]] = None,

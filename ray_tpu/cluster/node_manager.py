@@ -87,6 +87,11 @@ class NodeManager:
                                      prefault=cfg.object_store_prefault)
         self._lock = threading.RLock()
         self._idle_cv = threading.Condition(self._lock)
+        # Signalled whenever resources are credited back (lease return,
+        # blocked worker, bundle release): queued lease requests re-check
+        # feasibility instead of the caller re-polling over RPC (reference:
+        # tasks queue at the raylet, cluster_task_manager.cc).
+        self._avail_cond = threading.Condition(self._lock)
         self._spawning = 0
         self._max_concurrent_spawns = 4
         # FIFO worker handoff: lease requests queue here and are served
@@ -352,12 +357,14 @@ class NodeManager:
 
     def _release_resources(self, lease: Lease) -> None:
         # lease.pg holds the RESOLVED pool key from _try_acquire.
+        # Always called with self._lock held.
         pool = (self.available if lease.pg in (None, "main")
                 else self._bundle_avail.get(lease.pg))
         if pool is None:
             return
         for k, v in lease.resources.items():
             pool[k] = pool.get(k, 0) + v
+        self._avail_cond.notify_all()
 
     @blocking_rpc
     def rpc_request_lease(self, conn, resources: Dict[str, float],
@@ -397,10 +404,18 @@ class NodeManager:
 
     def _do_request_lease(self, resources: Dict[str, float],
                           pg: Optional[Tuple[bytes, int]]):
+        deadline = time.monotonic() + cfg.lease_queue_block_ms / 1000.0
         with self._lock:
-            resolved = self._try_acquire(resources, pg)
-            if resolved is None:
-                return None
+            while True:
+                resolved = self._try_acquire(resources, pg)
+                if resolved is not None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                # Queue here until resources free up (or the block window
+                # expires and the caller spills back via the head).
+                self._avail_cond.wait(min(remaining, 0.25))
         w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0)
         if w is None:
             lease = Lease("", None, resources, resolved)
@@ -466,13 +481,21 @@ class NodeManager:
                         pool[k] = pool.get(k, 0) - v
         return True
 
-    def rpc_mark_actor_host(self, conn, lease_id: str):
-        """Actor took over the leased worker: never returns to the idle pool
-        (lease resources stay held for the actor's lifetime)."""
+    def rpc_mark_actor_host(self, conn, lease_id: str,
+                            release: bool = False):
+        """Actor took over the leased worker: never returns to the idle
+        pool. `release` implements the reference's default actor resource
+        semantics — "1 CPU for scheduling [creation], 0 for running" — by
+        crediting the lease's resources back and zeroing them so no later
+        return/blocked/death path double-counts."""
         with self._lock:
             lease = self._leases.get(lease_id)
             if lease is not None:
                 lease.worker.is_actor_host = True
+                if release:
+                    if lease.blocked == 0:
+                        self._release_resources(lease)
+                    lease.resources = {}
         return True
 
     # ------------------------------------------------------------ bundles
@@ -489,6 +512,7 @@ class NodeManager:
                 self.available[k] = self.available.get(k, 0) - v
             self._bundles[(pg_id, idx)] = dict(bundle)
             self._bundle_avail[(pg_id, idx)] = dict(bundle)
+            self._avail_cond.notify_all()
         return True
 
     def rpc_release_bundle(self, conn, pg_id: bytes, idx: int):
@@ -498,6 +522,7 @@ class NodeManager:
             if bundle:
                 for k, v in bundle.items():
                     self.available[k] = self.available.get(k, 0) + v
+                self._avail_cond.notify_all()
         return True
 
     # ------------------------------------------------------------ objects

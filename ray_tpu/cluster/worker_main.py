@@ -84,6 +84,10 @@ class WorkerRuntime(ClusterCore):
         self._seen_tasks: set = set()
         self._seen_order = collections.deque()
         self._seen_lock = threading.Lock()
+        self._done_q = collections.deque()
+        self._done_ev = threading.Event()
+        threading.Thread(target=self._done_flush_loop, daemon=True,
+                         name="done-flush").start()
         # Cooperative cancellation: ids cancelled before execution start
         # are skipped (running user code is never preempted — reference
         # semantics for non-force cancel). FIFO-bounded like _seen_tasks.
@@ -107,9 +111,13 @@ class WorkerRuntime(ClusterCore):
 
     # ---------------------------------------------------------------- tasks
 
-    def rpc_push_task(self, conn, task_id_bytes: bytes, spec_blob: bytes):
-        if not self._seen_before(task_id_bytes):
-            self._exec_pool.submit(self._execute_task, spec_blob)
+    def rpc_push_tasks(self, conn, pairs):
+        """Batched push: one frame carries every task the dispatcher had
+        ready for this lease (the reference pipelines PushNormalTask the
+        same way via OnWorkerIdle bursts)."""
+        for task_id_bytes, spec_blob in pairs:
+            if not self._seen_before(task_id_bytes):
+                self._exec_pool.submit(self._execute_task, spec_blob)
         return True
 
     def _execute_task(self, spec_blob: bytes) -> None:
@@ -150,7 +158,9 @@ class WorkerRuntime(ClusterCore):
                 "resources": spec.get("resources", {})})
             t_start = time.time()
             try:
-                result = spec["func"](*args, **kwargs)
+                func = (self._fetch_function(spec["func_digest"])
+                        if "func_digest" in spec else spec["func"])
+                result = func(*args, **kwargs)
                 self._send_results(owner, task_id, return_ids, value=result,
                                    span=span())
                 return
@@ -212,24 +222,43 @@ class WorkerRuntime(ClusterCore):
                 else:
                     self._put_plasma(oid, header, buffers)
                     results.append((oid.binary(), "in_store", None))
-        try:
-            # Acked + retried: a chaos-dropped completion would otherwise
-            # leave the owner waiting forever. Owner-side handlers are
-            # idempotent (memory-store puts are first-write-wins, inflight
-            # pop guards the lease decrement).
-            client = self._owner_pool.get(owner)
-            if actor_ctx is not None:
-                actor_id_bytes, seq = actor_ctx
-                client.retrying_call("actor_call_done", actor_id_bytes, seq,
-                                     task_id.binary(), results, span,
-                                     timeout=10)
-            else:
-                client.retrying_call("task_done", task_id.binary(), results,
-                                     span, timeout=10)
-        except Exception:
-            # Owner gone: results are orphaned; large ones stay in the store
-            # until the owner's death GC reclaims them (best effort round 1).
-            pass
+        # Batched + acked + retried via the flusher: a chaos-dropped
+        # completion must not leave the owner waiting forever, and one
+        # frame per completion was a single-core throughput ceiling.
+        # Owner-side handlers are idempotent (memory-store puts are
+        # first-write-wins, inflight pop guards the lease decrement).
+        if actor_ctx is not None:
+            actor_id_bytes, seq = actor_ctx
+            entry = ("actor", (actor_id_bytes, seq, task_id.binary(),
+                               results, span))
+        else:
+            entry = ("task", (task_id.binary(), results, span))
+        self._done_q.append((owner, entry))
+        self._done_ev.set()
+
+    def _done_flush_loop(self) -> None:
+        """Drains completed-task results to their owners in batches: one
+        `batch_done` RPC per owner per cycle. Batches form naturally under
+        load because the flusher awaits each ack while new completions
+        queue up."""
+        while True:
+            self._done_ev.wait()
+            self._done_ev.clear()
+            by_owner: Dict[str, list] = {}
+            while self._done_q:
+                try:
+                    owner, entry = self._done_q.popleft()
+                except IndexError:
+                    break
+                by_owner.setdefault(owner, []).append(entry)
+            for owner, entries in by_owner.items():
+                try:
+                    self._owner_pool.get(owner).retrying_call(
+                        "batch_done", entries, timeout=10)
+                except Exception:
+                    # Owner gone: results are orphaned; large ones stay in
+                    # the store until the owner's death GC reclaims them.
+                    pass
 
     # ---------------------------------------------------------------- actors
 
@@ -285,7 +314,9 @@ class WorkerRuntime(ClusterCore):
             self._start_actor_loop(hosted)
         with self._hosted_lock:
             self._hosted[actor_id] = hosted
-        self.node.retrying_call("mark_actor_host", lease_id, timeout=5)
+        self.node.retrying_call("mark_actor_host", lease_id,
+                                spec.get("release_resources", False),
+                                timeout=5)
         return True
 
     def _start_actor_loop(self, hosted: _HostedActor) -> None:

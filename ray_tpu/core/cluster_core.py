@@ -86,7 +86,8 @@ class _KeyQueue:
     """Per-scheduling-key submission state: pending tasks + leased workers."""
 
     __slots__ = ("key", "queue", "leases", "dispatcher_running",
-                 "pending_lease_requests", "wake", "lease_fail_deadline")
+                 "pending_lease_requests", "wake", "lease_fail_deadline",
+                 "lease_backoff", "next_lease_attempt")
 
     def __init__(self, key: tuple):
         import collections
@@ -98,6 +99,10 @@ class _KeyQueue:
         self.pending_lease_requests = 0
         self.wake = threading.Event()
         self.lease_fail_deadline = None
+        # Declined-lease backoff: a saturated cluster must not cost a
+        # pick_node RPC + requester thread every 50ms per scheduling key.
+        self.lease_backoff = 0.0
+        self.next_lease_attempt = 0.0
 
 
 class _ActorConn:
@@ -203,6 +208,16 @@ class ClusterCore:
 
         self._push_acks = collections.deque()
         self._push_ack_event = threading.Event()
+        # Function table (reference: _private/function_manager.py exports a
+        # function ONCE to the GCS function table; tasks carry only its
+        # digest). Pickling the function per submit was the tasks_async
+        # bottleneck: a by-value cloudpickle both sides of every task.
+        import weakref
+
+        self._fn_exports: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
+        self._fn_exports_lock = threading.Lock()
+        self._fn_cache: Dict[bytes, Callable] = {}
         threading.Thread(target=self._push_ack_loop, daemon=True,
                          name="push-acks").start()
         self._lease_reaper = threading.Thread(
@@ -710,6 +725,16 @@ class ClusterCore:
             self._lease_task_finished(info.sched_key, info.worker_addr)
         return True
 
+    def rpc_batch_done(self, conn_ctx, entries):
+        """Batched completion sink: each entry is ("task"|"actor", args)
+        routed to the idempotent per-completion handlers."""
+        for kind, payload in entries:
+            if kind == "actor":
+                self.rpc_actor_call_done(conn_ctx, *payload)
+            else:
+                self.rpc_task_done(conn_ctx, *payload)
+        return True
+
     def rpc_ping(self, conn):
         return "pong"
 
@@ -724,6 +749,47 @@ class ClusterCore:
 
     def current_resources(self) -> Dict[str, float]:
         return runtime_context.current_worker_context().get("resources", {})
+
+    def _export_function(self, func: Callable) -> bytes:
+        """Export ``func`` to the head's function table once; return its
+        digest. Subsequent submits of the same function object reuse the
+        cached digest, so the per-task cost is a dict lookup instead of a
+        cloudpickle round."""
+        try:
+            digest = self._fn_exports.get(func)
+        except TypeError:  # unhashable/unweakrefable callable
+            digest = None
+        if digest is not None:
+            return digest
+        import hashlib
+
+        blob = SERIALIZER.encode(func)
+        digest = hashlib.sha1(blob).digest()
+        with self._fn_exports_lock:
+            if digest not in self._fn_cache:
+                self.head.retrying_call("kv_put", "__fn__", digest, blob,
+                                        False, timeout=10)
+                self._fn_cache[digest] = func
+        try:
+            self._fn_exports[func] = digest
+        except TypeError:
+            pass
+        return digest
+
+    def _fetch_function(self, digest: bytes) -> Callable:
+        """Resolve a task's function digest via the local cache, falling
+        back to one head KV fetch per (process, function)."""
+        fn = self._fn_cache.get(digest)
+        if fn is not None:
+            return fn
+        blob = self.head.retrying_call("kv_get", "__fn__", digest,
+                                       timeout=10)
+        if blob is None:
+            raise RuntimeError(
+                "function table entry missing (head lost its KV state?)")
+        fn = SERIALIZER.decode(blob)
+        self._fn_cache[digest] = fn
+        return fn
 
     def submit_task(self, func: Callable, args: Sequence, kwargs: Dict,
                     num_returns: int = 1, resources=None, max_retries: int = 0,
@@ -741,7 +807,7 @@ class ClusterCore:
         strategy = _strategy_dict(scheduling_strategy)
         spec_blob = SERIALIZER.encode({
             "task_id": task_id.binary(),
-            "func": func,
+            "func_digest": self._export_function(func),
             "args": tuple(args),
             "kwargs": dict(kwargs),
             "return_ids": [o.binary() for o in return_ids],
@@ -779,6 +845,11 @@ class ClusterCore:
             kq = self._key_queues.get(key)
             if kq is None:
                 kq = self._key_queues[key] = _KeyQueue(key)
+            if not kq.queue:
+                # A fresh burst after quiescence starts with a clean slate:
+                # stale saturation backoff must not delay its first lease.
+                kq.lease_backoff = 0.0
+                kq.next_lease_attempt = 0.0
             kq.queue.append((task_id_bytes, info))
             if not kq.dispatcher_running:
                 kq.dispatcher_running = True
@@ -793,7 +864,10 @@ class ClusterCore:
         queue onto leased workers in bursts (pipelined up to 4/worker).
         Lease acquisition runs on BACKGROUND threads (bounded by
         `max_pending_lease_requests_per_scheduling_key`) so slow lease
-        grants / worker spawns never stall the push path."""
+        grants / worker spawns never stall the push path. After draining,
+        the dispatcher lingers briefly: a sync submit-get loop would
+        otherwise pay a thread spawn per call."""
+        idle_deadline = None
         while True:
             batch: List[Tuple[tuple, _Lease]] = []
             with self._lease_lock:
@@ -809,30 +883,46 @@ class ClusterCore:
                     batch.append((kq.queue.popleft(), lease))
                 queue_len = len(kq.queue)
                 sample = kq.queue[0][1] if kq.queue else None
-            for (task_id_bytes, info), lease in batch:
-                self._push_to_lease(task_id_bytes, info, lease, kq)
+            if batch:
+                # One push frame per lease per round (the per-task frame +
+                # ack + wakeup tax was the single-core throughput ceiling).
+                by_lease: Dict[Any, list] = {}
+                for (task_id_bytes, info), lease in batch:
+                    by_lease.setdefault(id(lease), (lease, []))[1].append(
+                        (task_id_bytes, info))
+                for lease, items in by_lease.values():
+                    self._push_group_to_lease(items, lease, kq)
             if sample is not None:
                 self._maybe_request_leases(kq, sample, queue_len)
             if not batch:
                 with self._lease_lock:
-                    # Exit when nothing is queued and no HEALTHY lease has
-                    # work in flight (a broken lease's stuck counters must
-                    # not keep the dispatcher spinning — its tasks were
+                    # Quiescent when nothing is queued and no HEALTHY lease
+                    # has work in flight (a broken lease's stuck counters
+                    # must not keep the dispatcher spinning — its tasks were
                     # already re-enqueued or failed by the conn-lost hook).
                     done = (not kq.queue
                             and not kq.pending_lease_requests
                             and all(l.inflight <= 0 or l.broken
                                     for l in kq.leases))
-                    if done:
+                    if done and idle_deadline is not None \
+                            and time.monotonic() > idle_deadline:
                         kq.dispatcher_running = False
                         return
+                if done and idle_deadline is None:
+                    idle_deadline = time.monotonic() + 2.0
+                elif not done:
+                    idle_deadline = None
                 kq.wake.wait(0.25)
                 kq.wake.clear()
+            else:
+                idle_deadline = None
 
     def _maybe_request_leases(self, kq: "_KeyQueue", sample: _InflightTask,
                               queue_len: int) -> None:
         """Spawn background lease requesters if the queue outruns capacity."""
         with self._lease_lock:
+            if time.monotonic() < kq.next_lease_attempt:
+                return
             capacity = sum(4 - l.inflight for l in kq.leases
                            if not l.broken) + kq.pending_lease_requests * 4
             want = 0
@@ -859,6 +949,8 @@ class ClusterCore:
             with self._lease_lock:
                 kq.leases.append(lease)
                 kq.lease_fail_deadline = None
+                kq.lease_backoff = 0.0
+                kq.next_lease_attempt = 0.0
             kq.wake.set()
             return
         # Infeasible right now. If nothing is making progress for too long,
@@ -871,41 +963,52 @@ class ClusterCore:
             self._fail_queued(kq, TimeoutError(
                 f"no feasible node for {sample.resources}"))
         else:
+            with self._lease_lock:
+                kq.lease_backoff = min(max(kq.lease_backoff * 2, 0.1), 0.5)
+                kq.next_lease_attempt = time.monotonic() + kq.lease_backoff
             time.sleep(0.05)
             kq.wake.set()
 
-    def _push_to_lease(self, task_id_bytes: bytes, info: _InflightTask,
-                       lease: _Lease, kq: "_KeyQueue") -> None:
-        # A cancel must survive re-dispatch (worker-crash re-enqueue) and
-        # the queue-pop -> inflight-insert window: last check before push.
-        if TaskID(task_id_bytes) in self._cancelled:
-            from ray_tpu.exceptions import TaskCancelledError
+    def _push_group_to_lease(self, items: List[Tuple[bytes, _InflightTask]],
+                             lease: _Lease, kq: "_KeyQueue") -> None:
+        survivors: List[Tuple[bytes, _InflightTask]] = []
+        for task_id_bytes, info in items:
+            # A cancel must survive re-dispatch (worker-crash re-enqueue)
+            # and the queue-pop -> inflight-insert window: last check
+            # before push.
+            if TaskID(task_id_bytes) in self._cancelled:
+                from ray_tpu.exceptions import TaskCancelledError
 
-            err = TaskCancelledError(f"task {info.name} cancelled")
-            for oid in info.return_ids:
-                self.memory_store.put(oid, err, is_exception=True)
-            self._release_submitted_args(task_id_bytes)
-            # Undo this dispatch round's inflight++ (handles linger too).
-            self._lease_task_finished(info.sched_key, lease.worker_addr)
+                err = TaskCancelledError(f"task {info.name} cancelled")
+                for oid in info.return_ids:
+                    self.memory_store.put(oid, err, is_exception=True)
+                self._release_submitted_args(task_id_bytes)
+                # Undo this dispatch round's inflight++ (handles linger too).
+                self._lease_task_finished(info.sched_key, lease.worker_addr)
+                continue
+            info.worker_addr = lease.worker_addr
+            with self._inflight_lock:
+                self._inflight[task_id_bytes] = info
+            survivors.append((task_id_bytes, info))
+        if not survivors:
             return
-        info.worker_addr = lease.worker_addr
-        with self._inflight_lock:
-            self._inflight[task_id_bytes] = info
         try:
             worker = self._pool.get(lease.worker_addr,
                                     on_close=self._on_worker_conn_lost)
-            waiter = worker.call_async("push_task", task_id_bytes,
-                                       info.spec_blob)
+            waiter = worker.call_async(
+                "push_tasks",
+                [(tid, info.spec_blob) for tid, info in survivors])
             self._push_acks.append(
-                [waiter, task_id_bytes, info, lease, kq, 0,
-                 time.monotonic() + 10.0])
+                [waiter, survivors, lease, kq, 0, time.monotonic() + 10.0])
             self._push_ack_event.set()
         except BaseException:
             with self._inflight_lock:
-                self._inflight.pop(task_id_bytes, None)
+                for tid, _ in survivors:
+                    self._inflight.pop(tid, None)
             lease.broken = True
             with self._lease_lock:
-                kq.queue.appendleft((task_id_bytes, info))
+                for tid, info in reversed(survivors):
+                    kq.queue.appendleft((tid, info))
 
     def _push_ack_loop(self) -> None:
         """Collects push acks asynchronously (pipelining stays intact) and
@@ -923,7 +1026,7 @@ class ClusterCore:
                     self._push_ack_event.clear()
                     continue
                 entry = self._push_acks.popleft()
-                waiter, tid, info, lease, kq, attempts, deadline = entry
+                waiter, items, lease, kq, attempts, deadline = entry
                 if not waiter._event.is_set():
                     if time.monotonic() < deadline:
                         self._push_acks.append(entry)
@@ -944,27 +1047,32 @@ class ClusterCore:
                 time.sleep(0.05)
 
     def _retry_push(self, entry) -> None:
-        waiter, tid, info, lease, kq, attempts, deadline = entry
+        waiter, items, lease, kq, attempts, deadline = entry
         with self._inflight_lock:
-            if tid not in self._inflight:
-                return  # completed or already handled by conn-loss hook
+            live = [(tid, info) for tid, info in items
+                    if tid in self._inflight]
+        if not live:
+            return  # all completed or already handled by conn-loss hook
         if attempts < 3 and not lease.broken:
             try:
                 worker = self._pool.get(lease.worker_addr,
                                         on_close=self._on_worker_conn_lost)
-                w2 = worker.call_async("push_task", tid, info.spec_blob)
+                w2 = worker.call_async(
+                    "push_tasks",
+                    [(tid, info.spec_blob) for tid, info in live])
                 self._push_acks.append(
-                    [w2, tid, info, lease, kq, attempts + 1,
+                    [w2, live, lease, kq, attempts + 1,
                      time.monotonic() + 10.0])
                 return
             except BaseException:
                 pass
         # Give up on this worker: re-route through the queue.
-        with self._inflight_lock:
-            if self._inflight.pop(tid, None) is None:
-                return
         lease.broken = True
-        self._enqueue_task(tid, info)
+        for tid, info in live:
+            with self._inflight_lock:
+                if self._inflight.pop(tid, None) is None:
+                    continue
+            self._enqueue_task(tid, info)
 
     def _fail_queued(self, kq: "_KeyQueue", exc: Exception) -> None:
         err = capture_exception(exc)
@@ -1133,7 +1241,7 @@ class ClusterCore:
                      namespace: str = "default", max_concurrency: int = 1,
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
-                     runtime_env=None) -> ActorID:
+                     runtime_env=None, release_resources: bool = False) -> ActorID:
         resources = _as_resource_dict(resources)
         resources.setdefault("CPU", 1.0)
         actor_id = ActorID.of(self.job_id)
@@ -1141,6 +1249,7 @@ class ClusterCore:
             "cls": cls, "args": tuple(args), "kwargs": dict(kwargs),
             "max_concurrency": max_concurrency,
             "owner_addr": self.owner_addr,
+            "release_resources": release_resources,
         })
         # Constructor-arg refs must outlive this call: the head re-ships
         # spec_blob on every actor RESTART, long after the caller's local

@@ -99,6 +99,9 @@ _d("object_store_prefault", bool, False,
 
 # --- scheduling ---
 _d("lease_timeout_ms", int, 10_000, "worker lease validity")
+_d("lease_queue_block_ms", int, 3_000,
+   "how long a saturated node queues a lease request before declining "
+   "(spillback); reference: tasks queue at the raylet")
 _d("scheduler_spread_threshold", float, 0.5,
    "hybrid policy: pack onto a node until utilization crosses this, then spread")
 _d("max_pending_lease_requests_per_scheduling_key", int, 10, "lease pipelining cap")

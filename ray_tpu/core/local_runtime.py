@@ -292,7 +292,8 @@ class LocalRuntime:
                      namespace: str = "default", max_concurrency: int = 1,
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
-                     runtime_env=None) -> "ActorID":
+                     runtime_env=None,
+                     release_resources: bool = False) -> "ActorID":
         import inspect
 
         is_async = any(

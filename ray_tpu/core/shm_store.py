@@ -178,15 +178,21 @@ class ShmStore:
         return cls(h, name, owner=False)
 
     def close(self) -> None:
-        if self._h:
-            self._lib.rtpu_store_close(self._h)
-            self._h = None
-            if self._owner:
-                self._lib.rtpu_store_unlink(self.name.encode())
-                if self._spill_enabled:
-                    import shutil
+        # Deliberately does NOT rtpu_store_close (munmap): background
+        # threads (push-ack sweeps, GC-driven deferred releases) can still
+        # be inside a store call with the handle in hand — unmapping under
+        # them is a use-after-unmap SIGSEGV at shutdown. The mapping is
+        # reclaimed at process exit. Unlink (owner only) removes the NAME;
+        # live mappings in other processes stay valid per POSIX shm.
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        if self._h and self._owner:
+            self._lib.rtpu_store_unlink(self.name.encode())
+            if self._spill_enabled:
+                import shutil
 
-                    shutil.rmtree(self._spill_dir, ignore_errors=True)
+                shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     # -- raw segment access ------------------------------------------------
 

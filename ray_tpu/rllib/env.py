@@ -1,0 +1,121 @@
+"""Vectorized environments for RLlib-lite.
+
+Parity target: the reference wraps gymnasium vector envs inside
+`SingleAgentEnvRunner` (reference: rllib/env/single_agent_env_runner.py:65).
+This framework keeps the same contract — batched reset/step with auto-reset —
+but ships a dependency-free numpy CartPole so the library and its learning
+tests run anywhere (the reference's test envs come from gym; mirroring that
+dependency would gate the whole library on an uninstalled package).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class VectorEnv:
+    """B independent environment copies stepped in lockstep.
+
+    Auto-reset semantics: when a sub-env terminates, `step` returns the
+    terminal reward/done for that index and the NEXT observation is the
+    reset state (matching gymnasium's VectorEnv autoreset contract that the
+    reference's EnvRunner relies on).
+    """
+
+    num_envs: int
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                                 np.ndarray, Dict[str, Any]]:
+        """actions [B] int -> (obs [B, obs_size], reward [B], done [B], info)."""
+        raise NotImplementedError
+
+
+class CartPoleVecEnv(VectorEnv):
+    """Classic cart-pole balancing, vectorized in numpy.
+
+    Standard physics (Barto, Sutton & Anderson 1983): a pole hinged on a
+    cart; actions push the cart left/right with a fixed force; episode ends
+    when the pole tips past 12 degrees, the cart leaves +/-2.4, or after
+    `max_steps`. Reward 1 per surviving step.
+    """
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, num_envs: int = 8, max_steps: int = 500,
+                 seed: int = 0):
+        self.num_envs = num_envs
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _reset_indices(self, idx: np.ndarray) -> None:
+        self._state[idx] = self._rng.uniform(-0.05, 0.05, (len(idx), 4))
+        self._steps[idx] = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_indices(np.arange(self.num_envs))
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, th, th_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE, -self.FORCE)
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pm_len = self.POLE_MASS * self.POLE_HALF_LEN
+        cos_t, sin_t = np.cos(th), np.sin(th)
+        temp = (force + pm_len * th_dot ** 2 * sin_t) / total_mass
+        th_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN
+            * (4.0 / 3.0 - self.POLE_MASS * cos_t ** 2 / total_mass))
+        x_acc = temp - pm_len * th_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        th = th + self.DT * th_dot
+        th_dot = th_dot + self.DT * th_acc
+        self._state = np.stack([x, x_dot, th, th_dot], axis=1)
+        self._steps += 1
+
+        done = ((np.abs(x) > self.X_LIMIT)
+                | (np.abs(th) > self.THETA_LIMIT)
+                | (self._steps >= self.max_steps))
+        reward = np.ones(self.num_envs, np.float32)
+        if done.any():
+            self._reset_indices(np.flatnonzero(done))
+        return (self._state.astype(np.float32), reward,
+                done.astype(np.bool_), {})
+
+
+_ENV_REGISTRY = {"CartPole": CartPoleVecEnv}
+
+
+def register_env(name: str, ctor) -> None:
+    """Parity: ray.tune.registry.register_env."""
+    _ENV_REGISTRY[name] = ctor
+
+
+def make_env(name_or_ctor, num_envs: int, seed: int = 0) -> VectorEnv:
+    if callable(name_or_ctor):
+        return name_or_ctor(num_envs=num_envs, seed=seed)
+    ctor = _ENV_REGISTRY.get(name_or_ctor)
+    if ctor is None:
+        raise KeyError(f"unknown env {name_or_ctor!r}; register_env() it")
+    return ctor(num_envs=num_envs, seed=seed)

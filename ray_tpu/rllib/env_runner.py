@@ -1,0 +1,127 @@
+"""EnvRunner actors: distributed experience collection.
+
+Parity target: reference `SingleAgentEnvRunner.sample` (reference:
+rllib/env/single_agent_env_runner.py:65,140) and `EnvRunnerGroup`
+(rllib/env/env_runner_group.py:71, sync_weights :531). Runners are plain
+classes wrapped as ray_tpu actors by the group; weights ship once per
+iteration through the object store (one put, N zero-copy gets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+class EnvRunner:
+    """Owns a vector env + policy apply; samples fixed-length rollouts."""
+
+    def __init__(self, env_spec, num_envs: int, rollout_len: int,
+                 seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib import models
+
+        self.env = make_env(env_spec, num_envs=num_envs, seed=seed)
+        self.rollout_len = rollout_len
+        self.obs = self.env.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._sample_fn = jax.jit(models.sample_action)
+        self._params = None
+        # Per-sub-env running episode returns for metrics.
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: List[float] = []
+
+    def set_weights(self, params_ref) -> bool:
+        """params_ref: ObjectRef or raw pytree (group puts once per sync)."""
+        self._params = (ray_tpu.get(params_ref)
+                        if isinstance(params_ref, ray_tpu.ObjectRef)
+                        else params_ref)
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """Collect one [T, B] rollout with the current weights."""
+        import jax
+
+        assert self._params is not None, "set_weights() before sample()"
+        T, B = self.rollout_len, self.env.num_envs
+        obs = np.empty((T, B, self.env.observation_size), np.float32)
+        actions = np.empty((T, B), np.int32)
+        logps = np.empty((T, B), np.float32)
+        values = np.empty((T, B), np.float32)
+        rewards = np.empty((T, B), np.float32)
+        dones = np.empty((T, B), np.bool_)
+        for t in range(T):
+            self._key, k = jax.random.split(self._key)
+            a, lp, v = self._sample_fn(self._params, self.obs, k)
+            a = np.asarray(a)
+            obs[t] = self.obs
+            actions[t], logps[t], values[t] = a, np.asarray(lp), np.asarray(v)
+            self.obs, rewards[t], dones[t], _ = self.env.step(a)
+            self._ep_return += rewards[t]
+            for i in np.flatnonzero(dones[t]):
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        # Bootstrap value for the final observation (GAE tail).
+        _, _, last_v = self._sample_fn(self._params, self.obs, self._key)
+        return {
+            "obs": obs, "actions": actions, "logp": logps,
+            "values": values, "rewards": rewards, "dones": dones,
+            "last_value": np.asarray(last_v),
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        return {
+            "episode_return_mean":
+                float(np.mean(completed)) if completed else None,
+            "num_episodes": len(completed),
+        }
+
+
+class EnvRunnerGroup:
+    """N EnvRunner actors + a local fallback when num_env_runners == 0."""
+
+    def __init__(self, env_spec, *, num_env_runners: int, num_envs_per_runner: int,
+                 rollout_len: int, seed: int = 0):
+        self._local: Optional[EnvRunner] = None
+        self._actors = []
+        if num_env_runners == 0:
+            self._local = EnvRunner(env_spec, num_envs_per_runner,
+                                    rollout_len, seed)
+        else:
+            remote_cls = ray_tpu.remote(EnvRunner)
+            self._actors = [
+                remote_cls.remote(env_spec, num_envs_per_runner, rollout_len,
+                                  seed + 1000 * i)
+                for i in range(num_env_runners)
+            ]
+
+    def sync_weights(self, params) -> None:
+        """One object-store put; every runner fetches the same ref."""
+        if self._local is not None:
+            self._local.set_weights(params)
+            return
+        ref = ray_tpu.put(params)
+        ray_tpu.get([a.set_weights.remote(ref) for a in self._actors])
+
+    def sample(self) -> List[Dict[str, np.ndarray]]:
+        if self._local is not None:
+            return [self._local.sample()]
+        return ray_tpu.get([a.sample.remote() for a in self._actors])
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        if self._local is not None:
+            return [self._local.get_metrics()]
+        return ray_tpu.get([a.get_metrics.remote() for a in self._actors])
+
+    def stop(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

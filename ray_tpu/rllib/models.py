@@ -1,0 +1,62 @@
+"""Policy/value networks for RLlib-lite, as plain jax pytrees.
+
+Parity target: the reference's `RLModule` (reference:
+rllib/core/rl_module/rl_module.py:260) — forward_exploration /
+forward_train over a framework-specific net. Here the module is a pure
+function over a param pytree so it jits and shards like every other model
+in this framework (same idiom as models/llama.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_mlp_params(key: jax.Array, sizes: Sequence[int]) -> Params:
+    """Orthogonal-ish init (scaled normal) for an MLP with tanh trunks."""
+    params = {}
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / fan_in)
+        params[f"w{i}"] = jax.random.normal(
+            k, (fan_in, fan_out), jnp.float32) * scale
+        params[f"b{i}"] = jnp.zeros((fan_out,), jnp.float32)
+    return params
+
+
+def mlp_apply(params: Params, x: jax.Array, n_layers: int) -> jax.Array:
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_policy_params(key: jax.Array, obs_size: int, num_actions: int,
+                       hidden: int = 64) -> Params:
+    kp, kv = jax.random.split(key)
+    return {
+        "pi": init_mlp_params(kp, (obs_size, hidden, hidden, num_actions)),
+        "vf": init_mlp_params(kv, (obs_size, hidden, hidden, 1)),
+    }
+
+
+def policy_apply(params: Params, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [..., obs_size] -> (logits [..., A], value [...])."""
+    logits = mlp_apply(params["pi"], obs, 3)
+    value = mlp_apply(params["vf"], obs, 3)[..., 0]
+    return logits, value
+
+
+def sample_action(params: Params, obs: jax.Array, key: jax.Array):
+    """One exploration step: (action, logp, value) — jit-friendly."""
+    logits, value = policy_apply(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(action.shape[0]), action]
+    return action, logp, value
