@@ -17,6 +17,7 @@ store with a location stub.
 from __future__ import annotations
 
 import argparse
+import collections
 import inspect
 import sys
 import threading
@@ -79,15 +80,13 @@ class WorkerRuntime(ClusterCore):
         self._owner_pool = ClientPool()
         # Dedup for retried pushes (the submitter retries an unacked push;
         # at-least-once delivery + this set = exactly-once execution here).
-        import collections
-
         self._seen_tasks: set = set()
         self._seen_order = collections.deque()
         self._seen_lock = threading.Lock()
-        self._done_q = collections.deque()
-        self._done_ev = threading.Event()
-        threading.Thread(target=self._done_flush_loop, daemon=True,
-                         name="done-flush").start()
+        # Per-owner completion flushers: one dead/unreachable owner must not
+        # head-of-line block completion delivery to every other owner.
+        self._done_flushers: Dict[str, tuple] = {}
+        self._done_lock = threading.Lock()
         # Cooperative cancellation: ids cancelled before execution start
         # are skipped (running user code is never preempted — reference
         # semantics for non-force cancel). FIFO-bounded like _seen_tasks.
@@ -233,32 +232,54 @@ class WorkerRuntime(ClusterCore):
                                results, span))
         else:
             entry = ("task", (task_id.binary(), results, span))
-        self._done_q.append((owner, entry))
-        self._done_ev.set()
+        self._enqueue_done(owner, entry)
 
-    def _done_flush_loop(self) -> None:
-        """Drains completed-task results to their owners in batches: one
-        `batch_done` RPC per owner per cycle. Batches form naturally under
-        load because the flusher awaits each ack while new completions
-        queue up."""
+    def _enqueue_done(self, owner: str, entry) -> None:
+        """Routes a completion to the owner's dedicated flusher thread
+        (lazily spawned). Per-owner isolation: a dead owner stalls only
+        its own flusher, never delivery to other owners."""
+        with self._done_lock:
+            fl = self._done_flushers.get(owner)
+            if fl is None:
+                q: collections.deque = collections.deque()
+                ev = threading.Event()
+                t = threading.Thread(
+                    target=self._owner_flush_loop, args=(owner, q, ev),
+                    daemon=True, name=f"done-flush-{owner}")
+                fl = self._done_flushers[owner] = (q, ev, t)
+                t.start()
+            fl[0].append(entry)
+            fl[1].set()
+
+    def _owner_flush_loop(self, owner: str, q, ev: threading.Event) -> None:
+        """Drains completions to one owner in batches: one `batch_done`
+        RPC per cycle. Batches form naturally under load because the
+        flusher awaits each ack while new completions queue up. Exits
+        (and deregisters) after 60s idle so many short-lived owners don't
+        leak threads."""
         while True:
-            self._done_ev.wait()
-            self._done_ev.clear()
-            by_owner: Dict[str, list] = {}
-            while self._done_q:
+            if not ev.wait(timeout=60.0):
+                with self._done_lock:
+                    if not q:
+                        self._done_flushers.pop(owner, None)
+                        return
+                continue
+            ev.clear()
+            entries = []
+            while q:
                 try:
-                    owner, entry = self._done_q.popleft()
+                    entries.append(q.popleft())
                 except IndexError:
                     break
-                by_owner.setdefault(owner, []).append(entry)
-            for owner, entries in by_owner.items():
-                try:
-                    self._owner_pool.get(owner).retrying_call(
-                        "batch_done", entries, timeout=10)
-                except Exception:
-                    # Owner gone: results are orphaned; large ones stay in
-                    # the store until the owner's death GC reclaims them.
-                    pass
+            if not entries:
+                continue
+            try:
+                self._owner_pool.get(owner).retrying_call(
+                    "batch_done", entries, timeout=10)
+            except Exception:
+                # Owner gone: results are orphaned; large ones stay in
+                # the store until the owner's death GC reclaims them.
+                pass
 
     # ---------------------------------------------------------------- actors
 

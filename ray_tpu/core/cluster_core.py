@@ -217,7 +217,15 @@ class ClusterCore:
         self._fn_exports: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary())
         self._fn_exports_lock = threading.Lock()
-        self._fn_cache: Dict[bytes, Callable] = {}
+        # digest -> fn, LRU-bounded: unique-lambda loops must not grow it
+        # without bound; an evicted digest re-fetches from the head KV.
+        import collections
+
+        self._fn_cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._fn_cache_max = 4096
+        # Dedicated cache lock: _fn_exports_lock spans a head kv_put RPC in
+        # _export_function; cache mutation must never wait on network I/O.
+        self._fn_cache_lock = threading.Lock()
         threading.Thread(target=self._push_ack_loop, daemon=True,
                          name="push-acks").start()
         self._lease_reaper = threading.Thread(
@@ -727,12 +735,32 @@ class ClusterCore:
 
     def rpc_batch_done(self, conn_ctx, entries):
         """Batched completion sink: each entry is ("task"|"actor", args)
-        routed to the idempotent per-completion handlers."""
+        routed to the idempotent per-completion handlers. Records per-entry
+        event stats under the routed method name so state.rpc_event_stats()
+        accounting stays identical to the unbatched path."""
+        from ray_tpu.cluster import protocol
+
+        stats_on = protocol._stats_on()
         for kind, payload in entries:
-            if kind == "actor":
-                self.rpc_actor_call_done(conn_ctx, *payload)
-            else:
-                self.rpc_task_done(conn_ctx, *payload)
+            if not stats_on:
+                if kind == "actor":
+                    self.rpc_actor_call_done(conn_ctx, *payload)
+                else:
+                    self.rpc_task_done(conn_ctx, *payload)
+                continue
+            method = "actor_call_done" if kind == "actor" else "task_done"
+            t0 = time.monotonic()
+            ok = True
+            try:
+                if kind == "actor":
+                    self.rpc_actor_call_done(conn_ctx, *payload)
+                else:
+                    self.rpc_task_done(conn_ctx, *payload)
+            except Exception:
+                ok = False
+                raise
+            finally:
+                protocol._record_event_stat(method, time.monotonic() - t0, ok)
         return True
 
     def rpc_ping(self, conn):
@@ -754,7 +782,16 @@ class ClusterCore:
         """Export ``func`` to the head's function table once; return its
         digest. Subsequent submits of the same function object reuse the
         cached digest, so the per-task cost is a dict lookup instead of a
-        cloudpickle round."""
+        cloudpickle round.
+
+        Export-once semantics (matches the reference function manager,
+        python/ray/_private/function_manager.py): the snapshot taken at
+        first submit is what executes — mutating captured closure state
+        after the first ``.remote()`` does NOT re-export. Create a new
+        function object (or a fresh ``.options()``-bound task) to ship new
+        state. The local digest cache is LRU-bounded (``_fn_cache``) so
+        unique-lambda loops don't grow it without bound; the head-side
+        ``__fn__`` KV namespace is job-scoped and dropped with the job."""
         try:
             digest = self._fn_exports.get(func)
         except TypeError:  # unhashable/unweakrefable callable
@@ -769,26 +806,35 @@ class ClusterCore:
             if digest not in self._fn_cache:
                 self.head.retrying_call("kv_put", "__fn__", digest, blob,
                                         False, timeout=10)
-                self._fn_cache[digest] = func
+                self._fn_cache_put(digest, func)
         try:
             self._fn_exports[func] = digest
         except TypeError:
             pass
         return digest
 
+    def _fn_cache_put(self, digest: bytes, fn: Callable) -> None:
+        with self._fn_cache_lock:
+            self._fn_cache[digest] = fn
+            self._fn_cache.move_to_end(digest)
+            while len(self._fn_cache) > self._fn_cache_max:
+                self._fn_cache.popitem(last=False)
+
     def _fetch_function(self, digest: bytes) -> Callable:
         """Resolve a task's function digest via the local cache, falling
         back to one head KV fetch per (process, function)."""
-        fn = self._fn_cache.get(digest)
-        if fn is not None:
-            return fn
+        with self._fn_cache_lock:
+            fn = self._fn_cache.get(digest)
+            if fn is not None:
+                self._fn_cache.move_to_end(digest)
+                return fn
         blob = self.head.retrying_call("kv_get", "__fn__", digest,
                                        timeout=10)
         if blob is None:
             raise RuntimeError(
                 "function table entry missing (head lost its KV state?)")
         fn = SERIALIZER.decode(blob)
-        self._fn_cache[digest] = fn
+        self._fn_cache_put(digest, fn)
         return fn
 
     def submit_task(self, func: Callable, args: Sequence, kwargs: Dict,

@@ -9,7 +9,6 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict
 
-from ray_tpu.actor import _resources_from_options
 from ray_tpu.core.runtime_context import require_runtime
 
 _VALID_OPTIONS = {
