@@ -206,6 +206,15 @@ class HeadServer:
 
     # ------------------------------------------------------------- scheduling
 
+    def _feasible_nodes(self, resources: Dict[str, float],
+                        exclude: Set[str]) -> List[NodeInfo]:
+        """Alive, not excluded, demand fits current availability."""
+        with self._lock:
+            return [n for n in self._nodes.values()
+                    if n.alive and n.node_id not in exclude
+                    and all(n.available.get(k, 0) >= v
+                            for k, v in resources.items() if v > 0)]
+
     def _score_nodes(self, resources: Dict[str, float],
                      exclude: Set[str]) -> List[NodeInfo]:
         """Hybrid policy (reference: raylet/scheduling/policy/
@@ -277,8 +286,16 @@ class HeadServer:
                 # True round-robin: the head's availability view lags
                 # heartbeats, so utilization-ranking alone would send a
                 # burst of spread tasks to one node.
-                feasible = self._score_nodes(resources, exclude_set)
+                # Raw feasibility, NOT _score_nodes: the hybrid policy's
+                # pack-threshold filter drops feasible-but-utilized nodes,
+                # which would pin SPREAD tasks to the emptiest node. A
+                # fully-saturated cluster falls through to _score_nodes'
+                # by-total fallback so the lease request QUEUES at a node
+                # instead of the submitter churning pick_node.
+                feasible = self._feasible_nodes(resources, exclude_set)
                 feasible.sort(key=lambda n: n.node_id)
+                if not feasible:
+                    feasible = self._score_nodes(resources, exclude_set)
                 if feasible:
                     n = feasible[self._spread_rr % len(feasible)]
                     self._spread_rr += 1
@@ -371,7 +388,7 @@ class HeadServer:
             try:
                 lease = node.retrying_call(
                     "request_lease", info.resources, True, pg,
-                    _uuid.uuid4().hex,
+                    _uuid.uuid4().hex, None,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 10)
             except Exception:
                 exclude.add(node_id)

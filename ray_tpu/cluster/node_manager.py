@@ -49,7 +49,8 @@ def _die_with_parent():
 
 
 class WorkerProc:
-    def __init__(self, proc: subprocess.Popen, worker_id: str):
+    def __init__(self, proc: subprocess.Popen, worker_id: str,
+                 tpu: bool = False):
         self.proc = proc
         self.worker_id = worker_id
         self.address: Optional[str] = None  # set on register
@@ -57,15 +58,21 @@ class WorkerProc:
         self.idle_since = time.monotonic()
         self.lease_id: Optional[str] = None
         self.is_actor_host = False
+        self.tpu = tpu
 
 
 class Lease:
     def __init__(self, lease_id: str, worker: WorkerProc,
-                 resources: Dict[str, float], pg: Optional[Tuple[bytes, int]]):
+                 resources: Dict[str, float], pg: Optional[Tuple[bytes, int]],
+                 lessee: Optional[str] = None):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.pg = pg
+        # RPC address of the requesting process (owner_addr). A lease whose
+        # lessee dies must be reclaimed — a dead submitter can never return
+        # it (reference: raylet cleans up leases of disconnected clients).
+        self.lessee = lessee
         # >0 while the leased worker is blocked in get()/wait(): its
         # resources are temporarily returned to the pool so nested tasks can
         # schedule (reference: NotifyDirectCallTaskBlocked — without this,
@@ -101,6 +108,10 @@ class NodeManager:
         import collections
 
         self._worker_waiters = collections.deque()
+        # Dedicated TPU-slot pool: at most one live TPU-env worker per host.
+        self._tpu_idle: List[WorkerProc] = []
+        self._tpu_waiters = collections.deque()
+        self._tpu_spawning = 0
         self._lease_grant_order = collections.deque()
         self._workers: Dict[str, WorkerProc] = {}
         self._idle: List[WorkerProc] = []
@@ -176,9 +187,14 @@ class NodeManager:
                     self._workers.pop(w.worker_id, None)
                     if w in self._idle:
                         self._idle.remove(w)
+                    if w in self._tpu_idle:
+                        self._tpu_idle.remove(w)
                     if not w.ready.is_set():
                         # Died before registering: free its spawn slot.
-                        self._spawning = max(0, self._spawning - 1)
+                        if w.tpu:
+                            self._tpu_spawning = max(0, self._tpu_spawning - 1)
+                        else:
+                            self._spawning = max(0, self._spawning - 1)
             if dead:
                 self._idle_cv.notify_all()
         for w in dead:
@@ -189,6 +205,23 @@ class NodeManager:
             lease = self._leases.pop(w.lease_id, None) if w.lease_id else None
             if lease is not None and lease.blocked == 0:
                 self._release_resources(lease)
+            # Reclaim leases this worker REQUESTED (nested submission):
+            # the lessee is gone, nobody will ever return them.
+            if w.address:
+                orphans = [l for l in self._leases.values()
+                           if l.lessee == w.address]
+                for l in orphans:
+                    self._leases.pop(l.lease_id, None)
+                    if l.blocked == 0:
+                        self._release_resources(l)
+                    lw = l.worker
+                    lw.lease_id = None
+                    if (lw.worker_id in self._workers
+                            and not lw.is_actor_host
+                            and lw.proc.poll() is None and lw.ready.is_set()
+                            and lw not in self._idle
+                            and lw not in self._tpu_idle):
+                        self._hand_worker(lw)
         # The worker may have hosted actors: the head tracks actor->address,
         # workers report their hosted actors at registration; simplest robust
         # path is "head notices via actor_died from the caller"; we also
@@ -231,30 +264,45 @@ class NodeManager:
     def _spawner_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                self._spawn_requests.get(timeout=1.0)
+                tpu = self._spawn_requests.get(timeout=1.0)
             except Exception:
                 continue
             try:
-                self._spawn_worker_inner()
+                self._spawn_worker_inner(tpu=bool(tpu))
             except BaseException:  # noqa: BLE001
                 with self._idle_cv:
-                    self._spawning = max(0, self._spawning - 1)
+                    if tpu:
+                        self._tpu_spawning = max(0, self._tpu_spawning - 1)
+                    else:
+                        self._spawning = max(0, self._spawning - 1)
                     self._idle_cv.notify_all()
 
-    def _spawn_worker(self) -> None:
+    def _spawn_worker(self, tpu: bool = False) -> None:
         """Fire-and-forget spawn via the dedicated spawner thread (PDEATHSIG
         must be armed from a long-lived thread). The worker joins the idle
         pool when it registers; callers wait on _idle_cv, never on a
         specific spawn."""
-        self._spawn_requests.put(1)
+        self._spawn_requests.put(1 if tpu else 0)
 
-    def _spawn_worker_inner(self) -> WorkerProc:
+    def _spawn_worker_inner(self, tpu: bool = False) -> WorkerProc:
         worker_id = uuid.uuid4().hex
         log_dir = cfg.log_dir
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
         env = dict(os.environ)
         env["RTPU_WORKER_ID"] = worker_id
+        if not tpu:
+            # CPU pool worker: exactly one process per host may own the TPU
+            # runtime (multi-controller JAX; analog of TPU_VISIBLE_CHIPS
+            # isolation, reference python/ray/_private/accelerators/
+            # tpu.py:154). Stripping the TPU plugin env here also cuts
+            # worker cold-start by the full jax-import cost, which the
+            # site hook would otherwise charge to EVERY pool worker.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # Force cpu: an inherited JAX_PLATFORMS naming the (stripped)
+            # TPU plugin would fail backend init in the worker.
+            env["JAX_PLATFORMS"] = "cpu"
+            env["RTPU_TPU_CHIPS"] = "0"
         logf = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker_main",
@@ -267,7 +315,7 @@ class NodeManager:
             cwd=os.getcwd(),
             preexec_fn=_die_with_parent,
         )
-        w = WorkerProc(proc, worker_id)
+        w = WorkerProc(proc, worker_id, tpu=tpu)
         with self._lock:
             self._workers[worker_id] = w
         return w
@@ -285,7 +333,10 @@ class NodeManager:
                 return True  # duplicate (retry after lost ack)
             w.address = address
             w.ready.set()
-            self._spawning = max(0, self._spawning - 1)
+            if w.tpu:
+                self._tpu_spawning = max(0, self._tpu_spawning - 1)
+            else:
+                self._spawning = max(0, self._spawning - 1)
             self._hand_worker(w)
             # Demand still outstrips supply: keep the spawn pipeline full.
             if (self._worker_waiters
@@ -295,12 +346,30 @@ class NodeManager:
             self._idle_cv.notify_all()
         return True
 
-    def _pop_worker(self, timeout: float) -> Optional[WorkerProc]:
+    def _pop_worker(self, timeout: float,
+                    tpu: bool = False) -> Optional[WorkerProc]:
         """Claim an idle worker FIFO-fairly, spawning more (bounded
         concurrency — worker startup is CPU-heavy) while demand outstrips
-        the pool."""
+        the pool. TPU leases draw from the dedicated TPU-slot pool (one
+        TPU-env worker per host)."""
         ev = threading.Event()
         slot: List[Optional[WorkerProc]] = [None]
+        if tpu:
+            with self._idle_cv:
+                if self._tpu_idle and not self._tpu_waiters:
+                    return self._tpu_idle.pop()
+                self._tpu_waiters.append((ev, slot))
+                if self._tpu_spawning < 1:
+                    self._tpu_spawning += 1
+                    self._spawn_worker(tpu=True)
+            if ev.wait(timeout):
+                return slot[0]
+            with self._idle_cv:
+                try:
+                    self._tpu_waiters.remove((ev, slot))
+                except ValueError:
+                    pass
+                return slot[0]
         with self._idle_cv:
             if self._idle and not self._worker_waiters:
                 return self._idle.pop()
@@ -320,6 +389,15 @@ class NodeManager:
     def _hand_worker(self, w: WorkerProc) -> None:
         """Give an available worker to the oldest waiter, else idle it.
         Caller must hold the lock."""
+        if w.tpu:
+            while self._tpu_waiters:
+                ev, slot = self._tpu_waiters.popleft()
+                slot[0] = w
+                ev.set()
+                return
+            w.idle_since = time.monotonic()
+            self._tpu_idle.append(w)
+            return
         while self._worker_waiters:
             ev, slot = self._worker_waiters.popleft()
             slot[0] = w
@@ -370,7 +448,8 @@ class NodeManager:
     def rpc_request_lease(self, conn, resources: Dict[str, float],
                           wait_ready: bool = True,
                           pg: Optional[Tuple[bytes, int]] = None,
-                          req_id: Optional[str] = None):
+                          req_id: Optional[str] = None,
+                          lessee: Optional[str] = None):
         """Returns (worker_addr, lease_id) or None if infeasible (spillback).
         `req_id` makes retries idempotent: the memo is CLAIMED before the
         (slow) worker pop, so a retry arriving mid-flight waits for the
@@ -395,7 +474,11 @@ class NodeManager:
                 return entry[1]
         grant = None
         try:
-            grant = self._do_request_lease(resources, pg)
+            grant = self._do_request_lease(resources, pg, lessee)
+            if grant is not None and conn.peer_info.get("gone"):
+                # Requester died while queued: reclaim immediately.
+                self.rpc_return_lease(conn, grant[1])
+                grant = None
         finally:
             if entry is not None:
                 entry[1] = grant
@@ -403,7 +486,8 @@ class NodeManager:
         return grant
 
     def _do_request_lease(self, resources: Dict[str, float],
-                          pg: Optional[Tuple[bytes, int]]):
+                          pg: Optional[Tuple[bytes, int]],
+                          lessee: Optional[str] = None):
         deadline = time.monotonic() + cfg.lease_queue_block_ms / 1000.0
         with self._lock:
             while True:
@@ -416,20 +500,26 @@ class NodeManager:
                 # Queue here until resources free up (or the block window
                 # expires and the caller spills back via the head).
                 self._avail_cond.wait(min(remaining, 0.25))
-        w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0)
+        w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
+                             tpu=resources.get("TPU", 0) > 0)
         if w is None:
             lease = Lease("", None, resources, resolved)
             with self._lock:
                 self._release_resources(lease)
             return None
         lease_id = uuid.uuid4().hex
-        lease = Lease(lease_id, w, resources, resolved)
+        lease = Lease(lease_id, w, resources, resolved, lessee)
         w.lease_id = lease_id
         with self._lock:
             self._leases[lease_id] = lease
         return w.address, lease_id
 
-    def rpc_return_lease(self, conn, lease_id: str):
+    def rpc_return_lease(self, conn, lease_id: str, pool_worker: bool = True):
+        """pool_worker=False is the BROKEN-lease return: the lessee lost its
+        connection to the worker and re-routed the tasks, so the worker may
+        still be executing a stale copy — never pool it (double-dispatch);
+        terminate it and let the death sweep reap (execution-side dedup
+        makes the re-routed copies safe)."""
         with self._lock:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
@@ -438,9 +528,15 @@ class NodeManager:
                 self._release_resources(lease)
             w = lease.worker
             w.lease_id = None
-            if (w.worker_id in self._workers and not w.is_actor_host
+            if (pool_worker
+                    and w.worker_id in self._workers and not w.is_actor_host
                     and w.proc.poll() is None):
                 self._hand_worker(w)
+            elif not pool_worker and not w.is_actor_host:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
         return True
 
     def _lease_for_worker_addr(self, addr: str) -> Optional[Lease]:
@@ -448,6 +544,24 @@ class NodeManager:
             if l.worker is not None and l.worker.address == addr:
                 return l
         return None
+
+    def on_peer_disconnect(self, conn) -> None:
+        """A peer (worker/driver) connection dropped. Mark it so in-flight
+        lease grants to this peer are reclaimed instead of orphaned: a
+        killed submitter's QUEUED lease request can grant after its death —
+        the reply goes nowhere and nobody would ever return the lease."""
+        conn.peer_info["gone"] = True
+
+    def rpc_list_leases(self, conn):
+        """Introspection (state API / debugging): the node's open leases."""
+        with self._lock:
+            return [{"lease_id": l.lease_id, "resources": dict(l.resources),
+                     "pg": repr(l.pg), "blocked": l.blocked,
+                     "lessee": l.lessee,
+                     "worker": l.worker.address,
+                     "worker_alive": l.worker.proc.poll() is None,
+                     "is_actor_host": l.worker.is_actor_host}
+                    for l in self._leases.values()], dict(self.available)
 
     def rpc_worker_blocked(self, conn, worker_addr: str):
         """The leased worker entered a blocking get()/wait(): return its

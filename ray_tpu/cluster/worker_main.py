@@ -356,26 +356,34 @@ class WorkerRuntime(ClusterCore):
                          name=f"actor-loop-{hosted.actor_id.hex()[:8]}").start()
         ready.wait()
 
-    def rpc_push_actor_task(self, conn, blob: bytes, seq: int,
-                            min_pending: int = 0):
-        """At-least-once delivery in: dedup + per-submitter seq buffering
-        out. `min_pending` is the submitter's smallest still-pending seq —
-        everything below it was completed or failed elsewhere, so the
-        expected-seq horizon starts there (a fresh incarnation never waits
-        for seqs that predate it)."""
-        spec = SERIALIZER.decode(blob)
-        actor_id = ActorID(spec["actor_id"])
+    def rpc_push_actor_batch(self, conn, entries, min_pending: int = 0):
+        """Batched at-least-once actor-call delivery: one frame per
+        submitter burst, entries = [(seq, blob)] in seq order. Dedup +
+        per-submitter seq buffering out. `min_pending` is the submitter's
+        smallest still-pending seq — everything below it was completed or
+        failed elsewhere, so the expected-seq horizon starts there (a fresh
+        incarnation never waits for seqs that predate it)."""
+        if not entries:
+            return True
+        specs = []
+        for seq, blob in entries:
+            t = SERIALIZER.decode(blob)
+            specs.append((seq, {
+                "task_id": t[0], "actor_id": t[1], "method": t[2],
+                "args": t[3], "kwargs": t[4], "return_ids": t[5],
+                "owner_addr": t[6]}))
+        actor_id = ActorID(specs[0][1]["actor_id"])
         with self._hosted_lock:
             hosted = self._hosted.get(actor_id)
-        task_id = TaskID(spec["task_id"])
-        return_ids = [ObjectID(b) for b in spec["return_ids"]]
-        owner = spec["owner_addr"]
         if hosted is None or hosted.dead:
-            self._send_results(owner, task_id, return_ids,
-                               error=ActorDiedError(actor_id, "actor not "
-                                                    "hosted here"),
-                               actor_ctx=(spec["actor_id"], seq))
+            for seq, spec in specs:
+                self._send_results(
+                    spec["owner_addr"], TaskID(spec["task_id"]),
+                    [ObjectID(b) for b in spec["return_ids"]],
+                    error=ActorDiedError(actor_id, "actor not hosted here"),
+                    actor_ctx=(spec["actor_id"], seq))
             return True
+        owner = specs[0][1]["owner_addr"]
         with hosted.order_lock:
             st = hosted.order.get(owner)
             if st is None:
@@ -388,17 +396,90 @@ class WorkerRuntime(ClusterCore):
             # drop any stale buffered ones so the scan below can't stall.
             for s in [s for s in st.buf if s < st.expected]:
                 del st.buf[s]
-            if seq < st.expected or seq in st.buf:
-                return True  # duplicate of an executed/buffered push
-            st.buf[seq] = spec
+            for seq, spec in specs:
+                if seq < st.expected or seq in st.buf:
+                    continue  # duplicate of an executed/buffered push
+                st.buf[seq] = spec
             runnable = []
             while st.expected in st.buf:
                 s = st.expected
                 runnable.append((st.buf.pop(s), s))
                 st.expected += 1
+        if hosted.is_async and hosted.loop is not None:
+            # Async actors: schedule the whole runnable burst onto the
+            # actor's event loop in ONE threadsafe hop (pool.submit +
+            # run_coroutine_threadsafe per call doubled the thread churn).
+            import asyncio
+
+            def _schedule(batch):
+                for sp, s in batch:
+                    asyncio.ensure_future(
+                        self._run_async_actor_task(hosted, sp, s))
+
+            if runnable:
+                hosted.loop.call_soon_threadsafe(_schedule, runnable)
+            return True
         for sp, s in runnable:
             hosted.pool.submit(self._execute_actor_task, hosted, sp, s)
         return True
+
+    async def _run_async_actor_task(self, hosted: _HostedActor, spec: Dict,
+                                    seq: int) -> None:
+        """Runs one actor coroutine on the actor's event loop. Ref args
+        resolve on the pool (blocking gets must never stall the loop)."""
+        if spec["method"] == "__rtpu_dag_loop__":
+            # DAG bootstrap has its own thread handling in the sync path.
+            hosted.pool.submit(self._execute_actor_task, hosted, spec, seq)
+            return
+        task_id = TaskID(spec["task_id"])
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        owner = spec["owner_addr"]
+        actor_ctx = (spec["actor_id"], seq)
+        try:
+            args, kwargs = spec["args"], spec["kwargs"]
+            if any(isinstance(a, ObjectRef) for a in args) or any(
+                    isinstance(v, ObjectRef) for v in kwargs.values()):
+                import asyncio
+
+                args, kwargs = await asyncio.get_running_loop() \
+                    .run_in_executor(hosted.pool, self._resolve_args,
+                                     args, kwargs)
+            method = getattr(hosted.instance, spec["method"])
+            # ContextVar scoping: each asyncio task has its own context, so
+            # this set is visible only to THIS call's coroutine chain.
+            runtime_context.set_worker_context({
+                "task_id": task_id, "actor_id": hosted.actor_id,
+                "resources": {}})
+            t_exec = time.time()
+            if inspect.iscoroutinefunction(method):
+                result = await method(*args, **kwargs)
+            else:
+                # Plain methods on an async actor run on the pool: a
+                # blocking body must not stall every other coroutine.
+                import asyncio
+
+                ctx = runtime_context.current_worker_context()
+
+                def _call():
+                    prev = runtime_context.set_worker_context(ctx)
+                    try:
+                        if hosted.max_concurrency == 1:
+                            with hosted.lock:
+                                return method(*args, **kwargs)
+                        return method(*args, **kwargs)
+                    finally:
+                        runtime_context.set_worker_context(prev)
+
+                result = await asyncio.get_running_loop().run_in_executor(
+                    hosted.pool, _call)
+            self._send_results(owner, task_id, return_ids, value=result,
+                               actor_ctx=actor_ctx,
+                               span=(t_exec, time.time(),
+                                     f"actor.{spec['method']}"))
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, RayTpuError) else capture_exception(e)
+            self._send_results(owner, task_id, return_ids, error=err,
+                               actor_ctx=actor_ctx)
 
     def _execute_actor_task(self, hosted: _HostedActor, spec: Dict, seq: int) -> None:
         task_id = TaskID(spec["task_id"])

@@ -45,7 +45,6 @@ from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
                                 WorkerCrashedError)
 
-_LEASE_LINGER_S = 1.0
 
 
 class _Lease:
@@ -61,7 +60,7 @@ class _Lease:
         # the queue drained (slow worker spawn raced the burst) must still be
         # returned to its node — release_at=0 here used to mean "never",
         # permanently leaking the lease's CPUs and starving the cluster.
-        self.release_at = time.monotonic() + _LEASE_LINGER_S
+        self.release_at = time.monotonic() + cfg.lease_linger_ms / 1000.0
         self.broken = False
 
 
@@ -208,6 +207,10 @@ class ClusterCore:
 
         self._push_acks = collections.deque()
         self._push_ack_event = threading.Event()
+        self._borrow_buf: Dict[str, list] = {}
+        self._borrow_buf_lock = threading.Lock()
+        self._borrows_sent: set = set()
+        self._borrows_sent_order = _collections.deque()
         # Function table (reference: _private/function_manager.py exports a
         # function ONCE to the GCS function table; tasks carry only its
         # digest). Pickling the function per submit was the tasks_async
@@ -273,13 +276,40 @@ class ClusterCore:
         self.memory_store.get_async(oid, cb)
 
     def on_ref_deserialized(self, oid: ObjectID, owner_addr: Optional[str]) -> None:
-        # Borrow registration: tell the owner we hold a reference.
+        # Borrow registration: tell the owner we hold a reference. Buffered
+        # and flushed as one frame per owner (an object containing 10k refs
+        # must not cost 10k notify syscalls per get); the owner-side
+        # transfer pin covers the sub-second flush latency.
         if owner_addr and owner_addr != self.owner_addr:
-            try:
-                self._pool.get(owner_addr).notify(
-                    "add_borrower", oid.binary(), self.owner_addr)
-            except Exception:
-                pass
+            key = oid.binary()
+            flush = None
+            with self._borrow_buf_lock:
+                if key in self._borrows_sent:
+                    return  # owner already knows; re-gets of the same
+                            # ref-bearing object must not re-notify
+                self._borrows_sent.add(key)
+                self._borrows_sent_order.append(key)
+                while len(self._borrows_sent_order) > 200_000:
+                    self._borrows_sent.discard(
+                        self._borrows_sent_order.popleft())
+                self._borrow_buf.setdefault(owner_addr, []).append(key)
+                if len(self._borrow_buf[owner_addr]) >= 512:
+                    flush = self._borrow_buf.pop(owner_addr)
+            if flush is not None:
+                self._flush_borrows(owner_addr, flush)
+
+    def _flush_borrows(self, owner_addr: str, oid_blobs: list) -> None:
+        try:
+            self._pool.get(owner_addr).notify(
+                "add_borrowers", oid_blobs, self.owner_addr)
+        except Exception:
+            pass
+
+    def _flush_all_borrows(self) -> None:
+        with self._borrow_buf_lock:
+            bufs, self._borrow_buf = self._borrow_buf, {}
+        for owner_addr, oid_blobs in bufs.items():
+            self._flush_borrows(owner_addr, oid_blobs)
 
     def pin_for_transfer(self, oid: ObjectID,
                          owner_addr: Optional[str]) -> None:
@@ -295,6 +325,8 @@ class ClusterCore:
             (time.monotonic() + cfg.transfer_pin_ttl_s, oid))
 
     def _sweep_transfer_pins(self) -> None:
+        if self._borrow_buf:
+            self._flush_all_borrows()
         now = time.monotonic()
         while self._transfer_pins and self._transfer_pins[0][0] <= now:
             _, oid = self._transfer_pins.popleft()
@@ -404,6 +436,19 @@ class ClusterCore:
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef, got {type(r).__name__}")
+        # Batch fast path: every ref owned locally -> ONE memory-store wait
+        # for the whole list (per-ref lock/scope round-trips dominated
+        # large fan-in gets).
+        if len(ref_list) > 1 and all(
+                r.owner_address is None or r.owner_address == self.owner_addr
+                for r in ref_list):
+            oids = [r.id() for r in ref_list]
+            try:
+                recs = self.memory_store.get(oids, 0)
+            except GetTimeoutError:
+                with self._blocked_scope():
+                    recs = self.memory_store.get(oids, timeout)
+            return [self.resolve_record(rec) for rec in recs]
         out = []
         deadline = None if timeout is None else time.monotonic() + timeout
         for r in ref_list:
@@ -467,6 +512,26 @@ class ClusterCore:
         subscription-based, core_worker.h:682)."""
         if len(set(r.id() for r in refs)) != len(refs):
             raise ValueError("wait() requires unique object refs")
+        # Fast path: enough refs already resolved locally -> one lock pass,
+        # zero callback registration/removal churn.
+        owned = [r for r in refs
+                 if r.owner_address in (None, self.owner_addr)]
+        if len(owned) == len(refs):
+            ready_now = self.memory_store.ready_subset(
+                (r.id() for r in refs), num_returns)
+            if len(ready_now) < num_returns:
+                # All-local waits ride the store's condvar directly (the
+                # put_batch wakeup) — zero per-ref callback churn.
+                oids = [r.id() for r in refs]
+                with self._blocked_scope():
+                    ready_now = self.memory_store.wait(
+                        oids, num_returns, timeout)
+            ready, not_ready = [], []
+            for r in refs:
+                (ready if r.id() in ready_now
+                 and len(ready) < num_returns
+                 else not_ready).append(r)
+            return ready, not_ready
         deadline = None if timeout is None else time.monotonic() + timeout
         cv = threading.Condition()
         ready_ids: set = set()
@@ -671,8 +736,9 @@ class ClusterCore:
         ready = self.memory_store.wait(oids, 1, timeout)
         return [o.binary() for o in ready]
 
-    def rpc_add_borrower(self, conn, oid_bytes: bytes, borrower: str):
-        self.refcount.add_borrower(ObjectID(oid_bytes), borrower)
+    def rpc_add_borrowers(self, conn, oid_blobs: list, borrower: str):
+        for oid_bytes in oid_blobs:
+            self.refcount.add_borrower(ObjectID(oid_bytes), borrower)
         return True
 
     def rpc_remove_borrower(self, conn, oid_bytes: bytes, borrower: str):
@@ -697,12 +763,11 @@ class ClusterCore:
         for oid in oids or ():
             self.refcount.remove_submitted_task_ref(oid)
 
-    def rpc_task_done(self, conn, task_id_bytes: bytes,
-                      results: List[Tuple[bytes, str, Any]],
-                      span: Optional[Tuple[float, float, str]] = None):
-        """Completion push from the executing worker.
-        results: [(oid_bytes, kind, payload)] kind in value|error|in_store;
-        span: (exec_start, exec_end, name) for timeline/metrics."""
+    def _complete_task(self, task_id_bytes: bytes,
+                       results: List[Tuple[bytes, str, Any]],
+                       span, puts: list) -> None:
+        """Shared completion bookkeeping; value deliveries are appended to
+        ``puts`` so batched completions land in ONE memory-store pass."""
         with self._inflight_lock:
             info = self._inflight.pop(task_id_bytes, None)
         self._release_submitted_args(task_id_bytes)
@@ -724,13 +789,23 @@ class ClusterCore:
         for oid_bytes, kind, payload in results:
             oid = ObjectID(oid_bytes)
             if kind == "value":
-                self.memory_store.put(oid, SERIALIZER.decode(payload))
+                puts.append((oid, SERIALIZER.decode(payload), False))
             elif kind == "error":
-                self.memory_store.put(oid, payload, is_exception=True)
+                puts.append((oid, payload, True))
             else:
-                self.memory_store.put(oid, PlasmaStub(oid))
+                puts.append((oid, PlasmaStub(oid), False))
         if info is not None:
             self._lease_task_finished(info.sched_key, info.worker_addr)
+
+    def rpc_task_done(self, conn, task_id_bytes: bytes,
+                      results: List[Tuple[bytes, str, Any]],
+                      span: Optional[Tuple[float, float, str]] = None):
+        """Completion push from the executing worker.
+        results: [(oid_bytes, kind, payload)] kind in value|error|in_store;
+        span: (exec_start, exec_end, name) for timeline/metrics."""
+        puts: list = []
+        self._complete_task(task_id_bytes, results, span, puts)
+        self.memory_store.put_batch(puts)
         return True
 
     def rpc_batch_done(self, conn_ctx, entries):
@@ -741,26 +816,37 @@ class ClusterCore:
         from ray_tpu.cluster import protocol
 
         stats_on = protocol._stats_on()
-        for kind, payload in entries:
-            if not stats_on:
-                if kind == "actor":
-                    self.rpc_actor_call_done(conn_ctx, *payload)
-                else:
-                    self.rpc_task_done(conn_ctx, *payload)
-                continue
-            method = "actor_call_done" if kind == "actor" else "task_done"
-            t0 = time.monotonic()
-            ok = True
-            try:
-                if kind == "actor":
-                    self.rpc_actor_call_done(conn_ctx, *payload)
-                else:
-                    self.rpc_task_done(conn_ctx, *payload)
-            except Exception:
-                ok = False
-                raise
-            finally:
-                protocol._record_event_stat(method, time.monotonic() - t0, ok)
+        puts: list = []
+        try:
+            for kind, payload in entries:
+                method = "actor_call_done" if kind == "actor" else "task_done"
+                t0 = time.monotonic() if stats_on else 0.0
+                ok = True
+                try:
+                    if kind == "actor":
+                        (actor_id_bytes, seq, task_id_bytes,
+                         results, span) = payload
+                        aconn = self._actor_conn(ActorID(actor_id_bytes))
+                        with aconn.lock:
+                            aconn.pending.pop(seq, None)
+                        self._complete_task(task_id_bytes, results, span,
+                                            puts)
+                    else:
+                        self._complete_task(payload[0], payload[1],
+                                            payload[2] if len(payload) > 2
+                                            else None, puts)
+                except Exception:
+                    ok = False
+                    raise
+                finally:
+                    if stats_on:
+                        protocol._record_event_stat(
+                            method, time.monotonic() - t0, ok)
+        finally:
+            # A poison entry must not discard the completed entries'
+            # results: their inflight/lease bookkeeping already ran, and
+            # dropping the values would strand their owners in get().
+            self.memory_store.put_batch(puts)
         return True
 
     def rpc_ping(self, conn):
@@ -917,10 +1003,11 @@ class ClusterCore:
         while True:
             batch: List[Tuple[tuple, _Lease]] = []
             with self._lease_lock:
+                depth = cfg.max_tasks_in_flight_per_worker
                 while kq.queue:
                     lease = None
                     for l in kq.leases:
-                        if not l.broken and l.inflight < 4:
+                        if not l.broken and l.inflight < depth:
                             lease = l
                             break
                     if lease is None:
@@ -969,10 +1056,11 @@ class ClusterCore:
         with self._lease_lock:
             if time.monotonic() < kq.next_lease_attempt:
                 return
-            capacity = sum(4 - l.inflight for l in kq.leases
-                           if not l.broken) + kq.pending_lease_requests * 4
+            depth = cfg.max_tasks_in_flight_per_worker
+            capacity = sum(depth - l.inflight for l in kq.leases
+                           if not l.broken) + kq.pending_lease_requests * depth
             want = 0
-            while (capacity + want * 4 < queue_len
+            while (capacity + want * depth < queue_len
                    and kq.pending_lease_requests + want
                    < cfg.max_pending_lease_requests_per_scheduling_key):
                 want += 1
@@ -993,10 +1081,24 @@ class ClusterCore:
                 kq.pending_lease_requests -= 1
         if lease is not None:
             with self._lease_lock:
-                kq.leases.append(lease)
-                kq.lease_fail_deadline = None
-                kq.lease_backoff = 0.0
-                kq.next_lease_attempt = 0.0
+                if self._key_queues.get(kq.key) is not kq:
+                    # The kq was reaped while this grant was in flight:
+                    # nobody will ever dispatch on (or return) this lease —
+                    # hand the worker straight back to its node.
+                    orphaned = True
+                else:
+                    orphaned = False
+                    kq.leases.append(lease)
+                    kq.lease_fail_deadline = None
+                    kq.lease_backoff = 0.0
+                    kq.next_lease_attempt = 0.0
+            if orphaned:
+                try:
+                    self._pool.get(lease.node_addr).retrying_call(
+                        "return_lease", lease.lease_id, timeout=5)
+                except Exception:
+                    pass
+                return
             kq.wake.set()
             return
         # Infeasible right now. If nothing is making progress for too long,
@@ -1045,7 +1147,7 @@ class ClusterCore:
                 "push_tasks",
                 [(tid, info.spec_blob) for tid, info in survivors])
             self._push_acks.append(
-                [waiter, survivors, lease, kq, 0, time.monotonic() + 10.0])
+                [waiter, survivors, lease, kq, 0, time.monotonic() + 3.0])
             self._push_ack_event.set()
         except BaseException:
             with self._inflight_lock:
@@ -1099,7 +1201,7 @@ class ClusterCore:
                     if tid in self._inflight]
         if not live:
             return  # all completed or already handled by conn-loss hook
-        if attempts < 3 and not lease.broken:
+        if attempts < 8 and not lease.broken:
             try:
                 worker = self._pool.get(lease.worker_addr,
                                         on_close=self._on_worker_conn_lost)
@@ -1108,7 +1210,7 @@ class ClusterCore:
                     [(tid, info.spec_blob) for tid, info in live])
                 self._push_acks.append(
                     [w2, live, lease, kq, attempts + 1,
-                     time.monotonic() + 10.0])
+                     time.monotonic() + 3.0])
                 return
             except BaseException:
                 pass
@@ -1150,6 +1252,7 @@ class ClusterCore:
             try:
                 granted = self._pool.get(node_addr).retrying_call(
                     "request_lease", resources, True, pg, req_id,
+                    self.owner_addr,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 5)
             except (ConnectionLost, TimeoutError):
                 exclude.append(node_id)
@@ -1209,14 +1312,14 @@ class ClusterCore:
                 if l.worker_addr == worker_addr and l.inflight > 0:
                     l.inflight -= 1
                     if l.inflight <= 0:
-                        l.release_at = time.monotonic() + _LEASE_LINGER_S
+                        l.release_at = time.monotonic() + cfg.lease_linger_ms / 1000.0
                     break
             kq.wake.set()
 
     def _lease_reaper_loop(self) -> None:
         """Returns idle leases to their node managers after the linger."""
         while not self._shutdown_flag:
-            time.sleep(0.2)
+            time.sleep(0.05)
             now = time.monotonic()
             to_release = []
             with self._lease_lock:
@@ -1230,17 +1333,27 @@ class ClusterCore:
                             keep.append(l)
                     kq.leases[:] = keep
                     if (not kq.leases and not kq.queue
-                            and not kq.dispatcher_running):
+                            and not kq.dispatcher_running
+                            and not kq.pending_lease_requests):
+                        # pending_lease_requests guard: a slow worker-spawn
+                        # grant landing on a popped (orphaned) kq would
+                        # leak the lease's resources on its node forever.
                         self._key_queues.pop(key, None)
             for l in to_release:
-                if not l.broken:
-                    try:
-                        # Acked + retried: a lost return would leak the
-                        # lease's resources on the node forever.
-                        self._pool.get(l.node_addr).retrying_call(
-                            "return_lease", l.lease_id, timeout=5)
-                    except Exception:
-                        pass
+                # BROKEN leases are returned too: "broken" only means OUR
+                # connection to the worker died — if the worker is actually
+                # alive (transient conn loss), skipping the return would
+                # leave its resources debited on the node forever.
+                # pool_worker=False for broken ones: the worker may still
+                # be executing the re-routed tasks' original copies, so the
+                # node terminates it instead of pooling it (double-dispatch).
+                try:
+                    # Acked + retried: a lost return would leak the
+                    # lease's resources on the node forever.
+                    self._pool.get(l.node_addr).retrying_call(
+                        "return_lease", l.lease_id, not l.broken, timeout=5)
+                except Exception:
+                    pass
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
@@ -1289,7 +1402,13 @@ class ClusterCore:
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False) -> ActorID:
         resources = _as_resource_dict(resources)
-        resources.setdefault("CPU", 1.0)
+        # Only a DEFAULTED actor (no explicit resources) costs 1 CPU to
+        # schedule (released at mark_actor_host). An explicit num_cpus=0
+        # actor schedules with zero demand (reference: ray_option_utils —
+        # actors default num_cpus=1 for scheduling, 0 for running, but an
+        # explicit 0 is honored as 0).
+        if release_resources:
+            resources.setdefault("CPU", 1.0)
         actor_id = ActorID.of(self.job_id)
         spec_blob = SERIALIZER.encode({
             "cls": cls, "args": tuple(args), "kwargs": dict(kwargs),
@@ -1370,14 +1489,13 @@ class ClusterCore:
                 self.memory_store.put(oid, None)
             return refs
 
-        blob = SERIALIZER.encode({
-            "task_id": task_id.binary(),
-            "actor_id": actor_id.binary(),
-            "method": method_name,
-            "args": tuple(args), "kwargs": dict(kwargs),
-            "return_ids": [o.binary() for o in return_ids],
-            "owner_addr": self.owner_addr,
-        })
+        # Positional tuple spec (decoded into a dict worker-side): control
+        # frames are encode-bound at high call rates, and a 7-tuple pickles
+        # materially cheaper/smaller than a 7-key dict.
+        blob = SERIALIZER.encode((
+            task_id.binary(), actor_id.binary(), method_name,
+            tuple(args), dict(kwargs),
+            [o.binary() for o in return_ids], self.owner_addr))
         self._register_submitted_args(task_id.binary(), args, kwargs)
         from ray_tpu.util import metrics
 
@@ -1400,88 +1518,99 @@ class ClusterCore:
         return refs
 
     def _actor_sender_loop(self, conn: _ActorConn) -> None:
-        """Single per-actor sender: pushes queued calls in seq order
-        (pipelined, acked) over one pooled connection, then services unacked
-        pushes — an ack lost to chaos is retried (the worker dedups and
-        re-orders via the min_pending horizon). Any failure fails THAT call
-        and moves on — the sender thread itself must never die with
+        """Single per-actor sender: drains queued calls in seq order as
+        BATCHES — one `push_actor_batch` frame per burst (pipelined, acked)
+        over one pooled connection — then services unacked batches: a batch
+        ack lost to chaos is retried (the worker dedups and re-orders via
+        the min_pending horizon). Any failure fails the affected calls and
+        moves on — the sender thread itself must never die with
         sender_running stuck True (that would wedge the actor)."""
         while True:
+            batch: List[tuple] = []
             with conn.lock:
                 if not conn.outbound and not conn.unacked:
                     conn.sender_running = False
                     return
-                item = conn.outbound.popleft() if conn.outbound else None
                 # A conn-loss handler may have failed a seq while it was
                 # still queued (actor died/restarted before we sent it):
                 # failed-then-executed would duplicate side effects on the
                 # new incarnation, so never send a seq no longer pending.
-                if item is not None and item[0] not in conn.pending:
-                    continue
+                while conn.outbound and len(batch) < 256:
+                    item = conn.outbound.popleft()
+                    if item[0] in conn.pending:
+                        batch.append(item)
             try:
-                if item is not None:
-                    self._send_actor_push(conn, item[0], item[1], item[2], 0)
+                if batch:
+                    self._send_actor_batch(conn, batch, 0)
                     # Opportunistically reap acked heads to bound unacked.
-                    while conn.unacked and conn.unacked[0][3]._event.is_set():
+                    while conn.unacked and conn.unacked[0][1]._event.is_set():
                         self._settle_actor_ack(conn, conn.unacked.popleft())
                     continue
                 entry = conn.unacked[0]
-                if entry[3]._event.wait(0.05):
+                if entry[1]._event.wait(0.05):
                     conn.unacked.popleft()
                     self._settle_actor_ack(conn, entry)
-                elif time.monotonic() > entry[5]:
+                elif time.monotonic() > entry[3]:
                     conn.unacked.popleft()
-                    self._resend_actor_push(conn, entry)
+                    self._resend_actor_batch(conn, entry)
             except BaseException:  # noqa: BLE001 — keep the sender alive
-                if item is not None:
-                    self._fail_actor_call(conn, item[0])
+                for it in batch:
+                    self._fail_actor_call(conn, it[0])
 
-    def _send_actor_push(self, conn: _ActorConn, seq: int, task_id_bytes,
-                         blob, tries: int) -> None:
+    def _send_actor_batch(self, conn: _ActorConn, items: List[tuple],
+                          tries: int) -> None:
+        """items: [(seq, task_id_bytes, blob, return_ids)]. One RPC frame
+        carries the whole burst; the unacked entry tracks the batch."""
         if conn.dead:
-            self._fail_actor_call(conn, seq)
+            for it in items:
+                self._fail_actor_call(conn, it[0])
             return
         try:
             addr = self._resolve_actor_address(conn)
         except Exception:
             addr = None
         if addr is None:
-            self._fail_actor_call(conn, seq)
+            for it in items:
+                self._fail_actor_call(conn, it[0])
             return
         with conn.lock:
-            entry = conn.pending.get(seq)
-        if entry is None:
+            live = [it for it in items if it[0] in conn.pending]
+        if not live:
             return
         with self._inflight_lock:
-            self._inflight[task_id_bytes] = _InflightTask(
-                blob, entry[2], addr, 0, ("actor", conn.actor_id),
-                {}, None, "actor_task")
+            for seq, task_id_bytes, blob, rids in live:
+                self._inflight[task_id_bytes] = _InflightTask(
+                    blob, rids, addr, 0, ("actor", conn.actor_id),
+                    {}, None, "actor_task")
         try:
             waiter = self._pool.get(
                 addr, on_close=self._on_worker_conn_lost).call_async(
-                    "push_actor_task", blob, seq, conn.min_pending())
-            conn.unacked.append(
-                [seq, task_id_bytes, blob, waiter, tries,
-                 time.monotonic() + 10.0])
+                    "push_actor_batch",
+                    [(it[0], it[2]) for it in live], conn.min_pending())
+            # 2s resend deadline: worker-side dedup makes resends free, and
+            # a chaos-dropped frame must not stall the whole batch 10s.
+            conn.unacked.append([live, waiter, tries,
+                                 time.monotonic() + 2.0])
         except (ConnectionLost, OSError):
             self._handle_actor_conn_lost(conn)
 
     def _settle_actor_ack(self, conn: _ActorConn, entry) -> None:
         try:
-            entry[3].wait(0)
+            entry[1].wait(0)
         except BaseException:
-            self._resend_actor_push(conn, entry)
+            self._resend_actor_batch(conn, entry)
 
-    def _resend_actor_push(self, conn: _ActorConn, entry) -> None:
-        seq, task_id_bytes, blob, _, tries, _ = entry
+    def _resend_actor_batch(self, conn: _ActorConn, entry) -> None:
+        items, _, tries, _ = entry
         with conn.lock:
-            still_pending = seq in conn.pending
-        if not still_pending:
+            live = [it for it in items if it[0] in conn.pending]
+        if not live:
             return
-        if tries >= 4:
-            self._fail_actor_call(conn, seq)
+        if tries >= 10:
+            for it in live:
+                self._fail_actor_call(conn, it[0])
             return
-        self._send_actor_push(conn, seq, task_id_bytes, blob, tries + 1)
+        self._send_actor_batch(conn, live, tries + 1)
 
     def _fail_actor_call(self, conn: _ActorConn, seq: int) -> None:
         with conn.lock:

@@ -109,6 +109,22 @@ class ClusterRuntime(ClusterCore):
                  address: Optional[str] = None):
         self._procs: List[subprocess.Popen] = []
         self._nodes: List[NodeProc] = []
+        if address is None and "RTPU_LOG_DIR" not in os.environ:
+            # Session-scoped log dir: a long-lived shared dir accumulates
+            # thousands of stale worker logs, and the driver's log monitor
+            # (plus every spawn) would glob+stat all of them every poll.
+            import uuid as _uuid
+
+            # Remember the base across init/shutdown cycles so re-inits
+            # don't nest session dirs inside the previous session's.
+            base = getattr(ClusterRuntime, "_base_log_dir", None)
+            if base is None:
+                base = ClusterRuntime._base_log_dir = cfg.log_dir
+            session_dir = os.path.join(
+                base, f"session-{_uuid.uuid4().hex[:12]}")
+            cfg.set("log_dir", session_dir)
+            os.environ["RTPU_LOG_DIR"] = session_dir  # inherited by spawns
+            self._owns_log_dir_env = True
         if address is None:
             head_proc = _spawn(
                 [sys.executable, "-m", "ray_tpu.cluster.head_main"],
@@ -230,6 +246,8 @@ class ClusterRuntime(ClusterCore):
             pass
         if getattr(self, "_log_monitor", None) is not None:
             self._log_monitor.stop()  # else init/shutdown cycles double-ship
+        if getattr(self, "_owns_log_dir_env", False):
+            os.environ.pop("RTPU_LOG_DIR", None)  # fresh dir per session
         super().shutdown()
         for p in self._procs:
             try:

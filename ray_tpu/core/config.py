@@ -105,6 +105,14 @@ _d("lease_queue_block_ms", int, 3_000,
 _d("scheduler_spread_threshold", float, 0.5,
    "hybrid policy: pack onto a node until utilization crosses this, then spread")
 _d("max_pending_lease_requests_per_scheduling_key", int, 10, "lease pipelining cap")
+_d("lease_linger_ms", int, 100,
+   "how long an idle lease is kept before returning the worker to its "
+   "node (covers sync submit-get loops); long lingers serialize worker "
+   "handoff between competing submitters")
+_d("max_tasks_in_flight_per_worker", int, 16,
+   "pipelined task pushes per leased worker (reference: "
+   "RAY_max_tasks_in_flight_per_worker); bigger batches amortize frame + "
+   "ack cost for short tasks, smaller keeps load balancing tight")
 _d("worker_pool_min_workers", int, 0, "prestarted workers per node")
 _d("worker_pool_idle_ttl_s", float, 60.0, "idle worker reap time")
 _d("worker_niceness", int, 0, "niceness applied to spawned workers")

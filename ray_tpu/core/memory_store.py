@@ -54,6 +54,26 @@ class MemoryStore:
         for cb in callbacks:
             cb(rec)
 
+    def put_batch(self, items) -> None:
+        """items: [(object_id, value, is_exception)]. One lock acquisition
+        and one notify_all for a whole completion batch — per-put wakeups
+        were a measurable tax at high completion rates."""
+        fire: List[tuple] = []
+        with self._cv:
+            for object_id, value, is_exception in items:
+                if object_id in self._objects:
+                    continue  # idempotent: retries may double-store
+                rec = _Record(value, is_exception,
+                              isinstance(value, PlasmaStub))
+                self._objects[object_id] = rec
+                cbs = self._callbacks.pop(object_id, None)
+                if cbs:
+                    fire.append((cbs, rec))
+            self._cv.notify_all()
+        for cbs, rec in fire:
+            for cb in cbs:
+                cb(rec)
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._objects
@@ -115,6 +135,20 @@ class MemoryStore:
                 if remaining is not None and remaining <= 0:
                     return ready
                 self._cv.wait(timeout=remaining)
+
+    def ready_subset(self, object_ids, limit: int) -> Set[ObjectID]:
+        """First ``limit`` already-present ids, one lock pass, no waiting:
+        the fast path for wait() over mostly-ready ref lists (the
+        reference-shaped pop-1-of-1k wait loop is O(n^2) callback churn
+        without this)."""
+        out: Set[ObjectID] = set()
+        with self._lock:
+            for oid in object_ids:
+                if oid in self._objects:
+                    out.add(oid)
+                    if len(out) >= limit:
+                        break
+        return out
 
     def delete(self, object_ids: List[ObjectID]) -> None:
         with self._lock:

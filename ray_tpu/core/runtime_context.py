@@ -5,31 +5,29 @@ Parity: python/ray/runtime_context.py (get_runtime_context) in the reference.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from typing import Optional
 
 _lock = threading.Lock()
 _runtime = None
 
-# Per-execution-thread task context for cluster workers (task_id, actor_id,
-# resources) — set by the worker's execution loop around user code.
-_worker_ctx = threading.local()
+# Per-execution-context task info for cluster workers (task_id, actor_id,
+# resources) — set by the worker's execution loop around user code. A
+# ContextVar (not threading.local) so asyncio-actor coroutines interleaving
+# on one event-loop thread each see their OWN task context.
+_worker_ctx: "contextvars.ContextVar[Optional[dict]]" = (
+    contextvars.ContextVar("rtpu_worker_ctx", default=None))
 
 
 def current_worker_context() -> dict:
-    return getattr(_worker_ctx, "ctx", {})
+    return _worker_ctx.get() or {}
 
 
 def set_worker_context(ctx: Optional[dict]):
     """Returns the previous context; pass it back to restore."""
-    prev = getattr(_worker_ctx, "ctx", None)
-    if ctx is None:
-        try:
-            del _worker_ctx.ctx
-        except AttributeError:
-            pass
-    else:
-        _worker_ctx.ctx = ctx
+    prev = _worker_ctx.get()
+    _worker_ctx.set(ctx)
     return prev
 
 

@@ -50,6 +50,33 @@ def _jax_reduce(host: np.ndarray):
     return (_restore_jax, (host,))
 
 
+class _NeedsCloudpickle(Exception):
+    """Raised inside the fast path to force the cloudpickle fallback."""
+
+
+class _FastPickler(pickle.Pickler):
+    """C-speed pickler for the common case (control frames, numpy, plain
+    data). reducer_override keeps the jax-array host-numpy path; everything
+    else runs the C fast paths (~10-20x cheaper per frame than cloudpickle,
+    whose Python-level reducer_override is invoked per object).
+
+    __main__-defined classes/functions (driver scripts, REPLs) MUST go
+    by-value: stock pickle would happily encode them by reference
+    ("__main__.Foo"), which decodes to the WRONG (or missing) attribute in
+    a worker whose __main__ is worker_main — so seeing one aborts to the
+    cloudpickle path."""
+
+    def reducer_override(self, obj):
+        if _is_jax_array(obj):
+            return _jax_reduce(np.asarray(obj))
+        if isinstance(obj, type) or callable(obj):
+            if getattr(obj, "__module__", None) == "__main__":
+                raise _NeedsCloudpickle
+        elif type(obj).__module__ == "__main__":
+            raise _NeedsCloudpickle
+        return NotImplemented
+
+
 class Serializer:
     """Stateless encode/decode; one instance per worker."""
 
@@ -63,6 +90,16 @@ class Serializer:
                 return True  # keep small buffers inline
             buffers.append(view)
             return False  # emitted out-of-band
+
+        sio = io.BytesIO()
+        try:
+            _FastPickler(sio, protocol=5,
+                         buffer_callback=buffer_callback).dump(value)
+            return sio.getvalue(), buffers
+        except Exception:
+            # Functions / local classes / anything stock pickle rejects:
+            # retry with cloudpickle's by-value machinery.
+            buffers.clear()
 
         class _Pickler(cloudpickle.CloudPickler):
             def reducer_override(self, obj):

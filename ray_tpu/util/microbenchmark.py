@@ -2,18 +2,26 @@
 
 Parity target: the reference's `ray microbenchmark` CLI
 (reference: python/ray/_private/ray_perf.py:93, scripts.py:1966) — the
-canonical perf gate for core changes. Run as:
+canonical perf gate for core changes. Each row mirrors the reference
+benchmark's SHAPE (who submits, batch sizes, payloads): multi-client rows
+submit from worker/actor processes, n:n rows fan out through remote
+submitter tasks, put_gigabytes puts the reference's 800MB np.zeros. Run as:
 
     python -m ray_tpu.util.microbenchmark [--out PERF.json] [--quick]
 
 Prints one line per metric and writes a JSON file comparing against the
 reference's checked-in 2.42.0 numbers (BASELINE.md's core table).
+
+Rows not implemented here and why:
+- Ray Client get/put calls: no Ray-Client-equivalent tier (the framework
+  is in-cluster only); called out in SURVEY/VERDICT rather than faked.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import multiprocessing
 import time
 from typing import Callable, Dict, List
 
@@ -23,13 +31,23 @@ import numpy as np
 BASELINE = {
     "single_client_get_calls": 10_612,
     "single_client_put_calls": 4_866,
+    "multi_client_put_calls": 15_932,
     "single_client_put_gigabytes": 18.52,
+    "multi_client_put_gigabytes": 47.39,
     "single_client_tasks_sync": 1_013,
     "single_client_tasks_async": 8_032,
+    "multi_client_tasks_async": 22_745,
     "actor_calls_sync_1_1": 1_986,
     "actor_calls_async_1_1": 8_107,
+    "actor_calls_concurrent_1_1": 5_219,
+    "actor_calls_async_1_n": 8_137,
     "actor_calls_async_n_n": 26_442,
+    "actor_calls_with_arg_async_n_n": 2_732,
+    "async_actor_calls_sync_1_1": 1_475,
+    "async_actor_calls_async_1_1": 4_669,
+    "async_actor_calls_async_n_n": 23_390,
     "single_client_wait_1k_refs": 5.42,
+    "single_client_get_object_containing_10k_refs": 12.99,
     "pg_create_removal_per_s": 749,
 }
 
@@ -50,7 +68,7 @@ def timeit(name: str, fn: Callable[[], int], min_seconds: float = 2.0,
     rate = total_ops / dt
     base = BASELINE.get(name)
     suffix = f"  (ref {base:,.0f}; {rate / base:.2f}x)" if base else ""
-    print(f"{name:40s} {rate:12,.1f} /s{suffix}", flush=True)
+    print(f"{name:46s} {rate:12,.1f} /s{suffix}", flush=True)
     if results is not None:
         results[name] = rate
     return rate
@@ -69,25 +87,40 @@ def main(argv: List[str] = None) -> Dict[str, float]:
     import ray_tpu
 
     # Worker pool sized to the machine, like the reference (ray.init
-    # defaults num_cpus to the core count): more worker processes than
-    # cores just multiplies context-switch overhead and halves every
-    # number. Actors don't hold CPU while alive (reference semantics), so
-    # the 5-actor gang below fits any pool size.
-    ray_tpu.init(num_cpus=max(2, os.cpu_count() or 1),
-                 ignore_reinit_error=True)
+    # defaults num_cpus to the core count, min 2 so multi-client rows have
+    # two submitters even on 1-core hosts). Actors are num_cpus=0 in the
+    # reference benchmark and here, so actor gangs fit any pool size.
+    n_cpus = max(2, os.cpu_count() or 1)
+    ray_tpu.init(num_cpus=n_cpus, ignore_reinit_error=True,
+                 _system_config={"object_store_prefault": True})
     results: Dict[str, float] = {}
 
+    # Submitter fan-out widths (reference: multiprocessing.cpu_count()//2,
+    # m=4 submitter tasks). Floor at 2 so the shape survives 1-core hosts.
+    n_cpu_half = max(2, multiprocessing.cpu_count() // 2)
+    m_submitters = 4
+
     # ---------------- puts / gets --------------------------------------
-    small = b"x" * 1024
+    @ray_tpu.remote
+    def do_put_small():
+        for _ in range(100):
+            ray_tpu.put(0)
+        return 100
 
     def put_small():
-        refs = [ray_tpu.put(small) for _ in range(100)]
-        del refs
+        for _ in range(100):
+            ray_tpu.put(0)
         return 100
 
     timeit("single_client_put_calls", put_small, min_s, results)
 
-    cached_ref = ray_tpu.put(np.arange(1024))
+    def put_multi_small():
+        return sum(ray_tpu.get(
+            [do_put_small.remote() for _ in range(10)]))
+
+    timeit("multi_client_put_calls", put_multi_small, min_s, results)
+
+    cached_ref = ray_tpu.put(0)
 
     def get_small():
         for _ in range(100):
@@ -96,7 +129,9 @@ def main(argv: List[str] = None) -> Dict[str, float]:
 
     timeit("single_client_get_calls", get_small, min_s, results)
 
-    big = np.ones((128, 1024, 1024), dtype=np.uint8)  # 128 MB
+    # Reference workload: np.zeros(100M int64) = 800MB (zero pages read-
+    # side; the copy cost is the store-write side, like plasma).
+    big = np.zeros(100 * 1024 * 1024, dtype=np.int64)
 
     def put_big():
         ref = ray_tpu.put(big)
@@ -105,11 +140,23 @@ def main(argv: List[str] = None) -> Dict[str, float]:
 
     rate_bytes = timeit("single_client_put_bytes", put_big, min_s, {})
     results["single_client_put_gigabytes"] = rate_bytes / (1 << 30)
-    base = BASELINE["single_client_put_gigabytes"]
-    print(f"{'single_client_put_gigabytes':40s} "
-          f"{results['single_client_put_gigabytes']:12.2f} GB/s  "
-          f"(ref {base}; {results['single_client_put_gigabytes']/base:.2f}x)",
-          flush=True)
+
+    @ray_tpu.remote
+    def do_put_gb():
+        for _ in range(10):
+            ray_tpu.put(np.zeros(10 * 1024 * 1024, dtype=np.int64))
+        return 10 * 80 * 1024 * 1024
+
+    def put_multi_gb():
+        return sum(ray_tpu.get([do_put_gb.remote() for _ in range(10)]))
+
+    rate_bytes = timeit("multi_client_put_bytes", put_multi_gb,
+                        min_s, {})
+    results["multi_client_put_gigabytes"] = rate_bytes / (1 << 30)
+    for key in ("single_client_put_gigabytes", "multi_client_put_gigabytes"):
+        base = BASELINE[key]
+        print(f"{key:46s} {results[key]:12.2f} GB/s  "
+              f"(ref {base}; {results[key]/base:.2f}x)", flush=True)
 
     # ---------------- tasks --------------------------------------------
     @ray_tpu.remote
@@ -124,16 +171,40 @@ def main(argv: List[str] = None) -> Dict[str, float]:
     timeit("single_client_tasks_sync", tasks_sync, min_s, results)
 
     def tasks_async():
-        ray_tpu.get([nop.remote() for _ in range(200)])
-        return 200
+        ray_tpu.get([nop.remote() for _ in range(1000)])
+        return 1000
 
     timeit("single_client_tasks_async", tasks_async, min_s, results)
 
+    # Reference shape: m actors each submitting n tasks from THEIR OWN
+    # process (Actor.small_value_batch), aggregated.
+    n_batch = 250 if args.quick else 1000
+
+    @ray_tpu.remote(num_cpus=0)
+    class Submitter:
+        def small_value_batch(self, n):
+            ray_tpu.get([nop.remote() for _ in range(n)])
+            return n
+
+    submitters = [Submitter.remote() for _ in range(m_submitters)]
+    ray_tpu.get([s.small_value_batch.remote(10) for s in submitters])
+
+    def multi_tasks_async():
+        return sum(ray_tpu.get([
+            s.small_value_batch.remote(n_batch) for s in submitters]))
+
+    timeit("multi_client_tasks_async", multi_tasks_async, min_s, results)
+    # The reference's actors die via distributed GC when their handles go
+    # out of scope; kill explicitly so finished phases' actor processes
+    # don't tax later phases.
+    for s in submitters:
+        ray_tpu.kill(s)
+
     # ---------------- actors -------------------------------------------
-    @ray_tpu.remote
+    @ray_tpu.remote(num_cpus=0)
     class Echo:
         def ping(self, payload=b""):
-            return payload
+            return b"ok"
 
     actor = Echo.remote()
     ray_tpu.get(actor.ping.remote())
@@ -146,33 +217,136 @@ def main(argv: List[str] = None) -> Dict[str, float]:
     timeit("actor_calls_sync_1_1", actor_sync, min_s, results)
 
     def actor_async():
-        ray_tpu.get([actor.ping.remote() for _ in range(200)])
-        return 200
+        ray_tpu.get([actor.ping.remote() for _ in range(1000)])
+        return 1000
 
     timeit("actor_calls_async_1_1", actor_async, min_s, results)
 
-    n_pairs = 4
-    actors = [Echo.remote() for _ in range(n_pairs)]
-    ray_tpu.get([a.ping.remote() for a in actors])
+    conc_actor = Echo.options(max_concurrency=16).remote()
+    ray_tpu.get(conc_actor.ping.remote())
 
-    def actor_async_nn():
-        refs = []
-        for a in actors:
-            refs.extend(a.ping.remote() for _ in range(50))
-        ray_tpu.get(refs)
-        return len(refs)
+    def actor_concurrent():
+        ray_tpu.get([conc_actor.ping.remote() for _ in range(1000)])
+        return 1000
 
-    timeit("actor_calls_async_n_n", actor_async_nn, min_s, results)
+    timeit("actor_calls_concurrent_1_1", actor_concurrent, min_s, results)
+
+    # 1:n — ONE remote client actor fanning out to n server actors.
+    servers = [Echo.remote() for _ in range(n_cpu_half)]
+
+    @ray_tpu.remote(num_cpus=0)
+    class Client:
+        def __init__(self, servers):
+            self.servers = servers
+
+        def batch(self, n):
+            refs = []
+            for s in self.servers:
+                refs.extend(s.ping.remote() for _ in range(n))
+            ray_tpu.get(refs)
+            return len(refs)
+
+        def batch_arg(self, n):
+            x = ray_tpu.put(0)
+            refs = []
+            for s in self.servers:
+                refs.extend(s.ping.remote(x) for _ in range(n))
+            ray_tpu.get(refs)
+            return len(refs)
+
+    client = Client.remote(servers)
+    ray_tpu.get(client.batch.remote(10))
+
+    def actor_async_1_n():
+        return ray_tpu.get(client.batch.remote(n_batch))
+
+    timeit("actor_calls_async_1_n", actor_async_1_n, min_s, results)
+
+    # n:n — m remote submitter TASKS round-robin over n server actors
+    # (reference: `work.remote(actors)` x4).
+    @ray_tpu.remote
+    def work(actors, n):
+        k = len(actors)
+        ray_tpu.get([actors[i % k].ping.remote() for i in range(n)])
+        return n
+
+    def actor_async_n_n():
+        return sum(ray_tpu.get([
+            work.remote(servers, n_batch) for _ in range(m_submitters)]))
+
+    timeit("actor_calls_async_n_n", actor_async_n_n, min_s, results)
+
+    # n:n with a (put-ref) arg — reference Client.small_value_batch_arg.
+    clients = [Client.remote([s]) for s in servers]
+    ray_tpu.get([c.batch.remote(5) for c in clients])
+
+    def actor_arg_n_n():
+        return sum(ray_tpu.get(
+            [c.batch_arg.remote(n_batch) for c in clients]))
+
+    timeit("actor_calls_with_arg_async_n_n", actor_arg_n_n, min_s, results)
+    for a in [actor, conc_actor, client] + servers + clients:
+        ray_tpu.kill(a)
+
+    # ---------------- asyncio actors ------------------------------------
+    @ray_tpu.remote(num_cpus=0)
+    class AsyncEcho:
+        async def ping(self):
+            return b"ok"
+
+    aactor = AsyncEcho.remote()
+    ray_tpu.get(aactor.ping.remote())
+
+    def async_actor_sync():
+        for _ in range(20):
+            ray_tpu.get(aactor.ping.remote())
+        return 20
+
+    timeit("async_actor_calls_sync_1_1", async_actor_sync, min_s, results)
+
+    def async_actor_async():
+        ray_tpu.get([aactor.ping.remote() for _ in range(1000)])
+        return 1000
+
+    timeit("async_actor_calls_async_1_1", async_actor_async, min_s, results)
+
+    aservers = [AsyncEcho.remote() for _ in range(n_cpu_half)]
+    ray_tpu.get([a.ping.remote() for a in aservers])
+
+    def async_actor_n_n():
+        return sum(ray_tpu.get([
+            work.remote(aservers, n_batch) for _ in range(m_submitters)]))
+
+    timeit("async_actor_calls_async_n_n", async_actor_n_n, min_s, results)
+    for a in [aactor] + aservers:
+        ray_tpu.kill(a)
 
     # ---------------- wait over many refs ------------------------------
-    refs_1k = [ray_tpu.put(i) for i in range(1000)]
-
+    # Reference shape: submit 1k tasks, then ray.wait-pop them one at a
+    # time (1000 wait calls per op).
     def wait_1k():
-        ready, _ = ray_tpu.wait(refs_1k, num_returns=1000, timeout=30)
-        assert len(ready) == 1000
+        not_ready = [nop.remote() for _ in range(1000)]
+        while not_ready:
+            _ready, not_ready = ray_tpu.wait(not_ready, num_returns=1,
+                                             timeout=30)
         return 1
 
     timeit("single_client_wait_1k_refs", wait_1k, min_s, results)
+
+    # ---------------- object containing many refs ----------------------
+    @ray_tpu.remote
+    def create_object_containing_refs():
+        return [ray_tpu.put(1) for _ in range(10_000)]
+
+    obj_ref = create_object_containing_refs.remote()
+    ray_tpu.get(obj_ref)
+
+    def get_10k_refs():
+        ray_tpu.get(obj_ref)
+        return 1
+
+    timeit("single_client_get_object_containing_10k_refs", get_10k_refs,
+           min_s, results)
 
     # ---------------- placement groups ---------------------------------
     from ray_tpu.util.placement_group import (placement_group,
@@ -194,6 +368,9 @@ def main(argv: List[str] = None) -> Dict[str, float]:
             k: round(results[k] / BASELINE[k], 3)
             for k in results if k in BASELINE
         },
+        "hardware_note": (
+            f"{os.cpu_count()} CPU core(s); baseline numbers were produced "
+            "on multi-core AWS m5-class nodes (BASELINE.md)"),
     }
     if args.out:
         with open(args.out, "w") as f:
