@@ -1,0 +1,15 @@
+"""RLlib-lite: distributed RL on the TPU-native runtime.
+
+Parity surface: EnvRunner/EnvRunnerGroup (rollouts), PPOLearner (jitted
+update), PPO/PPOConfig (algorithm loop), register_env.
+"""
+
+from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_env, register_env
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.learner import PPOLearner
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "CartPoleVecEnv", "VectorEnv", "make_env", "register_env",
+    "EnvRunner", "EnvRunnerGroup", "PPOLearner", "PPO", "PPOConfig",
+]

@@ -33,7 +33,16 @@ class VectorEnv:
 
     def step(self, actions: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
                                                  np.ndarray, Dict[str, Any]]:
-        """actions [B] int -> (obs [B, obs_size], reward [B], done [B], info)."""
+        """actions [B] int -> (obs [B, obs_size], reward [B], done [B], info).
+
+        `done` = terminated | truncated (the auto-reset trigger). `info`
+        carries the split the learner needs for correct bootstrapping
+        (gymnasium separates terminateds/truncateds the same way):
+          - "terminated" [B] bool: true environment termination (value 0)
+          - "truncated"  [B] bool: time-limit cutoff (bootstrap with critic)
+          - "final_obs" [B, obs_size]: the pre-reset observation for done
+            rows (valid only where done; elsewhere it equals obs)
+        """
         raise NotImplementedError
 
 
@@ -94,14 +103,19 @@ class CartPoleVecEnv(VectorEnv):
         self._state = np.stack([x, x_dot, th, th_dot], axis=1)
         self._steps += 1
 
-        done = ((np.abs(x) > self.X_LIMIT)
-                | (np.abs(th) > self.THETA_LIMIT)
-                | (self._steps >= self.max_steps))
+        terminated = ((np.abs(x) > self.X_LIMIT)
+                      | (np.abs(th) > self.THETA_LIMIT))
+        truncated = (self._steps >= self.max_steps) & ~terminated
+        done = terminated | truncated
         reward = np.ones(self.num_envs, np.float32)
+        final_obs = self._state.astype(np.float32)
         if done.any():
             self._reset_indices(np.flatnonzero(done))
         return (self._state.astype(np.float32), reward,
-                done.astype(np.bool_), {})
+                done.astype(np.bool_),
+                {"terminated": terminated.astype(np.bool_),
+                 "truncated": truncated.astype(np.bool_),
+                 "final_obs": final_obs})
 
 
 _ENV_REGISTRY = {"CartPole": CartPoleVecEnv}

@@ -54,23 +54,36 @@ class EnvRunner:
         logps = np.empty((T, B), np.float32)
         values = np.empty((T, B), np.float32)
         rewards = np.empty((T, B), np.float32)
-        dones = np.empty((T, B), np.bool_)
+        terminated = np.zeros((T, B), np.bool_)
+        truncated = np.zeros((T, B), np.bool_)
+        # v(final_obs) at truncated steps — the learner bootstraps
+        # time-limit cutoffs with the critic instead of 0.
+        bootstrap = np.zeros((T, B), np.float32)
         for t in range(T):
             self._key, k = jax.random.split(self._key)
             a, lp, v = self._sample_fn(self._params, self.obs, k)
             a = np.asarray(a)
             obs[t] = self.obs
             actions[t], logps[t], values[t] = a, np.asarray(lp), np.asarray(v)
-            self.obs, rewards[t], dones[t], _ = self.env.step(a)
+            self.obs, rewards[t], done_t, info = self.env.step(a)
+            terminated[t] = info.get("terminated", done_t)
+            truncated[t] = info.get("truncated", False)
+            if truncated[t].any():
+                final_obs = info.get("final_obs")
+                if final_obs is not None:
+                    _, _, fv = self._sample_fn(self._params, final_obs, k)
+                    bootstrap[t] = np.where(truncated[t], np.asarray(fv), 0.0)
             self._ep_return += rewards[t]
-            for i in np.flatnonzero(dones[t]):
+            for i in np.flatnonzero(done_t):
                 self._completed.append(float(self._ep_return[i]))
                 self._ep_return[i] = 0.0
         # Bootstrap value for the final observation (GAE tail).
         _, _, last_v = self._sample_fn(self._params, self.obs, self._key)
         return {
             "obs": obs, "actions": actions, "logp": logps,
-            "values": values, "rewards": rewards, "dones": dones,
+            "values": values, "rewards": rewards,
+            "terminated": terminated, "truncated": truncated,
+            "bootstrap_value": bootstrap,
             "last_value": np.asarray(last_v),
         }
 
