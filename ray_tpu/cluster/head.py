@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.task_spec import pg_key_from_strategy
+from ray_tpu.cluster.persistence import HeadStore
 from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
 
 class _TransientReservationFailure(Exception):
@@ -82,7 +83,8 @@ class ActorInfo:
 class HeadServer:
     """All control-plane state + RPC handlers. One instance per cluster."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeInfo] = {}
         self._actors: Dict[bytes, ActorInfo] = {}
@@ -94,6 +96,12 @@ class HeadServer:
         self._job_counter = 1
         self._spread_rr = 0
         self._pool = ClientPool()
+        # Durable tables (reference: gcs_table_storage.h). None = memory
+        # only. Loaded BEFORE serving so a restarted head answers from the
+        # recovered state; nodes re-register on their first heartbeat NACK.
+        self._store = HeadStore(persist_path) if persist_path else None
+        if self._store is not None:
+            self._load_persisted()
         self._server = RpcServer(self, host, port).start()
         self.address = self._server.address
         self._stop = threading.Event()
@@ -101,10 +109,55 @@ class HeadServer:
             target=self._health_loop, daemon=True, name="head-health")
         self._health_thread.start()
 
+    # -------------------------------------------------------- persistence
+
+    def _load_persisted(self) -> None:
+        self._kv = dict(self._store.kv_load())
+        self._job_counter = self._store.get_meta("job_counter", 1)
+        for pg_id, state in self._store.load_pgs():
+            self._pgs[pg_id] = state
+        to_recover: List[ActorInfo] = []
+        for actor_id, st in self._store.load_actors():
+            info = ActorInfo(actor_id, st["name"], st["namespace"],
+                             st["spec_blob"], st["max_restarts"],
+                             st["resources"])
+            info.strategy = st.get("strategy")
+            info.restart_count = st.get("restart_count", 0)
+            info.state = st.get("state", PENDING)
+            info.worker_addr = st.get("worker_addr")
+            info.node_id = st.get("node_id")
+            info.death_reason = st.get("death_reason", "")
+            self._actors[actor_id] = info
+            if info.name is not None and info.state != DEAD:
+                self._named[(info.namespace, info.name)] = actor_id
+            # Creation/restart was in flight when the head died: re-drive
+            # it (worker-side create_actor is idempotent, so an actor that
+            # actually landed before the crash just re-registers ALIVE).
+            if info.state in (PENDING, RESTARTING):
+                to_recover.append(info)
+        for info in to_recover:
+            threading.Thread(target=self._restart_actor, args=(info,),
+                             daemon=True).start()
+
+    def _persist_actor(self, info: ActorInfo) -> None:
+        if self._store is None:
+            return
+        self._store.save_actor(info.actor_id, {
+            "name": info.name, "namespace": info.namespace,
+            "spec_blob": info.spec_blob, "max_restarts": info.max_restarts,
+            "restart_count": info.restart_count,
+            "resources": info.resources,
+            "state": info.state, "worker_addr": info.worker_addr,
+            "node_id": info.node_id, "death_reason": info.death_reason,
+            "strategy": getattr(info, "strategy", None),
+        })
+
     def shutdown(self) -> None:
         self._stop.set()
         self._server.stop()
         self._pool.close_all()
+        if self._store is not None:
+            self._store.close()
 
     # ------------------------------------------------------------- publish
 
@@ -334,6 +387,7 @@ class HeadServer:
                              max_restarts, resources)
             info.strategy = strategy
             self._actors[actor_id] = info
+        self._persist_actor(info)
         try:
             self._create_actor_on_some_node(info)
         except BaseException:
@@ -341,6 +395,8 @@ class HeadServer:
                 self._actors.pop(actor_id, None)
                 if name is not None:
                     self._named.pop((namespace, name), None)
+            if self._store is not None:
+                self._store.delete_actor(actor_id)
             raise
         return "created", None
 
@@ -412,6 +468,7 @@ class HeadServer:
                 info.state = ALIVE
                 info.worker_addr = worker_addr
                 info.node_id = node_id
+            self._persist_actor(info)
             with info.cond:
                 info.cond.notify_all()
             self._publish("ACTOR", {"actor_id": info.actor_id,
@@ -453,6 +510,7 @@ class HeadServer:
             info.death_reason = reason
             if not restart and info.name is not None:
                 self._named.pop((info.namespace, info.name), None)
+        self._persist_actor(info)
         self._publish("ACTOR", {"actor_id": info.actor_id, "state": info.state,
                                 "reason": reason})
         if restart:
@@ -472,6 +530,7 @@ class HeadServer:
                 info.death_reason = f"restart failed: {e!r}"
                 if info.name is not None:
                     self._named.pop((info.namespace, info.name), None)
+            self._persist_actor(info)
             with info.cond:
                 info.cond.notify_all()
             self._publish("ACTOR", {"actor_id": info.actor_id, "state": DEAD,
@@ -563,6 +622,8 @@ class HeadServer:
             if not overwrite and k in self._kv:
                 return False
             self._kv[k] = value
+        if self._store is not None:
+            self._store.kv_put(ns, key, value)
         return True
 
     def rpc_kv_get(self, conn, ns: str, key: bytes):
@@ -571,7 +632,10 @@ class HeadServer:
 
     def rpc_kv_del(self, conn, ns: str, key: bytes):
         with self._lock:
-            return self._kv.pop((ns, key), None) is not None
+            existed = self._kv.pop((ns, key), None) is not None
+        if self._store is not None:
+            self._store.kv_del(ns, key)
+        return existed
 
     def rpc_kv_keys(self, conn, ns: str, prefix: bytes = b""):
         with self._lock:
@@ -651,11 +715,15 @@ class HeadServer:
                                 "name": name,
                                 "bundle_nodes": [n.node_id for n in placement],
                                 "state": "CREATED"}
+        if self._store is not None:
+            self._store.save_pg(pg_id, self._pgs[pg_id])
         return True
 
     def rpc_remove_pg(self, conn, pg_id: bytes):
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
+        if self._store is not None:
+            self._store.delete_pg(pg_id)
         if pg is None:
             return False
         for idx, node_id in enumerate(pg["bundle_nodes"]):
@@ -682,7 +750,10 @@ class HeadServer:
     def rpc_new_job_id(self, conn):
         with self._lock:
             self._job_counter += 1
-            return self._job_counter
+            n = self._job_counter
+        if self._store is not None:
+            self._store.set_meta("job_counter", n)
+        return n
 
     def rpc_ping(self, conn):
         return "pong"

@@ -20,8 +20,11 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--persist", default=None,
+                   help="sqlite path for durable head tables; a restarted "
+                        "head (same --port + --persist) resumes from it")
     args = p.parse_args()
-    head = HeadServer(args.host, args.port)
+    head = HeadServer(args.host, args.port, persist_path=args.persist)
     print(f"ADDRESS {head.address}", flush=True)
     try:
         while True:

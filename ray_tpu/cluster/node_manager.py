@@ -173,9 +173,21 @@ class NodeManager:
             try:
                 with self._lock:
                     avail = dict(self.available)
-                self._head.call("heartbeat", self.node_id, avail, timeout=5)
+                acked = self._head.call("heartbeat", self.node_id, avail,
+                                        timeout=5)
+                if acked is False:
+                    # The head doesn't know us: it restarted and lost its
+                    # node table (nodes are ephemeral state — reference:
+                    # RayletNotifyGCSRestart re-registration). Re-register;
+                    # the next heartbeat restores our availability view.
+                    self._head.retrying_call(
+                        "register_node", self.node_id, self.address,
+                        self.total, self.labels, self.store_name, timeout=10)
             except Exception:
-                pass
+                try:
+                    self._head.reconnect()
+                except Exception:
+                    pass
             self._check_worker_deaths()
 
     def _check_worker_deaths(self) -> None:

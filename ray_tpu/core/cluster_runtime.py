@@ -126,11 +126,20 @@ class ClusterRuntime(ClusterCore):
             os.environ["RTPU_LOG_DIR"] = session_dir  # inherited by spawns
             self._owns_log_dir_env = True
         if address is None:
+            self._head_persist = os.path.join(cfg.log_dir, "head_state.db")
             head_proc = _spawn(
-                [sys.executable, "-m", "ray_tpu.cluster.head_main"],
+                [sys.executable, "-m", "ray_tpu.cluster.head_main",
+                 "--persist", self._head_persist],
                 "head.log")
             self._procs.append(head_proc)
             head_addr = _read_tagged_line(head_proc, "ADDRESS", 30)["ADDRESS"]
+            self._head_proc = head_proc
+            self._head_addr_str = head_addr
+            # Head fault tolerance: supervise + respawn on the SAME port
+            # with the SAME durable tables; clients' retrying calls ride
+            # out the gap (reference: GCS restart + redis-backed tables).
+            threading.Thread(target=self._head_supervisor_loop, daemon=True,
+                             name="head-supervisor").start()
 
             res = dict(resources or {})
             if num_cpus is not None:
@@ -168,6 +177,29 @@ class ClusterRuntime(ClusterCore):
         if cfg.metrics_report_period_ms > 0:
             threading.Thread(target=self._metrics_report_loop, daemon=True,
                              name="metrics-report").start()
+
+    def _head_supervisor_loop(self) -> None:
+        """Respawns a crashed head on its original port with its durable
+        tables. The port is stable so every cached client address stays
+        valid; reconnects happen inside retrying_call."""
+        port = self._head_addr_str.rsplit(":", 1)[1]
+        while not getattr(self, "_shutdown_flag", False):
+            proc = self._head_proc
+            if proc.poll() is None:
+                time.sleep(0.5)
+                continue
+            if getattr(self, "_shutdown_flag", False):
+                return
+            try:
+                new_proc = _spawn(
+                    [sys.executable, "-m", "ray_tpu.cluster.head_main",
+                     "--port", port, "--persist", self._head_persist],
+                    "head.log")
+                _read_tagged_line(new_proc, "ADDRESS", 30)
+                self._head_proc = new_proc
+                self._procs.append(new_proc)
+            except Exception:
+                time.sleep(1.0)  # port may linger in TIME_WAIT; retry
 
     # --------------------------------------------------------------- kv
 
