@@ -276,10 +276,16 @@ class WorkerRuntime(ClusterCore):
             try:
                 self._owner_pool.get(owner).retrying_call(
                     "batch_done", entries, timeout=10)
-            except Exception:
+            except (ConnectionLost, OSError):
                 # Owner gone: results are orphaned; large ones stay in
                 # the store until the owner's death GC reclaims them.
                 pass
+            except Exception as e:
+                # A handler-side error at a LIVE owner is a completion
+                # LOSS — it must be visible, never silent.
+                print(f"batch_done delivery to {owner} failed: {e!r}",
+                      file=sys.stderr, flush=True)
+                traceback.print_exc(file=sys.stderr)
 
     # ---------------------------------------------------------------- actors
 

@@ -366,10 +366,27 @@ class ClusterCore:
 
     def _put_plasma(self, oid: ObjectID, header: bytes, buffers) -> None:
         total = SERIALIZER.encode_total_size(header, buffers)
-        try:
-            mv = self.store.create_buffer(oid, total)
-        except ShmObjectExistsError:
-            return
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                mv = self.store.create_buffer(oid, total)
+                break
+            except ShmObjectExistsError:
+                # A concurrent writer (a re-routed duplicate execution on
+                # another worker) holds the slot. Returning immediately
+                # here minted GHOST objects: if that writer later ABORTS
+                # (store pressure, crash), its unsealed copy vanishes
+                # while our completion already told the owner "in_store".
+                # Wait for the other copy to SEAL; if it disappears
+                # instead, take over and write it ourselves.
+                buf = self.store.get(oid, timeout_ms=200)
+                if buf is not None:
+                    buf.release()
+                    return  # sealed by the other writer — done
+                if not self.store.contains(oid):
+                    continue  # aborted: retry the create ourselves
+                if time.monotonic() > deadline:
+                    raise
         try:
             SERIALIZER.encode_into(mv, header, buffers)
         except BaseException:
@@ -421,6 +438,14 @@ class ClusterCore:
             if not ok:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
             buf = self.store.get(oid, timeout_ms=5000)
+            while buf is None and time.monotonic() < deadline:
+                # Present a moment ago but the read missed: a restore from
+                # spill can fail transiently while concurrent readers pin
+                # the arena (out-of-core exchanges run at exactly this
+                # pressure). Back off briefly and retry within the
+                # deadline instead of failing the task.
+                time.sleep(0.2)
+                buf = self.store.get(oid, timeout_ms=5000)
             if buf is None:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
         # Zero-copy decode: views are taken over memoryview(buf), whose
@@ -1147,7 +1172,7 @@ class ClusterCore:
                 "push_tasks",
                 [(tid, info.spec_blob) for tid, info in survivors])
             self._push_acks.append(
-                [waiter, survivors, lease, kq, 0, time.monotonic() + 3.0])
+                [waiter, survivors, lease, kq, 0, time.monotonic() + 5.0])
             self._push_ack_event.set()
         except BaseException:
             with self._inflight_lock:
@@ -1210,7 +1235,7 @@ class ClusterCore:
                     [(tid, info.spec_blob) for tid, info in live])
                 self._push_acks.append(
                     [w2, live, lease, kq, attempts + 1,
-                     time.monotonic() + 3.0])
+                     time.monotonic() + 5.0])
                 return
             except BaseException:
                 pass

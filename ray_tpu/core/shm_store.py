@@ -16,6 +16,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 import weakref
 from typing import Optional, Tuple
 
@@ -23,6 +24,7 @@ from ray_tpu.core.ids import ObjectID
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
+
 
 
 def _load_lib():
@@ -99,12 +101,14 @@ class PinnedBuffer:
     against LRU eviction reusing the arena block (the reference ties plasma
     buffer lifetime to the python object the same way)."""
 
-    def __init__(self, store: "ShmStore", key: bytes, mv: memoryview):
+    def __init__(self, store: "ShmStore", key: bytes, mv: memoryview,
+                 spill_pin: bool = False):
         self._store = store
         self._key = key
         self.buffer = mv
         self._released = False
-        self._finalizer = weakref.finalize(self, store._release_raw, key)
+        self._finalizer = weakref.finalize(
+            self, store._release_raw, key, spill_pin)
 
     def release(self) -> None:
         if not self._released:
@@ -227,11 +231,20 @@ class ShmStore:
             if buf is None:
                 continue  # raced: deleted/spilled by someone else
             path = self._spill_path(key)
-            tmp = path + f".tmp{os.getpid()}"
+            # Unique per (process, thread): two exec threads spilling the
+            # same victim concurrently must not share a tmp name (the
+            # second os.replace would find it already moved).
+            tmp = path + f".tmp{os.getpid()}.{threading.get_ident()}"
             try:
                 with open(tmp, "wb") as f:
                     f.write(buf.buffer)
-                os.replace(tmp, path)  # atomic: readers see whole files only
+                try:
+                    os.replace(tmp, path)  # atomic: whole files only
+                except FileNotFoundError:
+                    # A concurrent spill (or a shutdown rmtree) won the
+                    # race; the object is either safely on disk already or
+                    # the store is going away.
+                    pass
             finally:
                 buf.release()
             self.spill_delete_only(oid)  # keep the file we just wrote
@@ -279,6 +292,20 @@ class ShmStore:
                 raise ShmObjectExistsError(key.hex())
             if not self._spill_enabled or attempts >= 20 \
                     or not self.spill_for(total):
+                # Dropped zero-copy views can sit in GC cycles (exception
+                # tracebacks referencing frames referencing buffers),
+                # keeping arena pins alive past their last use. One
+                # collect often frees enough to proceed — only then fail.
+                if self._spill_enabled and attempts < 22:
+                    import gc
+
+                    gc.collect()
+                    if self.spill_for(total):
+                        attempts += 1
+                        continue
+                    time.sleep(0.05)
+                    attempts += 1
+                    continue
                 raise ShmStoreFullError(
                     f"store full ({what}: {total} bytes requested; "
                     f"err={err.value}, spilling="
@@ -321,7 +348,8 @@ class ShmStore:
     def get(self, oid: ObjectID, timeout_ms: int = 0,
             _no_restore: bool = False) -> Optional[PinnedBuffer]:
         """Pinned zero-copy read; transparently restores spilled objects.
-        None on timeout/missing."""
+        None on timeout/missing. ``_no_restore`` pins are SPILL pins: their
+        release must never unlink the spill file (see _release_raw)."""
         key = self._key(oid)
         off = ctypes.c_uint64(0)
         size = ctypes.c_uint64(0)
@@ -335,7 +363,8 @@ class ShmStore:
                                         ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
-        return PinnedBuffer(self, key, self._view(off.value, size.value))
+        return PinnedBuffer(self, key, self._view(off.value, size.value),
+                            spill_pin=_no_restore)
 
     def get_bytes(self, oid: ObjectID,
                   timeout_ms: int = 0) -> Optional[bytes]:
@@ -348,12 +377,20 @@ class ShmStore:
         finally:
             buf.release()
 
-    def _release_raw(self, key: bytes) -> None:
+    def _release_raw(self, key: bytes, spill_pin: bool = False) -> None:
         if self._h:
             rc = self._lib.rtpu_obj_release(self._h, key)
-            if rc == 2 and self._spill_enabled:
+            if rc == 2 and self._spill_enabled and not spill_pin:
                 # Last pin of a DOOMED object (deleted while we held it):
                 # any spill file we or others wrote must not resurrect it.
+                # SPILL pins are exempt: two concurrent spills of the same
+                # victim interleave as (T1 pin, T2 pin, T2 file, T2
+                # arena-drop, T1 file, T1 release<-rc2) — T1 unlinking here
+                # destroyed the just-written backing file, leaving a GHOST
+                # object (owner says in_store; nothing anywhere). A stale
+                # file after a real delete() is already unlinked by
+                # delete() itself; the residual race leaks only a dead
+                # file, never data.
                 try:
                     os.unlink(self._spill_path(key))
                 except OSError:

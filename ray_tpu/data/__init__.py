@@ -7,13 +7,14 @@ shm object store; `iter_batches(device_put=...)` prefetches onto TPU.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
-from ray_tpu.data.dataset import (Dataset, MaterializedDataset,
+from ray_tpu.data.dataset import (Dataset, GroupedData,
+                                  MaterializedDataset,
                                   StreamSplitIterator, from_items,
                                   from_numpy, range, read_csv, read_json,
                                   read_parquet)
 
 __all__ = [
-    "Block", "BlockAccessor", "BlockMetadata", "Dataset",
+    "Block", "BlockAccessor", "BlockMetadata", "Dataset", "GroupedData",
     "MaterializedDataset", "StreamSplitIterator", "from_items", "from_numpy",
     "range", "read_csv", "read_json", "read_parquet",
 ]

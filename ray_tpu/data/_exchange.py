@@ -1,0 +1,292 @@
+"""All-to-all block exchange: the engine under shuffle/sort/groupby/repartition.
+
+Parity target: the reference's exchange planner
+(reference: python/ray/data/_internal/planner/exchange/
+exchange_task_scheduler.py, sort_task_spec.py, shuffle_task_spec.py,
+push_based_shuffle_task_scheduler.py) re-designed small: one generic
+two-stage exchange over the object plane —
+
+    map stage:    one task per input block -> N partition blocks
+                  (num_returns=N; partitions stay in the shm store, rows
+                  ride zero-copy numpy buffers)
+    reduce stage: one task per output partition, merging its N pieces
+
+The driver only moves REFS; block bytes flow worker->store->worker, and
+spilling makes the exchange out-of-core (a sort of 2x store memory walks
+through disk transparently).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+# --------------------------------------------------------------------------
+# Remote stage functions (module-level: pickled by reference, tiny specs)
+# --------------------------------------------------------------------------
+
+
+@ray_tpu.remote(max_retries=3, retry_exceptions=True)
+def _partition_block(block: Block, assignment_fn_blob, n: int,
+                     block_index: int = 0):
+    """Map stage: split `block` into n partition blocks by row assignment.
+    assignment_fn_blob: callable (block, block_index) -> [num_rows] int
+    partition ids (the index gives shuffles a distinct deterministic
+    stream per block — content-derived seeds collapse for equal blocks)."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    if rows == 0:
+        empty = {k: v[:0] for k, v in block.items()}
+        return tuple(empty for _ in range(n)) if n > 1 else empty
+    part_ids = assignment_fn_blob(block, block_index)
+    out = []
+    for j in range(n):
+        idx = np.flatnonzero(part_ids == j)
+        out.append({k: v[idx] for k, v in block.items()})
+    return tuple(out) if n > 1 else out[0]
+
+
+@ray_tpu.remote(max_retries=3, retry_exceptions=True)
+def _merge_blocks(finalize_fn_blob, *pieces: Block):
+    """Reduce stage: concat this partition's pieces + finalize (sort the
+    partition, local shuffle, aggregate, ...). Returns (block, metadata):
+    the block lands in the store, the metadata rides the completion push
+    inline so the driver never fetches block bytes for bookkeeping."""
+    merged = BlockAccessor.concat(list(pieces))
+    if not merged and pieces:
+        merged = {k: v[:0] for k, v in pieces[0].items()}
+    if finalize_fn_blob:
+        merged = finalize_fn_blob(merged)
+    return merged, BlockMetadata.of(merged)
+
+
+@ray_tpu.remote
+def _sample_keys(block: Block, key: str, k: int) -> np.ndarray:
+    """Sort sample stage: up to k evenly-spaced key values."""
+    col = block[key]
+    if len(col) <= k:
+        return np.sort(col)
+    idx = np.linspace(0, len(col) - 1, k).astype(np.int64)
+    return np.sort(col[idx])
+
+
+# --------------------------------------------------------------------------
+# The generic exchange
+# --------------------------------------------------------------------------
+
+
+def exchange(bundles: List[Tuple[Any, BlockMetadata]],
+             assignment_fn: Callable[[Block], np.ndarray],
+             num_outputs: int,
+             finalize_fn: Optional[Callable[[Block], Block]] = None,
+             ) -> List[Tuple[Any, BlockMetadata]]:
+    """Runs the two-stage exchange; returns the output bundles in
+    partition order. Refs only — no block bytes touch the driver."""
+    if not bundles:
+        return []
+    # Memory admission control for BOTH stages (reference: pull admission
+    # in pull_manager.h + the push-based shuffle's staged merges): a task
+    # pins its inputs and creates outputs (~2-3x block bytes of store
+    # working set), and pinned pages cannot spill — unthrottled submission
+    # can pin more than the whole arena at out-of-core sizes, livelocking
+    # every restore. Submit in waves sized to the live store capacity.
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+    from ray_tpu.core.runtime_context import require_runtime
+
+    total_bytes = sum(m.size_bytes for _r, m in bundles if m) or 1
+    in_bytes = max(1, total_bytes // len(bundles))
+    part_bytes = max(1, total_bytes // num_outputs)
+    try:  # the LIVE store capacity (init's object_store_memory argument)
+        _used, store_bytes, _n, _e = require_runtime().store.stats()
+    except Exception:
+        store_bytes = _cfg.object_store_memory_bytes
+
+    map_wave = int(max(1, min(len(bundles),
+                              store_bytes // (3 * in_bytes))))
+    part_refs: List[Sequence] = []
+    for start in range(0, len(bundles), map_wave):
+        wave_parts = []
+        for idx in range(start, min(start + map_wave, len(bundles))):
+            ref, _meta = bundles[idx]
+            refs = _partition_block.options(num_returns=num_outputs).remote(
+                ref, assignment_fn, num_outputs, idx)
+            wave_parts.append(refs if num_outputs > 1 else [refs])
+        flat = [r for parts in wave_parts for r in parts]
+        ray_tpu.wait(flat, num_returns=len(flat), timeout=600.0)
+        part_refs.extend(wave_parts)
+    wave = int(max(1, min(num_outputs, store_bytes // (3 * part_bytes))))
+    block_refs: list = []
+    metas: list = []
+    for start in range(0, num_outputs, wave):
+        wave_meta_refs = []
+        for j in range(start, min(start + wave, num_outputs)):
+            pieces = [parts[j] for parts in part_refs]
+            b_ref, m_ref = _merge_blocks.options(num_returns=2).remote(
+                finalize_fn, *pieces)
+            block_refs.append(b_ref)
+            wave_meta_refs.append(m_ref)
+        metas.extend(ray_tpu.get(wave_meta_refs))
+    return list(zip(block_refs, metas))
+
+
+# --------------------------------------------------------------------------
+# Concrete exchanges
+# --------------------------------------------------------------------------
+
+
+def repartition_exchange(bundles, num_outputs: int, seed=0):
+    """Round-robin row redistribution into exactly num_outputs blocks."""
+
+    def assign(block: Block, block_index: int) -> np.ndarray:
+        n = BlockAccessor(block).num_rows()
+        return np.arange(n) % num_outputs
+
+    return exchange(bundles, assign, num_outputs)
+
+
+def shuffle_exchange(bundles, num_outputs: int, seed: Optional[int]):
+    """Global random shuffle: every row lands in a uniformly random output
+    partition, and each partition applies a final local permutation — rows
+    cross blocks (the reference's full shuffle, not local_shuffle)."""
+    base = seed if seed is not None else np.random.SeedSequence().entropy
+
+    def assign(block: Block, block_index: int) -> np.ndarray:
+        # Per-block deterministic stream keyed by the block's POSITION:
+        # stable across lineage-recovery retries of the same block,
+        # distinct for every block (content-derived seeds collapse when
+        # blocks are equal-sized or equal-valued).
+        n = BlockAccessor(block).num_rows()
+        rng = np.random.default_rng([int(base) & 0xFFFFFFFF, block_index])
+        return rng.integers(0, num_outputs, n)
+
+    def finalize(block: Block) -> Block:
+        n = BlockAccessor(block).num_rows()
+        # Partition content (crc of the key-independent row count alone
+        # collapses for equal partitions): mix the first column's bytes.
+        import zlib
+
+        mix = 0
+        if block:
+            first = next(iter(block.values()))
+            mix = zlib.crc32(np.ascontiguousarray(first[:64]).tobytes())
+        rng = np.random.default_rng([int(base) & 0xFFFFFFFF, 7, n, mix])
+        perm = rng.permutation(n)
+        return {k: v[perm] for k, v in block.items()}
+
+    return exchange(bundles, assign, num_outputs, finalize)
+
+
+def sort_exchange(bundles, key: str, descending: bool, num_outputs: int):
+    """Sample -> range-partition -> per-partition sort (the reference's
+    SortTaskSpec pipeline). Output partition j holds keys in range j, so
+    concatenating partitions in order is globally sorted."""
+    # Chunked sampling: every sample task pins its whole block; all N at
+    # once can pin more than the store at out-of-core sizes.
+    samples = []
+    for start in range(0, len(bundles), 8):
+        samples.extend(ray_tpu.get(
+            [_sample_keys.remote(ref, key, 64)
+             for ref, _m in bundles[start:start + 8]]))
+    nonempty = [s for s in samples if len(s)]
+    if not nonempty:
+        return bundles  # no rows anywhere: nothing to sort
+    allkeys = np.sort(np.concatenate(nonempty))
+    # Positional sample quantiles, not np.quantile: interpolation rejects
+    # non-numeric dtypes, but sort keys may be strings/datetimes.
+    pos = np.linspace(0, len(allkeys) - 1,
+                      num_outputs + 1)[1:-1].astype(np.int64)
+    boundaries = allkeys[pos]
+
+    def assign(block: Block, block_index: int) -> np.ndarray:
+        part = np.searchsorted(boundaries, block[key], side="right")
+        if descending:
+            part = (num_outputs - 1) - part
+        return part
+
+    def finalize(block: Block) -> Block:
+        if not block:
+            return block
+        order = np.argsort(block[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        return {k: v[order] for k, v in block.items()}
+
+    return exchange(bundles, assign, num_outputs, finalize)
+
+
+def groupby_exchange(bundles, key: str, num_outputs: int,
+                     agg_fn: Callable[[Block, str], Block]):
+    """Hash-partition by key so every group lands whole in one partition,
+    then aggregate each partition locally (reference: hash shuffle +
+    per-partition GroupedData aggregation)."""
+
+    def assign(block: Block, block_index: int) -> np.ndarray:
+        col = block[key]
+        if col.dtype.kind in "iub":
+            h = col.astype(np.int64)
+        elif col.dtype.kind == "f":
+            h = col.astype(np.float64).view(np.int64)
+        else:
+            h = np.array([hash(x) for x in col.tolist()], np.int64)
+        return (h % num_outputs + num_outputs) % num_outputs
+
+    def finalize(block: Block) -> Block:
+        return agg_fn(block, key)
+
+    return exchange(bundles, assign, num_outputs, finalize)
+
+
+# --------------------------------------------------------------------------
+# Local group aggregation kernels (run inside reduce tasks)
+# --------------------------------------------------------------------------
+
+def make_group_aggregator(specs: List[Tuple[str, Optional[str], str]]):
+    """specs: [(agg_name, value_col_or_None, output_col)]. Returns the
+    reduce-side finalize fn: one output row per group key."""
+
+    def aggregate(block: Block, key: str) -> Block:
+        if not block or BlockAccessor(block).num_rows() == 0:
+            cols: Dict[str, np.ndarray] = {key: np.empty(0)}
+            for _a, _v, out_name in specs:
+                cols[out_name] = np.empty(0)
+            return cols
+        keys = block[key]
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        g = len(uniq)
+        out: Dict[str, np.ndarray] = {key: uniq}
+        for agg, vcol, out_name in specs:
+            if agg == "count":
+                out[out_name] = np.bincount(inverse, minlength=g)
+                continue
+            vals = block[vcol].astype(np.float64)
+            if agg == "sum":
+                out[out_name] = np.bincount(inverse, weights=vals,
+                                            minlength=g)
+            elif agg == "mean":
+                s = np.bincount(inverse, weights=vals, minlength=g)
+                c = np.bincount(inverse, minlength=g)
+                out[out_name] = s / np.maximum(c, 1)
+            elif agg == "min":
+                acc = np.full(g, np.inf)
+                np.minimum.at(acc, inverse, vals)
+                out[out_name] = acc
+            elif agg == "max":
+                acc = np.full(g, -np.inf)
+                np.maximum.at(acc, inverse, vals)
+                out[out_name] = acc
+            elif agg == "std":
+                s = np.bincount(inverse, weights=vals, minlength=g)
+                c = np.maximum(np.bincount(inverse, minlength=g), 1)
+                mean = s / c
+                sq = np.bincount(inverse, weights=vals * vals, minlength=g)
+                var = np.maximum(sq / c - mean * mean, 0.0)
+                out[out_name] = np.sqrt(var)
+            else:
+                raise ValueError(f"unknown aggregation {agg!r}")
+        return out
+
+    return aggregate

@@ -101,12 +101,64 @@ class Dataset:
 
         return self._with_op(DriverOperator(gen, name=f"limit({n})"))
 
+    # ------------------------------------------------- all-to-all exchanges
+
+    def _exchange_op(self, name: str, fn) -> "Dataset":
+        """Barrier op: materialize upstream bundles, run a two-stage block
+        exchange over the object plane, stream the outputs."""
+
+        def gen(upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+            bundles = list(upstream)
+            yield from fn(bundles)
+
+        return self._with_op(DriverOperator(gen, name=name))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Redistribute rows into exactly `num_blocks` even blocks
+        (reference: Dataset.repartition, dataset.py)."""
+        from ray_tpu.data._exchange import repartition_exchange
+
+        return self._exchange_op(
+            f"repartition({num_blocks})",
+            lambda b: repartition_exchange(b, num_blocks))
+
+    def sort(self, key: str, *, descending: bool = False,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Global sort by column: sample -> range partition -> local sort
+        (reference: Dataset.sort, python/ray/data/dataset.py:2532 +
+        exchange/sort_task_spec.py). Streaming the result in order yields
+        globally sorted rows; out-of-core via store spilling."""
+        from ray_tpu.data._exchange import sort_exchange
+
+        return self._exchange_op(
+            f"sort({key})",
+            lambda b: sort_exchange(b, key, descending,
+                                    num_partitions or max(1, len(b))))
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by column (reference: Dataset.groupby ->
+        GroupedData, python/ray/data/grouped_data.py)."""
+        return GroupedData(self, key)
+
     def random_shuffle(self, *, seed: Optional[int] = None,
-                       block_window: int = 16) -> "Dataset":
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        """GLOBAL random shuffle: an all-to-all exchange assigns every row
+        a uniformly random output partition, then each partition applies a
+        local permutation — rows cross blocks (reference:
+        Dataset.random_shuffle -> shuffle_task_spec.py). For the cheaper
+        block-local tier use `local_shuffle`."""
+        from ray_tpu.data._exchange import shuffle_exchange
+
+        return self._exchange_op(
+            "random_shuffle",
+            lambda b: shuffle_exchange(b, num_blocks or max(1, len(b)),
+                                       seed))
+
+    def local_shuffle(self, *, seed: Optional[int] = None,
+                      block_window: int = 16) -> "Dataset":
         """Block-local row shuffle (per-block seeds) + windowed block-order
-        shuffle (the reference's full exchange shuffle is a later
-        milestone; this is its `local_shuffle` tier, sufficient for
-        training-epoch decorrelation)."""
+        shuffle — the reference's `local_shuffle_buffer` tier: cheaper than
+        the global exchange, sufficient for training-epoch decorrelation."""
         rng_seed = seed
 
         def batch_fn(batch: Block, _block_index: int = 0) -> Block:
@@ -256,6 +308,75 @@ class Dataset:
             max_concurrency=n + 1,
         ).remote(self._read_tasks, self._ops, self._read_parallelism, n)
         return [StreamSplitIterator(coordinator, i, n) for i in _range(n)]
+
+
+class GroupedData:
+    """Deferred group-by: terminal aggregation methods return Datasets
+    (reference: python/ray/data/grouped_data.py GroupedData.count/sum/...).
+    Aggregations run as a hash exchange: every group lands whole in one
+    partition, aggregated locally there."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, specs) -> Dataset:
+        from ray_tpu.data._exchange import (groupby_exchange,
+                                            make_group_aggregator)
+
+        key = self._key
+        agg = make_group_aggregator(specs)
+        return self._ds._exchange_op(
+            f"groupby({key})",
+            lambda b: groupby_exchange(b, key, max(1, len(b)), agg))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None, "count()")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([("sum", col, f"sum({col})")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([("mean", col, f"mean({col})")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([("min", col, f"min({col})")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([("max", col, f"max({col})")])
+
+    def std(self, col: str) -> Dataset:
+        return self._agg([("std", col, f"std({col})")])
+
+    def aggregate(self, *specs) -> Dataset:
+        """specs: (agg_name, value_col, output_col) triples — several
+        aggregations in one exchange pass."""
+        return self._agg(list(specs))
+
+    def map_groups(self, fn) -> Dataset:
+        """Apply `fn(block) -> block` to each whole group (reference:
+        GroupedData.map_groups)."""
+        from ray_tpu.data._exchange import groupby_exchange
+
+        key = self._key
+
+        def per_partition(block: Block, k: str) -> Block:
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0:
+                return block
+            keys = block[k]
+            uniq, inverse = np.unique(keys, return_inverse=True)
+            outs = []
+            for gi in _range(len(uniq)):
+                idx = np.flatnonzero(inverse == gi)
+                outs.append(BlockAccessor.normalize(
+                    fn({c: v[idx] for c, v in block.items()})))
+            return BlockAccessor.concat(outs)
+
+        return self._ds._exchange_op(
+            f"map_groups({key})",
+            lambda b: groupby_exchange(b, key, max(1, len(b)),
+                                       per_partition))
 
 
 class MaterializedDataset(Dataset):
