@@ -122,6 +122,7 @@ class HeadServer:
                              st["spec_blob"], st["max_restarts"],
                              st["resources"])
             info.strategy = st.get("strategy")
+            info.runtime_env = st.get("runtime_env")
             info.restart_count = st.get("restart_count", 0)
             info.state = st.get("state", PENDING)
             info.worker_addr = st.get("worker_addr")
@@ -150,6 +151,7 @@ class HeadServer:
             "state": info.state, "worker_addr": info.worker_addr,
             "node_id": info.node_id, "death_reason": info.death_reason,
             "strategy": getattr(info, "strategy", None),
+            "runtime_env": getattr(info, "runtime_env", None),
         })
 
     def shutdown(self) -> None:
@@ -367,7 +369,8 @@ class HeadServer:
                            namespace: str, spec_blob: bytes, max_restarts: int,
                            resources: Dict[str, float],
                            get_if_exists: bool = False,
-                           strategy: Optional[Dict[str, Any]] = None):
+                           strategy: Optional[Dict[str, Any]] = None,
+                           runtime_env: Optional[Dict[str, Any]] = None):
         """Register + schedule + create. Returns ("created", None) /
         ("exists", actor_id) / raises on name conflict or placement failure.
         Idempotent on actor_id: a retried registration (lost reply) must not
@@ -386,6 +389,7 @@ class HeadServer:
             info = ActorInfo(actor_id, name, namespace, spec_blob,
                              max_restarts, resources)
             info.strategy = strategy
+            info.runtime_env = runtime_env
             self._actors[actor_id] = info
         self._persist_actor(info)
         try:
@@ -445,6 +449,7 @@ class HeadServer:
                 lease = node.retrying_call(
                     "request_lease", info.resources, True, pg,
                     _uuid.uuid4().hex, None,
+                    getattr(info, "runtime_env", None),
                     timeout=cfg.lease_timeout_ms / 1000.0 + 10)
             except Exception:
                 exclude.add(node_id)

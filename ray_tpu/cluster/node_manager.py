@@ -50,7 +50,7 @@ def _die_with_parent():
 
 class WorkerProc:
     def __init__(self, proc: subprocess.Popen, worker_id: str,
-                 tpu: bool = False):
+                 tpu: bool = False, env_hash: str = ""):
         self.proc = proc
         self.worker_id = worker_id
         self.address: Optional[str] = None  # set on register
@@ -59,6 +59,7 @@ class WorkerProc:
         self.lease_id: Optional[str] = None
         self.is_actor_host = False
         self.tpu = tpu
+        self.env_hash = env_hash
 
 
 class Lease:
@@ -114,7 +115,10 @@ class NodeManager:
         self._tpu_spawning = 0
         self._lease_grant_order = collections.deque()
         self._workers: Dict[str, WorkerProc] = {}
-        self._idle: List[WorkerProc] = []
+        # Idle pools keyed by runtime-env fingerprint ('' = default env):
+        # two runtime envs must never share a worker process (reference:
+        # worker_pool.h keys pools by runtime_env_hash the same way).
+        self._idle: Dict[str, List[WorkerProc]] = {}
         self._leases: Dict[str, Lease] = {}
         self._bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
         self._bundle_avail: Dict[Tuple[bytes, int], Dict[str, float]] = {}
@@ -197,8 +201,9 @@ class NodeManager:
                 if w.proc.poll() is not None:
                     dead.append(w)
                     self._workers.pop(w.worker_id, None)
-                    if w in self._idle:
-                        self._idle.remove(w)
+                    pool = self._idle.get(w.env_hash)
+                    if pool and w in pool:
+                        pool.remove(w)
                     if w in self._tpu_idle:
                         self._tpu_idle.remove(w)
                     if not w.ready.is_set():
@@ -231,7 +236,7 @@ class NodeManager:
                     if (lw.worker_id in self._workers
                             and not lw.is_actor_host
                             and lw.proc.poll() is None and lw.ready.is_set()
-                            and lw not in self._idle
+                            and lw not in self._idle.get(lw.env_hash, ())
                             and lw not in self._tpu_idle):
                         self._hand_worker(lw)
         # The worker may have hosted actors: the head tracks actor->address,
@@ -254,15 +259,25 @@ class NodeManager:
         while not self._stop.wait(5.0):
             now = time.monotonic()
             with self._lock:
-                keep, reap = [], []
+                reap = []
                 min_keep = cfg.worker_pool_min_workers
-                for w in self._idle:
-                    if (now - w.idle_since > ttl
-                            and len(self._idle) - len(reap) > min_keep):
-                        reap.append(w)
+                for env_hash, pool in list(self._idle.items()):
+                    keep = []
+                    for w in pool:
+                        # min_keep protects only the DEFAULT pool; custom
+                        # runtime-env workers reap fully.
+                        floor = min_keep if env_hash == "" else 0
+                        if (now - w.idle_since > ttl
+                                and len(pool) - len(
+                                    [r for r in reap if r.env_hash ==
+                                     env_hash]) > floor):
+                            reap.append(w)
+                        else:
+                            keep.append(w)
+                    if keep:
+                        self._idle[env_hash] = keep
                     else:
-                        keep.append(w)
-                self._idle = keep
+                        self._idle.pop(env_hash, None)
                 for w in reap:
                     self._workers.pop(w.worker_id, None)
             for w in reap:
@@ -276,11 +291,12 @@ class NodeManager:
     def _spawner_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                tpu = self._spawn_requests.get(timeout=1.0)
+                tpu, runtime_env = self._spawn_requests.get(timeout=1.0)
             except Exception:
                 continue
             try:
-                self._spawn_worker_inner(tpu=bool(tpu))
+                self._spawn_worker_inner(tpu=bool(tpu),
+                                         runtime_env=runtime_env)
             except BaseException:  # noqa: BLE001
                 with self._idle_cv:
                     if tpu:
@@ -289,20 +305,25 @@ class NodeManager:
                         self._spawning = max(0, self._spawning - 1)
                     self._idle_cv.notify_all()
 
-    def _spawn_worker(self, tpu: bool = False) -> None:
+    def _spawn_worker(self, tpu: bool = False, runtime_env=None) -> None:
         """Fire-and-forget spawn via the dedicated spawner thread (PDEATHSIG
         must be armed from a long-lived thread). The worker joins the idle
         pool when it registers; callers wait on _idle_cv, never on a
         specific spawn."""
-        self._spawn_requests.put(1 if tpu else 0)
+        self._spawn_requests.put((1 if tpu else 0, runtime_env))
 
-    def _spawn_worker_inner(self, tpu: bool = False) -> WorkerProc:
+    def _spawn_worker_inner(self, tpu: bool = False,
+                            runtime_env=None) -> WorkerProc:
+        from ray_tpu.core.runtime_env import (apply_to_spawn_env,
+                                              runtime_env_hash)
+
         worker_id = uuid.uuid4().hex
         log_dir = cfg.log_dir
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
         env = dict(os.environ)
         env["RTPU_WORKER_ID"] = worker_id
+        spawn_cwd = apply_to_spawn_env(runtime_env, env) or os.getcwd()
         if not tpu:
             # CPU pool worker: exactly one process per host may own the TPU
             # runtime (multi-controller JAX; analog of TPU_VISIBLE_CHIPS
@@ -324,10 +345,11 @@ class NodeManager:
              "--store-name", self.store_name,
              "--worker-id", worker_id],
             stdout=logf, stderr=logf, env=env,
-            cwd=os.getcwd(),
+            cwd=spawn_cwd,
             preexec_fn=_die_with_parent,
         )
-        w = WorkerProc(proc, worker_id, tpu=tpu)
+        w = WorkerProc(proc, worker_id, tpu=tpu,
+                       env_hash=runtime_env_hash(runtime_env))
         with self._lock:
             self._workers[worker_id] = w
         return w
@@ -350,20 +372,26 @@ class NodeManager:
             else:
                 self._spawning = max(0, self._spawning - 1)
             self._hand_worker(w)
-            # Demand still outstrips supply: keep the spawn pipeline full.
+            # Demand still outstrips supply: keep the spawn pipeline full
+            # FOR THE OLDEST WAITER'S ENV (a default-env refill would never
+            # satisfy a custom-env waiter).
             if (self._worker_waiters
                     and self._spawning < self._max_concurrent_spawns):
                 self._spawning += 1
-                self._spawn_worker()
+                self._spawn_worker(
+                    runtime_env=self._worker_waiters[0][3])
             self._idle_cv.notify_all()
         return True
 
-    def _pop_worker(self, timeout: float,
-                    tpu: bool = False) -> Optional[WorkerProc]:
+    def _pop_worker(self, timeout: float, tpu: bool = False,
+                    runtime_env=None) -> Optional[WorkerProc]:
         """Claim an idle worker FIFO-fairly, spawning more (bounded
         concurrency — worker startup is CPU-heavy) while demand outstrips
         the pool. TPU leases draw from the dedicated TPU-slot pool (one
-        TPU-env worker per host)."""
+        TPU-env worker per host); runtime envs draw only from their own
+        env-hash pool (two envs never share a worker)."""
+        from ray_tpu.core.runtime_env import runtime_env_hash
+
         ev = threading.Event()
         slot: List[Optional[WorkerProc]] = [None]
         if tpu:
@@ -382,25 +410,30 @@ class NodeManager:
                 except ValueError:
                     pass
                 return slot[0]
+        env_hash = runtime_env_hash(runtime_env)
         with self._idle_cv:
-            if self._idle and not self._worker_waiters:
-                return self._idle.pop()
-            self._worker_waiters.append((ev, slot))
+            pool = self._idle.get(env_hash)
+            same_env_waiting = any(e[2] == env_hash
+                                   for e in self._worker_waiters)
+            if pool and not same_env_waiting:
+                return pool.pop()
+            self._worker_waiters.append((ev, slot, env_hash, runtime_env))
             if self._spawning < self._max_concurrent_spawns:
                 self._spawning += 1
-                self._spawn_worker()
+                self._spawn_worker(runtime_env=runtime_env)
         if ev.wait(timeout):
             return slot[0]
         with self._idle_cv:
             try:
-                self._worker_waiters.remove((ev, slot))
+                self._worker_waiters.remove(
+                    (ev, slot, env_hash, runtime_env))
             except ValueError:
                 pass  # handed a worker concurrently with our timeout
             return slot[0]
 
     def _hand_worker(self, w: WorkerProc) -> None:
-        """Give an available worker to the oldest waiter, else idle it.
-        Caller must hold the lock."""
+        """Give an available worker to the oldest SAME-ENV waiter, else
+        idle it into its env pool. Caller must hold the lock."""
         if w.tpu:
             while self._tpu_waiters:
                 ev, slot = self._tpu_waiters.popleft()
@@ -410,13 +443,15 @@ class NodeManager:
             w.idle_since = time.monotonic()
             self._tpu_idle.append(w)
             return
-        while self._worker_waiters:
-            ev, slot = self._worker_waiters.popleft()
-            slot[0] = w
-            ev.set()
-            return
+        for entry in list(self._worker_waiters):
+            _ev, _slot, env_hash, _renv = entry
+            if env_hash == w.env_hash:
+                self._worker_waiters.remove(entry)
+                _slot[0] = w
+                _ev.set()
+                return
         w.idle_since = time.monotonic()
-        self._idle.append(w)
+        self._idle.setdefault(w.env_hash, []).append(w)
 
     # ------------------------------------------------------------ leases
 
@@ -461,7 +496,8 @@ class NodeManager:
                           wait_ready: bool = True,
                           pg: Optional[Tuple[bytes, int]] = None,
                           req_id: Optional[str] = None,
-                          lessee: Optional[str] = None):
+                          lessee: Optional[str] = None,
+                          runtime_env: Optional[Dict[str, Any]] = None):
         """Returns (worker_addr, lease_id) or None if infeasible (spillback).
         `req_id` makes retries idempotent: the memo is CLAIMED before the
         (slow) worker pop, so a retry arriving mid-flight waits for the
@@ -486,7 +522,8 @@ class NodeManager:
                 return entry[1]
         grant = None
         try:
-            grant = self._do_request_lease(resources, pg, lessee)
+            grant = self._do_request_lease(resources, pg, lessee,
+                                           runtime_env)
             if grant is not None and conn.peer_info.get("gone"):
                 # Requester died while queued: reclaim immediately.
                 self.rpc_return_lease(conn, grant[1])
@@ -499,7 +536,8 @@ class NodeManager:
 
     def _do_request_lease(self, resources: Dict[str, float],
                           pg: Optional[Tuple[bytes, int]],
-                          lessee: Optional[str] = None):
+                          lessee: Optional[str] = None,
+                          runtime_env: Optional[Dict[str, Any]] = None):
         deadline = time.monotonic() + cfg.lease_queue_block_ms / 1000.0
         with self._lock:
             while True:
@@ -513,7 +551,8 @@ class NodeManager:
                 # expires and the caller spills back via the head).
                 self._avail_cond.wait(min(remaining, 0.25))
         w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
-                             tpu=resources.get("TPU", 0) > 0)
+                             tpu=resources.get("TPU", 0) > 0,
+                             runtime_env=runtime_env)
         if w is None:
             lease = Lease("", None, resources, resolved)
             with self._lock:

@@ -66,10 +66,11 @@ class _Lease:
 
 class _InflightTask:
     __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
-                 "sched_key", "resources", "strategy", "name", "sys_retries")
+                 "sched_key", "resources", "strategy", "name", "sys_retries",
+                 "runtime_env")
 
     def __init__(self, spec_blob, return_ids, worker_addr, retries_left,
-                 sched_key, resources, strategy, name):
+                 sched_key, resources, strategy, name, runtime_env=None):
         self.spec_blob = spec_blob
         self.return_ids = return_ids
         self.worker_addr = worker_addr
@@ -79,6 +80,7 @@ class _InflightTask:
         self.strategy = strategy
         self.name = name
         self.sys_retries = None  # lazily set from config on first failure
+        self.runtime_env = runtime_env  # validated dict or None
 
 
 class _KeyQueue:
@@ -691,14 +693,16 @@ class ClusterCore:
         new_blob = SERIALIZER.encode(spec)
         info = _InflightTask(new_blob, rec.return_ids, None, 0,
                              rec.sched_key, rec.resources, rec.strategy,
-                             rec.name + "[recovery]")
+                             rec.name + "[recovery]",
+                             getattr(rec, "runtime_env", None))
         # Re-point the lineage mapping at the new spec so a SECOND loss
         # recovers from the resubmitted task, and re-protect the args.
         from ray_tpu.core.lineage import LineageRecord
 
         self.lineage.record(new_task_id.binary(), LineageRecord(
             new_blob, rec.sched_key, rec.resources, rec.strategy, rec.name,
-            rec.return_ids, rec.arg_ids))
+            rec.return_ids, rec.arg_ids,
+            runtime_env=getattr(rec, "runtime_env", None)))
         for arg in rec.arg_ids:
             self.refcount.add_submitted_task_ref(arg)
         with self._inflight_lock:
@@ -952,6 +956,10 @@ class ClusterCore:
                     num_returns: int = 1, resources=None, max_retries: int = 0,
                     retry_exceptions: bool = False, scheduling_strategy=None,
                     name: str = "", runtime_env=None) -> List[ObjectRef]:
+        from ray_tpu.core.runtime_env import (runtime_env_hash,
+                                              validate_runtime_env)
+
+        runtime_env = validate_runtime_env(runtime_env)
         resources = _as_resource_dict(resources)
         resources.setdefault("CPU", 1.0)
         task_id = TaskID.for_task(ActorID.nil_for_job(self.job_id))
@@ -975,10 +983,14 @@ class ClusterCore:
             "max_retries": max_retries,
         })
         sched_key = _sched_key(func, resources, strategy)
+        if runtime_env is not None:
+            # Distinct envs must never share leases/workers.
+            sched_key = sched_key + (runtime_env_hash(runtime_env),)
         info = _InflightTask(spec_blob, return_ids, None,
                              max_retries if retry_exceptions else 0,
                              sched_key, resources, strategy,
-                             name or getattr(func, "__name__", "task"))
+                             name or getattr(func, "__name__", "task"),
+                             runtime_env)
         from ray_tpu.util import metrics
 
         metrics.TASKS_SUBMITTED.inc()
@@ -988,7 +1000,7 @@ class ClusterCore:
 
         self.lineage.record(task_id.binary(), LineageRecord(
             spec_blob, sched_key, resources, strategy, info.name,
-            return_ids, arg_ids))
+            return_ids, arg_ids, runtime_env=runtime_env))
         self._enqueue_task(task_id.binary(), info)
         return refs
 
@@ -1100,7 +1112,8 @@ class ClusterCore:
     def _lease_requester(self, kq: "_KeyQueue",
                          sample: _InflightTask) -> None:
         try:
-            lease = self._request_new_lease(sample.resources, sample.strategy)
+            lease = self._request_new_lease(sample.resources, sample.strategy,
+                                            sample.runtime_env)
         finally:
             with self._lease_lock:
                 kq.pending_lease_requests -= 1
@@ -1258,7 +1271,8 @@ class ClusterCore:
             self._release_submitted_args(tid)
 
     def _request_new_lease(self, resources: Dict[str, float],
-                           strategy) -> Optional[_Lease]:
+                           strategy,
+                           runtime_env=None) -> Optional[_Lease]:
         """One head pick + node lease round trip; None if infeasible now.
         Both RPCs are retry-safe: pick_node is read-only, request_lease is
         idempotent via the per-attempt req_id (the node caches the grant)."""
@@ -1277,7 +1291,7 @@ class ClusterCore:
             try:
                 granted = self._pool.get(node_addr).retrying_call(
                     "request_lease", resources, True, pg, req_id,
-                    self.owner_addr,
+                    self.owner_addr, runtime_env,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 5)
             except (ConnectionLost, TimeoutError):
                 exclude.append(node_id)
@@ -1426,6 +1440,9 @@ class ClusterCore:
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False) -> ActorID:
+        from ray_tpu.core.runtime_env import validate_runtime_env
+
+        runtime_env = validate_runtime_env(runtime_env)
         resources = _as_resource_dict(resources)
         # Only a DEFAULTED actor (no explicit resources) costs 1 CPU to
         # schedule (released at mark_actor_host). An explicit num_cpus=0
@@ -1450,7 +1467,8 @@ class ClusterCore:
             status, existing = self.head.retrying_call(
                 "register_actor", actor_id.binary(), name, namespace,
                 spec_blob, max_restarts, resources, get_if_exists,
-                _strategy_dict(scheduling_strategy), timeout=120)
+                _strategy_dict(scheduling_strategy), runtime_env,
+                timeout=120)
         except BaseException:
             self._release_submitted_args(b"actor-args:" + actor_id.binary())
             raise

@@ -24,11 +24,12 @@ from ray_tpu.core.ids import ObjectID
 
 class LineageRecord:
     __slots__ = ("spec_blob", "sched_key", "resources", "strategy", "name",
-                 "return_ids", "arg_ids", "nbytes")
+                 "return_ids", "arg_ids", "nbytes", "runtime_env")
 
     def __init__(self, spec_blob: bytes, sched_key: tuple, resources,
                  strategy, name: str, return_ids: List[ObjectID],
-                 arg_ids: List[ObjectID]):
+                 arg_ids: List[ObjectID], runtime_env=None):
+        self.runtime_env = runtime_env
         self.spec_blob = spec_blob
         self.sched_key = sched_key
         self.resources = resources

@@ -1,0 +1,112 @@
+"""Runtime environment tests (reference analog:
+python/ray/tests/test_runtime_env_env_vars.py / test_runtime_env_working_dir):
+env application at worker spawn, per-env worker isolation, and loud
+rejection of unsupported fields.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_env_vars_applied(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "hello42"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=90) == "hello42"
+
+    @ray_tpu.remote
+    def read_default():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_default.remote(), timeout=90) is None
+
+
+def test_working_dir_applied(cluster, tmp_path):
+    marker = tmp_path / "marker.txt"
+    marker.write_text("present")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_cwd():
+        return os.getcwd(), open("marker.txt").read()
+
+    cwd, content = ray_tpu.get(read_cwd.remote(), timeout=90)
+    assert os.path.realpath(cwd) == os.path.realpath(str(tmp_path))
+    assert content == "present"
+
+
+def test_py_modules_applied(cluster, tmp_path):
+    mod = tmp_path / "rtpu_test_module_xyz.py"
+    mod.write_text("MAGIC = 1234\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def import_it():
+        import rtpu_test_module_xyz
+
+        return rtpu_test_module_xyz.MAGIC
+
+    assert ray_tpu.get(import_it.remote(), timeout=90) == 1234
+
+
+def test_envs_do_not_share_workers(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHICH_ENV": "A"}})
+    def pid_a():
+        return os.getpid(), os.environ["WHICH_ENV"]
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHICH_ENV": "B"}})
+    def pid_b():
+        return os.getpid(), os.environ["WHICH_ENV"]
+
+    @ray_tpu.remote
+    def pid_default():
+        return os.getpid()
+
+    pids_a = {p for p, e in ray_tpu.get(
+        [pid_a.remote() for _ in range(6)], timeout=120)}
+    pids_b = {p for p, e in ray_tpu.get(
+        [pid_b.remote() for _ in range(6)], timeout=120)}
+    pids_d = set(ray_tpu.get([pid_default.remote() for _ in range(6)],
+                             timeout=120))
+    assert not (pids_a & pids_b), "envs A and B shared a worker"
+    assert not (pids_a & pids_d), "env A shared a default worker"
+    assert not (pids_b & pids_d), "env B shared a default worker"
+    # Env values were really isolated.
+    envs_a = {e for _p, e in ray_tpu.get(
+        [pid_a.remote() for _ in range(3)], timeout=120)}
+    assert envs_a == {"A"}
+
+
+def test_actor_runtime_env(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=90) == "yes"
+
+
+def test_unsupported_runtime_env_raises(cluster):
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f():
+            return 1
+
+        f.remote()
+
+    with pytest.raises(ValueError, match="env_vars"):
+        @ray_tpu.remote(runtime_env={"env_vars": {"X": 1}})
+        def g():
+            return 1
+
+        g.remote()
