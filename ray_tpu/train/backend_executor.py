@@ -52,7 +52,12 @@ class ReportRound:
 
 class BackendExecutor:
     def __init__(self, scaling: ScalingConfig,
-                 use_jax_distributed: bool = False):
+                 use_jax_distributed: bool = False,
+                 num_workers: Optional[int] = None):
+        import dataclasses as _dc
+
+        if num_workers is not None and num_workers != scaling.num_workers:
+            scaling = _dc.replace(scaling, num_workers=num_workers)
         self._scaling = scaling
         self._use_jax_distributed = use_jax_distributed
         self._group: Optional[WorkerGroup] = None

@@ -21,6 +21,12 @@ class ScalingConfig:
     cpus_per_worker: float = 1.0
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"   # PACK | SPREAD | STRICT_SPREAD
+    # Elastic lower bound (reference: train/v2 elastic scaling —
+    # ScalingPolicy/ResizeDecision). None = fixed-size gang. When set, the
+    # trainer shrinks the gang to what fits (>= min_workers) on failure and
+    # grows back toward num_workers when capacity returns; every resize is
+    # a restart from the latest checkpoint at the new world size.
+    min_workers: Optional[int] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

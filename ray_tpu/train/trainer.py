@@ -78,13 +78,14 @@ class JaxTrainer:
         name = self._run.name or f"{self._train_fn.__name__}"
         return os.path.join(base, name)
 
-    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+    def _dataset_shards(self, n: Optional[int] = None
+                        ) -> Optional[List[Dict[str, Any]]]:
         """Split every dataset into one shard per worker (data-lite
         integration: Dataset.streaming_split; plain lists fall back to
         round-robin)."""
         if not self._datasets:
             return None
-        n = self._scaling.num_workers
+        n = n or self._scaling.num_workers
         per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self._datasets.items():
             if hasattr(ds, "streaming_split"):
@@ -109,9 +110,25 @@ class JaxTrainer:
         last_metrics: Optional[Dict[str, Any]] = None
         error: Optional[Exception] = None
 
+        from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
+                                                  FixedScalingPolicy,
+                                                  ResizeDecision)
+
+        if self._scaling.min_workers is not None:
+            policy = ElasticScalingPolicy(
+                self._scaling.num_workers, self._scaling.min_workers,
+                self._scaling.worker_resources())
+        else:
+            policy = FixedScalingPolicy(self._scaling.num_workers)
+
+        forced_size: Optional[int] = None
         while True:
+            size = forced_size if forced_size else policy.initial_size()
+            forced_size = None
             executor = BackendExecutor(
-                self._scaling, use_jax_distributed=self._use_jax_distributed)
+                self._scaling, use_jax_distributed=self._use_jax_distributed,
+                num_workers=size)
+            grow_to: Optional[int] = None
             try:
                 executor.start()
                 start_ckpt = (manager.latest.checkpoint.path if manager.latest
@@ -120,7 +137,7 @@ class JaxTrainer:
                 executor.start_training(
                     self._train_fn, self._config, path,
                     checkpoint_path=start_ckpt,
-                    dataset_shards=self._dataset_shards())
+                    dataset_shards=self._dataset_shards(size))
                 while True:
                     round_ = executor.get_next_round()
                     if round_ is None:
@@ -130,13 +147,27 @@ class JaxTrainer:
                     ckpt_path = round_.checkpoint_path()
                     if ckpt_path:
                         manager.register(ckpt_path, last_metrics)
-                break  # clean finish
+                    decision = policy.on_round(size)
+                    if isinstance(decision, ResizeDecision):
+                        # Capacity returned: controlled restart at the
+                        # larger world size from the latest checkpoint (a
+                        # pjit program is compiled for a fixed mesh —
+                        # elasticity operates between compiled runs).
+                        grow_to = decision.num_workers
+                        break
+                if grow_to is None:
+                    break  # clean finish
+                # The grow target was measured while the old gang still
+                # held its resources; trust it over a re-probe racing the
+                # just-released leases.
+                forced_size = grow_to
             except TrainWorkerError as e:
                 failures += 1
                 if max_failures >= 0 and failures > max_failures:
                     error = e
                     break
-                # else: loop — group restarts from manager.latest
+                # else: loop — the policy re-sizes to what fits now and
+                # the group restarts from manager.latest
             finally:
                 executor.shutdown()
 
