@@ -8,15 +8,18 @@ engine (the reference delegates that part to vLLM; serve/llm.py here).
 """
 
 from ray_tpu.serve.api import (Deployment, DeploymentHandle,
-                               DeploymentResponse, delete, deployment,
-                               get_deployment_handle, run, shutdown,
-                               status)
+                               DeploymentResponse,
+                               DeploymentResponseGenerator, delete,
+                               deployment, get_deployment_handle,
+                               get_multiplexed_model_id, multiplexed, run,
+                               shutdown, status)
 from ray_tpu.serve.batching import batch
 
 __all__ = [
-    "Deployment", "DeploymentHandle", "DeploymentResponse", "batch",
-    "delete", "deployment", "get_deployment_handle", "run", "shutdown",
-    "status", "start_http",
+    "Deployment", "DeploymentHandle", "DeploymentResponse",
+    "DeploymentResponseGenerator", "batch", "delete", "deployment",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "status", "start_http",
 ]
 
 

@@ -47,8 +47,33 @@ class HTTPProxyActor:
                         return self._send(500, {"error": str(e)})
                 return self._send(404, {"error": "not found"})
 
+            def _send_chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+
+            def _stream_response(self, h, method, payload) -> None:
+                """Chunked transfer: one JSON line per streamed item
+                (reference: proxy_response_generator.py writes streaming
+                responses the same incremental way over ASGI)."""
+                gen = h.options(method, stream=True).remote(payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonlines")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for item in gen:
+                        self._send_chunk(
+                            (json.dumps({"item": item}) + "\n").encode())
+                except Exception as e:  # noqa: BLE001 -> terminal record
+                    self._send_chunk(
+                        (json.dumps({"error": str(e)}) + "\n").encode())
+                self.wfile.write(b"0\r\n\r\n")
+
             def do_POST(self):
-                name = self.path.strip("/").split("/")[0]
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                name = parts[0] if parts else ""
+                method = parts[1] if len(parts) > 1 else "__call__"
+                stream = "stream=1" in (self.path.split("?", 1) + [""])[1]
                 if not name:
                     return self._send(404, {"error": "no deployment in path"})
                 try:
@@ -60,7 +85,10 @@ class HTTPProxyActor:
                     h = handles.get(name)
                     if h is None:
                         h = handles[name] = get_handle(name)
-                    result = h.remote(payload).result(timeout=120)
+                    if stream:
+                        return self._stream_response(h, method, payload)
+                    result = h.options(method).remote(
+                        payload).result(timeout=120)
                     return self._send(200, {"result": result})
                 except Exception as e:  # noqa: BLE001 — surfaced as 500
                     # The controller's KeyError arrives wrapped as a

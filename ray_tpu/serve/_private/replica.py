@@ -8,9 +8,22 @@ pow-2 router and the autoscaler consume).
 
 from __future__ import annotations
 
+import queue as _queue_mod
 import threading
 import time
+import uuid
 from typing import Any, Dict, Optional, Tuple
+
+# Per-request serve context (multiplexed model id, ...). A ContextVar so
+# asyncio deployments interleave safely too.
+import contextvars
+
+_request_context: "contextvars.ContextVar[Optional[dict]]" = (
+    contextvars.ContextVar("rtpu_serve_request_ctx", default=None))
+
+
+def get_request_context() -> dict:
+    return _request_context.get() or {}
 
 
 class ReplicaActor:
@@ -20,11 +33,28 @@ class ReplicaActor:
         self._total = 0
         self._lock = threading.Lock()
         self._started = time.time()
+        # Live response streams: stream_id -> buffer queue (a drain thread
+        # pulls the user generator so cursor polls never block on it).
+        self._streams: Dict[str, "_queue_mod.Queue"] = {}
+        self._stream_errors: Dict[str, BaseException] = {}
+        # Multiplexing: model ids this replica has loaded (the router's
+        # cache-affinity signal; reference: ModelMultiplexWrapper).
+        self._loaded_models: set = set()
         # Request-rate window for autoscaling decisions.
         self._window: list = []
 
+    def _resolve_target(self, method: str):
+        target = (self._callable if method == "__call__"
+                  else getattr(self._callable, method))
+        if method == "__call__" and not callable(self._callable):
+            raise TypeError(
+                f"{type(self._callable).__name__} is not callable; "
+                f"route to a named method instead")
+        return target
+
     def handle_request(self, method: str, args: tuple,
-                       kwargs: Dict[str, Any]):
+                       kwargs: Dict[str, Any],
+                       context: Optional[Dict[str, Any]] = None):
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -32,17 +62,97 @@ class ReplicaActor:
             self._window.append(now)
             if len(self._window) > 1000:
                 self._window = self._window[-500:]
+        token = _request_context.set(context or {})
         try:
-            target = (self._callable if method == "__call__"
-                      else getattr(self._callable, method))
-            if method == "__call__" and not callable(self._callable):
-                raise TypeError(
-                    f"{type(self._callable).__name__} is not callable; "
-                    f"route to a named method instead")
-            return target(*args, **kwargs)
+            if context and context.get("multiplexed_model_id"):
+                self._loaded_models.add(context["multiplexed_model_id"])
+            return self._resolve_target(method)(*args, **kwargs)
         finally:
+            _request_context.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    # ------------------------------------------------------------ streaming
+
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: Dict[str, Any],
+                                 context: Optional[Dict[str, Any]] = None,
+                                 ) -> str:
+        """Start a streaming call: the user method must return an
+        iterator/generator. Returns a stream id for next_chunks cursor
+        polling (reference: streaming responses flow as
+        ObjectRefGenerators; here the cursor rides the actor plane)."""
+        target = self._resolve_target(method)
+        sid = uuid.uuid4().hex
+        buf: "_queue_mod.Queue" = _queue_mod.Queue()
+        self._streams[sid] = buf
+        ctx = context or {}
+
+        def drain():
+            with self._lock:
+                self._ongoing += 1
+                self._total += 1
+                self._window.append(time.time())
+            token = _request_context.set(ctx)
+            try:
+                if ctx.get("multiplexed_model_id"):
+                    self._loaded_models.add(ctx["multiplexed_model_id"])
+                for item in target(*args, **kwargs):
+                    buf.put(("item", item))
+                buf.put(("done", None))
+            except BaseException as e:  # noqa: BLE001 -> surfaced to caller
+                buf.put(("error", e))
+            finally:
+                _request_context.reset(token)
+                with self._lock:
+                    self._ongoing -= 1
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"serve-stream-{sid[:8]}").start()
+        return sid
+
+    def next_chunks(self, sid: str, max_items: int = 64,
+                    wait_s: float = 10.0) -> Tuple[list, bool]:
+        """Cursor poll: blocks up to wait_s for the first item, then
+        drains whatever else is ready. Returns (items, done)."""
+        pending_err = self._stream_errors.pop(sid, None)
+        if pending_err is not None:
+            self._streams.pop(sid, None)
+            raise pending_err
+        buf = self._streams.get(sid)
+        if buf is None:
+            return [], True
+        items: list = []
+        try:
+            kind, val = buf.get(timeout=wait_s)
+        except _queue_mod.Empty:
+            return [], False
+        while True:
+            if kind == "item":
+                items.append(val)
+            elif kind == "done":
+                self._streams.pop(sid, None)
+                return items, True
+            else:
+                if items:
+                    # Deliver buffered items first; the error surfaces on
+                    # the NEXT poll (raising now would drop them).
+                    self._stream_errors[sid] = val
+                    return items, False
+                self._streams.pop(sid, None)
+                raise val
+            if len(items) >= max_items:
+                return items, False
+            try:
+                kind, val = buf.get_nowait()
+            except _queue_mod.Empty:
+                return items, False
+
+    def cancel_stream(self, sid: str) -> bool:
+        return self._streams.pop(sid, None) is not None
+
+    def loaded_models(self) -> list:
+        return sorted(self._loaded_models)
 
     def queue_len(self) -> int:
         return self._ongoing

@@ -25,6 +25,10 @@ class Router:
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
         self._inflight: Dict[Any, int] = {}
+        # Multiplex affinity: model id -> replica that last served it
+        # (cache locality; reference routers rank replicas by loaded
+        # model sets the same way).
+        self._model_affinity: Dict[str, Any] = {}
         self._last_refresh = 0.0
 
     def _refresh(self, force: bool = False) -> None:
@@ -44,19 +48,32 @@ class Router:
             self._inflight = {r: self._inflight.get(r, 0)
                               for r in replicas}
 
-    def choose(self):
-        """Pow-2: two random candidates, fewer local in-flight wins."""
+    def choose(self, model_id: Optional[str] = None):
+        """Pow-2: two random candidates, fewer local in-flight wins.
+        A multiplexed model id prefers its affine replica (model cache
+        locality) unless that replica disappeared."""
         self._refresh()
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} has no replicas")
-            if len(self._replicas) == 1:
-                choice = self._replicas[0]
-            else:
-                a, b = random.sample(self._replicas, 2)
-                choice = (a if self._inflight.get(a, 0)
-                          <= self._inflight.get(b, 0) else b)
+            choice = None
+            if model_id is not None:
+                affine = self._model_affinity.get(model_id)
+                if affine is not None and affine in self._replicas:
+                    choice = affine
+            if choice is None:
+                if len(self._replicas) == 1:
+                    choice = self._replicas[0]
+                else:
+                    a, b = random.sample(self._replicas, 2)
+                    choice = (a if self._inflight.get(a, 0)
+                              <= self._inflight.get(b, 0) else b)
+                if model_id is not None:
+                    self._model_affinity[model_id] = choice
+                    while len(self._model_affinity) > 4096:
+                        self._model_affinity.pop(
+                            next(iter(self._model_affinity)))
             self._inflight[choice] = self._inflight.get(choice, 0) + 1
             return choice
 

@@ -65,19 +65,71 @@ class DeploymentResponse:
         return self._ref
 
 
-class DeploymentHandle:
-    """Routes calls to a deployment's replicas (pow-2 choices)."""
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response (reference:
+    DeploymentResponseGenerator over ObjectRefGenerators; here chunks ride
+    a cursor-poll over the actor plane)."""
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, replica, sid, router):
+        self._replica = replica
+        self._sid = sid
+        self._router = router
+        self._buf: list = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            try:
+                items, done = ray_tpu.get(
+                    self._replica.next_chunks.remote(self._sid),
+                    timeout=120)
+            except BaseException:
+                self._done = True
+                self._router.done(self._replica)
+                raise
+            self._buf.extend(items)
+            if done:
+                self._done = True
+                self._router.done(self._replica)
+        return self._buf.pop(0)
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._done = True
+            self._replica.cancel_stream.remote(self._sid)
+            self._router.done(self._replica)
+
+
+class DeploymentHandle:
+    """Routes calls to a deployment's replicas (pow-2 choices, model
+    multiplexing affinity, optional streaming)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 stream: bool = False,
+                 multiplexed_model_id: Optional[str] = None):
         self._name = deployment_name
         self._method = method_name
+        self._stream = stream
+        self._model_id = multiplexed_model_id
         self._controller = _get_or_start_controller()
         self._router = Router(self._controller, deployment_name)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
+    def options(self, method_name: Optional[str] = None, *,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h._name = self._name
-        h._method = method_name
+        h._method = method_name if method_name is not None else self._method
+        h._stream = stream if stream is not None else self._stream
+        h._model_id = (multiplexed_model_id
+                       if multiplexed_model_id is not None
+                       else self._model_id)
         h._controller = self._controller
         h._router = self._router
         return h
@@ -87,9 +139,19 @@ class DeploymentHandle:
             raise AttributeError(item)
         return self.options(item)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        replica = self._router.choose()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+    def _context(self) -> Optional[Dict[str, Any]]:
+        if self._model_id is None:
+            return None
+        return {"multiplexed_model_id": self._model_id}
+
+    def remote(self, *args, **kwargs):
+        replica = self._router.choose(model_id=self._model_id)
+        if self._stream:
+            sid = ray_tpu.get(replica.handle_request_streaming.remote(
+                self._method, args, kwargs, self._context()), timeout=60)
+            return DeploymentResponseGenerator(replica, sid, self._router)
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            self._context())
         # One replay budget for a dead-replica result (submission itself
         # never raises for dead actors in this runtime).
         return DeploymentResponse(
@@ -97,12 +159,14 @@ class DeploymentHandle:
             retry=lambda: self._route_once(args, kwargs))
 
     def _route_once(self, args, kwargs) -> DeploymentResponse:
-        replica = self._router.choose()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        replica = self._router.choose(model_id=self._model_id)
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            self._context())
         return DeploymentResponse(ref, self._router, replica)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method))
+        return (DeploymentHandle,
+                (self._name, self._method, self._stream, self._model_id))
 
 
 class Deployment:
@@ -168,6 +232,52 @@ def run(target: Deployment, *, name: Optional[str] = None,
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a deployment: the model id the current request targeted
+    (reference: serve.get_multiplexed_model_id)."""
+    from ray_tpu.serve._private.replica import get_request_context
+
+    return get_request_context().get("multiplexed_model_id", "")
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Per-replica LRU model cache decorator (reference:
+    serve.multiplexed, python/ray/serve/multiplex.py): decorate a
+    ``load_model(self, model_id)`` method; calls are cached per replica,
+    least-recently-used models evicted beyond the cap (a model with a
+    ``__del__`` releases its resources on eviction)."""
+    import collections
+    import functools
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(self, model_id: str):
+            # Cache + lock live ON THE INSTANCE (per replica), created
+            # lazily: a closure-held lock would make the deployment class
+            # unpicklable when it ships to replicas.
+            state = getattr(self, "_rtpu_mux_state", None)
+            if state is None:
+                state = (collections.OrderedDict(), threading.Lock())
+                self._rtpu_mux_state = state
+            cache, lock = state
+            with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+            model = fn(self, model_id)
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+            return model
+
+        wrapper._rtpu_multiplexed = True
+        return wrapper
+
+    return decorate
 
 
 def status() -> Dict[str, Any]:

@@ -1,0 +1,114 @@
+"""Serve benchmark: req/s + p50/p99 TTFT on the native LLM engine.
+
+The BASELINE.json north star names "Serve-equivalent p50 TTFT + req/s";
+the reference publishes no serve numbers (it outsources the engine to
+vLLM), so these rows are recorded absolute, not vs_baseline. Run as:
+
+    python -m ray_tpu.serve.benchmark [--out PERF.json] [--seconds 10]
+
+Appends/merges `serve_*` rows into the PERF json. Uses the tiny-llama
+engine config so the row is comparable across rounds on the same host
+(CPU) while bench.py tracks the big-model TPU numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import Dict, List
+
+
+def run_benchmark(seconds: float = 10.0, concurrency: int = 8,
+                  prompt_len: int = 16, new_tokens: int = 8) -> Dict[str, float]:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    ray_tpu.init(num_cpus=max(2, os.cpu_count() or 1),
+                 ignore_reinit_error=True)
+    handle = serve.run(build_llm_deployment(
+        name="bench-llm", num_replicas=1,
+        engine_kwargs={"max_batch": concurrency, "max_len": 128}),
+        name="bench-llm")
+    rng = np.random.default_rng(0)
+
+    def prompt() -> List[int]:
+        return [int(t) for t in rng.integers(1, 50, prompt_len)]
+
+    # Warm up (compile prefill/decode).
+    handle.remote({"prompt_ids": prompt(),
+                   "max_new_tokens": 2}).result(timeout=600)
+
+    # ---- throughput: closed-loop clients ------------------------------
+    stop_at = time.perf_counter() + seconds
+    counts = [0] * concurrency
+
+    def client(i: int) -> None:
+        while time.perf_counter() < stop_at:
+            handle.remote({"prompt_ids": prompt(),
+                           "max_new_tokens": new_tokens}).result(timeout=120)
+            counts[i] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    total = sum(counts)
+    rps = total / elapsed
+    tokens_per_s = total * new_tokens / elapsed
+
+    # ---- TTFT: streaming first-token latency --------------------------
+    ttfts = []
+    for _ in range(20):
+        gen = handle.options("stream", stream=True).remote(
+            {"prompt_ids": prompt(), "max_new_tokens": new_tokens})
+        t0 = time.perf_counter()
+        next(iter(gen))
+        ttfts.append((time.perf_counter() - t0) * 1000.0)
+        for _tok in gen:
+            pass
+    ttfts.sort()
+    p50 = ttfts[len(ttfts) // 2]
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    serve.delete("bench-llm")
+    return {
+        "serve_llm_requests_per_s": round(rps, 2),
+        "serve_llm_tokens_per_s": round(tokens_per_s, 2),
+        "serve_llm_p50_ttft_ms": round(p50, 2),
+        "serve_llm_p99_ttft_ms": round(p99, 2),
+    }
+
+
+def main(argv=None) -> Dict[str, float]:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--seconds", type=float, default=10.0)
+    p.add_argument("--concurrency", type=int, default=8)
+    args = p.parse_args(argv)
+    rows = run_benchmark(seconds=args.seconds, concurrency=args.concurrency)
+    for k, v in rows.items():
+        print(f"{k:40s} {v:12,.2f}")
+    if args.out:
+        report = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                report = json.load(f)
+        report.setdefault("metrics", {}).update(rows)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"merged into {args.out}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,9 @@ class GenerationRequest:
     max_new_tokens: int = 32
     eos_id: Optional[int] = None
     future: Future = dataclasses.field(default_factory=Future)
+    # Streaming consumers read tokens from here as they decode; a ("done",
+    # None) / ("error", e) record terminates the stream.
+    stream_queue: Optional[Any] = None
     # engine state
     slot: int = -1
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -139,6 +142,29 @@ class LLMEngine:
         self._queue.put(req)
         return req.future.result(timeout=timeout)
 
+    def generate_stream(self, prompt_ids: List[int],
+                        max_new_tokens: int = 32,
+                        eos_id: Optional[int] = None,
+                        timeout: float = 300.0):
+        """Token-streaming generation: yields token ids as the engine
+        decodes them (reference: the vLLM engine's async token streams —
+        here the continuous-batching loop feeds per-request queues)."""
+        req = GenerationRequest(list(prompt_ids), max_new_tokens, eos_id,
+                                stream_queue=queue.Queue())
+        if not req.prompt_ids:
+            raise ValueError("empty prompt")
+        if len(req.prompt_ids) + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        self._queue.put(req)
+        while True:
+            kind, val = req.stream_queue.get(timeout=timeout)
+            if kind == "token":
+                yield val
+            elif kind == "done":
+                return
+            else:
+                raise val
+
     def stats(self) -> Dict[str, Any]:
         return {"active": len(self._active), "free_slots": len(self._free),
                 "waiting": self._queue.qsize()}
@@ -173,8 +199,12 @@ class LLMEngine:
                 self._free.append(slot)
                 if not req.future.done():
                     req.future.set_exception(e)
+                if req.stream_queue is not None:
+                    req.stream_queue.put(("error", e))
                 continue
             req.generated.append(first)
+            if req.stream_queue is not None:
+                req.stream_queue.put(("token", first))
             req.length = plen
             self._active.append(req)
             self._maybe_finish(req, first)
@@ -191,6 +221,8 @@ class LLMEngine:
                     "token_ids": req.generated,
                     "num_generated": len(req.generated),
                 })
+            if req.stream_queue is not None:
+                req.stream_queue.put(("done", None))
         return done
 
     def _engine_loop(self) -> None:
@@ -222,11 +254,15 @@ class LLMEngine:
                     self._free.append(req.slot)
                     if not req.future.done():
                         req.future.set_exception(e)
+                    if req.stream_queue is not None:
+                        req.stream_queue.put(("error", e))
                 continue
             for req in list(self._active):
                 tok = int(next_ids[req.slot])
                 req.length += 1
                 req.generated.append(tok)
+                if req.stream_queue is not None:
+                    req.stream_queue.put(("token", tok))
                 self._maybe_finish(req, tok)
 
 
@@ -243,6 +279,13 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
 
         def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
             return self.engine.generate(
+                request["prompt_ids"],
+                max_new_tokens=request.get("max_new_tokens", 32),
+                eos_id=request.get("eos_id"))
+
+        def stream(self, request: Dict[str, Any]):
+            """Token-streaming entry (use handle.options(stream=True))."""
+            return self.engine.generate_stream(
                 request["prompt_ids"],
                 max_new_tokens=request.get("max_new_tokens", 32),
                 eos_id=request.get("eos_id"))
