@@ -1,0 +1,9 @@
+"""Demand-driven autoscaling over pluggable node providers."""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import (GkeTpuSliceNodeProvider,
+                                              LocalNodeProvider,
+                                              NodeProvider)
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "GkeTpuSliceNodeProvider",
+           "LocalNodeProvider", "NodeProvider"]

@@ -95,6 +95,14 @@ class HeadServer:
         self._subscribers: Dict[str, List[Any]] = {}  # channel -> [conn]
         self._job_counter = 1
         self._spread_rr = 0
+        # Unmet demand ring (autoscaler signal): resource requests that
+        # found no feasible node (reference: autoscaler v2 reads cluster
+        # resource state demand the same way).
+        import collections as _collections
+
+        self._unmet_demand = _collections.deque(maxlen=512)
+        # submitter id -> (monotonic, [(resources, count)]) backlog reports
+        self._backlogs: Dict[str, Tuple[float, list]] = {}
         self._pool = ClientPool()
         # Durable tables (reference: gcs_table_storage.h). None = memory
         # only. Loaded BEFORE serving so a restarted head answers from the
@@ -272,10 +280,16 @@ class HeadServer:
 
     def _score_nodes(self, resources: Dict[str, float],
                      exclude: Set[str]) -> List[NodeInfo]:
+        return self._score_nodes_ex(resources, exclude)[0]
+
+    def _score_nodes_ex(self, resources: Dict[str, float],
+                        exclude: Set[str]) -> Tuple[List[NodeInfo], bool]:
         """Hybrid policy (reference: raylet/scheduling/policy/
         hybrid_scheduling_policy.cc): prefer packing onto already-used
         feasible nodes until utilization crosses `scheduler_spread_threshold`,
-        then prefer the least-utilized feasible node."""
+        then prefer the least-utilized feasible node. Returns
+        (ranked_nodes, saturated): saturated means nothing fits RIGHT NOW
+        and the ranking fell back to total capacity (autoscaler demand)."""
         with self._lock:
             feasible = []
             for n in self._nodes.values():
@@ -294,16 +308,16 @@ class HeadServer:
                             and all(n.total.get(k, 0) >= v
                                     for k, v in resources.items() if v > 0)]
                 by_total.sort(key=lambda n: (self._util(n), n.node_id))
-                return by_total
+                return by_total, True
 
             thresh = cfg.scheduler_spread_threshold
             below = [n for n in feasible if self._util(n) < thresh]
             if below:
                 # Pack: highest-utilization node still under threshold.
                 below.sort(key=lambda n: (-self._util(n), n.node_id))
-                return below
+                return below, False
             feasible.sort(key=lambda n: (self._util(n), n.node_id))
-            return feasible
+            return feasible, False
 
     @staticmethod
     def _util(n: NodeInfo) -> float:
@@ -356,9 +370,13 @@ class HeadServer:
                     self._spread_rr += 1
                     return n.node_id, n.address, n.store_name
                 return None
-        ranked = self._score_nodes(resources, exclude_set)
+        ranked, saturated = self._score_nodes_ex(resources, exclude_set)
         if not ranked:
+            self._unmet_demand.append((time.monotonic(), dict(resources)))
             return None
+        if saturated:
+            # Demand exceeds current capacity (autoscaler signal).
+            self._unmet_demand.append((time.monotonic(), dict(resources)))
         n = ranked[0]
         return n.node_id, n.address, n.store_name
 
@@ -751,6 +769,31 @@ class HeadServer:
             return pg_id in self._pgs
 
     # ------------------------------------------------------------- misc
+
+    def rpc_report_backlog(self, conn, submitter_id: str, entries: list):
+        """Periodic per-submitter queued-task backlog (autoscaler demand;
+        reference: backlog_size on lease requests)."""
+        with self._lock:
+            if entries:
+                self._backlogs[submitter_id] = (time.monotonic(), entries)
+            else:
+                self._backlogs.pop(submitter_id, None)
+        return True
+
+    def rpc_get_demand(self, conn, window_s: float = 30.0):
+        """Autoscaler poll: recent unmet resource demands (pick failures
+        + live queued backlogs) + node views."""
+        cutoff = time.monotonic() - window_s
+        with self._lock:
+            demands = [d for t, d in self._unmet_demand if t >= cutoff]
+            for sid, (t, entries) in list(self._backlogs.items()):
+                if t < cutoff:
+                    self._backlogs.pop(sid, None)
+                    continue
+                for resources, count in entries:
+                    demands.extend([dict(resources)] * int(count))
+            nodes = [n.view() for n in self._nodes.values()]
+        return {"unmet": demands, "nodes": nodes}
 
     def rpc_new_job_id(self, conn):
         with self._lock:

@@ -75,6 +75,16 @@ class WorkerRuntime(ClusterCore):
                          JobID.from_int(1), is_driver=False)
         self._exec_pool = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="task-exec")
+        # ONE normal-task execution slot: the lease this worker serves is
+        # sized for a single task's resources, so pipelined pushes QUEUE
+        # here and execute serially (running them all concurrently
+        # oversubscribed the node: 16 x 2-CPU tasks on a 2-CPU lease).
+        # A task blocked in get()/wait() yields the slot (the nested-task
+        # reentrancy the reference gets from blocked-worker resource
+        # release), tracked per-thread so nested blocked scopes release
+        # exactly once.
+        self._task_slot = threading.Semaphore(1)
+        self._slot_state = threading.local()
         self._hosted: Dict[ActorID, _HostedActor] = {}
         self._hosted_lock = threading.Lock()
         self._owner_pool = ClientPool()
@@ -119,7 +129,35 @@ class WorkerRuntime(ClusterCore):
                 self._exec_pool.submit(self._execute_task, spec_blob)
         return True
 
+    def _on_task_blocked(self) -> None:
+        ctx = runtime_context.current_worker_context()
+        if ctx.get("actor_id") is not None or not getattr(
+                self._slot_state, "holding", False):
+            return
+        depth = getattr(self._slot_state, "block_depth", 0)
+        self._slot_state.block_depth = depth + 1
+        if depth == 0:
+            self._task_slot.release()
+
+    def _on_task_unblocked(self) -> None:
+        depth = getattr(self._slot_state, "block_depth", 0)
+        if depth <= 0:
+            return
+        self._slot_state.block_depth = depth - 1
+        if depth == 1:
+            self._task_slot.acquire()
+
     def _execute_task(self, spec_blob: bytes) -> None:
+        self._task_slot.acquire()
+        self._slot_state.holding = True
+        self._slot_state.block_depth = 0
+        try:
+            self._execute_task_inner(spec_blob)
+        finally:
+            self._slot_state.holding = False
+            self._task_slot.release()
+
+    def _execute_task_inner(self, spec_blob: bytes) -> None:
         spec = SERIALIZER.decode(spec_blob)
         task_id = TaskID(spec["task_id"])
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
@@ -133,51 +171,58 @@ class WorkerRuntime(ClusterCore):
             return (t_start, time.time(), name)
 
         attempt = 0
-        while True:
-            try:
-                args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
-            except TaskError as te:
-                self._send_results(owner, task_id, return_ids,
-                                   error=te, span=span())
-                return
-            except BaseException as e:  # noqa: BLE001
-                self._send_results(owner, task_id, return_ids,
-                                   error=capture_exception(e), span=span())
-                return
-            if task_id.binary() in self._cancelled:
-                from ray_tpu.exceptions import TaskCancelledError
+        # Context covers ARG RESOLUTION too: blocked scopes during arg
+        # fetches must release the node resources + the execution slot, or
+        # a task waiting for an upstream output would pin the worker.
+        prev = runtime_context.set_worker_context({
+            "task_id": task_id, "actor_id": None,
+            "resources": spec.get("resources", {})})
+        try:
+            while True:
+                try:
+                    args, kwargs = self._resolve_args(spec["args"],
+                                                      spec["kwargs"])
+                except TaskError as te:
+                    self._send_results(owner, task_id, return_ids,
+                                       error=te, span=span())
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    self._send_results(owner, task_id, return_ids,
+                                       error=capture_exception(e),
+                                       span=span())
+                    return
+                if task_id.binary() in self._cancelled:
+                    from ray_tpu.exceptions import TaskCancelledError
 
-                self._send_results(owner, task_id, return_ids,
-                                   error=TaskCancelledError(
-                                       f"task {name} cancelled"),
-                                   span=span())
-                return
-            prev = runtime_context.set_worker_context({
-                "task_id": task_id, "actor_id": None,
-                "resources": spec.get("resources", {})})
-            t_start = time.time()
-            try:
-                func = (self._fetch_function(spec["func_digest"])
-                        if "func_digest" in spec else spec["func"])
-                result = func(*args, **kwargs)
-                self._send_results(owner, task_id, return_ids, value=result,
-                                   span=span())
-                return
-            except TaskError as te:
-                self._send_results(owner, task_id, return_ids, error=te,
-                                   span=span())
-                return
-            except BaseException as e:  # noqa: BLE001
-                attempt += 1
-                if spec.get("retry_exceptions") and attempt <= spec.get(
-                        "max_retries", 0):
-                    time.sleep(cfg.task_retry_delay_ms / 1000.0)
-                    continue
-                self._send_results(owner, task_id, return_ids,
-                                   error=capture_exception(e), span=span())
-                return
-            finally:
-                runtime_context.set_worker_context(prev)
+                    self._send_results(owner, task_id, return_ids,
+                                       error=TaskCancelledError(
+                                           f"task {name} cancelled"),
+                                       span=span())
+                    return
+                t_start = time.time()
+                try:
+                    func = (self._fetch_function(spec["func_digest"])
+                            if "func_digest" in spec else spec["func"])
+                    result = func(*args, **kwargs)
+                    self._send_results(owner, task_id, return_ids,
+                                       value=result, span=span())
+                    return
+                except TaskError as te:
+                    self._send_results(owner, task_id, return_ids, error=te,
+                                       span=span())
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    attempt += 1
+                    if spec.get("retry_exceptions") and attempt <= spec.get(
+                            "max_retries", 0):
+                        time.sleep(cfg.task_retry_delay_ms / 1000.0)
+                        continue
+                    self._send_results(owner, task_id, return_ids,
+                                       error=capture_exception(e),
+                                       span=span())
+                    return
+        finally:
+            runtime_context.set_worker_context(prev)
 
     def _resolve_args(self, args, kwargs):
         def res(a):

@@ -256,9 +256,17 @@ class ClusterCore:
                     self.node.notify("worker_blocked", self.owner_addr)
                 except Exception:
                     active = False
+            # Worker-side execution slot: a blocked task yields its slot so
+            # the next pipelined task can run (mirrors the node-side
+            # resource release above; WorkerRuntime installs the hooks).
+            hook = getattr(self, "_on_task_blocked", None) if active else None
+            if hook is not None:
+                hook()
             try:
                 yield
             finally:
+                if hook is not None:
+                    self._on_task_unblocked()
                 if active:
                     try:
                         self.node.notify("worker_unblocked", self.owner_addr)
@@ -1356,10 +1364,20 @@ class ClusterCore:
             kq.wake.set()
 
     def _lease_reaper_loop(self) -> None:
-        """Returns idle leases to their node managers after the linger."""
+        """Returns idle leases to their node managers after the linger.
+        Also reports per-key queued backlog to the head every ~2s — the
+        autoscaler's demand signal (reference: backlog_size rides lease
+        requests, raylet forwards demand to the autoscaler)."""
+        last_backlog_report = 0.0
         while not self._shutdown_flag:
             time.sleep(0.05)
             now = time.monotonic()
+            if now - last_backlog_report >= 2.0:
+                last_backlog_report = now
+                try:
+                    self._report_backlog()
+                except Exception:
+                    pass
             to_release = []
             with self._lease_lock:
                 for key, kq in list(self._key_queues.items()):
@@ -1393,6 +1411,26 @@ class ClusterCore:
                         "return_lease", l.lease_id, not l.broken, timeout=5)
                 except Exception:
                     pass
+
+    def _report_backlog(self) -> None:
+        entries = []
+        with self._lease_lock:
+            for kq in self._key_queues.values():
+                # Demand = undispatched queue + tasks PIPELINED onto leases
+                # beyond what they can run (1 task per lease executes; the
+                # rest wait in the worker's slot queue).
+                pipelined_waiting = sum(max(0, l.inflight - 1)
+                                        for l in kq.leases if not l.broken)
+                backlog = len(kq.queue) + pipelined_waiting
+                if backlog > 0:
+                    resources = dict(kq.key[1]) if len(kq.key) > 1 else {}
+                    if kq.queue:
+                        resources = dict(kq.queue[0][1].resources)
+                    entries.append((resources, backlog))
+        if entries or getattr(self, "_backlog_was_nonempty", False):
+            self._backlog_was_nonempty = bool(entries)
+            self.head.notify("report_backlog",
+                             self.worker_id.hex(), entries)
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):

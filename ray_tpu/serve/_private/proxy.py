@@ -64,10 +64,23 @@ class HTTPProxyActor:
                     for item in gen:
                         self._send_chunk(
                             (json.dumps({"item": item}) + "\n").encode())
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client hung up mid-stream (routine for LLM streams):
+                    # stop the replica-side generator and release the
+                    # router's in-flight count.
+                    gen.cancel()
+                    return
                 except Exception as e:  # noqa: BLE001 -> terminal record
-                    self._send_chunk(
-                        (json.dumps({"error": str(e)}) + "\n").encode())
-                self.wfile.write(b"0\r\n\r\n")
+                    gen.cancel()
+                    try:
+                        self._send_chunk(
+                            (json.dumps({"error": str(e)}) + "\n").encode())
+                    except OSError:
+                        return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
 
             def do_POST(self):
                 parts = [p for p in self.path.split("?")[0].split("/") if p]

@@ -33,13 +33,12 @@ class ReplicaActor:
         self._total = 0
         self._lock = threading.Lock()
         self._started = time.time()
-        # Live response streams: stream_id -> buffer queue (a drain thread
-        # pulls the user generator so cursor polls never block on it).
-        self._streams: Dict[str, "_queue_mod.Queue"] = {}
+        # Live response streams: stream_id -> [queue, cancelled_event,
+        # last_poll_monotonic] (a drain thread pulls the user generator so
+        # cursor polls never block on it). Streams abandoned without a
+        # cancel (client crash) are reaped after _STREAM_TTL_S idle.
+        self._streams: Dict[str, list] = {}
         self._stream_errors: Dict[str, BaseException] = {}
-        # Multiplexing: model ids this replica has loaded (the router's
-        # cache-affinity signal; reference: ModelMultiplexWrapper).
-        self._loaded_models: set = set()
         # Request-rate window for autoscaling decisions.
         self._window: list = []
 
@@ -64,8 +63,6 @@ class ReplicaActor:
                 self._window = self._window[-500:]
         token = _request_context.set(context or {})
         try:
-            if context and context.get("multiplexed_model_id"):
-                self._loaded_models.add(context["multiplexed_model_id"])
             return self._resolve_target(method)(*args, **kwargs)
         finally:
             _request_context.reset(token)
@@ -82,10 +79,12 @@ class ReplicaActor:
         iterator/generator. Returns a stream id for next_chunks cursor
         polling (reference: streaming responses flow as
         ObjectRefGenerators; here the cursor rides the actor plane)."""
+        self._reap_stale_streams()
         target = self._resolve_target(method)
         sid = uuid.uuid4().hex
         buf: "_queue_mod.Queue" = _queue_mod.Queue()
-        self._streams[sid] = buf
+        cancelled = threading.Event()
+        self._streams[sid] = [buf, cancelled, time.monotonic()]
         ctx = context or {}
 
         def drain():
@@ -94,15 +93,22 @@ class ReplicaActor:
                 self._total += 1
                 self._window.append(time.time())
             token = _request_context.set(ctx)
+            gen = None
             try:
-                if ctx.get("multiplexed_model_id"):
-                    self._loaded_models.add(ctx["multiplexed_model_id"])
-                for item in target(*args, **kwargs):
+                gen = target(*args, **kwargs)
+                for item in gen:
+                    if cancelled.is_set():
+                        break  # stop consuming (and computing) on cancel
                     buf.put(("item", item))
                 buf.put(("done", None))
             except BaseException as e:  # noqa: BLE001 -> surfaced to caller
                 buf.put(("error", e))
             finally:
+                if cancelled.is_set() and hasattr(gen, "close"):
+                    try:
+                        gen.close()
+                    except Exception:
+                        pass
                 _request_context.reset(token)
                 with self._lock:
                     self._ongoing -= 1
@@ -110,6 +116,16 @@ class ReplicaActor:
         threading.Thread(target=drain, daemon=True,
                          name=f"serve-stream-{sid[:8]}").start()
         return sid
+
+    _STREAM_TTL_S = 600.0
+
+    def _reap_stale_streams(self) -> None:
+        now = time.monotonic()
+        for sid, entry in list(self._streams.items()):
+            if now - entry[2] > self._STREAM_TTL_S:
+                entry[1].set()
+                self._streams.pop(sid, None)
+                self._stream_errors.pop(sid, None)
 
     def next_chunks(self, sid: str, max_items: int = 64,
                     wait_s: float = 10.0) -> Tuple[list, bool]:
@@ -119,9 +135,11 @@ class ReplicaActor:
         if pending_err is not None:
             self._streams.pop(sid, None)
             raise pending_err
-        buf = self._streams.get(sid)
-        if buf is None:
+        entry = self._streams.get(sid)
+        if entry is None:
             return [], True
+        buf = entry[0]
+        entry[2] = time.monotonic()
         items: list = []
         try:
             kind, val = buf.get(timeout=wait_s)
@@ -149,10 +167,12 @@ class ReplicaActor:
                 return items, False
 
     def cancel_stream(self, sid: str) -> bool:
-        return self._streams.pop(sid, None) is not None
-
-    def loaded_models(self) -> list:
-        return sorted(self._loaded_models)
+        entry = self._streams.pop(sid, None)
+        self._stream_errors.pop(sid, None)
+        if entry is None:
+            return False
+        entry[1].set()  # the drain thread stops pulling the generator
+        return True
 
     def queue_len(self) -> int:
         return self._ongoing

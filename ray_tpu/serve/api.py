@@ -147,8 +147,15 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         replica = self._router.choose(model_id=self._model_id)
         if self._stream:
-            sid = ray_tpu.get(replica.handle_request_streaming.remote(
-                self._method, args, kwargs, self._context()), timeout=60)
+            try:
+                sid = ray_tpu.get(replica.handle_request_streaming.remote(
+                    self._method, args, kwargs, self._context()), timeout=60)
+            except BaseException:
+                # The choose() above counted us in-flight; a failed stream
+                # setup must not permanently bias pow-2 away from the
+                # replica.
+                self._router.done(replica)
+                raise
             return DeploymentResponseGenerator(replica, sid, self._router)
         ref = replica.handle_request.remote(self._method, args, kwargs,
                                             self._context())
@@ -256,23 +263,36 @@ def multiplexed(max_num_models_per_replica: int = 3):
         def wrapper(self, model_id: str):
             # Cache + lock live ON THE INSTANCE (per replica), created
             # lazily: a closure-held lock would make the deployment class
-            # unpicklable when it ships to replicas.
+            # unpicklable when it ships to replicas. Per-model in-progress
+            # events serialize concurrent loads of the SAME model (an
+            # expensive load must run once, and the replica must never
+            # transiently exceed the model cap by racing loaders).
             state = getattr(self, "_rtpu_mux_state", None)
             if state is None:
-                state = (collections.OrderedDict(), threading.Lock())
+                state = (collections.OrderedDict(), threading.Lock(), {})
                 self._rtpu_mux_state = state
-            cache, lock = state
-            with lock:
-                if model_id in cache:
+            cache, lock, loading = state
+            while True:
+                with lock:
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    ev = loading.get(model_id)
+                    if ev is None:
+                        loading[model_id] = threading.Event()
+                        break
+                ev.wait(600)
+            try:
+                model = fn(self, model_id)
+                with lock:
+                    cache[model_id] = model
                     cache.move_to_end(model_id)
-                    return cache[model_id]
-            model = fn(self, model_id)
-            with lock:
-                cache[model_id] = model
-                cache.move_to_end(model_id)
-                while len(cache) > max_num_models_per_replica:
-                    cache.popitem(last=False)
-            return model
+                    while len(cache) > max_num_models_per_replica:
+                        cache.popitem(last=False)
+                return model
+            finally:
+                with lock:
+                    loading.pop(model_id).set()
 
         wrapper._rtpu_multiplexed = True
         return wrapper

@@ -1,0 +1,93 @@
+"""Autoscaler tests: unmet demand triggers scale-up; idle nodes reap
+(reference analog: python/ray/autoscaler/v2 tests + fake node provider).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalNodeProvider
+
+
+@pytest.fixture
+def cluster():
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_infeasible_demand_triggers_scale_up_then_idle_reap(cluster):
+    provider = LocalNodeProvider(cluster, node_types={"cpu": {"CPU": 4.0}})
+    scaler = Autoscaler(cluster, provider, AutoscalerConfig(
+        max_nodes=4, idle_timeout_s=3.0, demand_window_s=20.0))
+
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().node_id
+
+    # Infeasible on the 2-CPU head node: the lease layer records unmet
+    # demand at the head while the task stays queued.
+    refs = [big.remote() for _ in range(2)]
+    time.sleep(1.0)
+
+    did = scaler.step()
+    assert did["launched"], "no scale-up despite infeasible demand"
+    # The queued tasks complete on the new capacity.
+    nids = ray_tpu.get(refs, timeout=120)
+    assert len(provider.non_terminated_nodes()) >= 1
+    new_nodes = set(provider.non_terminated_nodes())
+    assert set(nids) <= new_nodes, "tasks did not run on autoscaled nodes"
+
+    # Idle reap: no demand; after idle_timeout the node drains + dies.
+    deadline = time.monotonic() + 60
+    reaped = []
+    while time.monotonic() < deadline and not reaped:
+        time.sleep(1.0)
+        reaped = scaler.step()["reaped"]
+    assert reaped, "idle autoscaled node was never reaped"
+    assert not provider.non_terminated_nodes()
+
+
+def test_scale_up_respects_max_nodes(cluster):
+    provider = LocalNodeProvider(cluster, node_types={"cpu": {"CPU": 4.0}})
+    scaler = Autoscaler(cluster, provider,
+                        AutoscalerConfig(max_nodes=2, max_launch_per_step=8))
+
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        time.sleep(0.2)
+        return 1
+
+    refs = [big.remote() for _ in range(12)]
+    time.sleep(1.0)
+    scaler.step()
+    time.sleep(1.0)
+    scaler.step()
+    # head node + at most (max_nodes - 1) autoscaled (head counts toward
+    # the cluster total the scaler clamps against).
+    assert len(provider.non_terminated_nodes()) <= 2
+    ray_tpu.get(refs, timeout=180)
+
+
+def test_bin_packing_absorbs_multiple_demands_per_node(cluster):
+    provider = LocalNodeProvider(cluster, node_types={"cpu": {"CPU": 4.0}})
+    scaler = Autoscaler(cluster, provider, AutoscalerConfig(max_nodes=8))
+
+    @ray_tpu.remote(num_cpus=2)
+    def mid():
+        time.sleep(1.5)
+        return 1
+
+    # Head has 2 CPUs: one mid runs there; the others queue. 4 unmet
+    # 2-CPU demands fit in ONE 4-CPU node x2, not four nodes.
+    refs = [mid.remote() for _ in range(5)]
+    time.sleep(2.5)  # one backlog report cycle
+    did = scaler.step()
+    # 5 x 2-CPU demands pack into <= 3 x 4-CPU nodes (NOT one node per
+    # demand); the exact count depends on how many had already dispatched
+    # when the backlog snapshot was taken.
+    assert 1 <= len(did["launched"]) <= 3, did
+    ray_tpu.get(refs, timeout=120)
