@@ -6,14 +6,16 @@ this runtime's actors: trials run the user trainable under a report
 session; ASHA prunes losers at successive-halving rungs.
 """
 
-from ray_tpu.train.session import report  # trials share the session API
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.train.session import get_checkpoint, report  # session API
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "ResultGrid", "TrialResult",
-    "TuneConfig", "Tuner", "choice", "grid_search", "loguniform", "randint",
-    "report", "uniform",
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
+    "get_checkpoint", "grid_search", "loguniform", "randint", "report",
+    "uniform",
 ]

@@ -76,3 +76,92 @@ class ASHAScheduler:
             decisions[trial_id] = (CONTINUE if score >= rung[k - 1]
                                    else STOP)
         return decisions
+
+
+class PopulationBasedTraining:
+    """PBT (reference: python/ray/tune/schedulers/pbt.py:221
+    PopulationBasedTraining): at each perturbation interval, bottom-
+    quantile trials EXPLOIT a top-quantile trial (clone its checkpoint +
+    config) and EXPLORE (mutate hyperparameters). Decisions come back as
+    {"action": "clone", "source": trial_id, "config": {...}} entries the
+    Tuner applies by restarting the trial from the source's checkpoint.
+    """
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Dict[str, Any] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int = 0):
+        import random as _random
+
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        if not hyperparam_mutations:
+            raise ValueError("hyperparam_mutations must be non-empty")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = _random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._latest: Dict[str, float] = {}
+
+    # The Tuner registers configs so explore() can mutate them.
+    def register(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Mutate each listed hyperparameter: resample with probability
+        resample_probability, else perturb x1.2 / x0.8 (numeric) or step
+        to a neighboring option (categorical) — reference explore()."""
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            cur = out.get(key)
+            if self._rng.random() < self.resample_p or cur is None:
+                if callable(spec):
+                    out[key] = spec()
+                elif isinstance(spec, (list, tuple)):
+                    out[key] = self._rng.choice(list(spec))
+                elif hasattr(spec, "sample"):
+                    out[key] = spec.sample(self._rng)
+                continue
+            if isinstance(spec, (list, tuple)) and cur in spec:
+                idx = list(spec).index(cur)
+                step = self._rng.choice([-1, 1])
+                out[key] = list(spec)[max(0, min(len(spec) - 1, idx + step))]
+            elif isinstance(cur, (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(cur)(cur * factor)
+        return out
+
+    def on_batch(self, results) -> Dict[str, Any]:
+        decisions: Dict[str, Any] = {}
+        for trial_id, _it, metrics in results:
+            if self.metric in metrics:
+                self._latest[trial_id] = self._score(metrics)
+            decisions[trial_id] = CONTINUE
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        if n < 2:
+            return decisions
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _s in ranked[:k]}
+        top = [tid for tid, _s in ranked[-k:]]
+        for trial_id, iteration, _metrics in results:
+            if (trial_id in bottom and iteration > 0
+                    and iteration % self.interval == 0):
+                source = self._rng.choice(top)
+                if source == trial_id:
+                    continue
+                new_config = self._explore(self._configs.get(source, {}))
+                self._configs[trial_id] = new_config
+                decisions[trial_id] = {"action": "clone", "source": source,
+                                       "config": new_config}
+        return decisions

@@ -89,7 +89,10 @@ class TrialActor:
         self._session: Optional[TrainSession] = None
 
     def start(self, trainable: Callable, config: Dict[str, Any],
-              trial_id: str) -> None:
+              trial_id: str,
+              checkpoint_path: Optional[str] = None) -> None:
+        from ray_tpu.train.checkpoint import Checkpoint
+
         ctx = TrainContextConfig(world_size=1, world_rank=0,
                                  experiment_path=trial_id,
                                  trial_info={"trial_id": trial_id,
@@ -103,7 +106,10 @@ class TrialActor:
 
                 _require_session().report(out)
 
-        self._session = TrainSession(runner, config, ctx)
+        self._session = TrainSession(
+            runner, config, ctx,
+            checkpoint=Checkpoint(checkpoint_path) if checkpoint_path
+            else None)
         self._session.start()
 
     def poll(self, timeout: float = 1.0):
@@ -116,7 +122,8 @@ class TrialActor:
                 exc, tb = r.error
                 out["error"] = f"{type(exc).__name__}: {exc}"
             return out
-        return {"done": False, "metrics": r.metrics}
+        return {"done": False, "metrics": r.metrics,
+                "checkpoint_path": r.checkpoint_path}
 
 
 @dataclasses.dataclass
@@ -129,6 +136,8 @@ class _Trial:
     done: bool = False
     error: Optional[str] = None
     stopped_early: bool = False
+    latest_checkpoint: Optional[str] = None
+    perturbs: int = 0
 
 
 class Tuner:
@@ -140,14 +149,81 @@ class Tuner:
         self._space = param_space or {}
         self._cfg = tune_config or TuneConfig()
         self._run_config = run_config
+        self._restored_trials: Optional[List[_Trial]] = None
+
+    # ------------------------------------------------- experiment state
+
+    def _experiment_dir(self) -> Optional[str]:
+        rc = self._run_config
+        if rc is None or getattr(rc, "storage_path", None) is None:
+            return None
+        name = getattr(rc, "name", None) or "tune_experiment"
+        return os.path.join(rc.storage_path, name)
+
+    def _snapshot(self, trials: List["_Trial"]) -> None:
+        """Atomic experiment-state snapshot after every round (reference:
+        python/ray/tune/execution/experiment_state.py checkpointing) —
+        a killed driver restores with Tuner.restore()."""
+        path = self._experiment_dir()
+        if path is None:
+            return
+        import json
+
+        os.makedirs(path, exist_ok=True)
+        state = {"trials": [{
+            "trial_id": t.trial_id, "config": t.config,
+            "history": t.history, "iteration": t.iteration,
+            "done": t.done, "error": t.error,
+            "stopped_early": t.stopped_early,
+            "latest_checkpoint": t.latest_checkpoint,
+            "perturbs": t.perturbs,
+        } for t in trials]}
+        tmp = os.path.join(path, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(path, "experiment_state.json"))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[Any] = None) -> "Tuner":
+        """Resume an interrupted experiment: finished trials keep their
+        results; unfinished ones restart from their latest checkpoint
+        (the trainable resumes via tune.get_checkpoint())."""
+        import json
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        trials = []
+        for ts in state["trials"]:
+            t = _Trial(ts["trial_id"], ts["config"],
+                       history=list(ts["history"]),
+                       iteration=ts["iteration"], done=ts["done"],
+                       error=ts.get("error"),
+                       stopped_early=ts.get("stopped_early", False),
+                       latest_checkpoint=ts.get("latest_checkpoint"),
+                       perturbs=ts.get("perturbs", 0))
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
     def fit(self) -> ResultGrid:
         cfg = self._cfg
         scheduler = cfg.scheduler or sched_mod.FIFOScheduler()
-        variants = generate_variants(self._space, cfg.num_samples, cfg.seed)
-        trials = [_Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", v)
-                  for i, v in enumerate(variants)]
-        pending = list(trials)
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            variants = generate_variants(self._space, cfg.num_samples,
+                                         cfg.seed)
+            trials = [_Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", v)
+                      for i, v in enumerate(variants)]
+        register = getattr(scheduler, "register", None)
+        if register is not None:
+            for t in trials:
+                register(t.trial_id, t.config)
+        pending = [t for t in trials if not t.done]
         running: List[_Trial] = []
         actor_cls = ray_tpu.remote(TrialActor)
 
@@ -157,7 +233,8 @@ class Tuner:
                 try:
                     t.actor = actor_cls.options(num_cpus=1).remote()
                     ray_tpu.get(t.actor.start.remote(
-                        self._trainable, t.config, t.trial_id), timeout=120)
+                        self._trainable, t.config, t.trial_id,
+                        t.latest_checkpoint), timeout=120)
                 except Exception as e:
                     # Cluster can't host another concurrent trial right
                     # now: requeue and run at the concurrency that fits —
@@ -192,6 +269,8 @@ class Tuner:
                     continue
                 t.iteration += 1
                 t.history.append(r["metrics"])
+                if r.get("checkpoint_path"):
+                    t.latest_checkpoint = r["checkpoint_path"]
                 round_results.append((t, r["metrics"]))
             # Whole round to the scheduler at once (batch-synchronous):
             # the lockstep polling order must not decide rung survival.
@@ -199,16 +278,43 @@ class Tuner:
                 decisions = scheduler.on_batch(
                     [(t.trial_id, t.iteration, m)
                      for t, m in round_results])
+                by_id = {t.trial_id: t for t in trials}
                 for t, _m in round_results:
-                    if decisions.get(t.trial_id) == sched_mod.STOP:
+                    d = decisions.get(t.trial_id)
+                    if d == sched_mod.STOP:
                         t.done = True
                         t.stopped_early = True
+                    elif isinstance(d, dict) and d.get("action") == "clone":
+                        # PBT exploit+explore: restart this trial from the
+                        # SOURCE trial's checkpoint with the explored
+                        # config (reference: pbt.py _exploit).
+                        source = by_id.get(d["source"])
+                        src_ckpt = (source.latest_checkpoint
+                                    if source else None)
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:
+                            pass
+                        t.config = d["config"]
+                        t.perturbs += 1
+                        if src_ckpt:
+                            t.latest_checkpoint = src_ckpt
+                        try:
+                            t.actor = actor_cls.options(
+                                num_cpus=1).remote()
+                            ray_tpu.get(t.actor.start.remote(
+                                self._trainable, t.config, t.trial_id,
+                                t.latest_checkpoint), timeout=120)
+                        except Exception as e:
+                            t.done = True
+                            t.error = f"PBT clone restart failed: {e}"
             for t in [t for t in running if t.done]:
                 running.remove(t)
                 try:
                     ray_tpu.kill(t.actor)
                 except Exception:
                     pass
+            self._snapshot(trials)
 
         results = [TrialResult(
             trial_id=t.trial_id, config=t.config,
