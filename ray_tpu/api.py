@@ -8,6 +8,7 @@ Parity target: python/ray/_private/worker.py public functions in the reference
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -56,6 +57,10 @@ def init(
         if object_store_memory is not None:
             GLOBAL_CONFIG.set("object_store_memory_bytes", int(object_store_memory))
 
+        if address is None:
+            # Submitted-job drivers join their cluster via the env the job
+            # supervisor sets (reference: RAY_ADDRESS).
+            address = os.environ.get("RTPU_ADDRESS") or None
         if local_mode or address == "local":
             from ray_tpu.core.local_runtime import LocalRuntime
 

@@ -146,6 +146,16 @@ class NodeManager:
                          name=f"node-hb-{node_id[:8]}").start()
         threading.Thread(target=self._reap_loop, daemon=True,
                          name=f"node-reap-{node_id[:8]}").start()
+        if (cfg.memory_monitor_refresh_ms > 0
+                and cfg.memory_usage_threshold < 1.0):
+            from ray_tpu.cluster.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self, cfg.memory_usage_threshold,
+                cfg.memory_monitor_refresh_ms)
+            threading.Thread(target=self.memory_monitor.run_forever,
+                             args=(self._stop,), daemon=True,
+                             name=f"node-memmon-{node_id[:8]}").start()
 
     # ------------------------------------------------------------ lifecycle
 

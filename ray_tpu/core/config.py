@@ -117,6 +117,13 @@ _d("worker_pool_min_workers", int, 0, "prestarted workers per node")
 _d("worker_pool_idle_ttl_s", float, 60.0, "idle worker reap time")
 _d("worker_niceness", int, 0, "niceness applied to spawned workers")
 
+_d("memory_usage_threshold", float, 0.95,
+   "node memory fraction above which the memory monitor kills the "
+   "worst worker (reference: RAY_memory_usage_threshold); 1.0 disables")
+_d("memory_monitor_refresh_ms", int, 1000,
+   "memory monitor sample period; 0 disables "
+   "(reference: RAY_memory_monitor_refresh_ms)")
+
 # --- fault tolerance ---
 _d("transfer_pin_ttl_s", float, 30.0,
    "owner-side lifetime extension for refs serialized into messages "
