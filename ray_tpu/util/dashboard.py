@@ -1,0 +1,154 @@
+"""Dashboard-lite: one HTML page + JSON API over the state surfaces.
+
+Parity target: the reference dashboard's head (reference:
+python/ray/dashboard/head.py:65 + its api endpoints) trimmed to the
+operator's daily loop: nodes, resources, actors, recent tasks, jobs,
+pending demand — live from the state API, auto-refreshing. Start with:
+
+    from ray_tpu.util import dashboard
+    port = dashboard.start(port=8265)          # inside a driver
+
+or `python -m ray_tpu.util.dashboard --address HOST:PORT [--port 8265]`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body { font-family: monospace; margin: 2em; background: #fafafa; }
+ h2 { border-bottom: 1px solid #ccc; padding-bottom: 2px; }
+ table { border-collapse: collapse; margin-bottom: 1.5em; }
+ td, th { border: 1px solid #ddd; padding: 3px 10px; text-align: left; }
+ th { background: #eee; }
+ .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED { color: #0a0; }
+ .DEAD, .FAILED { color: #c00; }
+</style></head><body>
+<h1>ray_tpu cluster</h1>
+<div id="content">%CONTENT%</div>
+</body></html>"""
+
+
+def _render() -> str:
+    from ray_tpu.util import state
+
+    parts = []
+
+    def table(title, rows, cols):
+        out = [f"<h2>{title}</h2><table><tr>"]
+        out += [f"<th>{c}</th>" for c in cols]
+        out.append("</tr>")
+        for r in rows:
+            out.append("<tr>")
+            for c in cols:
+                v = r.get(c, "")
+                cls = v if isinstance(v, str) else ""
+                out.append(f'<td class="{cls}">{v}</td>')
+            out.append("</tr>")
+        out.append("</table>")
+        parts.append("".join(out))
+
+    nodes = state.list_nodes()
+    table("Nodes", [{**n, "alive": "ALIVE" if n["alive"] else "DEAD",
+                     "available": json.dumps(n.get("available", {})),
+                     "resources": json.dumps(n.get("resources", {}))}
+                    for n in nodes],
+          ["node_id", "address", "alive", "available", "resources"])
+    table("Actors", state.list_actors(),
+          ["actor_id", "name", "state", "address"])
+    table("Recent tasks", state.list_tasks()[-25:],
+          ["task_id", "name", "state", "duration_s"])
+    try:
+        from ray_tpu.core.runtime_context import require_runtime
+
+        rt = require_runtime()
+        jobs = []
+        try:
+            import ray_tpu
+            from ray_tpu.jobs import JOB_MANAGER_NAME
+
+            mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
+            jobs = ray_tpu.get(mgr.list.remote(), timeout=5)
+        except Exception:
+            pass
+        table("Jobs", jobs,
+              ["submission_id", "status", "entrypoint", "message"])
+        demand = rt.head.retrying_call("get_demand", 30.0, timeout=5)
+        if demand["unmet"]:
+            parts.append(f"<h2>Pending demand</h2>"
+                         f"<p>{len(demand['unmet'])} unmet requests, "
+                         f"e.g. {json.dumps(demand['unmet'][0])}</p>")
+    except Exception:
+        pass
+    summary = state.summarize_objects()
+    parts.append(f"<h2>Object store</h2><pre>"
+                 f"{json.dumps(summary, indent=1, default=str)}</pre>")
+    return "".join(parts)
+
+
+def _api_payload() -> Dict[str, Any]:
+    from ray_tpu.util import state
+
+    return {"nodes": state.list_nodes(), "actors": state.list_actors(),
+            "tasks": state.list_tasks()[-100:],
+            "objects": state.summarize_objects()}
+
+
+def start(host: str = "127.0.0.1", port: int = 8265) -> int:
+    """Serve the dashboard from this (driver) process; returns the port."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            try:
+                if self.path.startswith("/api"):
+                    body = json.dumps(_api_payload(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                else:
+                    body = _PAGE.replace("%CONTENT%", _render()).encode()
+                    ctype = "text/html"
+                self.send_response(200)
+            except Exception as e:  # noqa: BLE001 — render errors as 500
+                body = str(e).encode()
+                ctype = "text/plain"
+                self.send_response(500)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="dashboard").start()
+    return server.server_address[1]
+
+
+def main(argv=None) -> int:
+    import argparse
+    import time
+
+    import ray_tpu
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True)
+    p.add_argument("--port", type=int, default=8265)
+    p.add_argument("--host", default="127.0.0.1")
+    args = p.parse_args(argv)
+    ray_tpu.init(address=args.address, ignore_reinit_error=True)
+    port = start(args.host, args.port)
+    print(f"dashboard at http://{args.host}:{port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

