@@ -784,6 +784,44 @@ class NodeManager:
             pass
         return True
 
+    @blocking_rpc
+    def rpc_pull_direct(self, conn, oid_bytes: bytes, source_addr: str,
+                        timeout_ms: int = 30000):
+        """Pull from a NAMED source node (no directory lookup): the
+        receive half of push-based transfer."""
+        from ray_tpu.core.ids import ObjectID
+
+        oid = ObjectID(oid_bytes)
+        if self.store.contains(oid):
+            return True
+        ok = self._pull_from(oid, source_addr,
+                             time.monotonic() + timeout_ms / 1000.0)
+        return ok or self.store.contains(oid)
+
+    @blocking_rpc
+    def rpc_push_object(self, conn, oid_bytes: bytes, target_addr: str,
+                        timeout_ms: int = 30000):
+        """PUSH a locally-held object to another node (reference:
+        object_manager.h:206 Push / push_manager.h): the transfer is
+        receiver-driven over the same chunk protocol, but initiated from
+        the holder side — the building block tree broadcasts fan out on,
+        instead of N nodes all pulling from one owner."""
+        from ray_tpu.core.ids import ObjectID
+
+        if not self.store.contains(ObjectID(oid_bytes)):
+            return False
+        try:
+            return bool(self._pool.get(target_addr).call(
+                "pull_direct", oid_bytes, self.address, timeout_ms,
+                timeout=timeout_ms / 1000.0 + 5))
+        except Exception:
+            return False
+
+    def rpc_has_object(self, conn, oid_bytes: bytes):
+        from ray_tpu.core.ids import ObjectID
+
+        return self.store.contains(ObjectID(oid_bytes))
+
     def rpc_store_stats(self, conn):
         used, capacity, n_objects, n_evictions = self.store.stats()
         return {"used": used, "capacity": capacity, "objects": n_objects,
