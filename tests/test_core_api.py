@@ -489,3 +489,50 @@ def test_kill_async_actor_with_inflight_call_fails_refs():
     ray_tpu.kill(a)
     with pytest.raises(ray_tpu.exceptions.ActorDiedError):
         ray_tpu.get(ref, timeout=5)
+
+
+def test_actor_pool():
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote(num_cpus=0)
+    class Doubler:
+        def double(self, v):
+            return v * 2
+
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    assert list(pool.map(lambda a, v: a.double.remote(v),
+                         list(range(12)))) == [v * 2 for v in range(12)]
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    list(range(8))))
+    assert out == [v * 2 for v in range(8)]
+
+
+def test_distributed_queue():
+    import pytest as _pytest
+
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put_batch(["b", "c"])
+    assert q.qsize() == 3
+    assert q.get() == "a"
+
+    # Handle pickles into tasks: producers/consumers share the queue.
+    @ray_tpu.remote
+    def produce(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consume(queue, n):
+        return [queue.get(timeout=30) for _ in range(n)]
+
+    ray_tpu.get(produce.remote(q, 5), timeout=60)
+    got = ray_tpu.get(consume.remote(q, 7), timeout=60)  # b, c + 0..4
+    assert got == ["b", "c", 0, 1, 2, 3, 4]
+    assert q.empty()
+    with _pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
