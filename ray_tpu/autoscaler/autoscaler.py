@@ -76,8 +76,15 @@ class Autoscaler:
         demands = state["unmet"]
         if not demands:
             return []
-        n_current = len(self._provider.non_terminated_nodes()) + len(
-            [n for n in state["nodes"] if n["alive"]])
+        # Provider nodes self-register with the head, so each appears both
+        # in non_terminated_nodes() and in state["nodes"] once up. Count
+        # alive cluster nodes plus provider nodes not alive in the cluster
+        # view (booting, or dead-but-still-billed VMs) — double-counting
+        # understates the launch budget; skipping dead VMs overshoots it.
+        alive_ids = {n["node_id"] for n in state["nodes"] if n["alive"]}
+        n_current = len(alive_ids) + len(
+            [pid for pid in self._provider.non_terminated_nodes()
+             if pid not in alive_ids])
         launched: List[str] = []
         # Bin-pack: demands first absorb EXISTING free capacity, then the
         # smallest node type that fits; one node absorbs several demands.

@@ -211,6 +211,10 @@ class ClusterCore:
         self._push_ack_event = threading.Event()
         self._borrow_buf: Dict[str, list] = {}
         self._borrow_buf_lock = threading.Lock()
+        #: owner_addr -> (retry-not-before deadline, consecutive failures);
+        #: keeps a dead owner from being retried inline on every ref
+        #: deserialization (flushes go through the periodic sweep instead).
+        self._borrow_flush_backoff: Dict[str, tuple] = {}
         self._borrows_sent: set = set()
         self._borrows_sent_order = _collections.deque()
         # Function table (reference: _private/function_manager.py exports a
@@ -303,21 +307,49 @@ class ClusterCore:
                     self._borrows_sent.discard(
                         self._borrows_sent_order.popleft())
                 self._borrow_buf.setdefault(owner_addr, []).append(key)
-                if len(self._borrow_buf[owner_addr]) >= 512:
+                if (len(self._borrow_buf[owner_addr]) >= 512
+                        and not self._in_borrow_backoff(owner_addr)):
                     flush = self._borrow_buf.pop(owner_addr)
             if flush is not None:
                 self._flush_borrows(owner_addr, flush)
+
+    def _in_borrow_backoff(self, owner_addr: str) -> bool:
+        ent = self._borrow_flush_backoff.get(owner_addr)
+        return ent is not None and time.monotonic() < ent[0]
 
     def _flush_borrows(self, owner_addr: str, oid_blobs: list) -> None:
         try:
             self._pool.get(owner_addr).notify(
                 "add_borrowers", oid_blobs, self.owner_addr)
+            self._borrow_flush_backoff.pop(owner_addr, None)
         except Exception:
-            pass
+            # A dropped notify must not permanently skip registration (the
+            # key is already in _borrows_sent, so nothing would ever retry
+            # and the owner could free an object we still hold once the
+            # transfer pin expires). Re-enqueue so the next sweep retries —
+            # with exponential backoff and a bounded buffer, so a dead
+            # owner costs neither inline RPC stalls nor unbounded memory.
+            _prev, fails = self._borrow_flush_backoff.get(owner_addr, (0, 0))
+            fails = min(fails + 1, 10)
+            self._borrow_flush_backoff[owner_addr] = (
+                time.monotonic() + min(60.0, 2.0 ** fails), fails)
+            with self._borrow_buf_lock:
+                buf = self._borrow_buf.setdefault(owner_addr, [])
+                buf.extend(oid_blobs)
+                if len(buf) > 100_000:
+                    # Dropped keys must leave _borrows_sent too, else a
+                    # later deserialization of the same ref would be
+                    # dedup-skipped and the borrow never registered.
+                    for k in buf[:-100_000]:
+                        self._borrows_sent.discard(k)
+                    del buf[:-100_000]
 
     def _flush_all_borrows(self) -> None:
         with self._borrow_buf_lock:
-            bufs, self._borrow_buf = self._borrow_buf, {}
+            bufs = {a: b for a, b in self._borrow_buf.items()
+                    if not self._in_borrow_backoff(a)}
+            for a in bufs:
+                del self._borrow_buf[a]
         for owner_addr, oid_blobs in bufs.items():
             self._flush_borrows(owner_addr, oid_blobs)
 

@@ -225,13 +225,49 @@ def groupby_exchange(bundles, key: str, num_outputs: int,
     per-partition GroupedData aggregation)."""
 
     def assign(block: Block, block_index: int) -> np.ndarray:
+        # Partition assignment must be identical no matter which worker
+        # process hashes a key (map tasks for different blocks run in
+        # different processes, and retried tasks may re-run anywhere), so
+        # Python hash() is unusable: str hashes are salted per process.
+        # crc32 over the value bytes is process-stable and deterministic.
+        import zlib
+
+        def scalar_hash(x) -> int:
+            # Equal-comparing numerics (1, 1.0, True) must co-partition,
+            # and arbitrary objects (default repr embeds the instance id,
+            # different per process) cannot be partitioned correctly —
+            # reject them rather than silently splitting groups.
+            if isinstance(x, bool):
+                x = int(x)
+            if isinstance(x, (int, float, np.integer, np.floating)):
+                f = float(x)
+                if f == int(f) and abs(f) < 2**53:
+                    return int(f)
+                return int(np.float64(0.0 if f == 0.0 else f)
+                           .view(np.int64))
+            if isinstance(x, bytes):
+                return zlib.crc32(x)
+            if isinstance(x, str):
+                return zlib.crc32(x.encode("utf-8", "surrogatepass"))
+            raise TypeError(
+                f"groupby key values must be str/bytes/numeric, got "
+                f"{type(x).__name__}: partition assignment for arbitrary "
+                f"objects is not process-stable")
+
         col = block[key]
         if col.dtype.kind in "iub":
             h = col.astype(np.int64)
         elif col.dtype.kind == "f":
-            h = col.astype(np.float64).view(np.int64)
+            # -0.0 == 0.0 must land in one partition: normalize the bit
+            # pattern before viewing as int64. Whole floats co-partition
+            # with equal ints via the same integer mapping as scalar_hash.
+            f = col.astype(np.float64)
+            f = np.where(f == 0.0, 0.0, f)
+            whole = np.isfinite(f) & (f == np.floor(f)) & (np.abs(f) < 2**53)
+            as_int = np.where(whole, f, 0.0).astype(np.int64)
+            h = np.where(whole, as_int, f.view(np.int64))
         else:
-            h = np.array([hash(x) for x in col.tolist()], np.int64)
+            h = np.array([scalar_hash(x) for x in col.tolist()], np.int64)
         return (h % num_outputs + num_outputs) % num_outputs
 
     def finalize(block: Block) -> Block:

@@ -131,7 +131,10 @@ class Queue:
             pass
 
     def __reduce__(self):
-        return (Queue, (0,), {"_actor": self._actor})
+        # Rebuild from the existing actor handle; Queue(0) here would spawn
+        # (and leak) a fresh _QueueActor on every deserialization.
+        return (_rebuild_queue, (self._actor,))
 
-    def __setstate__(self, state):
-        self._actor = state["_actor"]
+
+def _rebuild_queue(actor) -> "Queue":
+    return Queue(_actor=actor)

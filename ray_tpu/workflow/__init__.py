@@ -26,18 +26,21 @@ import ray_tpu
 
 _STORAGE_ENV = "RTPU_WORKFLOW_STORAGE"
 _DEFAULT_STORAGE = "/tmp/ray_tpu_workflows"
+_UNSET = object()
 
 
 class StepNode:
     """One bound step in a workflow DAG."""
 
     def __init__(self, fn, args: tuple, kwargs: Dict[str, Any],
-                 name: Optional[str] = None, max_retries: int = 3):
+                 name: Optional[str] = None, max_retries: int = 3,
+                 timeout: Optional[float] = None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.name = name or getattr(fn, "__name__", "step")
         self.max_retries = max_retries
+        self.timeout = timeout
 
     # --------------------------------------------------------- identity
 
@@ -71,30 +74,40 @@ class _Step:
     """What @workflow.step returns; .bind() builds StepNodes."""
 
     def __init__(self, fn, name: Optional[str] = None,
-                 max_retries: int = 3):
+                 max_retries: int = 3, timeout: Optional[float] = None):
         self._fn = fn
         self._name = name
         self._max_retries = max_retries
+        self._timeout = timeout
 
     def bind(self, *args, **kwargs) -> StepNode:
         return StepNode(self._fn, args, kwargs, self._name,
-                        self._max_retries)
+                        self._max_retries, self._timeout)
 
     def options(self, *, name: Optional[str] = None,
-                max_retries: Optional[int] = None) -> "_Step":
+                max_retries: Optional[int] = None,
+                timeout: Any = _UNSET) -> "_Step":
+        # timeout=None is meaningful (unbounded), so "not given" needs its
+        # own sentinel rather than None.
         return _Step(self._fn, name or self._name,
                      self._max_retries if max_retries is None
-                     else max_retries)
+                     else max_retries,
+                     self._timeout if timeout is _UNSET else timeout)
 
     def __call__(self, *args, **kwargs):
         return self._fn(*args, **kwargs)
 
 
-def step(_fn=None, *, name: Optional[str] = None, max_retries: int = 3):
-    """Decorator: a durable workflow step (reference: @workflow.step)."""
+def step(_fn=None, *, name: Optional[str] = None, max_retries: int = 3,
+         timeout: Optional[float] = None):
+    """Decorator: a durable workflow step (reference: @workflow.step).
+
+    ``max_retries`` is retries-after-first-failure (a step runs at most
+    ``1 + max_retries`` times); ``timeout`` bounds each attempt in
+    seconds (default: unbounded — workflows exist for long steps)."""
     if _fn is not None:
         return _Step(_fn)
-    return lambda fn: _Step(fn, name, max_retries)
+    return lambda fn: _Step(fn, name, max_retries, timeout)
 
 
 # --------------------------------------------------------------------------
@@ -139,7 +152,8 @@ def _save_result(workflow_id: str, step_id: str, value: Any) -> None:
 def _execute(node: StepNode, workflow_id: str,
              memo: Dict[str, Any]) -> Any:
     """Bottom-up recursive execution with per-step checkpointing. Steps
-    run as cluster tasks; independent upstream branches run in parallel."""
+    run as cluster tasks; upstream deps resolve depth-first (serially) —
+    parallelism comes from fan-out inside steps, not between branches."""
     sid = node.step_id()
     if sid in memo:
         return memo[sid]
@@ -147,11 +161,9 @@ def _execute(node: StepNode, workflow_id: str,
     if done:
         memo[sid] = value
         return value
-    # Resolve upstream deps (parallel across branches: launch all, then
-    # collect).
+    # Resolve upstream deps depth-first.
     resolved_args = []
-    pending: List[tuple] = []
-    for i, a in enumerate(node.args):
+    for a in node.args:
         if isinstance(a, StepNode):
             resolved_args.append(_execute(a, workflow_id, memo))
         else:
@@ -163,18 +175,19 @@ def _execute(node: StepNode, workflow_id: str,
     remote_fn = ray_tpu.remote(node.fn) if not hasattr(
         node.fn, "remote") else node.fn
     last_err = None
-    for _attempt in range(max(1, node.max_retries)):
+    attempts = 1 + max(0, node.max_retries)
+    for _attempt in range(attempts):
         try:
             value = ray_tpu.get(
                 remote_fn.remote(*resolved_args, **resolved_kwargs),
-                timeout=600)
+                timeout=node.timeout)
             break
         except Exception as e:  # noqa: BLE001 — step retry budget
             last_err = e
     else:
         raise RuntimeError(
             f"workflow step {node.name!r} failed after "
-            f"{node.max_retries} attempts") from last_err
+            f"{attempts} attempts") from last_err
     _save_result(workflow_id, sid, value)
     memo[sid] = value
     return value

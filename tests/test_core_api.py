@@ -529,8 +529,12 @@ def test_distributed_queue():
     def consume(queue, n):
         return [queue.get(timeout=30) for _ in range(n)]
 
-    ray_tpu.get(produce.remote(q, 5), timeout=60)
-    got = ray_tpu.get(consume.remote(q, 7), timeout=60)  # b, c + 0..4
+    # Submit both before getting either: the queue (maxsize=4) already holds
+    # 2 items, so the producer blocks on full until the consumer drains.
+    prod_ref = produce.remote(q, 5)
+    cons_ref = consume.remote(q, 7)  # b, c + 0..4
+    assert ray_tpu.get(prod_ref, timeout=60) == 5
+    got = ray_tpu.get(cons_ref, timeout=60)
     assert got == ["b", "c", 0, 1, 2, 3, 4]
     assert q.empty()
     with _pytest.raises(Empty):
