@@ -7,15 +7,23 @@ Prints ONE JSON line:
 The north-star target from BASELINE.json is >=40% MFU on Llama-class
 pretrain (reference has no TPU/LLM numbers checked in; 0.40 is the target
 ratio denominator). Extras report tokens/s/chip for context.
+
+Structure: the measurement runs in a CHILD subprocess (``--child``); the
+parent supervises with retry + backoff. Rationale: a TPU backend init
+failure is cached for the life of a JAX process, so retrying in-process
+is useless — and the round-3 driver run lost its only hardware number to
+exactly one flaky init. On persistent failure the parent diagnoses which
+processes hold the TPU device files and emits a structured failure record
+(still one JSON line) instead of a traceback.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 # Peak dense bf16 FLOP/s per chip by device kind substring.
 PEAK_FLOPS = [
@@ -28,6 +36,10 @@ PEAK_FLOPS = [
     ("cpu", 1e12),  # nominal, CI fallback
 ]
 
+ATTEMPTS = 4
+BACKOFFS_S = (10, 30, 60)  # between attempts
+CHILD_TIMEOUT_S = 1500     # first TPU compile can take minutes
+
 
 def peak_flops_for(device_kind: str) -> float:
     dk = device_kind.lower()
@@ -37,9 +49,10 @@ def peak_flops_for(device_kind: str) -> float:
     return 197e12
 
 
-def main() -> None:
+def child_main() -> None:
+    import numpy as np
+
     import jax
-    import jax.numpy as jnp
 
     from ray_tpu.models import llama
     from ray_tpu.parallel import spmd
@@ -108,5 +121,79 @@ def main() -> None:
     }))
 
 
+def accel_holders() -> list:
+    """Which processes hold TPU device files open (/dev/accel*, /dev/vfio*).
+    A wedged holder from a previous run is the usual cause of
+    'UNAVAILABLE: TPU backend setup/compile error'."""
+    holders = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            fd_dir = f"/proc/{pid}/fd"
+            try:
+                for fd in os.listdir(fd_dir):
+                    try:
+                        tgt = os.readlink(os.path.join(fd_dir, fd))
+                    except OSError:
+                        continue
+                    if "/dev/accel" in tgt or "/dev/vfio" in tgt:
+                        try:
+                            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                                cmd = f.read().replace(b"\0", b" ") \
+                                    .decode(errors="replace").strip()[:200]
+                        except OSError:
+                            cmd = "?"
+                        holders.append(
+                            {"pid": int(pid), "device": tgt, "cmd": cmd})
+                        break
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return holders
+
+
+def main() -> int:
+    errors = []
+    for attempt in range(ATTEMPTS):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timeout {CHILD_TIMEOUT_S}s")
+            continue
+        if proc.returncode == 0:
+            # Forward exactly the child's JSON line.
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("{")][-1]
+            print(line)
+            return 0
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-6:]
+        errors.append(f"attempt {attempt} rc={proc.returncode}: "
+                      + " | ".join(tail))
+        print(f"bench attempt {attempt} failed (rc={proc.returncode}); "
+              f"retrying", file=sys.stderr)
+        if attempt < ATTEMPTS - 1:
+            time.sleep(BACKOFFS_S[min(attempt, len(BACKOFFS_S) - 1)])
+    # Persistent failure: structured record, not a traceback. value 0.0
+    # plus an explicit error field — never a silently-plausible number.
+    print(json.dumps({
+        "metric": "train_mfu_llama1b",
+        "value": 0.0,
+        "unit": "mfu",
+        "vs_baseline": 0.0,
+        "error": "TPU backend init failed after retries",
+        "attempts": ATTEMPTS,
+        "attempt_errors": errors[-2:],
+        "accel_holders": accel_holders(),
+    }))
+    return 1
+
+
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child_main())
     sys.exit(main())

@@ -51,8 +51,15 @@ class RemoteError(RpcError):
 
 
 def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
+    # sendmsg gathers header+payload in one syscall without concatenating
+    # (the concat was one full copy per frame on the hot path).
     with lock:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+        n = 4 + len(payload)
+        sent = sock.sendmsg((_LEN.pack(len(payload)), payload))
+        if sent != n:
+            # Partial send (large payload): fall back to sendall for the rest.
+            rest = (_LEN.pack(len(payload)) + payload)[sent:]
+            sock.sendall(rest)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -44,7 +44,31 @@ from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
                                       RpcServer, blocking_rpc)
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
                                 WorkerCrashedError)
+from ray_tpu.core.lineage import LineageRecord as _LineageRecord
+from ray_tpu.util import metrics as _metrics
 
+
+class _SubmitTemplate:
+    """Constant-per-function submission state (see make_submit_template)."""
+
+    __slots__ = ("func", "num_returns", "resources", "strategy", "name",
+                 "sched_key", "spread", "effective_retries", "runtime_env",
+                 "env_hash", "spec_proto")
+
+    def __init__(self, func, num_returns, resources, strategy, name,
+                 sched_key, spread, effective_retries, runtime_env,
+                 env_hash, spec_proto):
+        self.func = func
+        self.num_returns = num_returns
+        self.resources = resources
+        self.strategy = strategy
+        self.name = name
+        self.sched_key = sched_key
+        self.spread = spread
+        self.effective_retries = effective_retries
+        self.runtime_env = runtime_env
+        self.env_hash = env_hash
+        self.spec_proto = spec_proto
 
 
 class _Lease:
@@ -157,6 +181,7 @@ class ClusterCore:
         self.refcount = ReferenceCounter(on_release=self._release_object)
         self.store = ShmStore.open(store_name)
         self._driver_task_id = TaskID.for_driver(job_id)
+        self._nil_actor = ActorID.nil_for_job(job_id)
         self._put_counter = itertools.count(1)
 
         self._pool = ClientPool()
@@ -577,27 +602,37 @@ class ClusterCore:
         borrowed refs long-poll their owner (one `wait_object` RPC per ref,
         not a poll-per-tick storm — the reference's Wait is likewise
         subscription-based, core_worker.h:682)."""
-        if len(set(r.id() for r in refs)) != len(refs):
+        # One pass extracts ids, checks uniqueness, and detects borrowed
+        # refs (this runs per call in pop-1-of-1k wait loops — every extra
+        # pass over `refs` multiplies into O(n^2) drain cost).
+        my_addr = self.owner_addr
+        oids = [r._id for r in refs]
+        all_owned = True
+        for r in refs:
+            oa = r._owner_addr
+            if oa is not None and oa != my_addr:
+                all_owned = False
+                break
+        if len(set(oids)) != len(refs):
             raise ValueError("wait() requires unique object refs")
         # Fast path: enough refs already resolved locally -> one lock pass,
         # zero callback registration/removal churn.
-        owned = [r for r in refs
-                 if r.owner_address in (None, self.owner_addr)]
-        if len(owned) == len(refs):
-            ready_now = self.memory_store.ready_subset(
-                (r.id() for r in refs), num_returns)
+        if all_owned:
+            ready_now = self.memory_store.ready_subset(oids, num_returns)
             if len(ready_now) < num_returns:
                 # All-local waits ride the store's condvar directly (the
                 # put_batch wakeup) — zero per-ref callback churn.
-                oids = [r.id() for r in refs]
                 with self._blocked_scope():
                     ready_now = self.memory_store.wait(
                         oids, num_returns, timeout)
             ready, not_ready = [], []
-            for r in refs:
-                (ready if r.id() in ready_now
-                 and len(ready) < num_returns
-                 else not_ready).append(r)
+            n_ready = 0
+            for r, oid in zip(refs, oids):
+                if oid in ready_now and n_ready < num_returns:
+                    ready.append(r)
+                    n_ready += 1
+                else:
+                    not_ready.append(r)
             return ready, not_ready
         deadline = None if timeout is None else time.monotonic() + timeout
         cv = threading.Condition()
@@ -802,7 +837,7 @@ class ClusterCore:
         given owned objects that are ready, blocking until at least one is
         or the timeout lapses."""
         oids = [ObjectID(b) for b in oid_bytes_list]
-        ready = self.memory_store.wait(oids, 1, timeout)
+        ready = self.memory_store.wait(oids, 1, timeout, return_all=True)
         return [o.binary() for o in ready]
 
     def rpc_add_borrowers(self, conn, oid_blobs: list, borrower: str):
@@ -996,52 +1031,88 @@ class ClusterCore:
                     num_returns: int = 1, resources=None, max_retries: int = 0,
                     retry_exceptions: bool = False, scheduling_strategy=None,
                     name: str = "", runtime_env=None) -> List[ObjectRef]:
+        tmpl = self.make_submit_template(
+            func, num_returns=num_returns, resources=resources,
+            max_retries=max_retries, retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy, name=name,
+            runtime_env=runtime_env)
+        return self.submit_templated(tmpl, args, kwargs)
+
+    def make_submit_template(self, func: Callable, *, num_returns: int = 1,
+                             resources=None, max_retries: int = 0,
+                             retry_exceptions: bool = False,
+                             scheduling_strategy=None, name: str = "",
+                             runtime_env=None) -> "_SubmitTemplate":
+        """Precompute everything about a submission that does not vary per
+        call (reference analog: the per-SchedulingKey caching inside
+        NormalTaskSubmitter). ``RemoteFunction`` caches the result, so the
+        ``f.remote()`` hot loop skips option normalization, strategy/
+        sched-key construction and the constant spec fields entirely."""
         from ray_tpu.core.runtime_env import (runtime_env_hash,
                                               validate_runtime_env)
 
         runtime_env = validate_runtime_env(runtime_env)
-        resources = _as_resource_dict(resources)
-        resources.setdefault("CPU", 1.0)
-        task_id = TaskID.for_task(ActorID.nil_for_job(self.job_id))
+        res = _as_resource_dict(resources)
+        res.setdefault("CPU", 1.0)
+        strategy = _strategy_dict(scheduling_strategy)
+        task_name = name or getattr(func, "__name__", "task")
+        spread = bool(strategy and strategy.get("kind") == "spread")
+        sched_key = None
+        if not spread:
+            sched_key = _sched_key(func, res, strategy)
+            if runtime_env is not None:
+                # Distinct envs must never share leases/workers.
+                sched_key = sched_key + (runtime_env_hash(runtime_env),)
+        spec_proto = {
+            "task_id": b"",
+            "func_digest": self._export_function(func),
+            "args": (),
+            "kwargs": {},
+            "return_ids": (),
+            "owner_addr": self.owner_addr,
+            "name": task_name,
+            "resources": res,
+            "retry_exceptions": retry_exceptions,
+            "max_retries": max_retries,
+        }
+        return _SubmitTemplate(
+            func, num_returns, res, strategy, task_name, sched_key, spread,
+            max_retries if retry_exceptions else 0, runtime_env,
+            runtime_env_hash(runtime_env) if runtime_env is not None
+            else None, spec_proto)
+
+    def submit_templated(self, tmpl: "_SubmitTemplate", args: Sequence,
+                         kwargs: Dict) -> List[ObjectRef]:
+        task_id = TaskID.for_task(self._nil_actor)
+        task_id_bytes = task_id.binary()
         return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+                      for i in range(tmpl.num_returns)]
         for oid in return_ids:
             self.refcount.add_owned_object(oid)
         refs = [ObjectRef(oid, self.owner_addr) for oid in return_ids]
 
-        strategy = _strategy_dict(scheduling_strategy)
-        spec_blob = SERIALIZER.encode({
-            "task_id": task_id.binary(),
-            "func_digest": self._export_function(func),
-            "args": tuple(args),
-            "kwargs": dict(kwargs),
-            "return_ids": [o.binary() for o in return_ids],
-            "owner_addr": self.owner_addr,
-            "name": name or getattr(func, "__name__", "task"),
-            "resources": resources,
-            "retry_exceptions": retry_exceptions,
-            "max_retries": max_retries,
-        })
-        sched_key = _sched_key(func, resources, strategy)
-        if runtime_env is not None:
-            # Distinct envs must never share leases/workers.
-            sched_key = sched_key + (runtime_env_hash(runtime_env),)
+        spec = dict(tmpl.spec_proto)
+        spec["task_id"] = task_id_bytes
+        spec["args"] = tuple(args)
+        spec["kwargs"] = dict(kwargs)
+        spec["return_ids"] = [o.binary() for o in return_ids]
+        spec_blob = SERIALIZER.encode(spec)
+        if tmpl.spread:
+            sched_key = _sched_key(tmpl.func, tmpl.resources, tmpl.strategy)
+            if tmpl.env_hash is not None:
+                sched_key = sched_key + (tmpl.env_hash,)
+        else:
+            sched_key = tmpl.sched_key
         info = _InflightTask(spec_blob, return_ids, None,
-                             max_retries if retry_exceptions else 0,
-                             sched_key, resources, strategy,
-                             name or getattr(func, "__name__", "task"),
-                             runtime_env)
-        from ray_tpu.util import metrics
-
-        metrics.TASKS_SUBMITTED.inc()
-        arg_ids = self._register_submitted_args(task_id.binary(), args,
-                                                kwargs)
-        from ray_tpu.core.lineage import LineageRecord
-
-        self.lineage.record(task_id.binary(), LineageRecord(
-            spec_blob, sched_key, resources, strategy, info.name,
-            return_ids, arg_ids, runtime_env=runtime_env))
-        self._enqueue_task(task_id.binary(), info)
+                             tmpl.effective_retries, sched_key,
+                             tmpl.resources, tmpl.strategy, tmpl.name,
+                             tmpl.runtime_env)
+        _metrics.TASKS_SUBMITTED.inc()
+        arg_ids = self._register_submitted_args(task_id_bytes, args, kwargs)
+        self.lineage.record(task_id_bytes, _LineageRecord(
+            spec_blob, sched_key, tmpl.resources, tmpl.strategy, tmpl.name,
+            return_ids, arg_ids, runtime_env=tmpl.runtime_env))
+        self._enqueue_task(task_id_bytes, info)
         return refs
 
     # ---- per-scheduling-key dispatch (reference: NormalTaskSubmitter's

@@ -27,9 +27,27 @@ import struct
 _rng_lock = threading.Lock()
 _counter = 0
 
+# Batched entropy: os.urandom is a syscall (~10us) and sits on the
+# per-task hot path (one TaskID per submit). Refill 8KB at a time and
+# slice; fork safety comes from re-keying on pid change (a forked child
+# must not replay the parent's buffered entropy).
+_rand_buf = b""
+_rand_pos = 0
+_rand_pid = -1
+
 
 def _rand_bytes(n: int) -> bytes:
-    return os.urandom(n)
+    global _rand_buf, _rand_pos, _rand_pid
+    if n > 8192:
+        return os.urandom(n)
+    with _rng_lock:
+        if _rand_pos + n > len(_rand_buf) or _rand_pid != os.getpid():
+            _rand_buf = os.urandom(8192)
+            _rand_pos = 0
+            _rand_pid = os.getpid()
+        out = _rand_buf[_rand_pos:_rand_pos + n]
+        _rand_pos += n
+        return out
 
 
 def _next_counter() -> int:

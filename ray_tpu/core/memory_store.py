@@ -123,12 +123,24 @@ class MemoryStore:
         object_ids: List[ObjectID],
         num_returns: int,
         timeout: Optional[float],
+        return_all: bool = False,
     ) -> Set[ObjectID]:
-        """Returns the set of ready ids (>= num_returns unless timeout)."""
+        """Returns the set of ready ids (>= num_returns unless timeout).
+        With ``return_all``, once the threshold is met the whole list is
+        scored (batch long-poll servers want every ready id per wake)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
-                ready = {oid for oid in object_ids if oid in self._objects}
+                # Early-exit scan: a wake only needs to find num_returns
+                # ready ids, not score the whole list (pop-1-of-1k wait
+                # loops re-scan on every put_batch wake otherwise).
+                ready = set()
+                objs = self._objects
+                for oid in object_ids:
+                    if oid in objs:
+                        ready.add(oid)
+                        if len(ready) >= num_returns and not return_all:
+                            return ready
                 if len(ready) >= num_returns:
                     return ready
                 remaining = None if deadline is None else deadline - time.monotonic()

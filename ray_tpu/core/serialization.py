@@ -145,7 +145,8 @@ class Serializer:
         header, buffers = self.serialize(value)
         out = bytearray(self.encode_total_size(header, buffers))
         n = self.encode_into(memoryview(out), header, buffers)
-        return bytes(out[:n])
+        # encode_total_size is exact, so the slice copy is only a guard.
+        return bytes(out) if n == len(out) else bytes(out[:n])
 
     def decode(self, data) -> Any:
         """Zero-copy decode: numpy results view into ``data``."""

@@ -7,6 +7,7 @@ reference; options normalization mirrors python/ray/_private/ray_option_utils.py
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Any, Dict
 
 from ray_tpu.core.runtime_context import require_runtime
@@ -34,6 +35,8 @@ class RemoteFunction:
     def __init__(self, func, default_options: Dict[str, Any]):
         self._func = func
         self._default_options = validate_options(default_options)
+        self._tmpl = None       # cached submit template (cluster runtimes)
+        self._tmpl_rt = None    # runtime the template was built against
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -53,16 +56,38 @@ class RemoteFunction:
         num_returns = opts.get("num_returns", 1)
         if num_returns == "dynamic":
             num_returns = 1  # dynamic generators collapse to one list ref
-        refs = rt.submit_task(
-            self._func, args, kwargs,
-            num_returns=num_returns,
-            resources=_task_resources(opts),
-            max_retries=opts.get("max_retries", 0),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            scheduling_strategy=opts.get("scheduling_strategy"),
-            name=opts.get("name") or self._func.__qualname__,
-            runtime_env=opts.get("runtime_env"),
-        )
+        make_tmpl = getattr(rt, "make_submit_template", None)
+        if make_tmpl is not None:
+            # Hot path: option normalization + constant spec fields are
+            # computed once per (function, runtime) and cached. The runtime
+            # is held via weakref so a module-level @remote function does
+            # not pin a shut-down runtime's sockets/stores alive.
+            cached_rt = self._tmpl_rt() if self._tmpl_rt is not None else None
+            if self._tmpl is None or cached_rt is not rt:
+                self._tmpl = make_tmpl(
+                    self._func,
+                    num_returns=num_returns,
+                    resources=_task_resources(opts),
+                    max_retries=opts.get("max_retries", 0),
+                    retry_exceptions=bool(opts.get("retry_exceptions",
+                                                   False)),
+                    scheduling_strategy=opts.get("scheduling_strategy"),
+                    name=opts.get("name") or self._func.__qualname__,
+                    runtime_env=opts.get("runtime_env"),
+                )
+                self._tmpl_rt = weakref.ref(rt)
+            refs = rt.submit_templated(self._tmpl, args, kwargs)
+        else:
+            refs = rt.submit_task(
+                self._func, args, kwargs,
+                num_returns=num_returns,
+                resources=_task_resources(opts),
+                max_retries=opts.get("max_retries", 0),
+                retry_exceptions=bool(opts.get("retry_exceptions", False)),
+                scheduling_strategy=opts.get("scheduling_strategy"),
+                name=opts.get("name") or self._func.__qualname__,
+                runtime_env=opts.get("runtime_env"),
+            )
         if opts.get("num_returns", 1) == 1 or opts.get("num_returns") == "dynamic":
             return refs[0]
         if opts.get("num_returns", 1) == 0:
@@ -72,6 +97,19 @@ class RemoteFunction:
     @property
     def underlying_function(self):
         return self._func
+
+    def __getstate__(self):
+        # The submit-template cache holds runtime handles (locks, sockets);
+        # it is a per-process cache, never shipped.
+        return {"_func": self._func,
+                "_default_options": self._default_options}
+
+    def __setstate__(self, state):
+        self._func = state["_func"]
+        self._default_options = state["_default_options"]
+        self._tmpl = None
+        self._tmpl_rt = None
+        functools.update_wrapper(self, self._func)
 
 
 def _task_resources(opts: Dict[str, Any]):
