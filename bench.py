@@ -39,6 +39,7 @@ PEAK_FLOPS = [
 ATTEMPTS = 4
 BACKOFFS_S = (10, 30, 60)  # between attempts
 CHILD_TIMEOUT_S = 1500     # first TPU compile can take minutes
+PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -52,6 +53,7 @@ def peak_flops_for(device_kind: str) -> float:
 def child_main() -> None:
     import numpy as np
 
+    _pin_platform()
     import jax
 
     from ray_tpu.models import llama
@@ -154,14 +156,60 @@ def accel_holders() -> list:
     return holders
 
 
+def _pin_platform() -> None:
+    """The axon TPU plugin force-appends itself to jax_platforms at import
+    time, overriding JAX_PLATFORMS=cpu — and a wedged tunnel then HANGS
+    backend init. Honor an explicit cpu request."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def probe_main() -> None:
+    """Cheap backend-liveness check: init + one tiny computation."""
+    _pin_platform()
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()
+    x = float(jnp.ones(8).sum())
+    assert x == 8.0
+    print(f"probe-ok {d[0].platform} {d[0].device_kind}")
+
+
+def _run(args: list, timeout_s: int):
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+
+
 def main() -> int:
     errors = []
     for attempt in range(ATTEMPTS):
+        # Phase 1: probe. A wedged axon tunnel HANGS in init (observed:
+        # >20min asleep in nanosleep) rather than raising — without this,
+        # each dead attempt burns the full measurement timeout.
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
+            probe = _run(["--probe"], PROBE_TIMEOUT_S)
+            if probe.returncode != 0:
+                tail = (probe.stderr or probe.stdout).strip() \
+                    .splitlines()[-4:]
+                raise RuntimeError("probe rc=%d: %s"
+                                   % (probe.returncode, " | ".join(tail)))
+        except (subprocess.TimeoutExpired, RuntimeError) as e:
+            msg = (f"attempt {attempt}: probe hang >{PROBE_TIMEOUT_S}s"
+                   if isinstance(e, subprocess.TimeoutExpired) else
+                   f"attempt {attempt}: {e}")
+            errors.append(msg)
+            print(msg + "; backing off", file=sys.stderr)
+            if attempt < ATTEMPTS - 1:
+                time.sleep(BACKOFFS_S[min(attempt, len(BACKOFFS_S) - 1)])
+            continue
+        # Phase 2: measurement.
+        try:
+            proc = _run(["--child"], CHILD_TIMEOUT_S)
         except subprocess.TimeoutExpired:
             errors.append(f"attempt {attempt}: timeout {CHILD_TIMEOUT_S}s")
             continue
@@ -196,4 +244,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         sys.exit(child_main())
+    if "--probe" in sys.argv:
+        sys.exit(probe_main())
     sys.exit(main())

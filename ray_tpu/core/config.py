@@ -145,6 +145,16 @@ _d("rpc_chaos_failure_prob", float, 0.0,
    "(src/ray/rpc/rpc_chaos.h)")
 _d("pubsub_poll_timeout_s", float, 30.0, "long-poll timeout")
 
+# --- data ---
+_d("data_memory_budget_bytes", int, 512 * 1024**2,
+   "streaming execution: target cap on bytes of blocks in flight across "
+   "all operators of one pipeline (reference: ReservationOpResourceAllocator "
+   "budgets in streaming_executor_state.py); 0 disables byte backpressure "
+   "and only the per-operator concurrency caps apply")
+_d("data_block_size_estimate", int, 8 * 1024**2,
+   "assumed block size before the first real block lands (seeds the "
+   "memory-budget admission until running averages exist)")
+
 # --- TPU / accelerator ---
 _d("tpu_chips_per_host", int, 4, "chips per TPU VM host (v5e/v5p default 4)")
 _d("tpu_slice_exclusive", bool, True,

@@ -4,17 +4,23 @@ Parity target: the reference Ray Data surface (python/ray/data/__init__ —
 Dataset, read_*/from_* constructors) over the pull-based streaming executor
 in `_streaming.py`. Blocks are column dicts of numpy arrays living in the
 shm object store; `iter_batches(device_put=...)` prefetches onto TPU.
+Plans are optimized before execution (map fusion, limit pushdown —
+`Dataset.explain()` shows the result), and execution is backpressured by a
+pipeline-wide memory budget (`data_memory_budget_bytes`).
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.dataset import (Dataset, GroupedData,
                                   MaterializedDataset,
-                                  StreamSplitIterator, from_items,
-                                  from_numpy, range, read_csv, read_json,
-                                  read_parquet)
+                                  StreamSplitIterator, from_arrow,
+                                  from_items, from_numpy, from_pandas,
+                                  range, read_binary_files, read_csv,
+                                  read_images, read_json, read_numpy,
+                                  read_parquet, read_text)
 
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "Dataset", "GroupedData",
-    "MaterializedDataset", "StreamSplitIterator", "from_items", "from_numpy",
-    "range", "read_csv", "read_json", "read_parquet",
+    "MaterializedDataset", "StreamSplitIterator", "from_arrow", "from_items",
+    "from_numpy", "from_pandas", "range", "read_binary_files", "read_csv",
+    "read_images", "read_json", "read_numpy", "read_parquet", "read_text",
 ]

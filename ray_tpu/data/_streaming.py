@@ -7,15 +7,24 @@ re-shaped: instead of a scheduling thread ranking operators by memory
 pressure, each operator is a bounded-concurrency *pull generator* over the
 upstream stream. Pulling from the sink drives the whole pipeline; blocks
 flow operator-to-operator as object refs (never materialized on the
-driver), and the in-flight caps ARE the backpressure.
+driver), and backpressure is two-tier: per-operator concurrency caps plus
+a pipeline-wide MEMORY BUDGET on bytes in flight (the reference's
+ResourceManager + backpressure_policy/ role, re-shaped for pull style).
+
+The logical plan is optimized before execution (reference:
+_internal/logical/rules/operator_fusion.py, limit_pushdown.py): adjacent
+stateless map stages fuse into one task per block, and limits push below
+row-preserving maps so work past the limit is never launched.
 """
 
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 
@@ -37,12 +46,82 @@ def _apply_batch_fn(block: Block, fn: Callable, fn_kwargs: Dict[str, Any],
     return BlockAccessor.concat(outs)
 
 
+class MapStage:
+    """One fused link of a map chain: (fn, kwargs, batch_size, pass_index)."""
+
+    __slots__ = ("fn", "kwargs", "batch_size", "pass_index", "name")
+
+    def __init__(self, fn: Callable, kwargs: Dict[str, Any],
+                 batch_size: Optional[int], pass_index: bool, name: str):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.batch_size = batch_size
+        self.pass_index = pass_index
+        self.name = name
+
+
+def _apply_stages(block: Block, stages: List[MapStage], index: int) -> Block:
+    for st in stages:
+        kw = (dict(st.kwargs, _block_index=index) if st.pass_index
+              else st.kwargs)
+        block = _apply_batch_fn(block, st.fn, kw, st.batch_size)
+    return block
+
+
+class MemoryBudget:
+    """Pipeline-wide cap on bytes of blocks in flight. Admission is
+    optimistic for the first block of each operator (a pipeline must never
+    deadlock at zero concurrency), strict beyond."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit = limit_bytes
+        self._used = 0
+        self._lock = threading.Lock()
+
+    def can_admit(self, estimate: int, holding: int) -> bool:
+        """holding: bytes this operator already has in flight — an operator
+        with nothing in flight is always admitted (liveness)."""
+        if self.limit <= 0:
+            return True
+        with self._lock:
+            return holding == 0 or self._used + estimate <= self.limit
+
+    def acquire(self, n: int) -> None:
+        if self.limit <= 0:
+            return
+        with self._lock:
+            self._used += n
+
+    def release(self, n: int) -> None:
+        if self.limit <= 0:
+            return
+        with self._lock:
+            self._used -= n
+
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+
+class ExecContext:
+    """Per-execution shared state handed to every operator."""
+
+    def __init__(self, memory_budget_bytes: Optional[int] = None):
+        self.budget = MemoryBudget(
+            cfg.data_memory_budget_bytes if memory_budget_bytes is None
+            else memory_budget_bytes)
+
+
 class Operator:
     """One stage: transforms an upstream iterator of RefBundles."""
 
     name: str = "op"
+    #: True when the op emits exactly the rows it receives (1:1, no
+    #: reorder) — the condition for limit pushdown.
+    preserves_rows: bool = False
 
-    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+    def execute(self, upstream: Iterator[RefBundle],
+                ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
         raise NotImplementedError
 
 
@@ -56,8 +135,11 @@ class InputOperator(Operator):
         self._tasks = read_tasks
         self._parallelism = parallelism
 
-    def execute(self, upstream) -> Iterator[RefBundle]:
+    def execute(self, upstream, ctx: Optional[ExecContext] = None
+                ) -> Iterator[RefBundle]:
         assert upstream is None
+        budget = ctx.budget if ctx else None
+        est = cfg.data_block_size_estimate
 
         # num_returns=2: the BLOCK stays in the executing worker's store —
         # only the (tiny) metadata is fetched to the driver. Blocks move
@@ -68,13 +150,28 @@ class InputOperator(Operator):
             return block, BlockMetadata.of(block)
 
         pending = collections.deque(self._tasks)
-        in_flight: List[List[ObjectRef]] = []
+        in_flight: collections.deque = collections.deque()
+        holding = 0
         while pending or in_flight:
-            while pending and len(in_flight) < self._parallelism:
-                in_flight.append(_read.remote(pending.popleft()))
+            while pending and len(in_flight) < self._parallelism and (
+                    budget is None or budget.can_admit(est, holding)):
+                # Record the estimate ACQUIRED with each entry: `est` is
+                # refined over time, and releasing a different value than
+                # acquired would drift the shared budget counter.
+                in_flight.append((_read.remote(pending.popleft()), est))
+                if budget is not None:
+                    budget.acquire(est)
+                    holding += est
             # Preserve input order: wait on the OLDEST in-flight read.
-            block_ref, meta_ref = in_flight.pop(0)
-            yield block_ref, ray_tpu.get(meta_ref)
+            (block_ref, meta_ref), est0 = in_flight.popleft()
+            meta = ray_tpu.get(meta_ref)
+            if budget is not None:
+                budget.release(est0)
+                holding -= est0
+                # Refine the estimate with observed sizes.
+                if meta.size_bytes:
+                    est = max(1, (est + meta.size_bytes) // 2)
+            yield block_ref, meta
 
 
 class TaskPoolMapOperator(Operator):
@@ -82,40 +179,76 @@ class TaskPoolMapOperator(Operator):
 
     Completion order is preserved (FIFO) so downstream sees deterministic
     block order; the bounded window still overlaps up to `concurrency`
-    transforms with upstream reads and downstream consumption.
-    """
+    transforms with upstream reads and downstream consumption. Holds a
+    CHAIN of fused stages: the optimizer merges adjacent map operators so
+    one task applies the whole chain per block (reference:
+    logical/rules/operator_fusion.py)."""
 
     def __init__(self, fn: Callable, *, batch_size: Optional[int] = None,
                  fn_kwargs: Optional[Dict[str, Any]] = None,
                  concurrency: int = 4, name: str = "map_batches",
-                 pass_index: bool = False):
-        self._fn = fn
-        self._kwargs = fn_kwargs or {}
-        self._batch_size = batch_size
+                 pass_index: bool = False, preserves_rows: bool = False):
+        self.stages: List[MapStage] = [MapStage(
+            fn, fn_kwargs or {}, batch_size, pass_index, name)]
         self._concurrency = concurrency
         self.name = name
-        # pass_index: fn also receives `_block_index=` (per-block seeds etc).
-        self._pass_index = pass_index
+        self.preserves_rows = preserves_rows
 
-    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
-        fn, kwargs, bs = self._fn, self._kwargs, self._batch_size
-        pass_index = self._pass_index
+    def can_fuse(self, other: "TaskPoolMapOperator") -> bool:
+        return isinstance(other, TaskPoolMapOperator)
+
+    def fused_with(self, other: "TaskPoolMapOperator") -> "TaskPoolMapOperator":
+        out = TaskPoolMapOperator(
+            lambda b: b, concurrency=min(self._concurrency,
+                                         other._concurrency))
+        out.stages = self.stages + other.stages
+        out.name = "+".join(st.name for st in out.stages)
+        out.preserves_rows = self.preserves_rows and other.preserves_rows
+        return out
+
+    def execute(self, upstream: Iterator[RefBundle],
+                ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
+        stages = self.stages
+        budget = ctx.budget if ctx else None
 
         @ray_tpu.remote(num_returns=2)
         def _transform(block: Block, index: int):
-            kw = dict(kwargs, _block_index=index) if pass_index else kwargs
-            out = _apply_batch_fn(block, fn, kw, bs)
+            out = _apply_stages(block, stages, index)
             return out, BlockMetadata.of(out)
 
         window: collections.deque = collections.deque()
-        for i, (ref, _meta) in enumerate(upstream):
-            window.append(_transform.remote(ref, i))
+        holding = 0
+        i = 0
+        for ref, meta in upstream:
+            est = meta.size_bytes or cfg.data_block_size_estimate
+            # Byte backpressure: drain completed work until this block is
+            # admissible (an operator holding nothing always admits one).
+            while window and budget is not None and not budget.can_admit(
+                    est, holding):
+                block_ref, meta_ref, est0 = window.popleft()
+                m = ray_tpu.get(meta_ref)
+                budget.release(est0)
+                holding -= est0
+                yield block_ref, m
+            if budget is not None:
+                budget.acquire(est)
+                holding += est
+            window.append((*_transform.remote(ref, i), est))
+            i += 1
             if len(window) >= self._concurrency:
-                block_ref, meta_ref = window.popleft()
-                yield block_ref, ray_tpu.get(meta_ref)
+                block_ref, meta_ref, est0 = window.popleft()
+                m = ray_tpu.get(meta_ref)
+                if budget is not None:
+                    budget.release(est0)
+                    holding -= est0
+                yield block_ref, m
         while window:
-            block_ref, meta_ref = window.popleft()
-            yield block_ref, ray_tpu.get(meta_ref)
+            block_ref, meta_ref, est0 = window.popleft()
+            m = ray_tpu.get(meta_ref)
+            if budget is not None:
+                budget.release(est0)
+                holding -= est0
+            yield block_ref, m
 
 
 class ActorPoolMapOperator(Operator):
@@ -138,9 +271,11 @@ class ActorPoolMapOperator(Operator):
         self._resources = resources
         self.name = name
 
-    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+    def execute(self, upstream: Iterator[RefBundle],
+                ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
         fn_cls, ctor, kwargs, bs = (self._fn_cls, self._ctor_kwargs,
                                     self._kwargs, self._batch_size)
+        budget = ctx.budget if ctx else None
 
         class _MapWorker:
             def __init__(self):
@@ -161,17 +296,37 @@ class ActorPoolMapOperator(Operator):
             # guaranteed by the actor runtime, cross-actor by the window).
             # num_returns=2 as above: blocks stay off the driver.
             window: collections.deque = collections.deque()
+            holding = 0
             i = 0
-            for ref, _meta in upstream:
-                window.append(pool[i % len(pool)].transform.options(
-                    num_returns=2).remote(ref))
+            for ref, meta in upstream:
+                est = meta.size_bytes or cfg.data_block_size_estimate
+                while window and budget is not None and not budget.can_admit(
+                        est, holding):
+                    block_ref, meta_ref, est0 = window.popleft()
+                    m = ray_tpu.get(meta_ref)
+                    budget.release(est0)
+                    holding -= est0
+                    yield block_ref, m
+                if budget is not None:
+                    budget.acquire(est)
+                    holding += est
+                window.append((*pool[i % len(pool)].transform.options(
+                    num_returns=2).remote(ref), est))
                 i += 1
                 if len(window) >= 2 * len(pool):
-                    block_ref, meta_ref = window.popleft()
-                    yield block_ref, ray_tpu.get(meta_ref)
+                    block_ref, meta_ref, est0 = window.popleft()
+                    m = ray_tpu.get(meta_ref)
+                    if budget is not None:
+                        budget.release(est0)
+                        holding -= est0
+                    yield block_ref, m
             while window:
-                block_ref, meta_ref = window.popleft()
-                yield block_ref, ray_tpu.get(meta_ref)
+                block_ref, meta_ref, est0 = window.popleft()
+                m = ray_tpu.get(meta_ref)
+                if budget is not None:
+                    budget.release(est0)
+                    holding -= est0
+                yield block_ref, m
         finally:
             for a in pool:
                 try:
@@ -189,13 +344,96 @@ class DriverOperator(Operator):
         self._gen = gen_fn
         self.name = name
 
-    def execute(self, upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+    def execute(self, upstream: Iterator[RefBundle],
+                ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
         return self._gen(upstream)
 
 
+class LimitOperator(Operator):
+    """Truncate the stream to n rows. A distinct class (not a bare
+    DriverOperator) so the optimizer can recognize and push it below
+    row-preserving maps (reference: logical/rules/limit_pushdown.py)."""
+
+    preserves_rows = False  # it drops rows — but commutes with 1:1 maps
+
+    def __init__(self, n: int):
+        self.n = n
+        self.name = f"limit({n})"
+
+    def execute(self, upstream: Iterator[RefBundle],
+                ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
+        remaining = self.n
+        for ref, meta in upstream:
+            if remaining <= 0:
+                return
+            if meta.num_rows <= remaining:
+                remaining -= meta.num_rows
+                yield ref, meta
+            else:
+                block = BlockAccessor(ray_tpu.get(ref)).slice(0, remaining)
+                remaining = 0
+                yield ray_tpu.put(block), BlockMetadata.of(block)
+
+
+# --------------------------------------------------------------------------
+# Plan optimizer
+# --------------------------------------------------------------------------
+
+
+def optimize_plan(ops: List[Operator]) -> List[Operator]:
+    """Rule passes over the operator chain (reference:
+    logical/interfaces/optimizer.py Rule/Optimizer):
+      1. limit pushdown — move LimitOperator below row-preserving maps so
+         the limit truncates the stream BEFORE transform work launches;
+      2. map fusion — merge adjacent stateless TaskPoolMapOperators into
+         one operator applying the fused stage chain (one task per block
+         instead of one per stage)."""
+    ops = list(ops)
+
+    # Rule 1: limit pushdown. Repeatedly swap (row-preserving map, limit)
+    # pairs — the limit also STAYS nowhere else: a 1:1 map emits exactly
+    # the rows it gets, so limit-then-map == map-then-limit.
+    changed = True
+    while changed:
+        changed = False
+        for i in range(len(ops) - 1):
+            if (isinstance(ops[i + 1], LimitOperator)
+                    and ops[i].preserves_rows):
+                ops[i], ops[i + 1] = ops[i + 1], ops[i]
+                changed = True
+
+    # Rule 2: fuse adjacent task-pool maps.
+    fused: List[Operator] = []
+    for op in ops:
+        if (fused and isinstance(op, TaskPoolMapOperator)
+                and isinstance(fused[-1], TaskPoolMapOperator)
+                and fused[-1].can_fuse(op)):
+            fused[-1] = fused[-1].fused_with(op)
+        else:
+            fused.append(op)
+    return fused
+
+
 def execute_plan(input_op: InputOperator,
-                 operators: List[Operator]) -> Iterator[RefBundle]:
-    stream = input_op.execute(None)
-    for op in operators:
-        stream = op.execute(stream)
+                 operators: List[Operator],
+                 memory_budget_bytes: Optional[int] = None
+                 ) -> Iterator[RefBundle]:
+    ctx = ExecContext(memory_budget_bytes)
+    stream = input_op.execute(None, ctx)
+    for op in optimize_plan(operators):
+        stream = op.execute(stream, ctx)
     return stream
+
+
+def explain_plan(input_op: InputOperator,
+                 operators: List[Operator]) -> str:
+    """The optimized plan, one operator per line (reference: the logical
+    plan dump users get from Dataset.explain())."""
+    lines = [f"input[{len(input_op._tasks)} read tasks, "
+             f"parallelism={input_op._parallelism}]"]
+    for op in optimize_plan(operators):
+        if isinstance(op, TaskPoolMapOperator) and len(op.stages) > 1:
+            lines.append(f"fused_map[{op.name}]")
+        else:
+            lines.append(op.name)
+    return " -> ".join(lines)

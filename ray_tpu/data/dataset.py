@@ -23,8 +23,9 @@ _range = range  # the module-level `range` READER below shadows the builtin
 import ray_tpu
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.data._streaming import (ActorPoolMapOperator, DriverOperator,
-                                     InputOperator, Operator, RefBundle,
-                                     TaskPoolMapOperator, execute_plan)
+                                     InputOperator, LimitOperator, Operator,
+                                     RefBundle, TaskPoolMapOperator,
+                                     execute_plan, explain_plan)
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 
 
@@ -65,7 +66,8 @@ class Dataset:
             rows = [fn(r) for r in BlockAccessor(batch).iter_rows()]
             return BlockAccessor.normalize(rows)
 
-        return self._with_op(TaskPoolMapOperator(batch_fn, name="map"))
+        return self._with_op(TaskPoolMapOperator(batch_fn, name="map",
+                                                 preserves_rows=True))
 
     def filter(self, fn) -> "Dataset":
         def batch_fn(batch: Block) -> Block:
@@ -86,20 +88,17 @@ class Dataset:
         return self._with_op(TaskPoolMapOperator(batch_fn, name="flat_map"))
 
     def limit(self, n: int) -> "Dataset":
-        def gen(upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
-            remaining = n
-            for ref, meta in upstream:
-                if remaining <= 0:
-                    return
-                if meta.num_rows <= remaining:
-                    remaining -= meta.num_rows
-                    yield ref, meta
-                else:
-                    block = BlockAccessor(ray_tpu.get(ref)).slice(0, remaining)
-                    remaining = 0
-                    yield ray_tpu.put(block), BlockMetadata.of(block)
+        return self._with_op(LimitOperator(n))
 
-        return self._with_op(DriverOperator(gen, name=f"limit({n})"))
+    def explain(self) -> str:
+        """The OPTIMIZED execution plan as a string — fused map chains
+        appear as one ``fused_map[...]`` stage, pushed-down limits appear
+        below the maps they commuted past (reference: the logical-plan
+        dump after rules in _internal/logical/optimizers.py)."""
+        return explain_plan(
+            InputOperator(self._read_tasks,
+                          parallelism=self._read_parallelism),
+            self._ops)
 
     # ------------------------------------------------- all-to-all exchanges
 
@@ -282,6 +281,74 @@ class Dataset:
     def materialize(self) -> "MaterializedDataset":
         bundles = list(self._stream())
         return MaterializedDataset(bundles)
+
+    # ------------------------------------------------------------- writers
+
+    def _write(self, path: str, writer: Callable[[Block, str], None],
+               suffix: str, concurrency: int = 4) -> List[str]:
+        """Distributed write: one task per block emits one part file
+        (reference: Dataset.write_* -> per-block write tasks). Returns the
+        written file paths."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_block(ref_block: Block, out_path: str) -> str:
+            writer(ref_block, out_path)
+            return out_path
+
+        window: List[Any] = []
+        out_paths: List[str] = []
+        for i, (ref, _meta) in enumerate(self._stream()):
+            part = os.path.join(path, f"part-{i:05d}{suffix}")
+            window.append(_write_block.remote(ref, part))
+            if len(window) >= concurrency:
+                out_paths.append(ray_tpu.get(window.pop(0)))
+        out_paths.extend(ray_tpu.get(window))
+        return out_paths
+
+    def write_parquet(self, path: str) -> List[str]:
+        def writer(block: Block, out: str) -> None:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(
+                pa.table({k: pa.array(v) for k, v in block.items()}), out)
+
+        return self._write(path, writer, ".parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        def writer(block: Block, out: str) -> None:
+            import csv
+
+            cols = list(block.keys())
+            with open(out, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(cols)
+                for row in zip(*(block[c] for c in cols)):
+                    w.writerow(row)
+
+        return self._write(path, writer, ".csv")
+
+    def write_json(self, path: str) -> List[str]:
+        def writer(block: Block, out: str) -> None:
+            import json
+
+            cols = list(block.keys())
+            with open(out, "w") as f:
+                for row in zip(*(block[c] for c in cols)):
+                    f.write(json.dumps({c: (v.item()
+                                            if hasattr(v, "item") else v)
+                                        for c, v in zip(cols, row)}) + "\n")
+
+        return self._write(path, writer, ".jsonl")
+
+    def write_numpy(self, path: str, column: str) -> List[str]:
+        def writer(block: Block, out: str) -> None:
+            np.save(out, block[column])
+
+        return self._write(path, writer, ".npy")
 
     # ------------------------------------------------------------ splits
 
@@ -587,6 +654,92 @@ def read_json(paths, *, parallelism: int = 4) -> Dataset:
 
     return Dataset([functools.partial(read_one, f) for f in files],
                    read_parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = 4,
+              encoding: str = "utf-8") -> Dataset:
+    """One row per line, column ``text`` (reference read_api.read_text)."""
+    files = _expand_paths(paths, (".txt", ".text"))
+
+    def read_one(path: str) -> Block:
+        with open(path, encoding=encoding) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.array(lines, dtype=object)}
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = 4) -> Dataset:
+    """.npy -> column ``data``; .npz -> one column per archive member
+    (reference read_api.read_numpy)."""
+    files = _expand_paths(paths, (".npy", ".npz"))
+
+    def read_one(path: str) -> Block:
+        loaded = np.load(path, allow_pickle=False)
+        if isinstance(loaded, np.ndarray):
+            return {"data": loaded}
+        return {k: loaded[k] for k in loaded.files}
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = 4,
+                      include_paths: bool = True) -> Dataset:
+    """Whole files as rows: columns ``bytes`` (+ ``path``) — the
+    reference's read_binary_files, the escape hatch every custom format
+    starts from."""
+    files = _expand_paths(paths, ("",))
+
+    def read_one(path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        out: Dict[str, np.ndarray] = {
+            "bytes": np.array([data], dtype=object)}
+        if include_paths:
+            out["path"] = np.array([path], dtype=object)
+        return out
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def read_images(paths, *, parallelism: int = 4,
+                include_paths: bool = False) -> Dataset:
+    """Decoded images as HWC uint8 arrays in column ``image`` (reference
+    read_api.read_images). Requires PIL; raises ImportError without it."""
+    from PIL import Image  # noqa: F401 — fail fast at plan build time
+
+    files = _expand_paths(paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif"))
+
+    def read_one(path: str) -> Block:
+        from PIL import Image as _Image
+
+        arr = np.asarray(_Image.open(path).convert("RGB"))
+        out: Dict[str, np.ndarray] = {
+            "image": np.empty(1, dtype=object)}
+        out["image"][0] = arr
+        if include_paths:
+            out["path"] = np.array([path], dtype=object)
+        return out
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 4) -> Dataset:
+    """One Dataset from a pandas DataFrame (reference from_pandas)."""
+    return from_numpy({c: df[c].to_numpy() for c in df.columns},
+                      parallelism=parallelism)
+
+
+def from_arrow(table, *, parallelism: int = 4) -> Dataset:
+    """One Dataset from a pyarrow Table (reference from_arrow)."""
+    return from_numpy(
+        {name: np.asarray(col) for name, col in
+         zip(table.column_names, table.columns)},
+        parallelism=parallelism)
 
 
 def _expand_paths(paths, suffixes) -> List[str]:

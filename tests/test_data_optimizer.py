@@ -1,0 +1,164 @@
+"""Plan optimizer (map fusion, limit pushdown), memory backpressure, and
+connector breadth (reference test model: python/ray/data/tests/
+test_execution_optimizer.py, test_backpressure_policies.py,
+test_numpy.py / test_text.py / test_binary.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.data._streaming import (InputOperator, LimitOperator,
+                                     MemoryBudget, TaskPoolMapOperator,
+                                     optimize_plan)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- optimizer
+
+def test_map_chain_fuses_to_one_operator(cluster):
+    ds = (rdata.range(32)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map_batches(lambda b: {"id": b["id"] + 1})
+          .map_batches(lambda b: {"id": b["id"] * 10}))
+    plan = ds.explain()
+    assert "fused_map" in plan, plan
+    # All three stages became ONE operator.
+    assert plan.count("map_batches") == 3 and plan.count("->") == 1, plan
+    assert [r["id"] for r in ds.take(4)] == [10, 30, 50, 70]
+
+
+def test_fusion_preserves_stage_order(cluster):
+    # (x*2)+1 != (x+1)*2 — fusion must apply stages in plan order.
+    ds = (rdata.range(8)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map_batches(lambda b: {"id": b["id"] + 1}))
+    assert [r["id"] for r in ds.take_all()] == [2 * i + 1 for i in range(8)]
+
+
+def test_limit_pushes_below_row_preserving_map(cluster):
+    ds = rdata.range(100).map(lambda r: {"id": r["id"] * 3}).limit(5)
+    plan = ds.explain()
+    # The pushed-down limit appears BEFORE the map in the plan.
+    assert plan.index("limit(5)") < plan.index("map"), plan
+    assert [r["id"] for r in ds.take_all()] == [0, 3, 6, 9, 12]
+
+
+def test_limit_does_not_push_below_filter(cluster):
+    ds = rdata.range(100).filter(lambda r: r["id"] % 2 == 1).limit(3)
+    plan = ds.explain()
+    assert plan.index("filter") < plan.index("limit(3)"), plan
+    assert [r["id"] for r in ds.take_all()] == [1, 3, 5]
+
+
+def test_optimize_plan_unit():
+    m1 = TaskPoolMapOperator(lambda b: b, name="a", preserves_rows=True)
+    m2 = TaskPoolMapOperator(lambda b: b, name="b", preserves_rows=True)
+    lim = LimitOperator(7)
+    out = optimize_plan([m1, m2, lim])
+    # limit hoisted to the front, then the two maps fused into one.
+    assert isinstance(out[0], LimitOperator)
+    assert len(out) == 2 and len(out[1].stages) == 2
+    assert [st.name for st in out[1].stages] == ["a", "b"]
+
+
+# ------------------------------------------------------------- backpressure
+
+def test_memory_budget_admission_unit():
+    b = MemoryBudget(100)
+    assert b.can_admit(60, holding=0)      # first block always admits
+    b.acquire(60)
+    assert not b.can_admit(60, holding=60)  # would exceed the cap
+    assert b.can_admit(60, holding=0)       # another op's first block: yes
+    b.release(60)
+    assert b.can_admit(60, holding=60)
+    assert MemoryBudget(0).can_admit(1 << 60, holding=1)  # 0 disables
+
+
+def test_pipeline_respects_memory_budget(cluster):
+    # Blocks of ~0.8MB with a 2MB budget: in-flight bytes must stay far
+    # below the unbudgeted case (16 blocks * 0.8MB ≈ 13MB).
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    old = cfg.data_memory_budget_bytes
+    cfg._values["data_memory_budget_bytes"] = 2 * 1024 * 1024
+    try:
+        ds = rdata.from_numpy(
+            {"x": np.zeros((16 * 100_000,), dtype=np.float64)},
+            parallelism=16).map_batches(lambda b: {"x": b["x"] * 2})
+        total = 0
+        for batch in ds.iter_batches(batch_size=None):
+            total += len(batch["x"])
+        assert total == 16 * 100_000
+    finally:
+        cfg._values["data_memory_budget_bytes"] = old
+
+
+# --------------------------------------------------------------- connectors
+
+def test_read_text_roundtrip(cluster, tmp_path):
+    p = tmp_path / "notes.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    rows = rdata.read_text(str(p)).take_all()
+    assert [r["text"] for r in rows] == ["alpha", "beta", "gamma"]
+
+
+def test_read_numpy_npy_npz(cluster, tmp_path):
+    np.save(tmp_path / "a.npy", np.arange(10))
+    rows = rdata.read_numpy(str(tmp_path / "a.npy")).take_all()
+    assert [r["data"] for r in rows] == list(range(10))
+    np.savez(tmp_path / "b.npz", p=np.arange(4), q=np.arange(4) * 2)
+    ds = rdata.read_numpy(str(tmp_path / "b.npz"))
+    rows = ds.take_all()
+    assert len(rows) == 4 and rows[3]["q"] == 6
+
+
+def test_read_binary_files(cluster, tmp_path):
+    (tmp_path / "x.bin").write_bytes(b"\x01\x02\x03")
+    (tmp_path / "y.bin").write_bytes(b"\xff" * 5)
+    rows = rdata.read_binary_files(
+        [str(tmp_path / "x.bin"), str(tmp_path / "y.bin")]).take_all()
+    assert rows[0]["bytes"] == b"\x01\x02\x03"
+    assert len(rows[1]["bytes"]) == 5
+    assert rows[0]["path"].endswith("x.bin")
+
+
+def test_from_pandas_and_arrow(cluster):
+    pd = pytest.importorskip("pandas")
+    pa = pytest.importorskip("pyarrow")
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    rows = rdata.from_pandas(df).take_all()
+    assert [r["a"] for r in rows] == [1, 2, 3]
+    t = pa.table({"c": [10, 20]})
+    rows = rdata.from_arrow(t).take_all()
+    assert [r["c"] for r in rows] == [10, 20]
+
+
+def test_write_parquet_roundtrip(cluster, tmp_path):
+    pytest.importorskip("pyarrow")
+    out = str(tmp_path / "out_pq")
+    files = rdata.range(50, parallelism=4).write_parquet(out)
+    assert len(files) == 4
+    back = rdata.read_parquet(out)
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+
+def test_write_csv_json_roundtrip(cluster, tmp_path):
+    ds = rdata.from_items([{"k": i, "v": float(i)} for i in range(20)],
+                          parallelism=2)
+    csv_files = ds.write_csv(str(tmp_path / "out_csv"))
+    assert len(csv_files) == 2
+    back = rdata.read_csv(str(tmp_path / "out_csv"))
+    assert sorted(int(r["k"]) for r in back.take_all()) == list(range(20))
+    json_files = ds.write_json(str(tmp_path / "out_json"))
+    assert len(json_files) == 2
+    back = rdata.read_json(str(tmp_path / "out_json"))
+    assert sorted(int(r["k"]) for r in back.take_all()) == list(range(20))
