@@ -26,6 +26,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.cluster.protocol import ConnectionLost
 from ray_tpu.core import runtime_context
 from ray_tpu.core.cluster_core import ClusterCore
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg

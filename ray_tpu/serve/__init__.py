@@ -24,7 +24,7 @@ __all__ = [
 
 
 def start_http(host: str = "127.0.0.1", port: int = 0):
-    """Start an HTTP ingress actor; returns (handle, port)."""
+    """Start one asyncio HTTP ingress actor; returns (handle, port)."""
     import ray_tpu
     from ray_tpu.serve._private.proxy import HTTPProxyActor
 
@@ -33,3 +33,15 @@ def start_http(host: str = "127.0.0.1", port: int = 0):
     # The port is assigned inside the actor; fetch it.
     addr = ray_tpu.get(actor.address.remote(), timeout=60)
     return actor, int(addr.rsplit(":", 1)[1])
+
+
+def start_http_per_node(host: str = "127.0.0.1"):
+    """One proxy actor per alive node, reconciled by the controller
+    (new nodes get proxies, dead proxies respawn — reference:
+    ProxyStateManager). Returns {node_id: \"host:port\"}."""
+    import ray_tpu
+    from ray_tpu.serve.api import _get_or_start_controller
+
+    controller = _get_or_start_controller()
+    return ray_tpu.get(controller.start_http_proxies.remote(host),
+                       timeout=120)

@@ -30,9 +30,27 @@ class ServeController:
         self._reconcile_mutex = threading.Lock()
         # name -> {config..., replicas: [ActorHandle], version}
         self._deployments: Dict[str, Dict[str, Any]] = {}
+        # Replica-SET versions + condvar: routers long-poll
+        # listen_for_change instead of polling get_replicas on a timer
+        # (reference: long_poll.py:204 LongPollHost).
+        self._set_versions: Dict[str, int] = {}
+        self._set_cond = threading.Condition(self._lock)
+        # node_id -> (proxy actor, address); reconciled to one per node
+        # when HTTP is enabled (reference: proxy_state.py ProxyStateManager).
+        self._proxies: Dict[str, Any] = {}
+        self._http_cfg: Any = None
+        # Serializes _ensure_proxies (user RPC vs reconcile loop): two
+        # concurrent passes would each spawn a proxy for the same node and
+        # the overwritten handle would leak its actor forever.
+        self._proxy_mutex = threading.Lock()
         self._shutdown = False
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()
+
+    def _bump_set(self, name: str) -> None:
+        """Callers hold self._lock. Wakes every long-poller."""
+        self._set_versions[name] = self._set_versions.get(name, 0) + 1
+        self._set_cond.notify_all()
 
     # ------------------------------------------------------------- deploy
 
@@ -55,12 +73,15 @@ class ServeController:
                 # Code/config changed: replace the replica set.
                 self._stop_replicas(d["replicas"])
                 d["replicas"] = []
+                self._bump_set(name)
         self._reconcile_once(name)
         return True
 
     def delete(self, name: str) -> bool:
         with self._lock:
             d = self._deployments.pop(name, None)
+            if d is not None:
+                self._bump_set(name)
         if d:
             self._stop_replicas(d["replicas"])
         return d is not None
@@ -68,10 +89,22 @@ class ServeController:
     def shutdown(self) -> bool:
         with self._lock:
             self._shutdown = True
+            self._http_cfg = None  # reconcile must not respawn proxies
             deps = list(self._deployments.values())
+            names = list(self._deployments)
             self._deployments.clear()
+            for n in names:
+                self._bump_set(n)
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
         for d in deps:
             self._stop_replicas(d["replicas"])
+        for actor, _addr in proxies:
+            try:
+                self._ray.get(actor.stop.remote(), timeout=5)
+                self._ray.kill(actor)
+            except Exception:
+                pass
         return True
 
     def _stop_replicas(self, replicas: List[Any],
@@ -175,12 +208,14 @@ class ServeController:
                 d2 = self._deployments.get(name)
                 if d2 is d:
                     d["replicas"].extend(new)
+                    self._bump_set(name)
                 else:
                     self._stop_replicas(new)
         elif to_add < 0:
             with self._lock:
                 victims = d["replicas"][to_add:]
                 del d["replicas"][to_add:]
+                self._bump_set(name)
             self._stop_replicas(victims)
 
     def _reconcile_loop(self) -> None:
@@ -192,6 +227,10 @@ class ServeController:
                 except Exception:
                     pass
             self._check_replica_health()
+            try:
+                self._ensure_proxies()
+            except Exception:
+                pass
 
     def _check_replica_health(self) -> None:
         """Dead replicas are pruned; reconcile replaces them next tick."""
@@ -211,6 +250,7 @@ class ServeController:
                     if d:
                         d["replicas"] = [r for r in d["replicas"]
                                          if r not in dead]
+                        self._bump_set(name)
                 # Kill pruned replicas: a half-dead process left running
                 # would leak its lease/worker forever.
                 for r in dead:
@@ -227,6 +267,115 @@ class ServeController:
             if d is None:
                 raise KeyError(f"no deployment named {name!r}")
             return list(d["replicas"])
+
+    def get_replica_set(self, name: str):
+        """(set_version, replicas) — the long-poll seed."""
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(f"no deployment named {name!r}")
+            return self._set_versions.get(name, 0), list(d["replicas"])
+
+    def listen_for_change(self, name: str, known_version: int,
+                          timeout: float = 30.0):
+        """Long-poll: blocks until the replica set's version moves past
+        ``known_version`` (or timeout), then returns (version, replicas) —
+        replicas is None when the deployment was deleted (reference:
+        LongPollHost.listen_for_change, long_poll.py:269). Routers get
+        set changes PUSHED within one RPC round instead of discovering
+        them on a poll timer."""
+        deadline = time.monotonic() + timeout
+        with self._set_cond:
+            while True:
+                d = self._deployments.get(name)
+                v = self._set_versions.get(name, 0)
+                if v != known_version:
+                    return v, (None if d is None else list(d["replicas"]))
+                # Version unchanged: PARK — including for a deleted
+                # deployment (the caller already saw the deletion at this
+                # version; returning early would turn its poll loop into a
+                # 1-RPC/s spin until redeploy).
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return v, (None if d is None else list(d["replicas"]))
+                self._set_cond.wait(remaining)
+
+    # -------------------------------------------------------- HTTP proxies
+
+    def start_http_proxies(self, host: str = "127.0.0.1") -> Dict[str, str]:
+        """One proxy actor per alive node (reference: ProxyStateManager,
+        proxy_state.py) — reconciled continuously: new nodes get a proxy,
+        dead proxies are respawned. Returns {node_id: address}."""
+        with self._lock:
+            self._http_cfg = host
+        self._ensure_proxies()
+        with self._lock:
+            return {nid: addr for nid, (_a, addr) in self._proxies.items()}
+
+    def list_proxies(self) -> Dict[str, str]:
+        with self._lock:
+            return {nid: addr for nid, (_a, addr) in self._proxies.items()}
+
+    def _ensure_proxies(self) -> None:
+        with self._proxy_mutex:
+            self._ensure_proxies_locked()
+
+    def _ensure_proxies_locked(self) -> None:
+        with self._lock:
+            host = self._http_cfg
+        if host is None or self._shutdown:
+            return
+        from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+        from ray_tpu.serve._private.proxy import HTTPProxyActor
+        from ray_tpu.util import state as state_api
+
+        try:
+            nodes = [n for n in state_api.list_nodes()
+                     if n.get("alive", True)]
+        except Exception:
+            return
+        alive_ids = {n["node_id"] for n in nodes}
+        with self._lock:
+            have = dict(self._proxies)
+        # Reap proxies on dead nodes / dead proxy actors.
+        for nid, (actor, _addr) in have.items():
+            dead = nid not in alive_ids
+            if not dead:
+                try:
+                    self._ray.get(actor.healthy.remote(), timeout=5)
+                except Exception:
+                    dead = True
+            if dead:
+                with self._lock:
+                    self._proxies.pop(nid, None)
+                try:
+                    self._ray.kill(actor)
+                except Exception:
+                    pass
+        for nid in alive_ids:
+            with self._lock:
+                if nid in self._proxies:
+                    continue
+            try:
+                actor = self._ray.remote(HTTPProxyActor).options(
+                    num_cpus=0, max_concurrency=8,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=nid, soft=True)).remote(host, 0)
+                addr = self._ray.get(actor.address.remote(), timeout=60)
+            except Exception:
+                continue
+            with self._lock:
+                if self._shutdown or self._http_cfg is None:
+                    keep = False
+                else:
+                    keep = True
+                    self._proxies[nid] = (actor, addr)
+            if not keep:
+                try:
+                    self._ray.kill(actor)
+                except Exception:
+                    pass
+                return
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:

@@ -1,14 +1,17 @@
-"""HTTP ingress: JSON-over-HTTP routed to deployment handles.
+"""HTTP ingress: asyncio (aiohttp) proxy routing to deployment handles.
 
-Parity target: reference python/ray/serve/proxy.py (ProxyActor :1129,
-HTTPProxy :752) trimmed to the -lite surface: a proxy actor runs a
-threaded stdlib HTTP server; `POST /<deployment>` with a JSON body calls
-the deployment (pow-2 routed) and returns the JSON result. `GET
-/-/healthz` for liveness, `GET /-/routes` lists deployments.
+Parity target: reference python/ray/serve/_private/proxy.py (ProxyActor
+:1129, HTTPProxy :752 — uvicorn/ASGI): an event-loop data plane where one
+loop multiplexes every in-flight request over awaited object refs, instead
+of parking one thread per request (the previous stdlib
+BaseHTTPRequestHandler design collapsed under concurrency). Endpoints:
+`POST /<deployment>[/<method>][?stream=1]` with a JSON body,
+`GET /-/healthz` liveness, `GET /-/routes` deployment listing.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
 from typing import Any, Dict
@@ -16,116 +19,169 @@ from typing import Any, Dict
 
 class HTTPProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        from http.server import (BaseHTTPRequestHandler,
-                                 ThreadingHTTPServer)
+        self._host = host
+        self._handles: Dict[str, Any] = {}
+        self.port = None
+        self._loop = asyncio.new_event_loop()
+        started = threading.Event()
+        boot_err: list = []
+
+        def run_loop():
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._start(host, port))
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                boot_err.append(e)
+                started.set()
+                return
+            started.set()
+            self._loop.run_forever()
+
+        threading.Thread(target=run_loop, daemon=True,
+                         name="serve-http-loop").start()
+        if not started.wait(30) or boot_err:
+            raise RuntimeError(f"proxy failed to start: "
+                               f"{boot_err[0] if boot_err else 'timeout'}")
+
+    async def _start(self, host: str, port: int) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/-/healthz", self._healthz)
+        app.router.add_get("/-/routes", self._routes)
+        app.router.add_post("/{tail:.*}", self._post)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._runner = runner
+
+    # ------------------------------------------------------------ handlers
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response({"status": "ok"})
+
+    async def _routes(self, request):
+        from aiohttp import web
 
         from ray_tpu.serve import api as serve_api
 
-        handles: Dict[str, Any] = {}
-        get_handle = serve_api.get_deployment_handle
-        list_status = serve_api.status
+        try:
+            # status() RPCs the controller — run off-loop.
+            payload = await asyncio.get_event_loop().run_in_executor(
+                None, serve_api.status)
+            return web.json_response(payload)
+        except Exception as e:  # noqa: BLE001 — surfaced as 500
+            return web.json_response({"error": str(e)}, status=500)
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
+    def _get_handle(self, name: str):
+        from ray_tpu.serve import api as serve_api
+
+        h = self._handles.get(name)
+        if h is None:
+            h = self._handles[name] = serve_api.get_deployment_handle(name)
+        return h
+
+    async def _post(self, request):
+        from aiohttp import web
+
+        parts = [p for p in request.path.split("/") if p]
+        name = parts[0] if parts else ""
+        method = parts[1] if len(parts) > 1 else "__call__"
+        stream = request.query.get("stream") == "1"
+        if not name:
+            return web.json_response({"error": "no deployment in path"},
+                                     status=404)
+        try:
+            body = await request.read()
+            payload = json.loads(body or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            return web.json_response({"error": f"bad json: {e}"},
+                                     status=400)
+        try:
+            h = self._get_handle(name)
+            if stream:
+                return await self._stream(request, h, method, payload)
+            # Routing runs in the executor: choose() is normally a dict
+            # pick, but the first call (or an unknown/scaled-to-zero
+            # deployment) does a synchronous controller fetch that must
+            # not stall the loop. The await then multiplexes the
+            # in-flight request on the loop.
+            resp = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: h.options(method).remote(payload))
+            result = await resp.result_async(timeout=120)
+            return web.json_response({"result": result})
+        except Exception as e:  # noqa: BLE001 — surfaced as 500
+            # The controller's KeyError arrives wrapped as a remote
+            # TaskError; match it by message for the 404.
+            if "no deployment named" in str(e) or isinstance(e, KeyError):
+                self._handles.pop(name, None)
+                return web.json_response(
+                    {"error": f"no deployment {name!r}"}, status=404)
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def _stream(self, request, h, method, payload):
+        """Chunked transfer: one JSON line per streamed item (reference:
+        proxy_response_generator.py writes streaming responses the same
+        incremental way over ASGI)."""
+        from aiohttp import web
+
+        # Routing/stream setup failures (unknown deployment, no replicas)
+        # happen BEFORE the response is prepared — let them propagate to
+        # _post's JSON error mapping. Setup runs off-loop: it does a
+        # blocking handle_request_streaming round-trip.
+        gen = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: h.options(method, stream=True).remote(payload))
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/jsonlines"})
+        await resp.prepare(request)
+        try:
+            async for item in gen:
+                await resp.write(
+                    (json.dumps({"item": item}) + "\n").encode())
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler on disconnect: stop the
+            # replica-side generator, then let aiohttp unwind.
+            gen.cancel()
+            raise
+        except (ConnectionResetError, OSError):
+            # Client hung up mid-stream (routine for LLM streams). The
+            # response is already prepared: returning it is the only
+            # valid way out — a JSON error response would be a second
+            # response on the same request.
+            gen.cancel()
+            return resp
+        except Exception as e:  # noqa: BLE001 -> terminal record
+            gen.cancel()
+            try:
+                await resp.write(
+                    (json.dumps({"error": str(e)}) + "\n").encode())
+            except (ConnectionResetError, OSError):
                 pass
+        try:
+            await resp.write_eof()
+        except (ConnectionResetError, OSError):
+            pass
+        return resp
 
-            def _send(self, code: int, payload: Dict[str, Any]) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/-/healthz":
-                    return self._send(200, {"status": "ok"})
-                if self.path == "/-/routes":
-                    try:
-                        return self._send(200, list_status())
-                    except Exception as e:
-                        return self._send(500, {"error": str(e)})
-                return self._send(404, {"error": "not found"})
-
-            def _send_chunk(self, data: bytes) -> None:
-                self.wfile.write(f"{len(data):X}\r\n".encode())
-                self.wfile.write(data + b"\r\n")
-
-            def _stream_response(self, h, method, payload) -> None:
-                """Chunked transfer: one JSON line per streamed item
-                (reference: proxy_response_generator.py writes streaming
-                responses the same incremental way over ASGI)."""
-                gen = h.options(method, stream=True).remote(payload)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/jsonlines")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                try:
-                    for item in gen:
-                        self._send_chunk(
-                            (json.dumps({"item": item}) + "\n").encode())
-                except (BrokenPipeError, ConnectionResetError):
-                    # Client hung up mid-stream (routine for LLM streams):
-                    # stop the replica-side generator and release the
-                    # router's in-flight count.
-                    gen.cancel()
-                    return
-                except Exception as e:  # noqa: BLE001 -> terminal record
-                    gen.cancel()
-                    try:
-                        self._send_chunk(
-                            (json.dumps({"error": str(e)}) + "\n").encode())
-                    except OSError:
-                        return
-                try:
-                    self.wfile.write(b"0\r\n\r\n")
-                except OSError:
-                    pass
-
-            def do_POST(self):
-                parts = [p for p in self.path.split("?")[0].split("/") if p]
-                name = parts[0] if parts else ""
-                method = parts[1] if len(parts) > 1 else "__call__"
-                stream = "stream=1" in (self.path.split("?", 1) + [""])[1]
-                if not name:
-                    return self._send(404, {"error": "no deployment in path"})
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
-                except (ValueError, json.JSONDecodeError) as e:
-                    return self._send(400, {"error": f"bad json: {e}"})
-                try:
-                    h = handles.get(name)
-                    if h is None:
-                        h = handles[name] = get_handle(name)
-                    if stream:
-                        return self._stream_response(h, method, payload)
-                    result = h.options(method).remote(
-                        payload).result(timeout=120)
-                    return self._send(200, {"result": result})
-                except Exception as e:  # noqa: BLE001 — surfaced as 500
-                    # The controller's KeyError arrives wrapped as a
-                    # remote TaskError; match it by message for the 404.
-                    if "no deployment named" in str(e) or \
-                            isinstance(e, KeyError):
-                        handles.pop(name, None)
-                        return self._send(404, {"error": f"no deployment "
-                                                f"{name!r}"})
-                    return self._send(500, {"error": str(e)})
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="serve-http").start()
+    # ----------------------------------------------------------- actor API
 
     def address(self) -> str:
         import socket
+        import time
 
+        for _ in range(100):
+            if self.port is not None:
+                break
+            time.sleep(0.1)
         return f"{socket.gethostbyname('localhost')}:{self.port}"
 
     def healthy(self) -> bool:
-        return True
+        return self._loop.is_running()
 
     def stop(self) -> bool:
-        self._server.shutdown()
+        self._loop.call_soon_threadsafe(self._loop.stop)
         return True

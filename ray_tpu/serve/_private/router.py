@@ -2,10 +2,12 @@
 
 Parity target: reference python/ray/serve/_private/replica_scheduler/
 pow_2_scheduler.py:52 — sample two replicas, send to the one with the
-shorter queue. Queue lengths are the CALLER's local in-flight view plus a
-periodically refreshed replica-reported gauge (the reference streams
-queue-len reports the same way; a per-call queue-len RPC would double the
-request latency).
+shorter queue. Queue lengths are the CALLER's local in-flight view.
+Replica-set changes arrive by LONG-POLL PUSH from the controller
+(reference: long_poll.py LongPollClient): a background thread blocks in
+`listen_for_change` and applies updates the moment the set version moves
+— scale-ups/downs and dead-replica prunes propagate in one RPC round,
+not on a refresh timer.
 """
 
 from __future__ import annotations
@@ -21,38 +23,98 @@ class Router:
                  refresh_interval_s: float = 2.0):
         self._controller = controller
         self._deployment = deployment
-        self._refresh_s = refresh_interval_s
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
+        self._version = -1
         self._inflight: Dict[Any, int] = {}
         # Multiplex affinity: model id -> replica that last served it
         # (cache locality; reference routers rank replicas by loaded
         # model sets the same way).
         self._model_affinity: Dict[str, Any] = {}
-        self._last_refresh = 0.0
+        self._poller_started = False
+        self._stopped = False
 
-    def _refresh(self, force: bool = False) -> None:
+    # ------------------------------------------------------------- updates
+
+    def _apply(self, version: int, replicas: Optional[List[Any]]) -> None:
+        with self._lock:
+            self._version = version
+            self._replicas = list(replicas or [])
+            self._inflight = {r: self._inflight.get(r, 0)
+                              for r in self._replicas}
+
+    def _seed(self) -> None:
+        """Synchronous first fetch (and recovery fetch after errors)."""
         import ray_tpu
 
-        now = time.monotonic()
-        with self._lock:
-            if not force and now - self._last_refresh < self._refresh_s \
-                    and self._replicas:
-                return
-            self._last_refresh = now
-        replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._deployment),
+        version, replicas = ray_tpu.get(
+            self._controller.get_replica_set.remote(self._deployment),
             timeout=30)
+        self._apply(version, replicas)
+
+    def _ensure_poller(self) -> None:
         with self._lock:
-            self._replicas = replicas
-            self._inflight = {r: self._inflight.get(r, 0)
-                              for r in replicas}
+            if self._poller_started:
+                return
+            self._poller_started = True
+        try:
+            self._seed()
+        except Exception:
+            pass
+        threading.Thread(target=self._poll_loop, daemon=True,
+                         name=f"serve-longpoll-{self._deployment}").start()
+
+    def _poll_loop(self) -> None:
+        import ray_tpu
+
+        failures = 0
+        while not self._stopped:
+            try:
+                version, replicas = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._deployment, self._version, 30.0),
+                    timeout=60)
+                failures = 0
+                if replicas is None:
+                    # Deployment deleted; the next listen_for_change PARKS
+                    # on the controller's condvar until it is redeployed
+                    # (no poll spin — the controller only returns early
+                    # when the version moves).
+                    self._apply(version, [])
+                    continue
+                self._apply(version, replicas)
+            except Exception:
+                failures += 1
+                time.sleep(min(5.0, 0.5 * failures))
+                # The controller may have been replaced (serve restart):
+                # re-resolve by name so the poller survives it.
+                if failures % 5 == 0:
+                    try:
+                        from ray_tpu.serve._private.controller import \
+                            CONTROLLER_NAME
+
+                        self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                        self._seed()
+                    except Exception:
+                        pass
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # ------------------------------------------------------------- routing
 
     def choose(self, model_id: Optional[str] = None):
         """Pow-2: two random candidates, fewer local in-flight wins.
         A multiplexed model id prefers its affine replica (model cache
         locality) unless that replica disappeared."""
-        self._refresh()
+        self._ensure_poller()
+        with self._lock:
+            empty = not self._replicas
+        if empty:
+            # Not seeded yet (or scaled to zero): one synchronous fetch.
+            # Propagates the controller's KeyError for an unknown
+            # deployment — callers (the proxy) map it to a 404.
+            self._seed()
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
@@ -83,5 +145,10 @@ class Router:
                 self._inflight[replica] -= 1
 
     def invalidate(self) -> None:
-        with self._lock:
-            self._last_refresh = 0.0
+        """A routed replica died: force a synchronous re-fetch now (the
+        long-poller will also catch the prune, this just removes the
+        race for the immediate retry)."""
+        try:
+            self._seed()
+        except Exception:
+            pass

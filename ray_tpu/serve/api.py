@@ -24,9 +24,25 @@ def _get_or_start_controller():
         except Exception:
             pass
         actor_cls = ray_tpu.remote(ServeController)
+        # Generous concurrency: every router in every process holds one
+        # listen_for_change long-poll slot open against this actor.
         return actor_cls.options(
-            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=16,
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=128,
             num_cpus=1).remote()
+
+
+# One router (and its long-poll thread) per deployment per process —
+# handles share them; creating a handle is cheap and leak-free.
+_routers: Dict[str, Router] = {}
+
+
+def _get_router(deployment_name: str, controller) -> Router:
+    with _lock:
+        r = _routers.get(deployment_name)
+        if r is None:
+            r = _routers[deployment_name] = Router(controller,
+                                                   deployment_name)
+        return r
 
 
 class DeploymentResponse:
@@ -55,6 +71,32 @@ class DeploymentResponse:
                 raise
             retry, self._retry = self._retry, None
             return retry().result(timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router.done(self._replica)
+
+    async def result_async(self, timeout: Optional[float] = 120.0):
+        """Awaitable result — the asyncio proxy's path: the event loop
+        multiplexes thousands of in-flight requests over these futures
+        instead of parking one thread per request. Blocking recovery steps
+        (replica-set re-fetch, re-route) run in the default executor so
+        one dead replica never stalls the loop."""
+        import asyncio
+
+        from ray_tpu.exceptions import ActorDiedError
+
+        try:
+            return await asyncio.wait_for(
+                asyncio.wrap_future(self._ref.future()), timeout)
+        except ActorDiedError:
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None, self._router.invalidate)
+            if self._retry is None:
+                raise
+            retry, self._retry = self._retry, None
+            next_resp = await loop.run_in_executor(None, retry)
+            return await next_resp.result_async(timeout=timeout)
         finally:
             if not self._done:
                 self._done = True
@@ -104,6 +146,32 @@ class DeploymentResponseGenerator:
             self._replica.cancel_stream.remote(self._sid)
             self._router.done(self._replica)
 
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        """Async iteration for the asyncio proxy: the cursor poll is an
+        awaited ref, so one stalled stream never parks a thread."""
+        import asyncio
+
+        while not self._buf:
+            if self._done:
+                raise StopAsyncIteration
+            try:
+                items, done = await asyncio.wait_for(
+                    asyncio.wrap_future(
+                        self._replica.next_chunks.remote(self._sid)
+                        .future()), 120)
+            except BaseException:
+                self._done = True
+                self._router.done(self._replica)
+                raise
+            self._buf.extend(items)
+            if done:
+                self._done = True
+                self._router.done(self._replica)
+        return self._buf.pop(0)
+
 
 class DeploymentHandle:
     """Routes calls to a deployment's replicas (pow-2 choices, model
@@ -117,7 +185,7 @@ class DeploymentHandle:
         self._stream = stream
         self._model_id = multiplexed_model_id
         self._controller = _get_or_start_controller()
-        self._router = Router(self._controller, deployment_name)
+        self._router = _get_router(deployment_name, self._controller)
 
     def options(self, method_name: Optional[str] = None, *,
                 stream: Optional[bool] = None,
@@ -225,15 +293,35 @@ def deployment(_cls: Optional[type] = None, *, name: Optional[str] = None,
 
 def run(target: Deployment, *, name: Optional[str] = None,
         _blocking: bool = True) -> DeploymentHandle:
-    """Deploy (or update) and return a handle (reference serve.run :499)."""
+    """Deploy (or update) and return a handle (reference serve.run :499).
+
+    Composition: bound Deployments may appear in another deployment's
+    ``.bind(...)`` args — each is deployed and replaced by a
+    DeploymentHandle before the parent's replicas construct (reference:
+    deployment graphs via DeploymentNode/handle injection), so deployments
+    call deployments through ordinary handles."""
     if not isinstance(target, Deployment):
         raise TypeError("serve.run expects a Deployment "
                         "(apply @serve.deployment and .bind() first)")
     controller = _get_or_start_controller()
-    dep_name = name or target.name
+    return _deploy_graph(controller, target, name or target.name)
+
+
+def _deploy_graph(controller, dep: Deployment,
+                  dep_name: str) -> DeploymentHandle:
+    """Deploy ``dep`` (recursively deploying bound sub-Deployments in its
+    init args first, substituting their handles); returns dep's handle."""
+
+    def resolve(v):
+        if isinstance(v, Deployment):
+            return _deploy_graph(controller, v, v.name)
+        return v
+
+    init_args = tuple(resolve(a) for a in dep._init_args)
+    init_kwargs = {k: resolve(v) for k, v in dep._init_kwargs.items()}
     ray_tpu.get(controller.deploy.remote(
-        dep_name, target._cls, target._init_args, target._init_kwargs,
-        target._config), timeout=180)
+        dep_name, dep._cls, init_args, init_kwargs, dep._config),
+        timeout=180)
     return DeploymentHandle(dep_name)
 
 
@@ -312,6 +400,10 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     with _lock:
+        routers = dict(_routers)
+        _routers.clear()
+        for r in routers.values():
+            r.stop()
         try:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
         except Exception:
