@@ -1,9 +1,12 @@
 """Demand-driven autoscaling over pluggable node providers."""
 
 from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.gce import (FakeGceApi, GceTpuApi,
+                                    GceTpuNodeProvider, RestGceTpuApi)
 from ray_tpu.autoscaler.node_provider import (GkeTpuSliceNodeProvider,
                                               LocalNodeProvider,
                                               NodeProvider)
 
-__all__ = ["Autoscaler", "AutoscalerConfig", "GkeTpuSliceNodeProvider",
-           "LocalNodeProvider", "NodeProvider"]
+__all__ = ["Autoscaler", "AutoscalerConfig", "FakeGceApi", "GceTpuApi",
+           "GceTpuNodeProvider", "GkeTpuSliceNodeProvider",
+           "LocalNodeProvider", "NodeProvider", "RestGceTpuApi"]

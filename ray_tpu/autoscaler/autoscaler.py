@@ -61,6 +61,11 @@ class Autoscaler:
         """One reconcile pass; returns what it did (tested directly)."""
         state = self._rt.head.retrying_call(
             "get_demand", self.config.demand_window_s, timeout=10)
+        # Snapshot the provider's node map ONCE per step: slice providers
+        # back cluster_node_ids by a cloud list call, and per-pid lookups
+        # would be O(slices) API calls per pass.
+        mapper = getattr(self._provider, "cluster_node_map", None)
+        self._node_map = mapper() if mapper is not None else None
         launched = self._scale_up(state)
         reaped = self._scale_down(state)
         return {"launched": launched, "reaped": reaped}
@@ -78,18 +83,25 @@ class Autoscaler:
             return []
         # Provider nodes self-register with the head, so each appears both
         # in non_terminated_nodes() and in state["nodes"] once up. Count
-        # alive cluster nodes plus provider nodes not alive in the cluster
-        # view (booting, or dead-but-still-billed VMs) — double-counting
-        # understates the launch budget; skipping dead VMs overshoots it.
+        # alive cluster nodes plus provider nodes none of whose hosts are
+        # alive in the cluster view (booting, or dead-but-still-billed
+        # VMs) — double-counting understates the launch budget; skipping
+        # dead VMs overshoots it.
         alive_ids = {n["node_id"] for n in state["nodes"] if n["alive"]}
         n_current = len(alive_ids) + len(
             [pid for pid in self._provider.non_terminated_nodes()
-             if pid not in alive_ids])
+             if not any(cid in alive_ids
+                        for cid in self._cluster_ids_of(pid))])
         launched: List[str] = []
         # Bin-pack: demands first absorb EXISTING free capacity, then the
         # smallest node type that fits; one node absorbs several demands.
-        types = sorted(self._provider.node_types.items(),
-                       key=lambda kv: sum(kv[1].values()))
+        # Non-numeric node-type entries (e.g. a slice provider's
+        # accelerator_type) are config, not capacity.
+        types = sorted(
+            ((name, {k: float(v) for k, v in res.items()
+                     if isinstance(v, (int, float))})
+             for name, res in self._provider.node_types.items()),
+            key=lambda kv: sum(kv[1].values()))
         pending_capacity: List[Dict[str, float]] = [
             dict(n["available"]) for n in state["nodes"] if n["alive"]]
         for demand in demands:
@@ -121,29 +133,48 @@ class Autoscaler:
                 break
         return launched[:budget]
 
+    def _cluster_ids_of(self, pid: str) -> List[str]:
+        """Cluster node ids behind one provider node. LocalNodeProvider
+        ids ARE cluster node ids; slice providers (GCE TPU) map one
+        provider id to every host of the slice (via the per-step
+        cluster_node_map snapshot)."""
+        node_map = getattr(self, "_node_map", None)
+        if node_map is not None:
+            return node_map.get(pid, [])
+        mapper = getattr(self._provider, "cluster_node_ids", None)
+        if mapper is not None:
+            return mapper(pid)
+        return [pid]
+
     def _scale_down(self, state) -> List[str]:
         now = time.monotonic()
         reaped: List[str] = []
         by_cluster_id = {n["node_id"]: n for n in state["nodes"]}
-        # Map managed provider nodes to cluster nodes (LocalNodeProvider
-        # ids ARE cluster node ids; cloud providers resolve via labels).
         alive_total = len([n for n in state["nodes"] if n["alive"]])
         for pid in list(self._managed):
-            node = by_cluster_id.get(pid)
-            if node is None or not node["alive"]:
+            nodes = [by_cluster_id.get(cid)
+                     for cid in self._cluster_ids_of(pid)]
+            nodes = [n for n in nodes if n is not None and n["alive"]]
+            if not nodes:
                 continue
-            idle = all(abs(node["available"].get(k, 0.0) - v) < 1e-9
-                       for k, v in node["resources"].items())
+            # A slice reaps only when EVERY host sat idle (TPU slices
+            # terminate whole, never host-by-host).
+            idle = all(
+                all(abs(n["available"].get(k, 0.0) - v) < 1e-9
+                    for k, v in n["resources"].items())
+                for n in nodes)
             if not idle:
                 self._idle_since.pop(pid, None)
                 continue
             t0 = self._idle_since.setdefault(pid, now)
             if (now - t0 >= self.config.idle_timeout_s
                     and alive_total - len(reaped) > self.config.min_nodes):
-                try:
-                    self._rt.head.retrying_call("drain_node", pid, timeout=10)
-                except Exception:
-                    pass
+                for n in nodes:
+                    try:
+                        self._rt.head.retrying_call(
+                            "drain_node", n["node_id"], timeout=10)
+                    except Exception:
+                        pass
                 self._provider.terminate_node(pid)
                 self._managed.pop(pid, None)
                 self._idle_since.pop(pid, None)

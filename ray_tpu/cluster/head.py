@@ -327,8 +327,14 @@ class HeadServer:
 
     def rpc_pick_node(self, conn, resources: Dict[str, float],
                       strategy: Optional[Dict[str, Any]] = None,
-                      exclude: Optional[List[str]] = None):
-        """Returns (node_id, address, store_name) or None (infeasible now)."""
+                      exclude: Optional[List[str]] = None,
+                      demand_key: Optional[Any] = None):
+        """Returns (node_id, address, store_name) or None (infeasible now).
+
+        ``demand_key`` identifies the REQUESTING ENTITY (actor id, sched
+        key) for the unmet-demand ring: N distinct requesters of one shape
+        must register as N demands, while one requester retrying must
+        register as one (see rpc_get_demand)."""
         exclude_set = set(exclude or ())
         strategy = strategy or {}
         kind = strategy.get("kind")
@@ -372,11 +378,13 @@ class HeadServer:
                 return None
         ranked, saturated = self._score_nodes_ex(resources, exclude_set)
         if not ranked:
-            self._unmet_demand.append((time.monotonic(), dict(resources)))
+            self._unmet_demand.append(
+                (time.monotonic(), dict(resources), demand_key))
             return None
         if saturated:
             # Demand exceeds current capacity (autoscaler signal).
-            self._unmet_demand.append((time.monotonic(), dict(resources)))
+            self._unmet_demand.append(
+                (time.monotonic(), dict(resources), demand_key))
         n = ranked[0]
         return n.node_id, n.address, n.store_name
 
@@ -433,7 +441,8 @@ class HeadServer:
         while True:
             picked = self.rpc_pick_node(None, info.resources,
                                         getattr(info, "strategy", None),
-                                        list(exclude))
+                                        list(exclude),
+                                        demand_key=info.actor_id)
             if picked is None:
                 if time.monotonic() > deadline:
                     with self._lock:
@@ -785,13 +794,29 @@ class HeadServer:
         + live queued backlogs) + node views."""
         cutoff = time.monotonic() - window_s
         with self._lock:
-            demands = [d for t, d in self._unmet_demand if t >= cutoff]
+            # Backlog reports carry true queued counts per shape — they
+            # are authoritative. The failed-pick ring records EVERY retry
+            # (one infeasible requester picks repeatedly), so it collapses
+            # to one entry per (requester, shape) — N concurrent actor
+            # creations of one shape stay N demands, one retrying actor
+            # stays one — and only for shapes the backlog doesn't already
+            # cover (raw ring entries would over-launch per retry).
+            demands = []
+            backlog_shapes = set()
             for sid, (t, entries) in list(self._backlogs.items()):
                 if t < cutoff:
                     self._backlogs.pop(sid, None)
                     continue
                 for resources, count in entries:
+                    backlog_shapes.add(tuple(sorted(resources.items())))
                     demands.extend([dict(resources)] * int(count))
+            ring: dict = {}
+            for t, d, key in self._unmet_demand:
+                if t >= cutoff:
+                    shape = tuple(sorted(d.items()))
+                    ring[(key, shape)] = (shape, d)
+            demands.extend(dict(d) for shape, d in ring.values()
+                           if shape not in backlog_shapes)
             nodes = [n.view() for n in self._nodes.values()]
         return {"unmet": demands, "nodes": nodes}
 

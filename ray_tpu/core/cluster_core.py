@@ -1388,10 +1388,16 @@ class ClusterCore:
         Both RPCs are retry-safe: pick_node is read-only, request_lease is
         idempotent via the per-attempt req_id (the node caches the grant)."""
         exclude: List[str] = []
+        # Demand identity for the head's unmet-demand ring: this
+        # submitter + shape. Retries of one starved key stay one demand;
+        # distinct submitters register separately.
+        demand_key = (self.worker_id.hex(),
+                      tuple(sorted(resources.items())))
         for _ in range(4):  # a few spillback hops per attempt
             try:
                 picked = self.head.retrying_call(
-                    "pick_node", resources, strategy, exclude, timeout=10)
+                    "pick_node", resources, strategy, exclude, demand_key,
+                    timeout=10)
             except (ConnectionLost, TimeoutError):
                 return None
             if picked is None:

@@ -52,7 +52,12 @@ class MemoryStore:
             callbacks = self._callbacks.pop(object_id, [])
             self._cv.notify_all()
         for cb in callbacks:
-            cb(rec)
+            try:
+                cb(rec)
+            except Exception:
+                # One broken callback (e.g. a cancelled future) must not
+                # crash the delivery thread or strand later callbacks.
+                pass
 
     def put_batch(self, items) -> None:
         """items: [(object_id, value, is_exception)]. One lock acquisition
@@ -72,7 +77,12 @@ class MemoryStore:
             self._cv.notify_all()
         for cbs, rec in fire:
             for cb in cbs:
-                cb(rec)
+                try:
+                    cb(rec)
+                except Exception:
+                    # A failing callback must not abort the rest of the
+                    # batch — unrelated waiters would hang forever.
+                    pass
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:

@@ -59,12 +59,23 @@ class ObjectRef:
         fut: Future = Future()
 
         def _on_ready(rec):
+            # The future may have been CANCELLED (asyncio.wait_for timeout
+            # or a disconnected client cancelling its await): set_* would
+            # raise InvalidStateError out of the store's delivery thread.
             try:
                 value = rt.resolve_record(rec)
             except BaseException as e:  # noqa: BLE001 - propagate task errors
-                fut.set_exception(e)
+                if not fut.cancelled():
+                    try:
+                        fut.set_exception(e)
+                    except Exception:
+                        pass
                 return
-            fut.set_result(value)
+            if not fut.cancelled():
+                try:
+                    fut.set_result(value)
+                except Exception:
+                    pass
 
         rt.register_ready_callback(self._id, _on_ready)
         return fut

@@ -68,6 +68,7 @@ class Router:
         import ray_tpu
 
         failures = 0
+        deleted_backoff = 0.0
         while not self._stopped:
             try:
                 version, replicas = ray_tpu.get(
@@ -76,12 +77,17 @@ class Router:
                     timeout=60)
                 failures = 0
                 if replicas is None:
-                    # Deployment deleted; the next listen_for_change PARKS
-                    # on the controller's condvar until it is redeployed
-                    # (no poll spin — the controller only returns early
-                    # when the version moves).
+                    # Deployment deleted. The next listen parks on the
+                    # controller condvar, but each park still holds a
+                    # concurrency slot for its 30s window — back off
+                    # between polls so a process full of stale handles
+                    # doesn't pin the controller's slot pool.
                     self._apply(version, [])
+                    deleted_backoff = min(300.0,
+                                          max(1.0, deleted_backoff * 2))
+                    time.sleep(deleted_backoff)
                     continue
+                deleted_backoff = 0.0
                 self._apply(version, replicas)
             except Exception:
                 failures += 1

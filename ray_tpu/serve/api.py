@@ -162,6 +162,17 @@ class DeploymentResponseGenerator:
                     asyncio.wrap_future(
                         self._replica.next_chunks.remote(self._sid)
                         .future()), 120)
+            except asyncio.CancelledError:
+                # Client disconnected while we were suspended here (the
+                # dominant state): tell the replica NOW — the caller's
+                # later gen.cancel() would no-op once _done is set, and
+                # the replica's drain thread would keep computing into an
+                # unbounded buffer.
+                if not self._done:
+                    self._done = True
+                    self._replica.cancel_stream.remote(self._sid)
+                    self._router.done(self._replica)
+                raise
             except BaseException:
                 self._done = True
                 self._router.done(self._replica)
@@ -396,6 +407,13 @@ def status() -> Dict[str, Any]:
 def delete(name: str) -> None:
     controller = _get_or_start_controller()
     ray_tpu.get(controller.delete.remote(name), timeout=60)
+    # Stop this process's router for the deleted deployment: a parked
+    # long-poll thread would otherwise pin a controller concurrency slot
+    # until redeploy. (A later handle re-creates a fresh router.)
+    with _lock:
+        r = _routers.pop(name, None)
+    if r is not None:
+        r.stop()
 
 
 def shutdown() -> None:
