@@ -27,6 +27,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.core.cluster_core import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime_context import get_runtime_context
 from ray_tpu import exceptions
@@ -49,6 +50,7 @@ __all__ = [
     "available_resources",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "get_runtime_context",

@@ -122,16 +122,30 @@ class Autoscaler:
                     pending_capacity.append(cap)
                     launched.append(_name)
                     break
-        budget = min(self.config.max_launch_per_step,
-                     max(0, self.config.max_nodes - n_current))
-        for node_type in launched[:budget]:
+        # max_nodes is a HOST cap and n_current counts hosts: charge each
+        # launch its host count (a v5p-8 slice = 2 hosts), else multi-host
+        # slices overshoot the cap by their host factor.
+        host_counter = getattr(self._provider, "node_type_hosts", None)
+        host_budget = max(0, self.config.max_nodes - n_current)
+        taken: List[str] = []
+        hosts_used = 0
+        for node_type in launched:
+            if len(taken) >= self.config.max_launch_per_step:
+                break
+            hosts = (host_counter(node_type)
+                     if host_counter is not None else 1)
+            if hosts_used + hosts > host_budget:
+                break
+            taken.append(node_type)
+            hosts_used += hosts
+        for node_type in taken:
             try:
                 pid = self._provider.create_node(node_type)
                 self._managed[pid] = None
                 self._launched += 1
             except Exception:
                 break
-        return launched[:budget]
+        return taken
 
     def _cluster_ids_of(self, pid: str) -> List[str]:
         """Cluster node ids behind one provider node. LocalNodeProvider
@@ -149,6 +163,7 @@ class Autoscaler:
     def _scale_down(self, state) -> List[str]:
         now = time.monotonic()
         reaped: List[str] = []
+        reaped_hosts = 0
         by_cluster_id = {n["node_id"]: n for n in state["nodes"]}
         alive_total = len([n for n in state["nodes"] if n["alive"]])
         for pid in list(self._managed):
@@ -167,8 +182,11 @@ class Autoscaler:
                 self._idle_since.pop(pid, None)
                 continue
             t0 = self._idle_since.setdefault(pid, now)
+            # min_nodes is a HOST floor: a multi-host slice removes all
+            # its hosts at once, so count hosts, not provider ids.
             if (now - t0 >= self.config.idle_timeout_s
-                    and alive_total - len(reaped) > self.config.min_nodes):
+                    and alive_total - reaped_hosts - len(nodes)
+                    >= self.config.min_nodes):
                 for n in nodes:
                     try:
                         self._rt.head.retrying_call(
@@ -179,6 +197,7 @@ class Autoscaler:
                 self._managed.pop(pid, None)
                 self._idle_since.pop(pid, None)
                 reaped.append(pid)
+                reaped_hosts += len(nodes)
         return reaped
 
     # ---------------------------------------------------------------- loop

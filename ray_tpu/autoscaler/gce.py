@@ -175,6 +175,12 @@ class GceTpuNodeProvider(NodeProvider):
         return [s["name"] for s in self._api.list_tpu_slices()
                 if s["state"] != "TERMINATED"]
 
+    def node_type_hosts(self, node_type: str) -> int:
+        """Hosts one create_node of this type adds to the cluster."""
+        spec = self.node_types[node_type]
+        _gen, _chips, hosts = parse_slice_shape(spec["accelerator_type"])
+        return hosts
+
     def cluster_node_ids(self, provider_node_id: str) -> List[str]:
         return self.cluster_node_map().get(provider_node_id, [])
 

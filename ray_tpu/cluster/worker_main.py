@@ -204,6 +204,10 @@ class WorkerRuntime(ClusterCore):
                 try:
                     func = (self._fetch_function(spec["func_digest"])
                             if "func_digest" in spec else spec["func"])
+                    if spec.get("streaming"):
+                        self._execute_streaming(owner, task_id, func, args,
+                                                kwargs, span)
+                        return
                     result = func(*args, **kwargs)
                     self._send_results(owner, task_id, return_ids,
                                        value=result, span=span())
@@ -224,6 +228,72 @@ class WorkerRuntime(ClusterCore):
                     return
         finally:
             runtime_context.set_worker_context(prev)
+
+    #: max items delivered ahead of the consumer before the producer
+    #: pauses (reference: streaming-generator backpressure —
+    #: _generator_backpressure_num_objects).
+    _STREAM_AHEAD_MAX = 64
+
+    def _execute_streaming(self, owner: str, task_id, func, args, kwargs,
+                           span) -> None:
+        """Run a streaming-generator task: each yield seals one object and
+        ships to the owner INCREMENTALLY (reference: streaming-generator
+        execution feeding task_manager.h:212 refs) — the full output never
+        materializes on either side at once. Flow control is CONSUMER
+        driven: past _STREAM_AHEAD_MAX unconsumed items the producer polls
+        the owner's consumed counter and pauses (the flush queue alone is
+        no gauge — the owner acks as fast as it buffers)."""
+        from ray_tpu.core.ids import ObjectID as _OID
+
+        task_id_bytes = task_id.binary()
+        index = 0
+        consumed = 0
+        err = None
+        cancelled = False
+        try:
+            gen = func(*args, **kwargs)
+            for item in gen:
+                if task_id_bytes in self._cancelled:
+                    cancelled = True
+                    break
+                oid = _OID.for_stream_return(task_id, index)
+                header, buffers = SERIALIZER.serialize(item)
+                total = SERIALIZER.encode_total_size(header, buffers)
+                if total <= cfg.object_store_inline_max_bytes:
+                    flat = bytearray(total)
+                    SERIALIZER.encode_into(memoryview(flat), header,
+                                           buffers)
+                    rec = (oid.binary(), "value", bytes(flat))
+                else:
+                    self._put_plasma(oid, header, buffers)
+                    rec = (oid.binary(), "in_store", None)
+                self._enqueue_done(owner, ("stream",
+                                           (task_id_bytes, index, rec)))
+                index += 1
+                while (index - consumed > self._STREAM_AHEAD_MAX
+                       and not cancelled):
+                    try:
+                        consumed = self._owner_pool.get(owner).call(
+                            "stream_consumed", task_id_bytes, timeout=10)
+                    except Exception:
+                        consumed = index  # owner unreachable: stop gating
+                        break
+                    if consumed < 0:  # stream abandoned owner-side
+                        cancelled = True
+                        break
+                    if index - consumed > self._STREAM_AHEAD_MAX:
+                        time.sleep(0.02)
+                if cancelled:
+                    break
+            if cancelled and hasattr(gen, "close"):
+                try:
+                    gen.close()
+                except Exception:
+                    pass
+        except BaseException as e:  # noqa: BLE001 -> terminal record
+            err = capture_exception(e)
+        self._enqueue_done(owner, ("stream_end",
+                                   (task_id_bytes, index, err, span())))
 
     def _resolve_args(self, args, kwargs):
         def res(a):

@@ -53,11 +53,11 @@ class _SubmitTemplate:
 
     __slots__ = ("func", "num_returns", "resources", "strategy", "name",
                  "sched_key", "spread", "effective_retries", "runtime_env",
-                 "env_hash", "spec_proto")
+                 "env_hash", "spec_proto", "streaming")
 
     def __init__(self, func, num_returns, resources, strategy, name,
                  sched_key, spread, effective_retries, runtime_env,
-                 env_hash, spec_proto):
+                 env_hash, spec_proto, streaming=False):
         self.func = func
         self.num_returns = num_returns
         self.resources = resources
@@ -69,6 +69,7 @@ class _SubmitTemplate:
         self.runtime_env = runtime_env
         self.env_hash = env_hash
         self.spec_proto = spec_proto
+        self.streaming = streaming
 
 
 class _Lease:
@@ -91,10 +92,11 @@ class _Lease:
 class _InflightTask:
     __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
                  "sched_key", "resources", "strategy", "name", "sys_retries",
-                 "runtime_env")
+                 "runtime_env", "streaming")
 
     def __init__(self, spec_blob, return_ids, worker_addr, retries_left,
-                 sched_key, resources, strategy, name, runtime_env=None):
+                 sched_key, resources, strategy, name, runtime_env=None,
+                 streaming=False):
         self.spec_blob = spec_blob
         self.return_ids = return_ids
         self.worker_addr = worker_addr
@@ -105,6 +107,65 @@ class _InflightTask:
         self.name = name
         self.sys_retries = None  # lazily set from config on first failure
         self.runtime_env = runtime_env  # validated dict or None
+        self.streaming = streaming
+
+
+class _StreamState:
+    """Owner-side record of one streaming-generator task (reference: the
+    streaming-generator ref bookkeeping in task_manager.h:212)."""
+
+    __slots__ = ("received", "consumed", "total", "error", "cv")
+
+    def __init__(self):
+        self.received = 0          # items delivered so far (contiguous)
+        self.consumed = 0          # items handed to the consumer
+        self.total = None          # set at stream end
+        self.error = None          # terminal error (raised at consume point)
+        self.cv = threading.Condition()
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded refs, in yield order.
+    Each __next__ blocks until the next item's object has ARRIVED at the
+    owner (the ref is immediately gettable). Dropping the generator
+    without draining it cancels the stream: the producer stops and
+    undelivered items are released."""
+
+    def __init__(self, core: "ClusterCore", task_id: TaskID):
+        self._core = core
+        self._task_id = task_id
+        self._index = 0
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        try:
+            ref = self._core._next_stream_ref(self._task_id, self._index,
+                                              timeout=600.0)
+        except StopIteration:
+            self._exhausted = True
+            raise
+        self._index += 1
+        return ref
+
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def close(self) -> None:
+        if not self._exhausted:
+            self._exhausted = True
+            try:
+                self._core._abandon_stream(self._task_id)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _KeyQueue:
@@ -200,6 +261,9 @@ class ClusterCore:
         # cannot free an argument out from under the executing worker
         # (reference: ReferenceCounter's submitted_task_ref_count).
         self._submitted_args: Dict[bytes, List[ObjectID]] = {}
+        # task_id -> _StreamState for in-flight streaming generators.
+        self._streams: Dict[bytes, _StreamState] = {}
+        self._streams_lock = threading.Lock()
         # (expiry, oid) transfer pins for owned refs serialized outbound;
         # swept by the push-ack loop.
         import collections as _collections
@@ -921,6 +985,7 @@ class ClusterCore:
 
         stats_on = protocol._stats_on()
         puts: list = []
+        notifies: list = []
         try:
             for kind, payload in entries:
                 method = "actor_call_done" if kind == "actor" else "task_done"
@@ -935,6 +1000,14 @@ class ClusterCore:
                             aconn.pending.pop(seq, None)
                         self._complete_task(task_id_bytes, results, span,
                                             puts)
+                    elif kind == "stream":
+                        self._handle_stream_item(payload[0], payload[1],
+                                                 payload[2], puts,
+                                                 notifies)
+                    elif kind == "stream_end":
+                        self._handle_stream_end(payload[0], payload[1],
+                                                payload[2], payload[3],
+                                                puts, notifies)
                     else:
                         self._complete_task(payload[0], payload[1],
                                             payload[2] if len(payload) > 2
@@ -951,6 +1024,8 @@ class ClusterCore:
             # results: their inflight/lease bookkeeping already ran, and
             # dropping the values would strand their owners in get().
             self.memory_store.put_batch(puts)
+            # Stream consumers wake only after their objects are gettable.
+            self._fire_stream_notifies(notifies)
         return True
 
     def rpc_ping(self, conn):
@@ -1057,6 +1132,9 @@ class ClusterCore:
         strategy = _strategy_dict(scheduling_strategy)
         task_name = name or getattr(func, "__name__", "task")
         spread = bool(strategy and strategy.get("kind") == "spread")
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
         sched_key = None
         if not spread:
             sched_key = _sched_key(func, res, strategy)
@@ -1075,11 +1153,13 @@ class ClusterCore:
             "retry_exceptions": retry_exceptions,
             "max_retries": max_retries,
         }
+        if streaming:
+            spec_proto["streaming"] = True
         return _SubmitTemplate(
             func, num_returns, res, strategy, task_name, sched_key, spread,
             max_retries if retry_exceptions else 0, runtime_env,
             runtime_env_hash(runtime_env) if runtime_env is not None
-            else None, spec_proto)
+            else None, spec_proto, streaming)
 
     def submit_templated(self, tmpl: "_SubmitTemplate", args: Sequence,
                          kwargs: Dict) -> List[ObjectRef]:
@@ -1106,14 +1186,149 @@ class ClusterCore:
         info = _InflightTask(spec_blob, return_ids, None,
                              tmpl.effective_retries, sched_key,
                              tmpl.resources, tmpl.strategy, tmpl.name,
-                             tmpl.runtime_env)
+                             tmpl.runtime_env, streaming=tmpl.streaming)
         _metrics.TASKS_SUBMITTED.inc()
         arg_ids = self._register_submitted_args(task_id_bytes, args, kwargs)
+        if tmpl.streaming:
+            # No lineage for streams (v1): partial replay would duplicate
+            # already-consumed items; a lost stream fails instead.
+            with self._streams_lock:
+                self._streams[task_id_bytes] = _StreamState()
+            self._enqueue_task(task_id_bytes, info)
+            return ObjectRefGenerator(self, task_id)
         self.lineage.record(task_id_bytes, _LineageRecord(
             spec_blob, sched_key, tmpl.resources, tmpl.strategy, tmpl.name,
             return_ids, arg_ids, runtime_env=tmpl.runtime_env))
         self._enqueue_task(task_id_bytes, info)
         return refs
+
+    # ------------------------------------------------- streaming generators
+
+    def _next_stream_ref(self, task_id: TaskID, index: int,
+                         timeout: float) -> ObjectRef:
+        """Block until yield #index has arrived (or the stream ended)."""
+        task_id_bytes = task_id.binary()
+        with self._streams_lock:
+            st = self._streams.get(task_id_bytes)
+        if st is None:
+            raise StopIteration
+        deadline = time.monotonic() + timeout
+        with st.cv:
+            while True:
+                if st.received > index:
+                    st.consumed = max(st.consumed, index + 1)
+                    return ObjectRef(
+                        ObjectID.for_stream_return(task_id, index),
+                        self.owner_addr)
+                if st.error is not None and st.received <= index:
+                    self._drop_stream(task_id_bytes)
+                    raise st.error
+                if st.total is not None and index >= st.total:
+                    self._drop_stream(task_id_bytes)
+                    raise StopIteration
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"stream item {index} of task "
+                        f"{task_id.hex()[:12]} not ready in {timeout}s")
+                st.cv.wait(min(remaining, 1.0))
+
+    def _drop_stream(self, task_id_bytes: bytes) -> None:
+        with self._streams_lock:
+            self._streams.pop(task_id_bytes, None)
+
+    def _abandon_stream(self, task_id: TaskID) -> None:
+        """The consumer dropped its generator: cancel producer-side and
+        release every delivered-but-unconsumed item (consumed items'
+        ObjectRefs release themselves through normal ref GC)."""
+        task_id_bytes = task_id.binary()
+        with self._streams_lock:
+            st = self._streams.pop(task_id_bytes, None)
+        if st is None:
+            return
+        with st.cv:
+            consumed, received = st.consumed, st.received
+            st.error = TaskError("stream abandoned by consumer")
+            st.cv.notify_all()
+        self._cancelled.add(task_id)  # worker's streaming loop checks this
+        self._cancelled_order.append(task_id)
+        while len(self._cancelled_order) > 8192:
+            self._cancelled.discard(self._cancelled_order.popleft())
+        with self._inflight_lock:
+            info = self._inflight.get(task_id_bytes)
+        if info is not None and info.worker_addr:
+            try:
+                self._pool.get(info.worker_addr).notify(
+                    "cancel_task", task_id_bytes)
+            except Exception:
+                pass
+        for idx in range(consumed, received):
+            oid = ObjectID.for_stream_return(task_id, idx)
+            self.memory_store.delete([oid])
+            try:
+                self.refcount.drop_owned_object(oid)
+            except Exception:
+                pass
+
+    def rpc_stream_consumed(self, conn, task_id_bytes: bytes) -> int:
+        """Producer flow-control poll: how many items the consumer has
+        taken (-1 = stream gone/abandoned; producer should stop)."""
+        with self._streams_lock:
+            st = self._streams.get(task_id_bytes)
+        if st is None:
+            return -1
+        with st.cv:
+            return st.consumed
+
+    def _handle_stream_item(self, task_id_bytes: bytes, index: int,
+                            result: Tuple[bytes, str, Any],
+                            puts: list, notifies: list) -> None:
+        with self._streams_lock:
+            live = task_id_bytes in self._streams
+        if not live:
+            return  # abandoned: do not store (would pin forever)
+        oid_bytes, kind, payload = result
+        oid = ObjectID(oid_bytes)
+        self.refcount.add_owned_object(oid)
+        if kind == "value":
+            puts.append((oid, SERIALIZER.decode(payload), False))
+        elif kind == "error":
+            puts.append((oid, payload, True))
+        else:
+            puts.append((oid, PlasmaStub(oid), False))
+        # The consumer wakes only AFTER put_batch lands (the ref must be
+        # gettable the moment __next__ returns): defer via `notifies`.
+        notifies.append(("item", task_id_bytes, index))
+
+    def _handle_stream_end(self, task_id_bytes: bytes, count: int,
+                           error, span, puts: list, notifies: list) -> None:
+        # Completion bookkeeping (inflight pop, lease credit, metrics).
+        self._complete_task(task_id_bytes, [], span, puts)
+        notifies.append(("end", task_id_bytes, count, error))
+
+    def _fire_stream_notifies(self, notifies: list) -> None:
+        for entry in notifies:
+            with self._streams_lock:
+                st = self._streams.get(entry[1])
+            if st is None:
+                continue
+            with st.cv:
+                if entry[0] == "item":
+                    st.received = max(st.received, entry[2] + 1)
+                else:
+                    st.total = entry[2]
+                    if entry[3] is not None:
+                        st.error = entry[3]
+                st.cv.notify_all()
+
+    def _fail_stream(self, task_id_bytes: bytes, error) -> None:
+        with self._streams_lock:
+            st = self._streams.get(task_id_bytes)
+        if st is not None:
+            with st.cv:
+                st.error = error
+                st.total = st.received
+                st.cv.notify_all()
 
     # ---- per-scheduling-key dispatch (reference: NormalTaskSubmitter's
     # per-SchedulingKey worker-lease pools + backlog, lease reuse via
@@ -1440,6 +1655,13 @@ class ClusterCore:
         for tid, info in victims:
             if info.sched_key and info.sched_key[0] == "actor":
                 continue  # actor calls handled by _handle_actor_conn_lost
+            if info.streaming:
+                # Replaying a partially-consumed stream would duplicate
+                # delivered items: fail it (documented v1 semantics).
+                self._fail_stream(tid, WorkerCrashedError(
+                    f"worker at {addr} died mid-stream in {info.name}"))
+                self._release_submitted_args(tid)
+                continue
             if info.sys_retries is None:
                 info.sys_retries = cfg.task_max_retries_default
             info.sys_retries -= 1

@@ -192,6 +192,16 @@ class ObjectID(BaseID):
             struct.pack("<I", put_index) + task_id.binary() + struct.pack("<I", _FLAG_PUT)
         )
 
+    @classmethod
+    def for_stream_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """The index-th yield of a streaming-generator task (reference:
+        streaming-generator return refs, task_manager.h:212)."""
+        return cls(struct.pack("<I", index) + task_id.binary()
+                   + struct.pack("<I", _FLAG_STREAM))
+
+    def is_stream(self) -> bool:
+        return bool(self.flags() & _FLAG_STREAM)
+
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[self._IDX : self._IDX + TaskID.SIZE])
 

@@ -86,6 +86,19 @@ class ReferenceCounter:
             ref = self._refs.setdefault(oid, _Ref(owned=True))
             ref.owned = True
 
+    def drop_owned_object(self, oid: ObjectID) -> None:
+        """Owner-side FORCED release (e.g. abandoned-stream items that no
+        ObjectRef was ever minted for): removes the record and fires the
+        release hook so stored bytes free immediately."""
+        self.flush_deferred()
+        with self._lock:
+            ref = self._refs.pop(oid, None)
+        if ref is not None and self._on_release is not None:
+            try:
+                self._on_release(oid)
+            except Exception:
+                pass
+
     def add_local_ref(self, oid: ObjectID) -> None:
         if not self.enabled:
             return

@@ -13,14 +13,16 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.dataset import (Dataset, GroupedData,
                                   MaterializedDataset,
                                   StreamSplitIterator, from_arrow,
-                                  from_items, from_numpy, from_pandas,
+                                  from_generators, from_items,
+                                  from_numpy, from_pandas,
                                   range, read_binary_files, read_csv,
                                   read_images, read_json, read_numpy,
                                   read_parquet, read_text)
 
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "Dataset", "GroupedData",
-    "MaterializedDataset", "StreamSplitIterator", "from_arrow", "from_items",
+    "MaterializedDataset", "StreamSplitIterator", "from_arrow",
+    "from_generators", "from_items",
     "from_numpy", "from_pandas", "range", "read_binary_files", "read_csv",
     "read_images", "read_json", "read_numpy", "read_parquet", "read_text",
 ]

@@ -149,7 +149,26 @@ class InputOperator(Operator):
             block = BlockAccessor.normalize(task())
             return block, BlockMetadata.of(block)
 
-        pending = collections.deque(self._tasks)
+        # Generator read tasks become STREAMING tasks: one task yields
+        # many blocks incrementally (reads of a file's row groups, a huge
+        # archive's members...) without ever materializing the whole
+        # output in the worker — alternate yields of block then metadata
+        # keep the blocks off the driver, matching _read's contract.
+        # Mixed inputs partition: plain tasks keep the budgeted windowed
+        # path, generator tasks stream (producer-side flow control bounds
+        # their in-flight bytes).
+        import inspect
+
+        plain = [t for t in self._tasks
+                 if not inspect.isgeneratorfunction(getattr(t, "func", t))]
+        plain_ids = {id(t) for t in plain}
+        gen_tasks = [t for t in self._tasks if id(t) not in plain_ids]
+        if gen_tasks:
+            yield from self._execute_streaming_reads(gen_tasks, ctx)
+            if not plain:
+                return
+
+        pending = collections.deque(plain)
         in_flight: collections.deque = collections.deque()
         holding = 0
         while pending or in_flight:
@@ -172,6 +191,39 @@ class InputOperator(Operator):
                 if meta.size_bytes:
                     est = max(1, (est + meta.size_bytes) // 2)
             yield block_ref, meta
+
+    def _execute_streaming_reads(self, tasks: List[Callable],
+                                 ctx: Optional[ExecContext]
+                                 ) -> Iterator[RefBundle]:
+        """Generator read tasks as streaming-generator tasks, up to
+        `parallelism` concurrent, items consumed in yield order. Each
+        task yields block, then BlockMetadata, alternating — the driver
+        fetches only the metadata items. In-flight bytes are bounded by
+        the producer-side stream flow control (the consumer-driven pause
+        in the worker), not the ExecContext byte budget."""
+
+        @ray_tpu.remote(num_returns="streaming")
+        def _read_stream(task):
+            out = task()
+            chunks = out if hasattr(out, "__next__") else [out]
+            for chunk in chunks:
+                block = BlockAccessor.normalize(chunk)
+                yield block
+                yield BlockMetadata.of(block)
+
+        pending = collections.deque(tasks)
+        live: collections.deque = collections.deque()
+        while pending or live:
+            while pending and len(live) < self._parallelism:
+                live.append(_read_stream.remote(pending.popleft()))
+            gen = live.popleft()
+            while True:
+                try:
+                    block_ref = next(gen)
+                except StopIteration:
+                    break
+                meta = ray_tpu.get(next(gen))
+                yield block_ref, meta
 
 
 class TaskPoolMapOperator(Operator):

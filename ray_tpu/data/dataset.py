@@ -591,6 +591,22 @@ def from_items(items: Sequence[Any], *, parallelism: int = 4) -> Dataset:
                     for c in chunks], read_parallelism=parallelism)
 
 
+def from_generators(gen_fns: Sequence[Callable], *,
+                    parallelism: int = 4) -> Dataset:
+    """Each ``gen_fn`` is a GENERATOR FUNCTION yielding blocks (row-dicts
+    or column dicts); it runs as ONE streaming-generator task whose chunks
+    ship incrementally — the natural constructor for sources much larger
+    than worker memory (reference analog: generator UDF read tasks over
+    `num_returns="streaming"`)."""
+    import inspect
+
+    for fn in gen_fns:
+        if not inspect.isgeneratorfunction(getattr(fn, "func", fn)):
+            raise TypeError(f"from_generators expects generator "
+                            f"functions, got {fn!r}")
+    return Dataset(list(gen_fns), read_parallelism=parallelism)
+
+
 def from_numpy(arrays: Dict[str, np.ndarray], *,
                parallelism: int = 4) -> Dataset:
     n = len(next(iter(arrays.values())))

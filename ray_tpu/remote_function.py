@@ -26,8 +26,10 @@ def validate_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     if unknown:
         raise ValueError(f"invalid option(s): {sorted(unknown)}")
     nr = opts.get("num_returns")
-    if nr is not None and not (nr == "dynamic" or (isinstance(nr, int) and nr >= 0)):
-        raise ValueError("num_returns must be a non-negative int or 'dynamic'")
+    if nr is not None and not (nr in ("dynamic", "streaming")
+                               or (isinstance(nr, int) and nr >= 0)):
+        raise ValueError("num_returns must be a non-negative int, "
+                         "'dynamic', or 'streaming'")
     return opts
 
 
@@ -56,6 +58,22 @@ class RemoteFunction:
         num_returns = opts.get("num_returns", 1)
         if num_returns == "dynamic":
             num_returns = 1  # dynamic generators collapse to one list ref
+        if num_returns == "streaming":
+            make_tmpl = getattr(rt, "make_submit_template", None)
+            if make_tmpl is None:
+                raise RuntimeError(
+                    "num_returns='streaming' requires the cluster runtime")
+            if self._tmpl is None or (self._tmpl_rt() if self._tmpl_rt
+                                      else None) is not rt:
+                self._tmpl = make_tmpl(
+                    self._func, num_returns="streaming",
+                    resources=_task_resources(opts),
+                    max_retries=0, retry_exceptions=False,
+                    scheduling_strategy=opts.get("scheduling_strategy"),
+                    name=opts.get("name") or self._func.__qualname__,
+                    runtime_env=opts.get("runtime_env"))
+                self._tmpl_rt = weakref.ref(rt)
+            return rt.submit_templated(self._tmpl, args, kwargs)
         make_tmpl = getattr(rt, "make_submit_template", None)
         if make_tmpl is not None:
             # Hot path: option normalization + constant spec fields are
