@@ -206,7 +206,8 @@ class WorkerRuntime(ClusterCore):
                             if "func_digest" in spec else spec["func"])
                     if spec.get("streaming"):
                         self._execute_streaming(owner, task_id, func, args,
-                                                kwargs, span)
+                                                kwargs, span,
+                                                spec.get("stream_ahead"))
                         return
                     result = func(*args, **kwargs)
                     self._send_results(owner, task_id, return_ids,
@@ -229,13 +230,9 @@ class WorkerRuntime(ClusterCore):
         finally:
             runtime_context.set_worker_context(prev)
 
-    #: max items delivered ahead of the consumer before the producer
-    #: pauses (reference: streaming-generator backpressure —
-    #: _generator_backpressure_num_objects).
-    _STREAM_AHEAD_MAX = 64
 
     def _execute_streaming(self, owner: str, task_id, func, args, kwargs,
-                           span) -> None:
+                           span, stream_ahead=None) -> None:
         """Run a streaming-generator task: each yield seals one object and
         ships to the owner INCREMENTALLY (reference: streaming-generator
         execution feeding task_manager.h:212 refs) — the full output never
@@ -246,10 +243,14 @@ class WorkerRuntime(ClusterCore):
         from ray_tpu.core.ids import ObjectID as _OID
 
         task_id_bytes = task_id.binary()
+        # Per-task override (generator_backpressure_num_objects) beats the
+        # global default — Data sizes it to the pipeline memory budget.
+        ahead_max = int(stream_ahead or cfg.streaming_ahead_max)
         index = 0
         consumed = 0
         err = None
         cancelled = False
+        poll_sleep = 0.02
         try:
             gen = func(*args, **kwargs)
             for item in gen:
@@ -270,8 +271,7 @@ class WorkerRuntime(ClusterCore):
                 self._enqueue_done(owner, ("stream",
                                            (task_id_bytes, index, rec)))
                 index += 1
-                while (index - consumed > self._STREAM_AHEAD_MAX
-                       and not cancelled):
+                while (index - consumed > ahead_max and not cancelled):
                     try:
                         consumed = self._owner_pool.get(owner).call(
                             "stream_consumed", task_id_bytes, timeout=10)
@@ -281,8 +281,13 @@ class WorkerRuntime(ClusterCore):
                     if consumed < 0:  # stream abandoned owner-side
                         cancelled = True
                         break
-                    if index - consumed > self._STREAM_AHEAD_MAX:
-                        time.sleep(0.02)
+                    if index - consumed > ahead_max:
+                        # Exponential poll backoff: a long-stalled
+                        # consumer must not cost the owner 50 RPCs/s.
+                        time.sleep(poll_sleep)
+                        poll_sleep = min(0.5, poll_sleep * 1.6)
+                    else:
+                        poll_sleep = 0.02
                 if cancelled:
                     break
             if cancelled and hasattr(gen, "close"):

@@ -142,8 +142,9 @@ class ObjectRefGenerator:
 
     def __next__(self) -> ObjectRef:
         try:
-            ref = self._core._next_stream_ref(self._task_id, self._index,
-                                              timeout=600.0)
+            ref = self._core._next_stream_ref(
+                self._task_id, self._index,
+                timeout=cfg.streaming_item_timeout_s)
         except StopIteration:
             self._exhausted = True
             raise
@@ -1117,7 +1118,9 @@ class ClusterCore:
                              resources=None, max_retries: int = 0,
                              retry_exceptions: bool = False,
                              scheduling_strategy=None, name: str = "",
-                             runtime_env=None) -> "_SubmitTemplate":
+                             runtime_env=None,
+                             generator_backpressure_num_objects=None
+                             ) -> "_SubmitTemplate":
         """Precompute everything about a submission that does not vary per
         call (reference analog: the per-SchedulingKey caching inside
         NormalTaskSubmitter). ``RemoteFunction`` caches the result, so the
@@ -1155,6 +1158,9 @@ class ClusterCore:
         }
         if streaming:
             spec_proto["streaming"] = True
+            if generator_backpressure_num_objects is not None:
+                spec_proto["stream_ahead"] = int(
+                    generator_backpressure_num_objects)
         return _SubmitTemplate(
             func, num_returns, res, strategy, task_name, sched_key, spread,
             max_retries if retry_exceptions else 0, runtime_env,
@@ -1237,10 +1243,29 @@ class ClusterCore:
         with self._streams_lock:
             self._streams.pop(task_id_bytes, None)
 
+    def _mark_cancelled(self, task_id: TaskID) -> None:
+        """Shared cancel bookkeeping: remember the id (bounded) and tell
+        the executing worker, if dispatched (used by cancel() and stream
+        abandonment)."""
+        self._cancelled.add(task_id)
+        self._cancelled_order.append(task_id)
+        while len(self._cancelled_order) > 8192:
+            self._cancelled.discard(self._cancelled_order.popleft())
+        with self._inflight_lock:
+            info = self._inflight.get(task_id.binary())
+        if info is not None and info.worker_addr:
+            try:
+                self._pool.get(info.worker_addr).notify(
+                    "cancel_task", task_id.binary())
+            except Exception:
+                pass
+
     def _abandon_stream(self, task_id: TaskID) -> None:
         """The consumer dropped its generator: cancel producer-side and
         release every delivered-but-unconsumed item (consumed items'
-        ObjectRefs release themselves through normal ref GC)."""
+        ObjectRefs release themselves through normal ref GC; items racing
+        through rpc_batch_done are reconciled post-commit in
+        _fire_stream_notifies)."""
         task_id_bytes = task_id.binary()
         with self._streams_lock:
             st = self._streams.pop(task_id_bytes, None)
@@ -1248,27 +1273,20 @@ class ClusterCore:
             return
         with st.cv:
             consumed, received = st.consumed, st.received
-            st.error = TaskError("stream abandoned by consumer")
+            st.error = TaskError(
+                "StreamAbandoned", "stream abandoned by consumer")
             st.cv.notify_all()
-        self._cancelled.add(task_id)  # worker's streaming loop checks this
-        self._cancelled_order.append(task_id)
-        while len(self._cancelled_order) > 8192:
-            self._cancelled.discard(self._cancelled_order.popleft())
-        with self._inflight_lock:
-            info = self._inflight.get(task_id_bytes)
-        if info is not None and info.worker_addr:
-            try:
-                self._pool.get(info.worker_addr).notify(
-                    "cancel_task", task_id_bytes)
-            except Exception:
-                pass
+        self._mark_cancelled(task_id)
         for idx in range(consumed, received):
-            oid = ObjectID.for_stream_return(task_id, idx)
-            self.memory_store.delete([oid])
-            try:
-                self.refcount.drop_owned_object(oid)
-            except Exception:
-                pass
+            self._release_stream_item(task_id, idx)
+
+    def _release_stream_item(self, task_id: TaskID, index: int) -> None:
+        oid = ObjectID.for_stream_return(task_id, index)
+        self.memory_store.delete([oid])
+        try:
+            self.refcount.drop_owned_object(oid)
+        except Exception:
+            pass
 
     def rpc_stream_consumed(self, conn, task_id_bytes: bytes) -> int:
         """Producer flow-control poll: how many items the consumer has
@@ -1311,6 +1329,12 @@ class ClusterCore:
             with self._streams_lock:
                 st = self._streams.get(entry[1])
             if st is None:
+                # Stream abandoned while this batch was mid-commit: the
+                # item landed in the store AFTER _abandon_stream's release
+                # pass — reconcile here or it is owned forever with no
+                # ref and no release path.
+                if entry[0] == "item":
+                    self._release_stream_item(TaskID(entry[1]), entry[2])
                 continue
             with st.cv:
                 if entry[0] == "item":
@@ -1773,12 +1797,11 @@ class ClusterCore:
         from ray_tpu.exceptions import TaskCancelledError
 
         task_id = ref.id().task_id()
-        self._cancelled.add(task_id)
-        self._cancelled_order.append(task_id)
-        while len(self._cancelled_order) > 8192:
-            old = self._cancelled_order.popleft()
-            self._cancelled.discard(old)
         tid_bytes = task_id.binary()
+        # Mark FIRST (closes the race with a concurrent dispatch: the
+        # push path re-checks _cancelled right before pushing), then
+        # remove from queues / notify the worker.
+        self._mark_cancelled(task_id)
         # Still queued? Remove + fail its returns.
         with self._lease_lock:
             for kq in self._key_queues.values():
@@ -1792,15 +1815,6 @@ class ClusterCore:
                                                   is_exception=True)
                         self._release_submitted_args(tid_bytes)
                         return
-        # Dispatched: tell the worker not to start it.
-        with self._inflight_lock:
-            info = self._inflight.get(tid_bytes)
-        if info is not None and info.worker_addr:
-            try:
-                self._pool.get(info.worker_addr).notify(
-                    "cancel_task", tid_bytes)
-            except Exception:
-                pass
 
     # ------------------------------------------------------------------ actors
 

@@ -145,6 +145,16 @@ _d("rpc_chaos_failure_prob", float, 0.0,
    "(src/ray/rpc/rpc_chaos.h)")
 _d("pubsub_poll_timeout_s", float, 30.0, "long-poll timeout")
 
+# --- streaming generators ---
+_d("streaming_item_timeout_s", float, 600.0,
+   "how long ObjectRefGenerator.__next__ waits for the next yield before "
+   "raising GetTimeoutError (slow-but-healthy producers need headroom)")
+_d("streaming_ahead_max", int, 64,
+   "default producer window: items delivered ahead of the consumer before "
+   "the streaming-generator producer pauses (reference: "
+   "_generator_backpressure_num_objects); per-task override via the "
+   "generator_backpressure_num_objects task option")
+
 # --- data ---
 _d("data_memory_budget_bytes", int, 512 * 1024**2,
    "streaming execution: target cap on bytes of blocks in flight across "

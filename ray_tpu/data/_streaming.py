@@ -199,10 +199,21 @@ class InputOperator(Operator):
         `parallelism` concurrent, items consumed in yield order. Each
         task yields block, then BlockMetadata, alternating — the driver
         fetches only the metadata items. In-flight bytes are bounded by
-        the producer-side stream flow control (the consumer-driven pause
-        in the worker), not the ExecContext byte budget."""
+        the producer-side stream window, SIZED from the pipeline memory
+        budget (budget / (block estimate x live streams)) so big blocks
+        cannot pile up 64-deep per stream regardless of their size."""
+        budget_bytes = (ctx.budget.limit if ctx else 0)
+        est = max(1, cfg.data_block_size_estimate)
+        live_streams = max(1, min(self._parallelism, len(tasks)))
+        if budget_bytes > 0:
+            ahead_blocks = max(2, min(64, budget_bytes
+                                      // (est * live_streams)))
+        else:
+            ahead_blocks = 64
+        # Items alternate block/meta: 2 items per block.
+        opts = {"generator_backpressure_num_objects": 2 * ahead_blocks}
 
-        @ray_tpu.remote(num_returns="streaming")
+        @ray_tpu.remote(num_returns="streaming", **opts)
         def _read_stream(task):
             out = task()
             chunks = out if hasattr(out, "__next__") else [out]

@@ -18,6 +18,7 @@ _VALID_OPTIONS = {
     "runtime_env", "max_concurrency", "max_restarts", "max_task_retries",
     "lifetime", "namespace", "get_if_exists", "placement_group",
     "max_calls", "concurrency_groups", "label_selector",
+    "generator_backpressure_num_objects",
 }
 
 
@@ -71,7 +72,9 @@ class RemoteFunction:
                     max_retries=0, retry_exceptions=False,
                     scheduling_strategy=opts.get("scheduling_strategy"),
                     name=opts.get("name") or self._func.__qualname__,
-                    runtime_env=opts.get("runtime_env"))
+                    runtime_env=opts.get("runtime_env"),
+                    generator_backpressure_num_objects=opts.get(
+                        "generator_backpressure_num_objects"))
                 self._tmpl_rt = weakref.ref(rt)
             return rt.submit_templated(self._tmpl, args, kwargs)
         make_tmpl = getattr(rt, "make_submit_template", None)

@@ -1,0 +1,412 @@
+"""DQN: replay-buffer off-policy learning (double DQN + target network).
+
+Parity target: the reference DQN family
+(reference: rllib/algorithms/dqn/dqn.py DQN/DQNConfig, training_step's
+sample->store->replay->update->target-sync loop; dqn_rainbow_learner.py
+for the double-Q/target-net update; utils/replay_buffers/ for storage).
+TPU-first: the whole TD update (double-Q targets, Huber loss, Adam,
+periodic target sync) is one jitted function; the grads path is split
+(compute_grads/apply_grads) so a LearnerGroup can allreduce gradients
+across learner actors between the two halves (the reference's
+multi-learner DDP role, learner_group.py:80)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
+                                          ReplayBuffer)
+
+
+class DQNLearnerState(NamedTuple):
+    params: Any
+    target_params: Any
+    opt_state: Any
+    updates: Any  # jnp scalar: gradient steps taken (drives target sync)
+
+
+class DQNLearner:
+    """Double-DQN learner over a jitted TD update."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 hidden: int = 64, lr: float = 1e-3, gamma: float = 0.99,
+                 target_update_freq: int = 200, huber_delta: float = 1.0,
+                 max_grad_norm: float = 10.0, seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.rllib import models
+
+        self.gamma = gamma
+        self.target_update_freq = target_update_freq
+        self.huber_delta = huber_delta
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adam(lr),
+        )
+        params = models.init_q_params(jax.random.PRNGKey(seed), obs_size,
+                                      num_actions, hidden)
+        import jax.numpy as jnp
+
+        self.state = DQNLearnerState(params, jax.tree.map(jnp.copy, params),
+                                     self._tx.init(params),
+                                     jnp.zeros((), jnp.int32))
+        self._grads_fn = jax.jit(self._compute_grads_impl)
+        self._apply_fn = jax.jit(self._apply_grads_impl)
+
+    # ------------------------------------------------------------- weights
+
+    def get_weights(self):
+        return self.state.params
+
+    def set_weights(self, params) -> None:
+        self.state = self.state._replace(params=params)
+
+    # -------------------------------------------------------------- update
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        grads, stats, td = self.compute_grads(batch)
+        self.apply_grads(grads)
+        stats["td_errors"] = td
+        return stats
+
+    def compute_grads(self, batch: Dict[str, np.ndarray]):
+        """(grads pytree, scalar stats, td_errors) — the multi-learner
+        cut point: allreduce grads between compute and apply."""
+        grads, (loss, qmean, td) = self._grads_fn(self.state, batch)
+        return grads, {"loss": float(loss), "q_mean": float(qmean)}, \
+            np.asarray(td)
+
+    def apply_grads(self, grads) -> None:
+        self.state = self._apply_fn(self.state, grads)
+
+    # ---------------------------------------------------------------- impl
+
+    def _compute_grads_impl(self, state: DQNLearnerState, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import models
+
+        obs = batch["obs"]
+        actions = batch["actions"]
+        rewards = batch["rewards"]
+        next_obs = batch["next_obs"]
+        dones = batch["dones"]
+        weights = batch.get("weights")
+
+        # Double DQN: online net PICKS the next action, target net SCORES
+        # it (reference: dqn_rainbow_learner double_q path).
+        next_a = jnp.argmax(models.q_apply(state.params, next_obs), axis=-1)
+        next_q = jnp.take_along_axis(
+            models.q_apply(state.target_params, next_obs),
+            next_a[:, None], axis=-1)[:, 0]
+        targets = rewards + self.gamma * (1.0 - dones) * next_q
+        targets = jax.lax.stop_gradient(targets)
+
+        def loss_fn(params):
+            q = jnp.take_along_axis(
+                models.q_apply(params, obs), actions[:, None], axis=-1)[:, 0]
+            td = q - targets
+            d = self.huber_delta
+            hub = jnp.where(jnp.abs(td) <= d, 0.5 * td ** 2,
+                            d * (jnp.abs(td) - 0.5 * d))
+            if weights is not None:
+                hub = hub * weights
+            return hub.mean(), (q.mean(), td)
+
+        (loss, (qmean, td)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return grads, (loss, qmean, td)
+
+    def _apply_grads_impl(self, state: DQNLearnerState, grads):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        updates, opt_state = self._tx.update(grads, state.opt_state,
+                                             state.params)
+        params = optax.apply_updates(state.params, updates)
+        n = state.updates + 1
+        sync = (n % self.target_update_freq) == 0
+        target = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params)
+        return DQNLearnerState(params, target, opt_state, n)
+
+
+class _DQNRunner:
+    """Epsilon-greedy transition collector over a vector env (reference:
+    the off-policy EnvRunner sampling raw transitions into the buffer)."""
+
+    def __init__(self, env_spec, num_envs: int, seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib import models
+
+        self.env = make_env(env_spec, num_envs=num_envs, seed=seed)
+        self.obs = self.env.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._act = jax.jit(models.epsilon_greedy_action)
+        self._params = None
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: list = []
+
+    def set_weights(self, params_ref) -> bool:
+        import ray_tpu
+
+        self._params = (ray_tpu.get(params_ref)
+                        if isinstance(params_ref, ray_tpu.ObjectRef)
+                        else params_ref)
+        return True
+
+    def sample_transitions(self, n_steps: int,
+                           epsilon: float) -> Dict[str, np.ndarray]:
+        import jax
+
+        assert self._params is not None
+        B = self.env.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(n_steps):
+            self._key, k = jax.random.split(self._key)
+            a = np.asarray(self._act(self._params, self.obs, k, epsilon))
+            prev_obs = self.obs
+            self.obs, r, done, info = self.env.step(a)
+            terminated = info.get("terminated", done)
+            # next_obs for the transition is the PRE-reset observation;
+            # the TD target zeroes only on true termination (truncation
+            # bootstraps, same contract as the PPO GAE path).
+            final_obs = info.get("final_obs", self.obs)
+            next_obs = np.where(done[:, None], final_obs, self.obs)
+            obs_l.append(prev_obs)
+            act_l.append(a)
+            rew_l.append(r)
+            next_l.append(next_obs)
+            done_l.append(terminated.astype(np.float32))
+            self._ep_return += r
+            for i in np.flatnonzero(done):
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        return {
+            "obs": np.concatenate(obs_l),
+            "actions": np.concatenate(act_l),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l),
+            "dones": np.concatenate(done_l),
+            "steps": n_steps * B,
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        return {
+            "episode_return_mean":
+                float(np.mean(completed)) if completed else None,
+            "num_episodes": len(completed),
+        }
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    """Builder-style config (reference: DQNConfig fluent API)."""
+
+    env: Union[str, Callable] = "CartPole"
+    num_env_runners: int = 0
+    num_envs_per_runner: int = 8
+    rollout_steps: int = 32          # env steps per runner per iteration
+    hidden: int = 64
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    prioritized_replay: bool = False
+    learning_starts: int = 1_000
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_freq: int = 200
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 8_000
+    num_learners: int = 0            # 0 = in-process; N = learner actors
+    seed: int = 0
+
+    def environment(self, env) -> "DQNConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int = None,
+                    num_envs_per_env_runner: int = None,
+                    rollout_fragment_length: int = None) -> "DQNConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_steps = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: float = None, gamma: float = None,
+                 train_batch_size: int = None,
+                 target_network_update_freq: int = None,
+                 num_steps_sampled_before_learning_starts: int = None,
+                 updates_per_iteration: int = None,
+                 prioritized_replay: bool = None,
+                 buffer_capacity: int = None) -> "DQNConfig":
+        for name, val in (("lr", lr), ("gamma", gamma),
+                          ("train_batch_size", train_batch_size),
+                          ("target_update_freq",
+                           target_network_update_freq),
+                          ("learning_starts",
+                           num_steps_sampled_before_learning_starts),
+                          ("updates_per_iteration", updates_per_iteration),
+                          ("prioritized_replay", prioritized_replay),
+                          ("buffer_capacity", buffer_capacity)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def learners(self, *, num_learners: int = None) -> "DQNConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """The algorithm object (reference: DQN(Algorithm), training_step:
+    sample -> store -> replay -> update -> target sync -> weight sync)."""
+
+    def __init__(self, config: DQNConfig):
+        import ray_tpu
+        from ray_tpu.rllib.learner_group import LearnerGroup
+
+        self.config = config
+        probe = make_env(config.env, num_envs=1, seed=config.seed)
+        obs_size, num_actions = probe.observation_size, probe.num_actions
+
+        def factory():
+            return DQNLearner(
+                obs_size, num_actions, hidden=config.hidden, lr=config.lr,
+                gamma=config.gamma,
+                target_update_freq=config.target_update_freq,
+                seed=config.seed)
+
+        self.learner_group = LearnerGroup(
+            factory, num_learners=config.num_learners,
+            group_name=f"dqn-{id(self)}")
+        buf_cls = (PrioritizedReplayBuffer if config.prioritized_replay
+                   else ReplayBuffer)
+        self.buffer = buf_cls(config.buffer_capacity, obs_size,
+                              seed=config.seed)
+        if config.num_env_runners == 0:
+            self._local_runner: Optional[_DQNRunner] = _DQNRunner(
+                config.env, config.num_envs_per_runner, config.seed)
+            self._runner_actors = []
+        else:
+            self._local_runner = None
+            cls = ray_tpu.remote(_DQNRunner)
+            self._runner_actors = [
+                cls.remote(config.env, config.num_envs_per_runner,
+                           config.seed + 1000 * i)
+                for i in range(config.num_env_runners)]
+        self._sync_runner_weights()
+        self._iteration = 0
+        self._total_steps = 0
+
+    # ------------------------------------------------------------- helpers
+
+    def _sync_runner_weights(self) -> None:
+        import ray_tpu
+
+        w = self.learner_group.get_weights()
+        if self._local_runner is not None:
+            self._local_runner.set_weights(w)
+            return
+        ref = ray_tpu.put(w)
+        ray_tpu.get([a.set_weights.remote(ref)
+                     for a in self._runner_actors])
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._total_steps / max(1, c.epsilon_decay_steps))
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def _collect(self) -> int:
+        import ray_tpu
+
+        eps = self._epsilon()
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample_transitions(
+                self.config.rollout_steps, eps)]
+        else:
+            batches = ray_tpu.get([
+                a.sample_transitions.remote(self.config.rollout_steps, eps)
+                for a in self._runner_actors])
+        steps = 0
+        for b in batches:
+            self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
+                                  b["next_obs"], b["dones"])
+            steps += int(b["steps"])
+        return steps
+
+    # --------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, Any]:
+        self._total_steps += self._collect()
+        stats: Dict[str, Any] = {}
+        if len(self.buffer) >= self.config.learning_starts:
+            for _ in range(self.config.updates_per_iteration):
+                batch = self.buffer.sample(self.config.train_batch_size)
+                stats = self.learner_group.update_from_batch(batch)
+                td = stats.pop("td_errors", None)
+                if (td is not None
+                        and isinstance(self.buffer,
+                                       PrioritizedReplayBuffer)):
+                    self.buffer.update_priorities(batch["indices"], td)
+            self._sync_runner_weights()
+        return stats
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        learner_stats = self.training_step()
+        self._iteration += 1
+        if self._local_runner is not None:
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            import ray_tpu
+
+            metrics = ray_tpu.get([a.get_metrics.remote()
+                                   for a in self._runner_actors])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m.get("episode_return_mean") is not None]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "epsilon": self._epsilon(),
+            "time_this_iter_s": time.monotonic() - t0,
+            "env_runners": {
+                "episode_return_mean":
+                    float(np.mean(returns)) if returns else None,
+                "num_episodes": sum(m.get("num_episodes", 0)
+                                    for m in metrics),
+            },
+            "learners": {"default_policy": learner_stats},
+        }
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        self.learner_group.stop()
+        for a in self._runner_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

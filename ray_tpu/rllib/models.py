@@ -60,3 +60,28 @@ def sample_action(params: Params, obs: jax.Array, key: jax.Array):
     logp = jax.nn.log_softmax(logits)[
         jnp.arange(action.shape[0]), action]
     return action, logp, value
+
+
+# ----------------------------------------------------------------- Q nets
+
+def init_q_params(key: jax.Array, obs_size: int, num_actions: int,
+                  hidden: int = 64) -> Params:
+    """Q-network params (reference: DQN's RLModule Q head)."""
+    return {"q": init_mlp_params(key, (obs_size, hidden, hidden,
+                                       num_actions))}
+
+
+def q_apply(params: Params, obs: jax.Array) -> jax.Array:
+    """obs [..., obs_size] -> q-values [..., A]."""
+    return mlp_apply(params["q"], obs, 3)
+
+
+def epsilon_greedy_action(params: Params, obs: jax.Array, key: jax.Array,
+                          epsilon: jax.Array) -> jax.Array:
+    """Exploration policy for value-based methods — jit-friendly."""
+    q = q_apply(params, obs)
+    greedy = jnp.argmax(q, axis=-1)
+    kr, ka = jax.random.split(key)
+    random_a = jax.random.randint(ka, greedy.shape, 0, q.shape[-1])
+    explore = jax.random.uniform(kr, greedy.shape) < epsilon
+    return jnp.where(explore, random_a, greedy)

@@ -1,0 +1,228 @@
+"""Actor-level collectives: allreduce/allgather/broadcast/barrier over
+actor gangs.
+
+Parity target: the reference's `ray.util.collective`
+(reference: python/ray/util/collective/collective.py —
+init_collective_group :120, allreduce :258, allgather :423,
+reducescatter :472, send/recv :531/:594, backed by NCCL/Gloo groups).
+TPU-first re-design: tensor-parallel collectives inside ONE SPMD program
+are XLA collectives over ICI (psum/all_gather in pjit/shard_map — see
+parallel/), so this module exists for the OTHER tier the reference also
+serves: host-side gangs of independent actors (Tune trials, RL learners,
+elastic groups) that must reduce without entering one compiled program.
+
+Implementation: a per-group coordinator actor gathers each rank's
+contribution per operation sequence number, reduces once, and hands every
+rank the result (object-store refs carry the payloads, so N-rank
+allreduce moves each array twice over the object plane). This is the
+Gloo-backend role, not the NCCL one — correctness and API parity over
+peak bandwidth; gangs needing line-rate reductions belong inside SPMD.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+class _GroupContext:
+    __slots__ = ("coordinator", "world_size", "rank", "seq", "lock")
+
+    def __init__(self, coordinator, world_size: int, rank: int):
+        self.coordinator = coordinator
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+        self.lock = threading.Lock()
+
+
+# Process-wide (NOT thread-local: actors with max_concurrency>1 serve
+# methods from a thread pool, and the gang identity is per-process).
+_GROUPS: Dict[str, _GroupContext] = {}
+_GROUPS_LOCK = threading.Lock()
+
+
+def _contexts() -> Dict[str, _GroupContext]:
+    return _GROUPS
+
+
+class _Coordinator:
+    """Rendezvous + reduce for one collective group. Every op carries a
+    sequence number; contributions for the same (op_kind, seq) rendezvous
+    together, the reduction computes once, and all ranks read the same
+    result. Handlers block (the actor runs with max_concurrency >= world
+    size), mirroring the synchronous semantics of the reference API."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        #: (kind, seq) -> {"parts": {rank: value}, "result": ...}
+        self._ops: Dict[tuple, Dict[str, Any]] = {}
+
+    def world_size(self) -> int:
+        return self._world
+
+    def _rendezvous(self, kind: str, seq: int, rank: int, value,
+                    finalize, timeout: float = 300.0):
+        key = (kind, seq)
+        with self._cv:
+            op = self._ops.setdefault(key, {"parts": {}, "result": None,
+                                            "taken": 0})
+            op["parts"][rank] = value
+            if len(op["parts"]) == self._world:
+                op["result"] = finalize(op["parts"])
+                self._cv.notify_all()
+            else:
+                if not self._cv.wait_for(
+                        lambda: op["result"] is not None, timeout):
+                    self._ops.pop(key, None)
+                    raise TimeoutError(
+                        f"collective {kind}#{seq}: only "
+                        f"{len(op['parts'])}/{self._world} ranks arrived")
+            result = op["result"]
+            op["taken"] += 1
+            if op["taken"] >= self._world:
+                self._ops.pop(key, None)  # all ranks served: GC the op
+            return result
+
+    def allreduce(self, rank: int, seq: int, array, op: str = "sum"):
+        def finalize(parts):
+            stack = np.stack([np.asarray(parts[r])
+                              for r in range(self._world)])
+            if op == "sum":
+                return stack.sum(axis=0)
+            if op == "mean":
+                return stack.mean(axis=0)
+            if op == "max":
+                return stack.max(axis=0)
+            if op == "min":
+                return stack.min(axis=0)
+            raise ValueError(f"unknown reduce op {op!r}")
+
+        return self._rendezvous("allreduce", seq, rank, array, finalize)
+
+    def allgather(self, rank: int, seq: int, array):
+        # No coercion: values may be LISTS of ragged arrays (a gradient
+        # pytree's leaves ride one allgather via allreduce_multi).
+        return self._rendezvous(
+            "allgather", seq, rank, array,
+            lambda parts: [parts[r] for r in range(self._world)])
+
+    def reducescatter(self, rank: int, seq: int, array, op: str = "sum"):
+        def finalize(parts):
+            stack = np.stack([np.asarray(parts[r])
+                              for r in range(self._world)])
+            red = stack.mean(axis=0) if op == "mean" else stack.sum(axis=0)
+            return np.array_split(red, self._world)
+
+        chunks = self._rendezvous("reducescatter", seq, rank, array,
+                                  finalize)
+        return chunks[rank]
+
+    def broadcast(self, rank: int, seq: int, array, root: int = 0):
+        return self._rendezvous(
+            "broadcast", seq, rank, array,
+            lambda parts: np.asarray(parts[root]))
+
+    def barrier(self, rank: int, seq: int) -> bool:
+        self._rendezvous("barrier", seq, rank, None, lambda parts: True)
+        return True
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join this process/actor to a named collective gang (reference:
+    init_collective_group, collective.py:120). Every rank must call it;
+    rank 0's call may create the coordinator."""
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    actor_cls = ray_tpu.remote(_Coordinator)
+    coordinator = actor_cls.options(
+        name=f"rtpu-collective-{group_name}", get_if_exists=True,
+        num_cpus=0, max_concurrency=max(8, world_size + 2),
+    ).remote(world_size)
+    ws = ray_tpu.get(coordinator.world_size.remote(), timeout=60)
+    if ws != world_size:
+        raise ValueError(
+            f"group {group_name!r} already exists with world_size {ws}")
+    _contexts()[group_name] = _GroupContext(coordinator, world_size, rank)
+
+
+def _ctx(group_name: str) -> _GroupContext:
+    ctx = _contexts().get(group_name)
+    if ctx is None:
+        raise RuntimeError(
+            f"no collective group {group_name!r} in this process: call "
+            f"init_collective_group(world_size, rank, group_name) first")
+    return ctx
+
+
+def _op(group_name: str):
+    ctx = _ctx(group_name)
+    with ctx.lock:
+        seq = ctx.seq
+        ctx.seq += 1
+    return ctx, seq
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Synchronous allreduce; returns the reduced array (reference
+    allreduce mutates in place for NCCL; host arrays return here)."""
+    ctx, seq = _op(group_name)
+    return ray_tpu.get(ctx.coordinator.allreduce.remote(
+        ctx.rank, seq, np.asarray(tensor), op), timeout=600)
+
+
+def allreduce_multi(tensors: List[Any], group_name: str = "default",
+                    op: str = "sum") -> List[np.ndarray]:
+    """Allreduce a LIST of arrays in one rendezvous (one round trip for a
+    whole gradient pytree's leaves)."""
+    ctx, seq = _op(group_name)
+    flat = [np.asarray(t) for t in tensors]
+    out = ray_tpu.get(ctx.coordinator.allgather.remote(
+        ctx.rank, seq, flat), timeout=600)
+    # Reduce locally: sum/mean across ranks leaf-wise.
+    n = len(out)
+    result = []
+    for leaf_i in range(len(flat)):
+        stack = np.stack([out[r][leaf_i] for r in range(n)])
+        result.append(stack.mean(axis=0) if op == "mean"
+                      else stack.sum(axis=0))
+    return result
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    ctx, seq = _op(group_name)
+    return ray_tpu.get(ctx.coordinator.allgather.remote(
+        ctx.rank, seq, np.asarray(tensor)), timeout=600)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    ctx, seq = _op(group_name)
+    return ray_tpu.get(ctx.coordinator.reducescatter.remote(
+        ctx.rank, seq, np.asarray(tensor), op), timeout=600)
+
+
+def broadcast(tensor, root: int = 0, group_name: str = "default"):
+    ctx, seq = _op(group_name)
+    return ray_tpu.get(ctx.coordinator.broadcast.remote(
+        ctx.rank, seq, None if tensor is None else np.asarray(tensor),
+        root), timeout=600)
+
+
+def barrier(group_name: str = "default") -> None:
+    ctx, seq = _op(group_name)
+    ray_tpu.get(ctx.coordinator.barrier.remote(ctx.rank, seq), timeout=600)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    ctx = _contexts().pop(group_name, None)
+    if ctx is not None and ctx.rank == 0:
+        try:
+            ray_tpu.kill(ctx.coordinator)
+        except Exception:
+            pass
