@@ -245,7 +245,11 @@ class WorkerRuntime(ClusterCore):
         task_id_bytes = task_id.binary()
         # Per-task override (generator_backpressure_num_objects) beats the
         # global default — Data sizes it to the pipeline memory budget.
-        ahead_max = int(stream_ahead or cfg.streaming_ahead_max)
+        # <= 0 disables backpressure (the reference's -1 sentinel).
+        ahead_max = (int(stream_ahead) if stream_ahead is not None
+                     else int(cfg.streaming_ahead_max))
+        if ahead_max <= 0:
+            ahead_max = float("inf")
         index = 0
         consumed = 0
         err = None

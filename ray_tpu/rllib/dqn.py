@@ -296,8 +296,7 @@ class DQN:
                 seed=config.seed)
 
         self.learner_group = LearnerGroup(
-            factory, num_learners=config.num_learners,
-            group_name=f"dqn-{id(self)}")
+            factory, num_learners=config.num_learners)
         buf_cls = (PrioritizedReplayBuffer if config.prioritized_replay
                    else ReplayBuffer)
         self.buffer = buf_cls(config.buffer_capacity, obs_size,

@@ -31,7 +31,10 @@ class _LearnerActor:
         collective.init_collective_group(world, rank, group_name)
         self._group = group_name
 
-    def update_shard(self, batch_ref) -> Dict[str, Any]:
+    def update_shard(self, batch_ref, weight: float = 1.0) -> Dict[str, Any]:
+        """weight = shard_rows * world / total_rows: pre-scaling each
+        local gradient makes the gang's unweighted mean equal the exact
+        FULL-batch gradient even when shards divide unevenly."""
         import jax
         import ray_tpu
         from ray_tpu.util import collective
@@ -41,7 +44,7 @@ class _LearnerActor:
         grads, stats, td = self.learner.compute_grads(batch)
         flat, treedef = jax.tree_util.tree_flatten(grads)
         reduced = collective.allreduce_multi(
-            [np.asarray(g) for g in flat], self._group, op="mean")
+            [np.asarray(g) * weight for g in flat], self._group, op="mean")
         self.learner.apply_grads(
             jax.tree_util.tree_unflatten(treedef, reduced))
         stats["td_errors"] = td
@@ -57,9 +60,14 @@ class _LearnerActor:
 
 class LearnerGroup:
     def __init__(self, learner_factory: Callable, *, num_learners: int = 0,
-                 group_name: str = "learner-group"):
+                 group_name: Optional[str] = None):
+        import uuid
+
         self._actors: List[Any] = []
         self._local = None
+        # Unique by default: a reused name (e.g. from a recycled id())
+        # would attach to a stale coordinator with the wrong world size.
+        self._group_name = group_name or f"lg-{uuid.uuid4().hex[:10]}"
         if num_learners == 0:
             self._local = learner_factory()
             return
@@ -68,7 +76,7 @@ class LearnerGroup:
         cls = ray_tpu.remote(_LearnerActor)
         self._actors = [
             cls.options(max_concurrency=2).remote(
-                learner_factory, rank, num_learners, group_name)
+                learner_factory, rank, num_learners, self._group_name)
             for rank in range(num_learners)]
         # Construction barrier: every rank joined the collective group.
         ray_tpu.get([a.get_weights.remote() for a in self._actors],
@@ -85,17 +93,27 @@ class LearnerGroup:
         import ray_tpu
 
         # Shard the batch row-wise across learners; each computes local
-        # grads, the gang allreduces (mean), all apply identically.
+        # grads, the gang allreduces, all apply identically. A batch
+        # smaller than the gang would leave EMPTY shards (NaN gradients
+        # from a zero-row loss mean): wrap rows so every learner gets at
+        # least one row, and weight grads by shard size so the reduced
+        # mean equals the full-batch gradient for uneven splits.
         n = len(self._actors)
         rows = len(batch["actions"])
-        shards = []
+        if rows < n:
+            idx = np.arange(n) % rows
+            batch = {k: v[idx] for k, v in batch.items()}
+            rows = n
         bounds = np.linspace(0, rows, n + 1).astype(int)
+        shards, weights = [], []
         for i in range(n):
             lo, hi = bounds[i], bounds[i + 1]
             shards.append({k: v[lo:hi] for k, v in batch.items()})
+            weights.append((hi - lo) * n / rows)
         stats = ray_tpu.get(
-            [a.update_shard.remote(shard)
-             for a, shard in zip(self._actors, shards)], timeout=600)
+            [a.update_shard.remote(shard, w)
+             for a, shard, w in zip(self._actors, shards, weights)],
+            timeout=600)
         # td_errors re-assemble in batch order (priority updates need
         # positions aligned to the ORIGINAL batch indices).
         tds = [s.pop("td_errors", None) for s in stats]
@@ -128,5 +146,13 @@ class LearnerGroup:
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
+            except Exception:
+                pass
+        if self._actors:
+            # The gang's named coordinator actor dies with the group —
+            # leaked coordinators would accumulate per LearnerGroup.
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(
+                    f"rtpu-collective-{self._group_name}"))
             except Exception:
                 pass

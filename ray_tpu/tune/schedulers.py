@@ -165,3 +165,59 @@ class PopulationBasedTraining:
                 decisions[trial_id] = {"action": "clone", "source": source,
                                        "config": new_config}
         return decisions
+
+
+class MedianStoppingRule:
+    """Median stopping (reference: python/ray/tune/schedulers/
+    median_stopping_rule.py): a trial stops when its best metric so far
+    falls below the MEDIAN of other trials' running-average metric at the
+    same iteration — a gentle prune that needs no rung schedule.
+
+    Guards: no stops before `min_samples_required` trials have reported
+    at an iteration, nor before `grace_period` iterations of the trial
+    itself (fresh trials get time to warm up)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 grace_period: int = 2, min_samples_required: int = 3):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of scores per reported iteration
+        self._scores: Dict[str, List[float]] = {}
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metrics: Dict[str, Any]) -> str:
+        return self.on_batch([(trial_id, iteration, metrics)])[trial_id]
+
+    def on_batch(self, results) -> Dict[str, str]:
+        decisions: Dict[str, str] = {}
+        for trial_id, _iteration, metrics in results:
+            self._scores.setdefault(trial_id, []).append(
+                self._score(metrics))
+        for trial_id, iteration, _metrics in results:
+            mine = self._scores[trial_id]
+            if iteration < self.grace:
+                decisions[trial_id] = CONTINUE
+                continue
+            t = len(mine)
+            # Other trials' RUNNING AVERAGE over their first t reports.
+            others = [sum(s[:t]) / min(t, len(s))
+                      for tid, s in self._scores.items()
+                      if tid != trial_id and s]
+            if len(others) < self.min_samples:
+                decisions[trial_id] = CONTINUE
+                continue
+            others.sort()
+            mid = len(others) // 2
+            median = (others[mid] if len(others) % 2
+                      else 0.5 * (others[mid - 1] + others[mid]))
+            best = max(mine)
+            decisions[trial_id] = CONTINUE if best >= median else STOP
+        return decisions

@@ -93,3 +93,203 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
                     cfg[k] = v
             variants.append(cfg)
     return variants
+
+
+# --------------------------------------------------------------------------
+# Searchers (sequential model-based suggestion)
+# --------------------------------------------------------------------------
+
+
+class Searcher:
+    """ABC for sequential config suggestion (reference:
+    python/ray/tune/search/searcher.py Searcher — suggest /
+    on_trial_complete; hyperopt/optuna plug in behind the same seam).
+    The Tuner draws configs lazily from a searcher so every suggestion
+    can condition on finished trials."""
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: Dict[str, Any]) -> None:
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    f"param {k!r}: grid_search axes are exhaustive, not "
+                    f"suggestible — use tune.choice() with a searcher, or "
+                    f"drop the searcher for grid execution")
+        self.metric = metric
+        self.mode = mode
+        self.space = param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    # Snapshot/restore of the observation history (rides the experiment
+    # state file; reference: searcher save/restore).
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the algorithm behind hyperopt's
+    default searcher; reference integration surface:
+    python/ray/tune/search/hyperopt/hyperopt_search.py).
+
+    After ``n_initial`` random trials, observations split into the top
+    ``gamma`` fraction (good) and the rest (bad). Candidates are drawn
+    from the good-set density l(x) and ranked by l(x)/g(x): maximizing
+    that ratio proposes configs that look like winners and unlike losers
+    (Bergstra et al. 2011). Floats use Gaussian KDEs (log-space when the
+    domain is log); integers round; categoricals use smoothed counts."""
+
+    def __init__(self, *, n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[Dict[str, Any]] = []   # {"config", "score"}
+
+    # ------------------------------------------------------------ state
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"obs": self._obs}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self._obs = list(state.get("obs", []))
+
+    # ---------------------------------------------------------- suggest
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        import math
+
+        if len(self._obs) < self.n_initial:
+            cfg = {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                   for k, v in self.space.items()}
+            self._live[trial_id] = cfg
+            return cfg
+        # Split observations: maximize -> high scores are "good". The
+        # good-set size grows ~ gamma*sqrt(n) (hyperopt's rule): a flat
+        # top-25% dilutes the winners' density with mediocre points.
+        ordered = sorted(self._obs, key=lambda o: o["score"],
+                         reverse=(self.mode == "max"))
+        n = len(ordered)
+        n_good = min(max(2, round(4 * self.gamma * math.sqrt(n))), 25)
+        good, bad = ordered[:n_good], ordered[n_good:] or ordered[:1]
+
+        # Per-dimension TPE (matching hyperopt's independent-factor
+        # model): draw candidates from the good density MIXED WITH THE
+        # PRIOR (the mixture keeps exploration alive — a pure good-KDE
+        # collapses onto early mediocre winners), rank by l(x)/g(x),
+        # keep each dimension's argmax.
+        cfg: Dict[str, Any] = {}
+        for key, dom in self.space.items():
+            if not isinstance(dom, Domain):
+                cfg[key] = dom
+                continue
+            gvals = [o["config"][key] for o in good]
+            bvals = [o["config"][key] for o in bad]
+            if isinstance(dom, Categorical):
+                cats = dom.categories
+                gc = {c: 1.0 for c in cats}
+                for v in gvals:
+                    gc[v] = gc.get(v, 1.0) + 1.0
+                bc = {c: 1.0 for c in cats}
+                for w in bvals:
+                    bc[w] = bc.get(w, 1.0) + 1.0
+                gtot, btot = sum(gc.values()), sum(bc.values())
+                # Sample candidates from the good distribution, keep the
+                # best ratio (sampling, not argmax over all categories:
+                # preserves stochasticity across parallel suggests).
+                best_c, best_r = None, -math.inf
+                for _ in range(self.n_candidates):
+                    c = self._rng.choices(
+                        cats, weights=[gc[x] for x in cats])[0]
+                    r = math.log(gc[c] / gtot) - math.log(bc[c] / btot)
+                    if r > best_r:
+                        best_c, best_r = c, r
+                cfg[key] = best_c
+                continue
+            log_space = isinstance(dom, Float) and dom.log
+            xform = math.log if log_space else (lambda z: z)
+            lo_d = xform(float(dom.lower))
+            hi_d = xform(float(dom.upper if isinstance(dom, Float)
+                               else dom.upper - 1))
+            width = max(hi_d - lo_d, 1e-12)
+            gx = [xform(float(v)) for v in gvals]
+            bx = [xform(float(v)) for v in bvals]
+
+            def bandwidths(samples: List[float]) -> List[float]:
+                """Per-sample bandwidth = spacing to adjacent samples
+                (hyperopt's adaptive-parzen rule): kernels SHRINK as
+                points concentrate, so refinement is unbounded, while
+                isolated points keep wide kernels for exploration."""
+                order = sorted(range(len(samples)),
+                               key=lambda i: samples[i])
+                srt = [samples[i] for i in order]
+                bws = [0.0] * len(samples)
+                for pos, i in enumerate(order):
+                    left = srt[pos] - srt[pos - 1] if pos > 0 else width
+                    right = (srt[pos + 1] - srt[pos]
+                             if pos + 1 < len(srt) else width)
+                    bws[i] = min(max(max(left, right), width / 100.0),
+                                 width)
+                return bws
+
+            gbws = bandwidths(gx)
+            bbws = bandwidths(bx)
+
+            def logpdf(x: float, samples: List[float],
+                       bws: List[float]) -> float:
+                # MEAN kernel density blended with a uniform prior, no
+                # count asymmetry: normalizing l by n_good and g by n_bad
+                # hands every EMPTY region a constant ratio advantage of
+                # log((n_bad+1)/(n_good+1)) and the argmax degenerates to
+                # uniform exploration.
+                n = max(1, len(samples))
+                acc = 0.0
+                for s, bw in zip(samples, bws):
+                    acc += math.exp(-0.5 * ((x - s) / bw) ** 2) / (
+                        bw * 2.5066282746310002)
+                dens = 0.9 * (acc / n) + 0.1 / width
+                return math.log(max(dens, 1e-300))
+
+            best_x, best_r = None, -math.inf
+            for _ in range(self.n_candidates):
+                if self._rng.random() < 1.0 / (len(gx) + 1):
+                    x = self._rng.uniform(lo_d, hi_d)  # prior draw
+                else:
+                    i = self._rng.randrange(len(gx))
+                    x = self._rng.gauss(gx[i], gbws[i])
+                    x = min(max(x, lo_d), hi_d)
+                if isinstance(dom, Integer):
+                    x = float(int(round(x)))
+                r = logpdf(x, gx, gbws) - logpdf(x, bx, bbws)
+                if r > best_r:
+                    best_x, best_r = x, r
+            if log_space:
+                # exp(log(bound)) can land an ulp outside the domain.
+                cfg[key] = min(max(math.exp(best_x), dom.lower),
+                               dom.upper)
+            elif isinstance(dom, Integer):
+                cfg[key] = min(max(int(best_x), dom.lower), dom.upper - 1)
+            else:
+                cfg[key] = min(max(best_x, dom.lower), dom.upper)
+        self._live[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or result is None:
+            return
+        score = result.get(self.metric)
+        if score is None:
+            return
+        self._obs.append({"config": cfg, "score": float(score)})

@@ -28,7 +28,9 @@ class TuneConfig:
     mode: str = "max"                   # "max" | "min"
     num_samples: int = 1
     max_concurrent_trials: int = 4
-    scheduler: Optional[Any] = None     # FIFOScheduler | ASHAScheduler
+    scheduler: Optional[Any] = None     # FIFOScheduler | ASHAScheduler | ...
+    #: sequential searcher (TPESearcher, ...); None = random/grid variants
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
 
 
@@ -150,6 +152,7 @@ class Tuner:
         self._cfg = tune_config or TuneConfig()
         self._run_config = run_config
         self._restored_trials: Optional[List[_Trial]] = None
+        self._searcher_state: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------- experiment state
 
@@ -160,10 +163,12 @@ class Tuner:
         name = getattr(rc, "name", None) or "tune_experiment"
         return os.path.join(rc.storage_path, name)
 
-    def _snapshot(self, trials: List["_Trial"]) -> None:
+    def _snapshot(self, trials: List["_Trial"], searcher=None) -> None:
         """Atomic experiment-state snapshot after every round (reference:
         python/ray/tune/execution/experiment_state.py checkpointing) —
-        a killed driver restores with Tuner.restore()."""
+        a killed driver restores with Tuner.restore(). Searcher
+        observation state rides along (reference: searcher save/restore)
+        so a resumed BO experiment keeps its model."""
         path = self._experiment_dir()
         if path is None:
             return
@@ -178,6 +183,11 @@ class Tuner:
             "latest_checkpoint": t.latest_checkpoint,
             "perturbs": t.perturbs,
         } for t in trials]}
+        if searcher is not None:
+            try:
+                state["searcher"] = searcher.get_state()
+            except Exception:
+                pass
         tmp = os.path.join(path, ".experiment_state.tmp")
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -207,13 +217,22 @@ class Tuner:
                        perturbs=ts.get("perturbs", 0))
             trials.append(t)
         tuner._restored_trials = trials
+        tuner._searcher_state = state.get("searcher")
         return tuner
 
     def fit(self) -> ResultGrid:
         cfg = self._cfg
         scheduler = cfg.scheduler or sched_mod.FIFOScheduler()
+        searcher = cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(cfg.metric, cfg.mode,
+                                           self._space)
+            if self._searcher_state:
+                searcher.set_state(self._searcher_state)
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            trials = []  # created lazily: each suggest sees prior results
         else:
             variants = generate_variants(self._space, cfg.num_samples,
                                          cfg.seed)
@@ -225,11 +244,26 @@ class Tuner:
                 register(t.trial_id, t.config)
         pending = [t for t in trials if not t.done]
         running: List[_Trial] = []
+        created = len(trials)
+        reported_done: set = set()
         actor_cls = ray_tpu.remote(TrialActor)
 
-        while pending or running:
-            while pending and len(running) < cfg.max_concurrent_trials:
-                t = pending.pop(0)
+        def can_create() -> bool:
+            return searcher is not None and created < cfg.num_samples
+
+        while pending or running or can_create():
+            while ((pending or can_create())
+                   and len(running) < cfg.max_concurrent_trials):
+                if pending:
+                    t = pending.pop(0)
+                else:
+                    trial_id = (f"trial_{created:04d}_"
+                                f"{uuid.uuid4().hex[:6]}")
+                    t = _Trial(trial_id, searcher.suggest(trial_id))
+                    trials.append(t)
+                    created += 1
+                    if register is not None:
+                        register(t.trial_id, t.config)
                 try:
                     t.actor = actor_cls.options(num_cpus=1).remote()
                     ray_tpu.get(t.actor.start.remote(
@@ -314,7 +348,14 @@ class Tuner:
                     ray_tpu.kill(t.actor)
                 except Exception:
                     pass
-            self._snapshot(trials)
+            if searcher is not None:
+                for t in trials:
+                    if t.done and t.trial_id not in reported_done:
+                        reported_done.add(t.trial_id)
+                        searcher.on_trial_complete(
+                            t.trial_id,
+                            t.history[-1] if t.history else None)
+            self._snapshot(trials, searcher)
 
         results = [TrialResult(
             trial_id=t.trial_id, config=t.config,

@@ -105,6 +105,25 @@ class _Coordinator:
 
         return self._rendezvous("allreduce", seq, rank, array, finalize)
 
+    def allreduce_list(self, rank: int, seq: int, arrays: List[Any],
+                       op: str = "sum"):
+        """Leaf-wise reduce of a LIST of arrays (a gradient pytree's
+        leaves in one rendezvous). Server-side reduction: each rank
+        receives ONE reduced set, not every rank's copy."""
+
+        def finalize(parts):
+            n_leaves = len(parts[0])
+            out = []
+            for i in range(n_leaves):
+                stack = np.stack([np.asarray(parts[r][i])
+                                  for r in range(self._world)])
+                out.append(stack.mean(axis=0) if op == "mean"
+                           else stack.sum(axis=0))
+            return out
+
+        return self._rendezvous("allreduce_list", seq, rank, arrays,
+                                finalize)
+
     def allgather(self, rank: int, seq: int, array):
         # No coercion: values may be LISTS of ragged arrays (a gradient
         # pytree's leaves ride one allgather via allreduce_multi).
@@ -180,19 +199,12 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
 def allreduce_multi(tensors: List[Any], group_name: str = "default",
                     op: str = "sum") -> List[np.ndarray]:
     """Allreduce a LIST of arrays in one rendezvous (one round trip for a
-    whole gradient pytree's leaves)."""
+    whole gradient pytree's leaves; reduction runs coordinator-side so
+    each rank receives one reduced set, not world_size copies)."""
     ctx, seq = _op(group_name)
     flat = [np.asarray(t) for t in tensors]
-    out = ray_tpu.get(ctx.coordinator.allgather.remote(
-        ctx.rank, seq, flat), timeout=600)
-    # Reduce locally: sum/mean across ranks leaf-wise.
-    n = len(out)
-    result = []
-    for leaf_i in range(len(flat)):
-        stack = np.stack([out[r][leaf_i] for r in range(n)])
-        result.append(stack.mean(axis=0) if op == "mean"
-                      else stack.sum(axis=0))
-    return result
+    return ray_tpu.get(ctx.coordinator.allreduce_list.remote(
+        ctx.rank, seq, flat, op), timeout=600)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:

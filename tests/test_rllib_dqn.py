@@ -126,7 +126,11 @@ def test_collective_allreduce_allgather_8_actors(cluster):
         assert gathered == [[i] for i in range(world)]
         assert chunk == [float(rank) * world]  # sum of 8 copies, split
         assert b == [42.0]
-    from ray_tpu.util.collective import destroy_collective_group
+    for r in ranks:
+        ray_tpu.kill(r)
+    # The named coordinator must not outlive the gang in the shared
+    # module cluster (a stale world_size poisons later groups).
+    ray_tpu.kill(ray_tpu.get_actor("rtpu-collective-test-gang"))
 
 
 def test_learner_group_multi_learner_matches_single(cluster):

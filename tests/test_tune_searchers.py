@@ -1,0 +1,159 @@
+"""TPE searcher + median stopping rule (reference test model:
+python/ray/tune/tests/test_searchers.py, test_trial_scheduler.py
+median-stopping cases)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+from ray_tpu.tune.search import TPESearcher
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------- TPE
+
+def _sphere_score(x: float, y: float) -> float:
+    """Unimodal quadratic (negated: higher is better); optimum 0 at
+    (2, -3)."""
+    return -((x - 2.0) ** 2 + (y + 3.0) ** 2)
+
+
+def test_tpe_beats_random_on_seeded_objective():
+    """Seeded A/B: mean best-of-40 over 8 seeds — TPE must beat pure
+    random sampling (the VERDICT 'BO beats random' gate)."""
+    import random as _random
+
+    def space():
+        return {"x": tune.uniform(-10.0, 10.0),
+                "y": tune.uniform(-10.0, 10.0)}
+
+    tpe_bests, rnd_bests = [], []
+    for seed in range(8):
+        searcher = TPESearcher(n_initial=10, seed=seed)
+        searcher.set_search_properties("score", "max", space())
+        best = -np.inf
+        for i in range(40):
+            tid = f"t{i}"
+            cfg = searcher.suggest(tid)
+            score = _sphere_score(cfg["x"], cfg["y"])
+            searcher.on_trial_complete(tid, {"score": score})
+            best = max(best, score)
+        tpe_bests.append(best)
+        rng = _random.Random(seed)
+        sp = space()
+        rnd_bests.append(max(
+            _sphere_score(sp["x"].sample(rng), sp["y"].sample(rng))
+            for _ in range(40)))
+    assert np.mean(tpe_bests) > np.mean(rnd_bests), \
+        (tpe_bests, rnd_bests)
+
+
+def test_tpe_handles_categorical_int_log():
+    space = {
+        "opt": tune.choice(["adam", "sgd"]),
+        "layers": tune.randint(1, 5),
+        "lr": tune.loguniform(1e-5, 1e-1),
+    }
+    searcher = TPESearcher(n_initial=5, seed=0)
+    searcher.set_search_properties("score", "max", space)
+    # Objective: adam + lr near 1e-3 + layers=3 wins.
+    import math
+
+    for i in range(30):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert cfg["opt"] in ("adam", "sgd")
+        assert 1 <= cfg["layers"] < 5
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        score = ((1.0 if cfg["opt"] == "adam" else 0.0)
+                 - abs(math.log10(cfg["lr"]) + 3.0)
+                 - abs(cfg["layers"] - 3) * 0.2)
+        searcher.on_trial_complete(tid, {"score": score})
+    # The searcher's model should now prefer adam strongly.
+    suggestions = [searcher.suggest(f"p{i}") for i in range(10)]
+    adam_frac = sum(c["opt"] == "adam" for c in suggestions) / 10
+    assert adam_frac >= 0.6, adam_frac
+
+
+def test_tpe_state_roundtrip():
+    s1 = TPESearcher(n_initial=2, seed=0)
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s1.set_search_properties("score", "max", space)
+    for i in range(6):
+        tid = f"t{i}"
+        cfg = s1.suggest(tid)
+        s1.on_trial_complete(tid, {"score": cfg["x"]})
+    state = s1.get_state()
+    s2 = TPESearcher(n_initial=2, seed=0)
+    s2.set_search_properties("score", "max", space)
+    s2.set_state(state)
+    assert len(s2._obs) == 6
+    cfg = s2.suggest("t9")  # model-based immediately (past n_initial)
+    assert 0.0 <= cfg["x"] <= 1.0
+
+
+# -------------------------------------------------------- median stopping
+
+def test_median_stopping_prunes_loser():
+    rule = MedianStoppingRule("acc", grace_period=2,
+                              min_samples_required=2)
+    # 3 trials: a,b strong; c weak. Feed 4 rounds.
+    for it in range(1, 5):
+        batch = [("a", it, {"acc": 0.9}), ("b", it, {"acc": 0.8}),
+                 ("c", it, {"acc": 0.1})]
+        decisions = rule.on_batch(batch)
+        if it < 2:
+            assert decisions["c"] == CONTINUE  # grace
+        if it >= 2:
+            assert decisions["a"] == CONTINUE
+            assert decisions["b"] == CONTINUE
+    assert decisions["c"] == STOP
+
+
+def test_median_stopping_no_stop_below_min_samples():
+    rule = MedianStoppingRule("acc", grace_period=0,
+                              min_samples_required=5)
+    decisions = rule.on_batch([("a", 3, {"acc": 0.0}),
+                               ("b", 3, {"acc": 1.0})])
+    assert decisions["a"] == CONTINUE  # only 1 other trial reported
+
+
+# -------------------------------------------------------------- end-to-end
+
+def test_tuner_with_tpe_and_median_stopping(cluster, tmp_path):
+    """Full Tuner.fit with the searcher + median stopping: the best found
+    config must land near the objective's optimum, and the searcher state
+    must be in the experiment snapshot."""
+    import json
+
+    def objective(config):
+        for _ in range(3):
+            tune.report({"score": -(config["x"] - 2.0) ** 2})
+
+    class RC:
+        storage_path = str(tmp_path)
+        name = "tpe_exp"
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=25,
+            max_concurrent_trials=3,
+            search_alg=TPESearcher(n_initial=8, seed=3),
+            scheduler=MedianStoppingRule("score", grace_period=1)),
+        run_config=RC())
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert abs(best.config["x"] - 2.0) < 2.5, best.config
+    state = json.loads(
+        (tmp_path / "tpe_exp" / "experiment_state.json").read_text())
+    assert state.get("searcher", {}).get("obs"), "searcher state missing"
