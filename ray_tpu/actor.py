@@ -120,6 +120,7 @@ class ActorClass:
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=opts.get("concurrency_groups"),
             max_restarts=opts.get("max_restarts", 0),
             resources=resources,
             lifetime=opts.get("lifetime"),

@@ -155,11 +155,16 @@ def remote(*args, **options):
     return decorate
 
 
-def method(num_returns: int = 1, **_ignored):
-    """Per-method options decorator (parity: ray.method)."""
+def method(num_returns: int = 1, concurrency_group: Optional[str] = None,
+           **_ignored):
+    """Per-method options decorator (parity: ray.method — num_returns +
+    concurrency_group routing, the reference's
+    ConcurrencyGroupManager seam)."""
 
     def decorate(f):
         f.__ray_tpu_num_returns__ = num_returns
+        if concurrency_group is not None:
+            f.__ray_tpu_concurrency_group__ = concurrency_group
         return f
 
     return decorate

@@ -103,6 +103,11 @@ class HeadServer:
         self._unmet_demand = _collections.deque(maxlen=512)
         # submitter id -> (monotonic, [(resources, count)]) backlog reports
         self._backlogs: Dict[str, Tuple[float, list]] = {}
+        # Cluster-wide task-event ring (reference: GcsTaskManager,
+        # gcs_task_manager.h:86): every owner's completed-task events land
+        # here so list_tasks from ANY driver covers the whole cluster.
+        self._task_events = _collections.deque(
+            maxlen=int(cfg.task_events_buffer_size))
         self._pool = ClientPool()
         # Durable tables (reference: gcs_table_storage.h). None = memory
         # only. Loaded BEFORE serving so a restarted head answers from the
@@ -778,6 +783,23 @@ class HeadServer:
             return pg_id in self._pgs
 
     # ------------------------------------------------------------- misc
+
+    def rpc_report_task_events(self, conn, owner_addr: str,
+                               events: list) -> bool:
+        """Owners flush completed-task events here every backlog sweep
+        (reference: TaskEventBuffer -> GcsTaskManager.AddTaskEventData)."""
+        with self._lock:
+            for e in events:
+                e["owner"] = owner_addr
+                self._task_events.append(e)
+        return True
+
+    def rpc_list_task_events(self, conn, limit: int = 100) -> list:
+        """Most-recent-first cluster task events (state API backend)."""
+        with self._lock:
+            out = list(self._task_events)
+        out.reverse()
+        return out[:max(0, int(limit))]
 
     def rpc_report_backlog(self, conn, submitter_id: str, entries: list):
         """Periodic per-submitter queued-task backlog (autoscaler demand;

@@ -52,7 +52,8 @@ class _OrderState:
 
 class _HostedActor:
     def __init__(self, actor_id: ActorID, instance: Any, max_concurrency: int,
-                 is_async: bool):
+                 is_async: bool,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.actor_id = actor_id
         self.instance = instance
         self.max_concurrency = max_concurrency
@@ -61,10 +62,37 @@ class _HostedActor:
         self.pool = ThreadPoolExecutor(
             max_workers=max_concurrency,
             thread_name_prefix=f"actor-{actor_id.hex()[:8]}")
+        # Concurrency groups (reference: concurrency_group_manager.h):
+        # each named group gets its OWN executor with its own cap, so one
+        # group's saturation never blocks another's methods; within a
+        # group, submissions stay FIFO (size-1 groups are strictly
+        # ordered). Methods route via @ray_tpu.method(concurrency_group=).
+        self.group_pools: Dict[str, ThreadPoolExecutor] = {}
+        for gname, gsize in (concurrency_groups or {}).items():
+            self.group_pools[gname] = ThreadPoolExecutor(
+                max_workers=max(1, int(gsize)),
+                thread_name_prefix=f"actor-{actor_id.hex()[:8]}-{gname}")
+        self._method_groups: Dict[str, str] = {}
+        for mname in dir(type(instance)):
+            m = getattr(type(instance), mname, None)
+            g = getattr(m, "__ray_tpu_concurrency_group__", None)
+            if g is not None:
+                self._method_groups[mname] = g
         self.loop = None
         self.order: Dict[str, _OrderState] = {}  # owner_addr -> state
         self.order_lock = threading.Lock()
         self.dead = False
+
+    def pool_for(self, method_name: str) -> ThreadPoolExecutor:
+        group = self._method_groups.get(method_name)
+        if group is None:
+            return self.pool
+        pool = self.group_pools.get(group)
+        if pool is None:
+            # Undeclared group on the actor: fall back to the default
+            # pool rather than failing the call.
+            return self.pool
+        return pool
 
 
 class WorkerRuntime(ClusterCore):
@@ -461,7 +489,8 @@ class WorkerRuntime(ClusterCore):
             instance = cls(*args, **kwargs)
         finally:
             runtime_context.set_worker_context(prev)
-        hosted = _HostedActor(actor_id, instance, max_conc, is_async)
+        hosted = _HostedActor(actor_id, instance, max_conc, is_async,
+                              spec.get("concurrency_groups"))
         if is_async:
             self._start_actor_loop(hosted)
         with self._hosted_lock:
@@ -537,21 +566,32 @@ class WorkerRuntime(ClusterCore):
                 runnable.append((st.buf.pop(s), s))
                 st.expected += 1
         if hosted.is_async and hosted.loop is not None:
-            # Async actors: schedule the whole runnable burst onto the
-            # actor's event loop in ONE threadsafe hop (pool.submit +
+            # Async actors: schedule the runnable burst onto the actor's
+            # event loop in ONE threadsafe hop (pool.submit +
             # run_coroutine_threadsafe per call doubled the thread churn).
+            # CONCURRENCY-GROUP methods are the exception: they route
+            # through their group executor so the group's cap applies
+            # (the loop path would run them unbounded).
             import asyncio
+
+            loop_batch = [(sp, s) for sp, s in runnable
+                          if hosted.pool_for(sp["method"]) is hosted.pool]
+            for sp, s in runnable:
+                pool = hosted.pool_for(sp["method"])
+                if pool is not hosted.pool:
+                    pool.submit(self._execute_actor_task, hosted, sp, s)
 
             def _schedule(batch):
                 for sp, s in batch:
                     asyncio.ensure_future(
                         self._run_async_actor_task(hosted, sp, s))
 
-            if runnable:
-                hosted.loop.call_soon_threadsafe(_schedule, runnable)
+            if loop_batch:
+                hosted.loop.call_soon_threadsafe(_schedule, loop_batch)
             return True
         for sp, s in runnable:
-            hosted.pool.submit(self._execute_actor_task, hosted, sp, s)
+            hosted.pool_for(sp["method"]).submit(
+                self._execute_actor_task, hosted, sp, s)
         return True
 
     async def _run_async_actor_task(self, hosted: _HostedActor, spec: Dict,
@@ -617,6 +657,15 @@ class WorkerRuntime(ClusterCore):
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
         owner = spec["owner_addr"]
         actor_ctx = (spec["actor_id"], seq)
+        if hosted.dead:
+            # A kill raced this queued call out of its executor: the owner
+            # was already told the actor died — never execute on a dead
+            # instance (side effects + a success reply would contradict it).
+            self._send_results(
+                owner, task_id, return_ids,
+                error=ActorDiedError(hosted.actor_id, "actor was killed"),
+                actor_ctx=actor_ctx)
+            return
         if spec["method"] == "__rtpu_dag_loop__":
             # Compiled-DAG bootstrap (ray_tpu/dag/compiled_dag.py): run the
             # shipped per-actor schedule on a dedicated thread — the actor
@@ -655,13 +704,26 @@ class WorkerRuntime(ClusterCore):
                                            actor_ctx=actor_ctx)
 
                 fut.add_done_callback(_done)
+                if hosted.pool_for(spec["method"]) is not hosted.pool:
+                    # Group-routed coroutine: HOLD this group-pool thread
+                    # until completion so the group's concurrency cap
+                    # bounds coroutines too (results flow via _done).
+                    try:
+                        fut.result()
+                    except BaseException:  # noqa: BLE001 — _done reported
+                        pass
                 return
             prev = runtime_context.set_worker_context({
                 "task_id": task_id, "actor_id": hosted.actor_id,
                 "resources": {}})
             t_exec = time.time()
             try:
-                if hosted.max_concurrency == 1:
+                # The max_concurrency=1 serialization lock applies only to
+                # DEFAULT-pool methods: a concurrency-group method has its
+                # own executor cap and must not queue behind the default
+                # group (the whole point of groups).
+                if (hosted.max_concurrency == 1
+                        and hosted.pool_for(spec["method"]) is hosted.pool):
                     with hosted.lock:
                         result = method(*args, **kwargs)
                 else:
@@ -697,6 +759,8 @@ class WorkerRuntime(ClusterCore):
             for stop in getattr(hosted, "dag_stops", []):
                 stop.set()
             hosted.pool.shutdown(wait=False, cancel_futures=True)
+            for gpool in hosted.group_pools.values():
+                gpool.shutdown(wait=False, cancel_futures=True)
             if hosted.loop is not None:
                 hosted.loop.call_soon_threadsafe(hosted.loop.stop)
         # The worker process hosting an actor exits on kill (the lease dies

@@ -270,6 +270,9 @@ class ClusterCore:
         import collections as _collections
 
         self._transfer_pins: "_collections.deque" = _collections.deque()
+        # Completed-task events awaiting the periodic flush to the head.
+        self._task_event_outbox: "_collections.deque" = _collections.deque(
+            maxlen=10_000)
         # Lineage-based recovery: creating-task specs per owned object
         # (reference: task_manager.h:265 ResubmitTask).
         from ray_tpu.core.lineage import LineageStore
@@ -951,10 +954,16 @@ class ClusterCore:
                                         "status": status})
             metrics.TASKS_FINISHED.inc()
             metrics.TASK_EXEC_SECONDS.observe(max(0.0, t1 - t0))
-            self._recent_tasks.append({
+            event = {
                 "task_id": task_id_bytes.hex(), "name": name,
                 "duration_s": round(t1 - t0, 6), "status": status,
-                "end_ts": t1})
+                "end_ts": t1}
+            self._recent_tasks.append(event)
+            # Cluster-wide visibility: events flush to the head in the
+            # periodic sweep (reference: TaskEventBuffer -> GcsTaskManager,
+            # gcs_task_manager.h:86 — list_tasks from ANY driver must see
+            # EVERY owner's tasks, not just its own).
+            self._task_event_outbox.append(event)
         for oid_bytes, kind, payload in results:
             oid = ObjectID(oid_bytes)
             if kind == "value":
@@ -1786,6 +1795,20 @@ class ClusterCore:
             self._backlog_was_nonempty = bool(entries)
             self.head.notify("report_backlog",
                              self.worker_id.hex(), entries)
+        # Ship completed-task events to the head (cluster-wide list_tasks;
+        # reference: TaskEventBuffer periodic flush to GcsTaskManager).
+        if self._task_event_outbox:
+            events = []
+            while self._task_event_outbox and len(events) < 2000:
+                try:
+                    events.append(self._task_event_outbox.popleft())
+                except IndexError:
+                    break
+            try:
+                self.head.notify("report_task_events",
+                                 self.owner_addr, events)
+            except Exception:
+                pass  # best-effort observability; next sweep retries new ones
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True):
@@ -1822,7 +1845,9 @@ class ClusterCore:
                      namespace: str = "default", max_concurrency: int = 1,
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
-                     runtime_env=None, release_resources: bool = False) -> ActorID:
+                     runtime_env=None, release_resources: bool = False,
+                     concurrency_groups: Optional[Dict[str, int]] = None,
+                     ) -> ActorID:
         from ray_tpu.core.runtime_env import validate_runtime_env
 
         runtime_env = validate_runtime_env(runtime_env)
@@ -1838,6 +1863,7 @@ class ClusterCore:
         spec_blob = SERIALIZER.encode({
             "cls": cls, "args": tuple(args), "kwargs": dict(kwargs),
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
             "owner_addr": self.owner_addr,
             "release_resources": release_resources,
         })

@@ -292,8 +292,10 @@ class LocalRuntime:
                      namespace: str = "default", max_concurrency: int = 1,
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
-                     runtime_env=None,
-                     release_resources: bool = False) -> "ActorID":
+                     runtime_env=None, release_resources: bool = False,
+                     concurrency_groups=None) -> "ActorID":
+        # Local mode runs every method on one pool; concurrency groups
+        # only isolate executors in cluster workers.
         import inspect
 
         is_async = any(

@@ -26,8 +26,11 @@ def list_placement_groups() -> Dict[str, Any]:
 
 
 def list_tasks(limit: int = 100) -> List[Dict[str, Any]]:
-    """In-flight submissions + recent completions known to THIS owner
-    (reference list_tasks aggregates the GCS task events the same way)."""
+    """CLUSTER-WIDE task view: this owner's in-flight submissions plus
+    the head's aggregated task-event ring — every owner flushes its
+    completions there, so tasks submitted by OTHER drivers/workers are
+    visible too (reference: list_tasks over GcsTaskManager's events,
+    dashboard/state_aggregator.py)."""
     rt = require_runtime()
     out: List[Dict[str, Any]] = []
     inflight = getattr(rt, "_inflight", None)
@@ -37,10 +40,29 @@ def list_tasks(limit: int = 100) -> List[Dict[str, Any]]:
                 out.append({"task_id": tid.hex(), "name": info.name,
                             "state": "RUNNING",
                             "worker": info.worker_addr})
+    # Merge the owner-local ring FIRST: THIS owner's newest completions
+    # may not have reached the head yet (events flush on a ~2s sweep),
+    # and truncation must never drop them in favor of older head events.
+    finished = []
+    seen = set()
     recent = getattr(rt, "_recent_tasks", None)
     if recent is not None:
         for rec in list(recent)[-limit:]:
-            out.append(dict(rec, state="FINISHED"))
+            seen.add(rec.get("task_id"))
+            finished.append(dict(rec, state="FINISHED"))
+    head = getattr(rt, "head", None)
+    if head is not None:
+        try:
+            # Single attempt, short timeout: the state API is a diagnostic
+            # surface — when the head is down it must degrade to the local
+            # view immediately, not after a retry ladder.
+            for rec in head.call("list_task_events", limit, timeout=2):
+                if rec.get("task_id") not in seen:
+                    finished.append(dict(rec, state="FINISHED"))
+        except Exception:
+            pass  # head unreachable: local view only
+    finished.sort(key=lambda r: r.get("end_ts", 0.0), reverse=True)
+    out.extend(finished)
     return out[:limit]
 
 
