@@ -489,6 +489,12 @@ class HeadServer:
             if lease is None:
                 exclude.add(node_id)
                 continue
+            if isinstance(lease, dict) and "env_error" in lease:
+                # Permanent env failure: actor creation fails with the
+                # install error instead of cycling spillbacks.
+                raise RuntimeError(
+                    f"actor runtime_env setup failed: "
+                    f"{lease['env_error']}")
             worker_addr, lease_id = lease
             worker = self._pool.get(worker_addr)
             try:

@@ -109,6 +109,11 @@ class NodeManager:
         import collections
 
         self._worker_waiters = collections.deque()
+        # env_hash -> error string for runtime envs whose materialization
+        # failed: lease requests for them FAIL FAST with
+        # RuntimeEnvSetupError instead of timing out into an endless
+        # spillback-and-reinstall loop.
+        self._env_failures: Dict[str, str] = {}
         # Dedicated TPU-slot pool: at most one live TPU-env worker per host.
         self._tpu_idle: List[WorkerProc] = []
         self._tpu_waiters = collections.deque()
@@ -130,6 +135,23 @@ class NodeManager:
         self._server = RpcServer(self, host).start()
         self.address = self._server.address
         self._stop = threading.Event()
+        # Per-node Prometheus endpoint (reference: the per-node metrics
+        # agent exporting core metrics): GET /metrics on this port serves
+        # the process registry + live node gauges; the port is advertised
+        # as a node label for scrape-config discovery.
+        self._metrics_exporter = None
+        if cfg.metrics_export_port >= 0:
+            try:
+                from ray_tpu.util.metrics_agent import start_exporter
+
+                self._metrics_exporter = start_exporter(
+                    host, cfg.metrics_export_port,
+                    collectors=[self._collect_node_metrics])
+                labels = dict(labels)
+                labels["metrics-port"] = str(self._metrics_exporter.port)
+                self.labels = labels
+            except Exception:
+                pass
         self._head = RpcClient(head_addr)
         self._head.retrying_call("register_node", node_id, self.address,
                                  resources, labels, self.store_name,
@@ -161,6 +183,9 @@ class NodeManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
+            self._metrics_exporter = None
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
@@ -315,16 +340,90 @@ class NodeManager:
                         self._spawning = max(0, self._spawning - 1)
                     self._idle_cv.notify_all()
 
+    def _collect_node_metrics(self):
+        """Live node gauges per scrape (store occupancy, workers, leases,
+        resource availability) — the node-plane view the reference's
+        metrics agent exports."""
+        from ray_tpu.util.metrics_agent import gauge_lines
+
+        nid = {"node_id": self.node_id[:12]}
+        lines = []
+        try:
+            used, capacity, n_objects, n_evictions = self.store.stats()
+            lines += gauge_lines(
+                "rtpu_node_store_bytes", "object store occupancy",
+                [({**nid, "kind": "used"}, used),
+                 ({**nid, "kind": "capacity"}, capacity)])
+            lines += gauge_lines(
+                "rtpu_node_store_objects", "objects resident in the store",
+                [(nid, n_objects)])
+        except Exception:
+            pass
+        with self._lock:
+            n_workers = len(self._workers)
+            n_idle = sum(len(v) for v in self._idle.values())
+            n_leases = len(self._leases)
+            avail = dict(self.available)
+            total = dict(self.total)
+        lines += gauge_lines(
+            "rtpu_node_workers", "worker processes on this node",
+            [({**nid, "state": "alive"}, n_workers),
+             ({**nid, "state": "idle"}, n_idle)])
+        lines += gauge_lines("rtpu_node_leases", "active worker leases",
+                             [(nid, n_leases)])
+        lines += gauge_lines(
+            "rtpu_node_resource", "node resource totals and availability",
+            [({**nid, "resource": k, "kind": "total"}, v)
+             for k, v in total.items()]
+            + [({**nid, "resource": k, "kind": "available"}, v)
+               for k, v in avail.items()])
+        return lines
+
     def _spawn_worker(self, tpu: bool = False, runtime_env=None) -> None:
         """Fire-and-forget spawn via the dedicated spawner thread (PDEATHSIG
         must be armed from a long-lived thread). The worker joins the idle
         pool when it registers; callers wait on _idle_cv, never on a
-        specific spawn."""
+        specific spawn.
+
+        Envs needing MATERIALIZATION (pip venv build, up to minutes) are
+        prepared on their own thread first — the single spawner thread
+        must never head-of-line block default-env spawns behind an
+        install — then the Popen itself still runs on the spawner."""
+        from ray_tpu.core.runtime_env import needs_materialization
+
+        if needs_materialization(runtime_env):
+            threading.Thread(target=self._materialize_then_spawn,
+                             args=(tpu, runtime_env), daemon=True,
+                             name="env-builder").start()
+            return
+        self._spawn_requests.put((1 if tpu else 0, runtime_env))
+
+    def _materialize_then_spawn(self, tpu: bool, runtime_env) -> None:
+        from ray_tpu.core.runtime_env import (resolve_python_executable,
+                                              runtime_env_hash)
+
+        try:
+            resolve_python_executable(runtime_env)  # cached after success
+        except Exception as e:  # noqa: BLE001 — surfaced via lease error
+            h = runtime_env_hash(runtime_env)
+            with self._idle_cv:
+                self._env_failures[h] = str(e)
+                self._spawning -= 1
+                # Wake same-env waiters now: their retry hits the
+                # fail-fast path instead of waiting out the lease timeout.
+                for entry in list(self._worker_waiters):
+                    if entry[2] == h:
+                        self._worker_waiters.remove(entry)
+                        entry[0].set()
+            print(f"runtime_env materialization failed: {e}",
+                  file=sys.stderr, flush=True)
+            return
         self._spawn_requests.put((1 if tpu else 0, runtime_env))
 
     def _spawn_worker_inner(self, tpu: bool = False,
                             runtime_env=None) -> WorkerProc:
         from ray_tpu.core.runtime_env import (apply_to_spawn_env,
+                                              resolve_python_executable,
                                               runtime_env_hash)
 
         worker_id = uuid.uuid4().hex
@@ -346,9 +445,17 @@ class NodeManager:
             # TPU plugin would fail backend init in the worker.
             env["JAX_PLATFORMS"] = "cpu"
             env["RTPU_TPU_CHIPS"] = "0"
+        # pip/py_executable envs swap the worker interpreter (the venv is
+        # built-or-cached here, node-side — the runtime-env agent role).
+        try:
+            py = resolve_python_executable(runtime_env) or sys.executable
+        except Exception as e:
+            print(f"runtime_env materialization failed: {e}",
+                  file=sys.stderr, flush=True)
+            raise
         logf = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.cluster.worker_main",
+            [py, "-m", "ray_tpu.cluster.worker_main",
              "--node-addr", self.address,
              "--head-addr", self.head_addr,
              "--node-id", self.node_id,
@@ -422,6 +529,13 @@ class NodeManager:
                 return slot[0]
         env_hash = runtime_env_hash(runtime_env)
         with self._idle_cv:
+            err = self._env_failures.get(env_hash)
+            if err is not None:
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                raise RuntimeEnvSetupError(
+                    f"runtime_env setup failed on node "
+                    f"{self.node_id[:8]}: {err}")
             pool = self._idle.get(env_hash)
             same_env_waiting = any(e[2] == env_hash
                                    for e in self._worker_waiters)
@@ -560,9 +674,18 @@ class NodeManager:
                 # Queue here until resources free up (or the block window
                 # expires and the caller spills back via the head).
                 self._avail_cond.wait(min(remaining, 0.25))
-        w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
-                             tpu=resources.get("TPU", 0) > 0,
-                             runtime_env=runtime_env)
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+        try:
+            w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
+                                 tpu=resources.get("TPU", 0) > 0,
+                                 runtime_env=runtime_env)
+        except RuntimeEnvSetupError as e:
+            lease = Lease("", None, resources, resolved)
+            with self._lock:
+                self._release_resources(lease)
+            # Dict reply: unambiguous vs the (addr, lease_id) grant tuple.
+            return {"env_error": str(e)}
         if w is None:
             lease = Lease("", None, resources, resolved)
             with self._lock:

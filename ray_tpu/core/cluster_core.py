@@ -1470,12 +1470,23 @@ class ClusterCore:
 
     def _lease_requester(self, kq: "_KeyQueue",
                          sample: _InflightTask) -> None:
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+        env_err = None
+        lease = None
         try:
             lease = self._request_new_lease(sample.resources, sample.strategy,
                                             sample.runtime_env)
+        except RuntimeEnvSetupError as e:
+            env_err = e
         finally:
             with self._lease_lock:
                 kq.pending_lease_requests -= 1
+        if env_err is not None:
+            # The env can never materialize: every queued task of this key
+            # fails NOW with the real install error (not a hang).
+            self._fail_queued(kq, env_err)
+            return
         if lease is not None:
             with self._lease_lock:
                 if self._key_queues.get(kq.key) is not kq:
@@ -1664,6 +1675,12 @@ class ClusterCore:
             if granted is None:
                 exclude.append(node_id)
                 continue
+            if isinstance(granted, dict) and "env_error" in granted:
+                # Permanent per-node env failure: spilling back would just
+                # reinstall-and-fail elsewhere forever.
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                raise RuntimeEnvSetupError(granted["env_error"])
             worker_addr, lease_id = granted
             return _Lease(worker_addr, lease_id, node_addr)
         return None

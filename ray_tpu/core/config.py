@@ -173,6 +173,10 @@ _d("device_prefetch_depth", int, 2, "host->HBM prefetch pipeline depth for data"
 
 # --- metrics / events ---
 _d("metrics_report_period_ms", int, 5000, "metrics push period")
+_d("metrics_export_port", int, 0,
+   "per-node Prometheus scrape port (GET /metrics on every node manager; "
+   "the bound port rides the node's 'metrics-port' label). 0 = ephemeral "
+   "port, -1 disables the exporter")
 _d("task_events_buffer_size", int, 10_000, "ring buffer of per-task state events")
 _d("event_stats_enabled", bool, True, "per-handler latency accounting")
 

@@ -1,19 +1,26 @@
 """Runtime environments: per-task/actor worker process environments.
 
 Parity target: the reference's runtime_env system
-(reference: python/ray/_private/runtime_env/working_dir.py,
-runtime_env/agent/runtime_env_agent.py, and the per-env worker pools keyed
-by runtime_env_hash in src/ray/raylet/worker_pool.h), re-designed small:
+(reference: python/ray/_private/runtime_env/working_dir.py, pip.py,
+py_executable plugin, runtime_env/agent/runtime_env_agent.py, and the
+per-env worker pools keyed by runtime_env_hash in
+src/ray/raylet/worker_pool.h), re-designed small:
 
 - supported fields: ``env_vars`` (dict str->str), ``working_dir`` (local
   path the worker chdirs into), ``py_modules`` (local paths prepended to
-  the worker's PYTHONPATH)
+  the worker's PYTHONPATH), ``pip`` (package list / options dict — the
+  node materializes a CACHED venv per requirements fingerprint and spawns
+  the worker from its interpreter), ``py_executable`` (explicit worker
+  interpreter path)
 - the env is validated AT OPTION TIME and anything unsupported raises —
   silently accepting a correctness-relevant option is worse than not
   having it
 - a canonical fingerprint rides the scheduling key and the lease request,
   so leases and idle-pool workers are only ever reused within the SAME
   runtime env (two envs never share a worker process)
+- pip venvs live under ``RTPU_RUNTIME_ENV_DIR`` (default
+  /tmp/ray_tpu/runtime_envs), keyed by the requirements hash — the
+  reference's URI cache role: N tasks with one env pay one install
 
 working_dir/py_modules are local/shared-filesystem paths: in-cluster
 workers resolve them directly (the reference uploads to GCS for remote
@@ -27,7 +34,10 @@ import json
 import os
 from typing import Any, Dict, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip",
+              "py_executable"}
+_ENV_CACHE_DIR_VAR = "RTPU_RUNTIME_ENV_DIR"
+_DEFAULT_ENV_CACHE = "/tmp/ray_tpu/runtime_envs"
 
 
 def validate_runtime_env(env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -62,6 +72,40 @@ def validate_runtime_env(env: Optional[Dict[str, Any]]) -> Optional[Dict[str, An
             raise ValueError("runtime_env['py_modules'] must be a list of "
                              "path strings")
         out["py_modules"] = [os.path.abspath(p) for p in pm]
+    pip = env.get("pip")
+    if pip is not None:
+        # List form: ["pkg==1.0", ...]. Dict form adds installer options
+        # (find_links/no_index for offline/local-wheel installs).
+        if isinstance(pip, (list, tuple)):
+            pip = {"packages": list(pip)}
+        if not isinstance(pip, dict) or not isinstance(
+                pip.get("packages"), (list, tuple)) or not all(
+                isinstance(p, str) for p in pip["packages"]):
+            raise ValueError(
+                "runtime_env['pip'] must be a list of requirement strings "
+                "or {'packages': [...], 'find_links': path, "
+                "'no_index': bool}")
+        unknown_pip = set(pip) - {"packages", "find_links", "no_index"}
+        if unknown_pip:
+            # Same invariant as top-level fields: a silently-dropped
+            # option would also alias distinct envs onto one cached venv.
+            raise ValueError(
+                f"unsupported pip option(s) {sorted(unknown_pip)}; "
+                f"supported: packages, find_links, no_index")
+        norm = {"packages": sorted(pip["packages"])}
+        if pip.get("find_links") is not None:
+            norm["find_links"] = os.path.abspath(str(pip["find_links"]))
+        if pip.get("no_index"):
+            norm["no_index"] = True
+        out["pip"] = norm
+    pyx = env.get("py_executable")
+    if pyx is not None:
+        if not isinstance(pyx, str):
+            raise ValueError("runtime_env['py_executable'] must be a path")
+        if env.get("pip") is not None:
+            raise ValueError("py_executable and pip are mutually "
+                             "exclusive (pip builds its own interpreter)")
+        out["py_executable"] = os.path.abspath(pyx)
     return out or None
 
 
@@ -84,4 +128,94 @@ def apply_to_spawn_env(env: Optional[Dict[str, Any]],
     for p in reversed(env.get("py_modules") or ()):
         spawn_env["PYTHONPATH"] = p + os.pathsep + spawn_env.get(
             "PYTHONPATH", "")
+    if env.get("pip") or env.get("py_executable"):
+        # A non-default interpreter must still import ray_tpu: the repo
+        # root rides PYTHONPATH (venvs use --system-site-packages for the
+        # baked-in deps, but ray_tpu itself may be path-imported).
+        import ray_tpu as _pkg
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        spawn_env["PYTHONPATH"] = (
+            repo_root + os.pathsep + spawn_env.get("PYTHONPATH", ""))
     return env.get("working_dir")
+
+
+def needs_materialization(env: Optional[Dict[str, Any]]) -> bool:
+    """True when worker spawn requires building state first (pip venv)."""
+    return bool(env and env.get("pip"))
+
+
+def resolve_python_executable(env: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The interpreter the worker should spawn with, materializing the
+    pip venv on first use (reference: pip.py's virtualenv-per-URI with the
+    agent's cache; None = the node's own interpreter). Creation is
+    CACHED per requirements fingerprint and concurrency-safe via an
+    atomic rename: parallel spawns of one env pay one install."""
+    if not env:
+        return None
+    if env.get("py_executable"):
+        return env["py_executable"]
+    pip = env.get("pip")
+    if not pip:
+        return None
+    import subprocess
+    import sys
+    import tempfile
+
+    key = hashlib.sha1(json.dumps(pip, sort_keys=True).encode()) \
+        .hexdigest()[:16]
+    cache_root = os.environ.get(_ENV_CACHE_DIR_VAR, _DEFAULT_ENV_CACHE)
+    final = os.path.join(cache_root, f"pip-{key}")
+    python = os.path.join(final, "bin", "python")
+    if os.path.exists(python):
+        return python
+    os.makedirs(cache_root, exist_ok=True)
+    build = tempfile.mkdtemp(prefix=f"pip-{key}-", dir=cache_root)
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             build], check=True, capture_output=True, timeout=300)
+        # The node's interpreter may ITSELF be a venv: --system-site-
+        # packages then exposes the BASE python's site dir, not the
+        # node's (where jax/cloudpickle/... actually live). Link the
+        # node's site-packages via a .pth — appended AFTER the new
+        # venv's own site dir on sys.path, so per-env installed versions
+        # still override.
+        site_dir = os.path.join(
+            build, "lib",
+            f"python{sys.version_info.major}.{sys.version_info.minor}",
+            "site-packages")
+        parent_sites = [p for p in __import__("site").getsitepackages()
+                        if os.path.isdir(p)]
+        with open(os.path.join(site_dir, "_rtpu_parent_site.pth"),
+                  "w") as f:
+            f.write("\n".join(parent_sites) + "\n")
+        cmd = [os.path.join(build, "bin", "python"), "-m", "pip",
+               "install", "--quiet", "--disable-pip-version-check"]
+        if pip.get("no_index"):
+            cmd.append("--no-index")
+        if pip.get("find_links"):
+            cmd += ["--find-links", pip["find_links"]]
+        cmd += list(pip["packages"])
+        proc = subprocess.run(cmd, capture_output=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"pip install for runtime_env failed: "
+                f"{proc.stderr.decode(errors='replace')[-800:]}")
+        try:
+            os.rename(build, final)  # atomic publish
+        except OSError:
+            # A concurrent builder won the rename: use theirs, drop ours.
+            if os.path.exists(python):
+                import shutil
+
+                shutil.rmtree(build, ignore_errors=True)
+            else:
+                return os.path.join(build, "bin", "python")
+        return python
+    except Exception:
+        import shutil
+
+        shutil.rmtree(build, ignore_errors=True)
+        raise

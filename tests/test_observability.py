@@ -130,3 +130,28 @@ def test_dashboard_lite(cluster):
             f"http://127.0.0.1:{port}/api", timeout=30) as resp:
         payload = json.loads(resp.read())
     assert payload["nodes"] and "objects" in payload
+
+
+def test_per_node_prometheus_endpoint(cluster):
+    """Every node manager serves GET /metrics (reference: the per-node
+    metrics agent -> Prometheus scrape); the port rides the node label."""
+    import urllib.request
+
+    from ray_tpu.util import state
+
+    nodes = [n for n in state.list_nodes() if n.get("alive", True)]
+    assert nodes
+    scraped = 0
+    for n in nodes:
+        port = n.get("labels", {}).get("metrics-port")
+        if port is None:
+            continue
+        host = n["address"].rsplit(":", 1)[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        assert "rtpu_node_store_bytes" in body
+        assert "rtpu_node_workers" in body
+        assert "rtpu_node_resource" in body
+        scraped += 1
+    assert scraped >= 1, "no node advertised a metrics port"

@@ -98,7 +98,7 @@ def test_actor_runtime_env(cluster):
 
 def test_unsupported_runtime_env_raises(cluster):
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"deps": []}})
         def f():
             return 1
 
@@ -110,3 +110,84 @@ def test_unsupported_runtime_env_raises(cluster):
             return 1
 
         g.remote()
+
+
+def _build_tiny_wheel(tmp_path, name="rtpu_envtest_pkg", version="1.2.3"):
+    """A minimal local wheel so pip installs work with zero egress
+    (the reference mocks indices in its runtime_env tests similarly)."""
+    import subprocess
+    import sys
+
+    src = tmp_path / "pkgsrc"
+    (src / name).mkdir(parents=True)
+    (src / name / "__init__.py").write_text(
+        f"__version__ = {version!r}\n"
+        f"def marker():\n    return 'installed-{version}'\n")
+    (src / "pyproject.toml").write_text(
+        '[build-system]\nrequires = ["setuptools"]\n'
+        'build-backend = "setuptools.build_meta"\n'
+        f'[project]\nname = "{name}"\nversion = "{version}"\n')
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-index",
+         "--no-build-isolation", "--wheel-dir", str(wheels), str(src)],
+        check=True, capture_output=True, timeout=300)
+    return str(wheels)
+
+
+def test_pip_runtime_env_installs_and_isolates(cluster, tmp_path):
+    """pip env: the task runs in a venv where the package imports; the
+    DEFAULT env must not see it (reference: pip.py per-URI virtualenvs)."""
+    wheels = _build_tiny_wheel(tmp_path)
+    env = {"pip": {"packages": ["rtpu_envtest_pkg"], "no_index": True,
+                   "find_links": wheels}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def with_pkg():
+        import rtpu_envtest_pkg
+
+        return rtpu_envtest_pkg.marker()
+
+    @ray_tpu.remote
+    def without_pkg():
+        try:
+            import rtpu_envtest_pkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    # Generous timeout: the FIRST call builds the venv (~5-10s).
+    assert ray_tpu.get(with_pkg.remote(), timeout=180) == "installed-1.2.3"
+    assert ray_tpu.get(without_pkg.remote(), timeout=60) == "isolated"
+    # Cache hit: the second task over the same env reuses the venv (fast).
+    import time as _time
+
+    t0 = _time.monotonic()
+    assert ray_tpu.get(with_pkg.remote(), timeout=60) == "installed-1.2.3"
+    assert _time.monotonic() - t0 < 30
+
+
+def test_py_executable_runtime_env(cluster):
+    import sys
+
+    @ray_tpu.remote(runtime_env={"py_executable": sys.executable})
+    def which_python():
+        return sys.executable
+
+    assert ray_tpu.get(which_python.remote(), timeout=90) == sys.executable
+
+
+def test_pip_runtime_env_failure_fails_fast(cluster, tmp_path):
+    """An uninstallable pip env must FAIL the task with the install error
+    (not hang through endless lease spillbacks)."""
+    env = {"pip": {"packages": ["rtpu-definitely-missing-pkg"],
+                   "no_index": True, "find_links": str(tmp_path)}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def doomed():
+        return 1
+
+    with pytest.raises(Exception, match="runtime_env|env"):
+        ray_tpu.get(doomed.remote(), timeout=120)
