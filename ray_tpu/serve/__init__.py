@@ -19,20 +19,34 @@ __all__ = [
     "Deployment", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "delete", "deployment",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "status", "start_http",
+    "run", "shutdown", "status", "start_http", "start_grpc",
 ]
+
+
+def _start_ingress(actor_cls, host: str, port: int):
+    """Shared ingress-actor bootstrap: spawn, fetch the bound port."""
+    import ray_tpu
+
+    actor = ray_tpu.remote(actor_cls).options(
+        max_concurrency=16).remote(host, port)
+    addr = ray_tpu.get(actor.address.remote(), timeout=60)
+    return actor, int(addr.rsplit(":", 1)[1])
 
 
 def start_http(host: str = "127.0.0.1", port: int = 0):
     """Start one asyncio HTTP ingress actor; returns (handle, port)."""
-    import ray_tpu
     from ray_tpu.serve._private.proxy import HTTPProxyActor
 
-    actor = ray_tpu.remote(HTTPProxyActor).options(
-        max_concurrency=16).remote(host, port)
-    # The port is assigned inside the actor; fetch it.
-    addr = ray_tpu.get(actor.address.remote(), timeout=60)
-    return actor, int(addr.rsplit(":", 1)[1])
+    return _start_ingress(HTTPProxyActor, host, port)
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0):
+    """Start a gRPC ingress actor; returns (handle, port). Method path:
+    /ray_tpu.serve/<deployment>[.<method>], JSON payloads; metadata
+    rtpu-stream=1 selects server streaming."""
+    from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+
+    return _start_ingress(GrpcProxyActor, host, port)
 
 
 def start_http_per_node(host: str = "127.0.0.1"):

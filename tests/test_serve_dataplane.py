@@ -159,3 +159,46 @@ def test_per_node_proxies(cluster):
         out = _post(f"http://{addr}/echo2", {"v": 42})
         assert out["result"]["echo"] == 42
     serve.delete("echo2")
+
+
+def test_grpc_ingress_unary_and_streaming(cluster):
+    """gRPC ingress (reference: serve's gRPC proxy/grpc_util): unary +
+    server-streaming through generic handlers, NOT_FOUND for unknown
+    deployments."""
+    grpc = pytest.importorskip("grpc")
+
+    @serve.deployment(name="gsvc")
+    class GSvc:
+        def __call__(self, p):
+            return {"doubled": p.get("n", 0) * 2}
+
+        def gen(self, p):
+            for i in range(p.get("k", 3)):
+                yield {"i": i}
+
+    serve.run(GSvc.bind())
+    _proxy, port = serve.start_grpc()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    unary = chan.unary_unary("/ray_tpu.serve/gsvc",
+                             request_serializer=bytes,
+                             response_deserializer=bytes)
+    out = json.loads(unary(json.dumps({"n": 21}).encode(), timeout=60))
+    assert out["result"]["doubled"] == 42
+
+    stream = chan.unary_stream("/ray_tpu.serve/gsvc.gen",
+                               request_serializer=bytes,
+                               response_deserializer=bytes)
+    frames = [json.loads(f) for f in stream(
+        json.dumps({"k": 4}).encode(), timeout=60,
+        metadata=(("rtpu-stream", "1"),))]
+    assert [f["item"]["i"] for f in frames] == [0, 1, 2, 3]
+
+    missing = chan.unary_unary("/ray_tpu.serve/nosuchdep",
+                               request_serializer=bytes,
+                               response_deserializer=bytes)
+    with pytest.raises(grpc.RpcError) as ei:
+        missing(b"{}", timeout=60)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    chan.close()
+    serve.delete("gsvc")
