@@ -13,6 +13,7 @@ shards the model; this layer moves the microbatch activations).
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -99,18 +100,25 @@ class CompiledDAG:
         # One channel per ARGUMENT SLOT (not per producer/consumer pair —
         # binding the same upstream to two args needs two SPSC channels),
         # plus one per driver-visible output. producer_outputs collects
-        # every channel a node must write.
-        self._input_channels: List[ShmChannel] = []
-        producer_outputs: Dict[int, List[ShmChannel]] = {}
+        # every channel a node must write. Each channel records its
+        # (writer, reader) endpoints — "driver" or an actor key — so the
+        # kind can be chosen AFTER placement resolves: same-node pairs
+        # ride shm, cross-node pairs ride the push transfer.
+        self._input_channels: List[Any] = []
+        producer_outputs: Dict[int, List[Any]] = {}
+        chan_ends: Dict[int, list] = {}  # id(ch) -> [writer, reader]
+        current_consumer: List[Any] = ["driver"]
 
         def argspec(v):
             if isinstance(v, InputNode):
                 ch = self._chan()
                 self._input_channels.append(ch)
+                chan_ends[id(ch)] = [ch, "driver", current_consumer[0]]
                 return ("chan", ch)
             if isinstance(v, DAGNode):
                 ch = self._chan()
                 producer_outputs.setdefault(v._dag_id, []).append(ch)
+                chan_ends[id(ch)] = [ch, None, current_consumer[0]]
                 return ("chan", ch)
             return ("const", v)
 
@@ -120,11 +128,14 @@ class CompiledDAG:
         # intra-actor dependency order; the reference's dag_node_operation
         # applies the same per-actor restriction).
         ops_by_node: Dict[int, Dict[str, Any]] = {}
+        node_actor_key: Dict[int, bytes] = {}
         for n in order:
             if not isinstance(n, ClassMethodNode):
                 continue
             key = n.actor.actor_id.binary()
             self._actors[key] = n.actor
+            node_actor_key[n._dag_id] = key
+            current_consumer[0] = key
             op = {
                 "method": n.method_name,
                 "args": [argspec(a) for a in n.args],
@@ -133,18 +144,24 @@ class CompiledDAG:
             }
             ops_by_node[n._dag_id] = op
             per_actor.setdefault(key, []).append(op)
+        current_consumer[0] = "driver"
         self._output_channels = []
         for out in output_nodes:
             if not isinstance(out, ClassMethodNode):
                 raise ValueError("DAG outputs must be actor-method nodes")
             ch = self._chan()
             self._output_channels.append(ch)
+            chan_ends[id(ch)] = [ch, None, "driver"]
             producer_outputs.setdefault(out._dag_id, []).append(ch)
-        # Second pass: attach collected output channels.
+        # Second pass: attach collected output channels + writer endpoints.
         for node_id, op in ops_by_node.items():
             op["outputs"] = producer_outputs.get(node_id, [])
+            for ch in op["outputs"]:
+                chan_ends[id(ch)][1] = node_actor_key[node_id]
 
-        self._validate_same_node()
+        replacements = self._resolve_channel_kinds(chan_ends)
+        if replacements:
+            self._rewrite_channels(per_actor, replacements)
 
         # Ship each actor its schedule; the worker runs a dedicated loop
         # thread (special method intercepted in worker_main).
@@ -156,29 +173,80 @@ class CompiledDAG:
             for key, handle in self._actors.items()
         ], timeout=60)
 
-    def _validate_same_node(self) -> None:
-        """Shm channels are same-node: refuse to compile a DAG whose actors
-        sit elsewhere (a silent cross-node hang is far worse than an
-        error; multi-node DAGs are a later milestone)."""
+    def _resolve_channel_kinds(self, chan_ends: Dict[int, list]
+                               ) -> Dict[int, Any]:
+        """Placement-aware channel selection: endpoints on one node keep
+        the shm channel; endpoints on DIFFERENT nodes get a
+        CrossNodeChannel over the push transfer (reference analog:
+        shared-memory channels vs cross-node mutable-object push,
+        node_manager.proto:444). Returns {id(old_ch): replacement}.
+
+        Resolution failures RAISE: compile is the one place an error is
+        cheap, and guessing shm for an actor that is actually remote is a
+        silent hang on first execute."""
         from ray_tpu.core.runtime_context import require_runtime
+        from ray_tpu.dag.channel import CrossNodeChannel
 
         rt = require_runtime()
         my_node = getattr(rt, "node_id", None)
         lister = getattr(rt, "list_actors", None)
-        if my_node is None or lister is None:
-            return
-        try:
+        nodes_fn = getattr(rt, "nodes", None)
+        if my_node is None or lister is None or nodes_fn is None:
+            return {}  # single-process runtime: shm always works
+
+        # Actors may still be PENDING placement (node_id None until the
+        # head schedules them): wait placement out rather than guessing.
+        actor_keys = {ep for ends in chan_ends.values()
+                      for ep in ends[1:] if ep != "driver"}
+        deadline = time.monotonic() + 60.0
+        while True:
             table = {a["actor_id"]: a for a in lister()}
-        except Exception:
-            return
-        for key in self._actors:
-            info = table.get(key.hex()) or table.get(key)
-            if info and info.get("node_id") not in (None, my_node):
+            unplaced = [k for k in actor_keys
+                        if (table.get(k.hex()) or {}).get("node_id")
+                        is None]
+            if not unplaced:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"DAG compile: {len(unplaced)} actor(s) not placed "
+                    f"within 60s (first: "
+                    f"{unplaced[0].hex()[:12]})")
+            time.sleep(0.1)
+        node_addr = {n["node_id"]: n["address"] for n in nodes_fn()}
+
+        def endpoint_node(ep) -> str:
+            if ep == "driver":
+                return my_node
+            return table[ep.hex()]["node_id"]
+
+        replacements: Dict[int, Any] = {}
+        for _ch_id, (ch, writer, reader) in chan_ends.items():
+            wn, rn = endpoint_node(writer), endpoint_node(reader)
+            if wn == rn:
+                continue
+            wa, ra = node_addr.get(wn), node_addr.get(rn)
+            if wa is None or ra is None:
                 raise ValueError(
-                    f"compiled DAGs require all actors on the driver's "
-                    f"node (shm channels): actor {key.hex()[:12]} is on "
-                    f"{info.get('node_id')!r}, driver on {my_node!r}. "
-                    f"Pin actors with NodeAffinitySchedulingStrategy.")
+                    f"cannot resolve node addresses for cross-node DAG "
+                    f"channel ({wn!r} -> {rn!r})")
+            replacements[id(ch)] = CrossNodeChannel(
+                ch.channel_id, wa, ra, capacity=self._capacity)
+        return replacements
+
+    def _rewrite_channels(self, per_actor: Dict[bytes, list],
+                          replacements: Dict[int, Any]) -> None:
+        def swap(ch):
+            return replacements.get(id(ch), ch)
+
+        self._input_channels = [swap(c) for c in self._input_channels]
+        self._output_channels = [swap(c) for c in self._output_channels]
+        for ops in per_actor.values():
+            for op in ops:
+                op["args"] = [(k, swap(v) if k == "chan" else v)
+                              for k, v in op["args"]]
+                op["kwargs"] = {key: (k, swap(v) if k == "chan" else v)
+                                for key, (k, v) in op["kwargs"].items()}
+                op["outputs"] = [swap(c) for c in op["outputs"]]
 
     # ------------------------------------------------------------ execute
 
