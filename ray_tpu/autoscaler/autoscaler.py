@@ -75,7 +75,17 @@ class Autoscaler:
     def _fits(self, demand: Dict[str, float],
               resources: Dict[str, float]) -> bool:
         return all(resources.get(k, 0.0) >= v
-                   for k, v in demand.items() if v > 0)
+                   for k, v in demand.items()
+                   if k != "_labels" and v > 0)
+
+    @staticmethod
+    def _labels_match(demand: Dict[str, Any],
+                      labels: Dict[str, str]) -> bool:
+        """A label-constrained demand (see head node_label picks) only
+        counts against capacity that CARRIES those labels — scaling up
+        unlabeled nodes for it would loop forever."""
+        need = demand.get("_labels") or {}
+        return all(labels.get(k) == v for k, v in dict(need).items())
 
     def _scale_up(self, state) -> List[str]:
         demands = state["unmet"]
@@ -99,27 +109,35 @@ class Autoscaler:
         # accelerator_type) are config, not capacity.
         types = sorted(
             ((name, {k: float(v) for k, v in res.items()
-                     if isinstance(v, (int, float))})
+                     if isinstance(v, (int, float))},
+              dict(res.get("_labels", {})))
              for name, res in self._provider.node_types.items()),
             key=lambda kv: sum(kv[1].values()))
-        pending_capacity: List[Dict[str, float]] = [
-            dict(n["available"]) for n in state["nodes"] if n["alive"]]
+        pending_capacity: List[tuple] = [
+            (dict(n["available"]), dict(n.get("labels") or {}))
+            for n in state["nodes"] if n["alive"]]
         for demand in demands:
             placed = False
-            for cap in pending_capacity:
-                if self._fits(demand, cap):
+            for cap, labels in pending_capacity:
+                if self._fits(demand, cap) and self._labels_match(demand,
+                                                                  labels):
                     for k, v in demand.items():
+                        if k == "_labels":
+                            continue
                         cap[k] = cap.get(k, 0.0) - v
                     placed = True
                     break
             if placed:
                 continue
-            for _name, res in types:
-                if self._fits(demand, res):
+            for _name, res, type_labels in types:
+                if self._fits(demand, res) and self._labels_match(
+                        demand, type_labels):
                     cap = dict(res)
                     for k, v in demand.items():
+                        if k == "_labels":
+                            continue
                         cap[k] = cap.get(k, 0.0) - v
-                    pending_capacity.append(cap)
+                    pending_capacity.append((cap, type_labels))
                     launched.append(_name)
                     break
         # max_nodes is a HOST cap and n_current counts hosts: charge each

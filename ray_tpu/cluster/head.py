@@ -362,6 +362,48 @@ class HeadServer:
                     if n and n.alive and node_id not in exclude_set:
                         return n.node_id, n.address, n.store_name
                 return None
+            elif kind == "node_label":
+                # Label policy (reference: NodeLabelSchedulingStrategy,
+                # scheduling_strategies.py:135 + the node-label policy in
+                # raylet/scheduling/policy/): HARD labels filter, SOFT
+                # labels rank, then most-available-first so multi-host
+                # slices spread rather than insertion-order pack. A
+                # momentarily-FULL matching node still gets picked by
+                # TOTAL capacity (the lease QUEUES at the node — same
+                # no-churn design as the other branches).
+                hard = dict(strategy.get("hard") or ())
+                soft = dict(strategy.get("soft") or ())
+                matching = [n for n in self._nodes.values()
+                            if n.alive and n.node_id not in exclude_set
+                            and all(n.labels.get(k) == v
+                                    for k, v in hard.items())]
+                candidates = [n for n in matching
+                              if all(n.available.get(k, 0.0) >= v
+                                     for k, v in resources.items())]
+                if not candidates:
+                    candidates = [n for n in matching
+                                  if all(n.total.get(k, 0.0) >= v
+                                         for k, v in resources.items())]
+                if not candidates:
+                    # Carry the label constraint with the demand — the
+                    # autoscaler must not scale up nodes that can never
+                    # match it.
+                    demand = dict(resources)
+                    if hard:
+                        demand["_labels"] = hard
+                    self._unmet_demand.append(
+                        (time.monotonic(), demand, demand_key))
+                    return None
+
+                def rank(n):
+                    soft_hits = sum(1 for k, v in soft.items()
+                                    if n.labels.get(k) == v)
+                    free = sum(n.available.get(k, 0.0)
+                               for k in resources)
+                    return (-soft_hits, -free, n.node_id)
+
+                n = min(candidates, key=rank)
+                return n.node_id, n.address, n.store_name
             elif kind == "spread":
                 # True round-robin: the head's availability view lags
                 # heartbeats, so utilization-ranking alone would send a

@@ -103,7 +103,8 @@ class WorkerRuntime(ClusterCore):
         super().__init__(head_addr, node_addr, node_id, store_name,
                          JobID.from_int(1), is_driver=False)
         self._exec_pool = ThreadPoolExecutor(
-            max_workers=64, thread_name_prefix="task-exec")
+            max_workers=cfg.worker_exec_pool_size,
+            thread_name_prefix="task-exec")
         # ONE normal-task execution slot: the lease this worker serves is
         # sized for a single task's resources, so pipelined pushes QUEUE
         # here and execute serially (running them all concurrently
@@ -143,7 +144,7 @@ class WorkerRuntime(ClusterCore):
                 return True
             self._seen_tasks.add(task_id_bytes)
             self._seen_order.append(task_id_bytes)
-            if len(self._seen_order) > 20_000:
+            if len(self._seen_order) > cfg.worker_seen_tasks_max:
                 self._seen_tasks.discard(self._seen_order.popleft())
             return False
 
@@ -411,7 +412,7 @@ class WorkerRuntime(ClusterCore):
         (and deregisters) after 60s idle so many short-lived owners don't
         leak threads."""
         while True:
-            if not ev.wait(timeout=60.0):
+            if not ev.wait(timeout=cfg.done_flusher_idle_ttl_s):
                 with self._done_lock:
                     if not q:
                         self._done_flushers.pop(owner, None)

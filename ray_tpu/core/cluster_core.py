@@ -272,7 +272,7 @@ class ClusterCore:
         self._transfer_pins: "_collections.deque" = _collections.deque()
         # Completed-task events awaiting the periodic flush to the head.
         self._task_event_outbox: "_collections.deque" = _collections.deque(
-            maxlen=10_000)
+            maxlen=cfg.task_event_outbox_max)
         # Lineage-based recovery: creating-task specs per owned object
         # (reference: task_manager.h:265 ResubmitTask).
         from ray_tpu.core.lineage import LineageStore
@@ -282,7 +282,7 @@ class ClusterCore:
         self._recover_lock = threading.Lock()
         # Observability: recent completions ring (util.state.list_tasks).
         self._recent_tasks: "_collections.deque" = _collections.deque(
-            maxlen=512)
+            maxlen=cfg.recent_tasks_ring)
         self._actors: Dict[ActorID, _ActorConn] = {}
         self._actors_lock = threading.Lock()
         self._actor_classes: Dict[ActorID, Any] = {}
@@ -400,7 +400,8 @@ class ClusterCore:
                     self._borrows_sent.discard(
                         self._borrows_sent_order.popleft())
                 self._borrow_buf.setdefault(owner_addr, []).append(key)
-                if (len(self._borrow_buf[owner_addr]) >= 512
+                if (len(self._borrow_buf[owner_addr])
+                        >= cfg.borrow_flush_batch_size
                         and not self._in_borrow_backoff(owner_addr)):
                     flush = self._borrow_buf.pop(owner_addr)
             if flush is not None:
@@ -429,13 +430,14 @@ class ClusterCore:
             with self._borrow_buf_lock:
                 buf = self._borrow_buf.setdefault(owner_addr, [])
                 buf.extend(oid_blobs)
-                if len(buf) > 100_000:
+                cap = cfg.borrow_buffer_max
+                if len(buf) > cap:
                     # Dropped keys must leave _borrows_sent too, else a
                     # later deserialization of the same ref would be
                     # dedup-skipped and the borrow never registered.
-                    for k in buf[:-100_000]:
+                    for k in buf[:-cap]:
                         self._borrows_sent.discard(k)
-                    del buf[:-100_000]
+                    del buf[:-cap]
 
     def _flush_all_borrows(self) -> None:
         with self._borrow_buf_lock:
@@ -1258,7 +1260,7 @@ class ClusterCore:
         abandonment)."""
         self._cancelled.add(task_id)
         self._cancelled_order.append(task_id)
-        while len(self._cancelled_order) > 8192:
+        while len(self._cancelled_order) > cfg.cancelled_ids_max:
             self._cancelled.discard(self._cancelled_order.popleft())
         with self._inflight_lock:
             info = self._inflight.get(task_id.binary())
@@ -1438,7 +1440,8 @@ class ClusterCore:
                         kq.dispatcher_running = False
                         return
                 if done and idle_deadline is None:
-                    idle_deadline = time.monotonic() + 2.0
+                    idle_deadline = (time.monotonic()
+                                     + cfg.dispatcher_idle_linger_s)
                 elif not done:
                     idle_deadline = None
                 kq.wake.wait(0.25)
@@ -2004,7 +2007,7 @@ class ClusterCore:
                 # still queued (actor died/restarted before we sent it):
                 # failed-then-executed would duplicate side effects on the
                 # new incarnation, so never send a seq no longer pending.
-                while conn.outbound and len(batch) < 256:
+                while conn.outbound and len(batch) < cfg.actor_send_batch_max:
                     item = conn.outbound.popleft()
                     if item[0] in conn.pending:
                         batch.append(item)
@@ -2285,6 +2288,24 @@ def _strategy_dict(strategy) -> Optional[Dict[str, Any]]:
     if kind == "NodeAffinitySchedulingStrategy":
         return {"kind": "node_affinity", "node_id": strategy.node_id,
                 "soft": getattr(strategy, "soft", False)}
+    if kind == "NodeLabelSchedulingStrategy":
+        return {"kind": "node_label",
+                "hard": tuple(dict(strategy.hard).items()
+                              if not isinstance(strategy.hard, tuple)
+                              else strategy.hard),
+                "soft": tuple(dict(strategy.soft).items()
+                              if not isinstance(strategy.soft, tuple)
+                              else strategy.soft)}
+    if kind == "SliceAffinitySchedulingStrategy":
+        # TPU-native sugar: hard label match on the slice name (the GCE
+        # provider labels every slice host with tpu-slice=<name>), plus
+        # the per-host pin when host_index is given (tpu-worker-id label,
+        # core/accelerators.py slice_node_resources) — SPMD gangs place
+        # one process per specific slice host.
+        hard = [("tpu-slice", strategy.slice_name)]
+        if strategy.host_index is not None:
+            hard.append(("tpu-worker-id", str(strategy.host_index)))
+        return {"kind": "node_label", "hard": tuple(hard), "soft": ()}
     raise ValueError(f"unknown scheduling strategy {strategy!r}")
 
 

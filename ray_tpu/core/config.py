@@ -124,6 +124,31 @@ _d("memory_monitor_refresh_ms", int, 1000,
    "memory monitor sample period; 0 disables "
    "(reference: RAY_memory_monitor_refresh_ms)")
 
+# --- core worker internals ---
+_d("borrow_flush_batch_size", int, 512,
+   "borrow registrations buffered per owner before an inline flush "
+   "(between flushes the periodic sweep delivers)")
+_d("borrow_buffer_max", int, 100_000,
+   "cap on re-enqueued borrow notifications per unreachable owner")
+_d("cancelled_ids_max", int, 8192,
+   "FIFO-bounded remembered cancelled task ids (dedup for re-dispatch)")
+_d("actor_send_batch_max", int, 256,
+   "max actor calls coalesced into one push_actor_batch frame")
+_d("recent_tasks_ring", int, 512,
+   "per-owner recent task completions kept for the local state API")
+_d("task_event_outbox_max", int, 10_000,
+   "completed-task events buffered between flushes to the head")
+_d("dispatcher_idle_linger_s", float, 2.0,
+   "how long an idle per-key dispatcher thread lingers before exiting "
+   "(covers sync submit-get loops without a thread spawn per call)")
+_d("worker_seen_tasks_max", int, 20_000,
+   "executed-task dedup window per worker (at-least-once pushes)")
+_d("worker_exec_pool_size", int, 64,
+   "worker task-execution thread pool (tasks beyond the lease slot "
+   "queue; blocked tasks yield the slot)")
+_d("done_flusher_idle_ttl_s", float, 60.0,
+   "per-owner completion flusher thread exits after this idle time")
+
 # --- fault tolerance ---
 _d("transfer_pin_ttl_s", float, 30.0,
    "owner-side lifetime extension for refs serialized into messages "
