@@ -84,7 +84,8 @@ class FakeGceApi(GceTpuApi):
         self._slices: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
 
-    def create_tpu_slice(self, name: str, accelerator_type: str) -> None:
+    def create_tpu_slice(self, name: str, accelerator_type: str,
+                         extra_labels=None) -> None:
         _gen, _chips, hosts = parse_slice_shape(accelerator_type)
         # Record CREATING before hosts boot (like the real API: the node
         # resource exists immediately, state flips to READY when all hosts
@@ -97,8 +98,10 @@ class FakeGceApi(GceTpuApi):
         nodes = []
         for worker_id in range(hosts):
             res, labels = slice_node_resources(accelerator_type, worker_id)
-            node = self._rt.add_node(num_cpus=8.0, resources=res,
-                                     labels={**labels, "tpu-slice": name})
+            node = self._rt.add_node(
+                num_cpus=8.0, resources=res,
+                labels={**labels, **(extra_labels or {}),
+                        "tpu-slice": name})
             nodes.append(node)
         with self._lock:
             s = self._slices.get(name)
@@ -160,12 +163,17 @@ class GceTpuNodeProvider(NodeProvider):
     def _resources_of(self, node_type: str) -> Dict[str, float]:
         spec = self.node_types[node_type]
         return {k: float(v) for k, v in spec.items()
-                if k != "accelerator_type"}
+                if k not in ("accelerator_type", "_labels")}
 
     def create_node(self, node_type: str) -> str:
         spec = self.node_types[node_type]
         name = f"{node_type}-{uuid.uuid4().hex[:8]}"
-        self._api.create_tpu_slice(name, spec["accelerator_type"])
+        try:
+            self._api.create_tpu_slice(name, spec["accelerator_type"],
+                                       dict(spec.get("_labels", {})))
+        except TypeError:
+            # API impls without label support (REST stub) still work.
+            self._api.create_tpu_slice(name, spec["accelerator_type"])
         return name
 
     def terminate_node(self, provider_node_id: str) -> None:

@@ -62,7 +62,12 @@ class LocalNodeProvider(NodeProvider):
             try:
                 res = dict(self.node_types[node_type])
                 cpus = res.pop("CPU", 0.0)
-                node = self._rt.add_node(num_cpus=cpus, resources=res or None)
+                # "_labels" is node METADATA, not capacity: apply as node
+                # labels (label-constrained demands match against them).
+                labels = dict(res.pop("_labels", {}))
+                node = self._rt.add_node(num_cpus=cpus,
+                                         resources=res or None,
+                                         labels=labels or None)
                 self._results.put(("ok", node))
             except BaseException as e:  # noqa: BLE001
                 self._results.put(("err", e))

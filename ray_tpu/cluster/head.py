@@ -387,10 +387,11 @@ class HeadServer:
                 if not candidates:
                     # Carry the label constraint with the demand — the
                     # autoscaler must not scale up nodes that can never
-                    # match it.
+                    # match it. Tuple form: demand shapes are HASHED by
+                    # the dedup in rpc_get_demand (a dict would raise).
                     demand = dict(resources)
                     if hard:
-                        demand["_labels"] = hard
+                        demand["_labels"] = tuple(sorted(hard.items()))
                     self._unmet_demand.append(
                         (time.monotonic(), demand, demand_key))
                     return None

@@ -1808,8 +1808,18 @@ class ClusterCore:
                 backlog = len(kq.queue) + pipelined_waiting
                 if backlog > 0:
                     resources = dict(kq.key[1]) if len(kq.key) > 1 else {}
+                    strat = None
                     if kq.queue:
-                        resources = dict(kq.queue[0][1].resources)
+                        info = kq.queue[0][1]
+                        resources = dict(info.resources)
+                        strat = info.strategy
+                    # Label-constrained backlogs carry the constraint:
+                    # the autoscaler must not satisfy them with capacity
+                    # that can never match (see Autoscaler._labels_match).
+                    if strat and strat.get("kind") == "node_label" \
+                            and strat.get("hard"):
+                        resources["_labels"] = tuple(
+                            sorted(tuple(p) for p in strat["hard"]))
                     entries.append((resources, backlog))
         if entries or getattr(self, "_backlog_was_nonempty", False):
             self._backlog_was_nonempty = bool(entries)

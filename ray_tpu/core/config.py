@@ -196,6 +196,15 @@ _d("tpu_slice_exclusive", bool, True,
    "enforce one-process-per-host TPU ownership when leasing TPU resources")
 _d("device_prefetch_depth", int, 2, "host->HBM prefetch pipeline depth for data")
 
+# --- compiled DAGs ---
+_d("dag_overlap_comm", bool, False,
+   "compiled DAGs: run channel writes on a dedicated sender thread so "
+   "compute for step n+1 overlaps the send of step n (reference: "
+   "overlap_gpu_communication, dag/context.py:78 — also opt-in there). "
+   "Wins when send latency and compute can genuinely run in parallel "
+   "(multi-core hosts, cross-node channels); on single-core hosts the "
+   "thread hop costs more than it saves (measured 0.77x)")
+
 # --- metrics / events ---
 _d("metrics_report_period_ms", int, 5000, "metrics push period")
 _d("metrics_export_port", int, 0,

@@ -316,7 +316,61 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
     iteration per seq — read op inputs, call the method on the actor
     instance, write outputs. Errors are forwarded downstream (the driver
     raises them from the output channel); a stop sentinel propagates and
-    ends the loop."""
+    ends the loop.
+
+    COMM OVERLAP (reference: dag_node_operation.py:506-539's
+    READ/COMPUTE/WRITE schedule with overlapped communication, toggled by
+    DAGContext.overlap_gpu_communication): output writes run on a
+    dedicated per-loop SENDER thread, so compute for seq+1 overlaps the
+    channel send of seq — on cross-node channels (a push RPC per message)
+    that send is the hop's whole latency. Order is preserved: one sender
+    drains the queue FIFO, and every channel stays single-writer."""
+    import queue as _q
+
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+    overlap = bool(getattr(_cfg, "dag_overlap_comm", True))
+    send_q: "_q.Queue" = _q.Queue(maxsize=32)
+    send_failed: List[BaseException] = []
+
+    def _sender():
+        while True:
+            item = send_q.get()
+            if item is None:
+                return
+            mode, ch, payload, s = item
+            try:
+                if mode == "w":
+                    ch.write(payload, s)
+                elif mode == "e":
+                    ch.write_error(payload, s)
+                else:
+                    ch.write_stop(s)
+            except BaseException as e:  # noqa: BLE001 — surfaced to loop
+                send_failed.append(e)
+
+    sender_thread = None
+    if overlap:
+        sender_thread = threading.Thread(
+            target=_sender, daemon=True, name="dag-sender")
+        sender_thread.start()
+
+    def emit(mode, ch, payload, s):
+        if overlap and not send_failed:
+            send_q.put((mode, ch, payload, s))
+            return
+        if mode == "w":
+            ch.write(payload, s)
+        elif mode == "e":
+            ch.write_error(payload, s)
+        else:
+            ch.write_stop(s)
+
+    def finish():
+        if sender_thread is not None:
+            send_q.put(None)
+            sender_thread.join(timeout=30)
+
     seq = 0
     while not stop_event.is_set():
         stopped = False
@@ -353,7 +407,7 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
             if saw_stop:
                 for out in op["outputs"]:
                     try:
-                        out.write_stop(seq)
+                        emit("s", out, None, seq)
                     except Exception:
                         pass
                 # Consume the REMAINING ops' input sentinels too — each
@@ -371,7 +425,7 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
                             pass
                     for out in later["outputs"]:
                         try:
-                            out.write_stop(seq)
+                            emit("s", out, None, seq)
                         except Exception:
                             pass
                 stopped = True
@@ -379,16 +433,23 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
             if first_err is not None:
                 # An upstream error rode the channel in: forward it.
                 for out in op["outputs"]:
-                    out.write_error(first_err, seq)
+                    emit("e", out, first_err, seq)
                 continue
             try:
                 result = getattr(instance, op["method"])(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — forwarded, not fatal
                 for out in op["outputs"]:
-                    out.write_error(e, seq)
+                    emit("e", out, e, seq)
                 continue
             for out in op["outputs"]:
-                out.write(result, seq)
+                emit("w", out, result, seq)
         if stopped:
+            finish()
+            return
+        if send_failed:
+            # A channel write failed on the sender: the pipeline is
+            # broken — stop rather than compute into a dead channel.
+            finish()
             return
         seq += 1
+    finish()
