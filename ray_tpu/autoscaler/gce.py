@@ -166,13 +166,18 @@ class GceTpuNodeProvider(NodeProvider):
                 if k not in ("accelerator_type", "_labels")}
 
     def create_node(self, node_type: str) -> str:
+        import inspect
+
         spec = self.node_types[node_type]
         name = f"{node_type}-{uuid.uuid4().hex[:8]}"
-        try:
+        # Signature probe, not try/except TypeError: catching the live
+        # call would mask real TypeErrors AND silently drop labels (an
+        # unlabeled slice can never satisfy a label demand — launch loop).
+        params = inspect.signature(self._api.create_tpu_slice).parameters
+        if "extra_labels" in params:
             self._api.create_tpu_slice(name, spec["accelerator_type"],
                                        dict(spec.get("_labels", {})))
-        except TypeError:
-            # API impls without label support (REST stub) still work.
+        else:
             self._api.create_tpu_slice(name, spec["accelerator_type"])
         return name
 

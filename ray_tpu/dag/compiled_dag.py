@@ -329,7 +329,7 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
 
     from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
 
-    overlap = bool(getattr(_cfg, "dag_overlap_comm", True))
+    overlap = bool(getattr(_cfg, "dag_overlap_comm", False))
     send_q: "_q.Queue" = _q.Queue(maxsize=32)
     send_failed: List[BaseException] = []
 
@@ -356,7 +356,10 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
         sender_thread.start()
 
     def emit(mode, ch, payload, s):
-        if overlap and not send_failed:
+        # Once a sender exists it stays the ONLY writer (switching to
+        # direct writes mid-flight would race its queued writes and
+        # reorder seqs on a channel).
+        if overlap:
             send_q.put((mode, ch, payload, s))
             return
         if mode == "w":
@@ -448,7 +451,13 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
             return
         if send_failed:
             # A channel write failed on the sender: the pipeline is
-            # broken — stop rather than compute into a dead channel.
+            # broken — say so LOUDLY (the sync path would have printed a
+            # thread traceback) and stop rather than compute into a dead
+            # channel; the driver surfaces as a channel timeout.
+            import sys as _sys
+
+            print(f"compiled-DAG sender write failed; stopping loop: "
+                  f"{send_failed[0]!r}", file=_sys.stderr, flush=True)
             finish()
             return
         seq += 1

@@ -142,6 +142,18 @@ def _cmd_logs(args) -> int:
     return 0
 
 
+def _cmd_serve_deploy(args) -> int:
+    import json as _json
+
+    from ray_tpu import serve
+
+    _connect(args.address)
+    handles = serve.deploy_config(args.config)
+    print(_json.dumps({"deployed": sorted(handles),
+                       "status": serve.status()}, indent=1, default=str))
+    return 0
+
+
 def _cmd_down(args) -> int:
     rt = _connect(args.address)
     nodes = rt.head.retrying_call("list_nodes", timeout=10)
@@ -191,6 +203,14 @@ def main(argv=None) -> int:
     s4.add_argument("--address", required=True)
     s4.add_argument("job_id")
     s4.set_defaults(fn=_cmd_logs)
+
+    s5 = sub.add_parser(
+        "serve-deploy",
+        help="deploy serve applications from a YAML config "
+             "(reference: `serve deploy`)")
+    s5.add_argument("--address", required=True)
+    s5.add_argument("config", help="path to the serve YAML")
+    s5.set_defaults(fn=_cmd_serve_deploy)
 
     args = p.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

@@ -14,12 +14,14 @@ from ray_tpu.serve.api import (Deployment, DeploymentHandle,
                                get_multiplexed_model_id, multiplexed, run,
                                shutdown, status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.schema import deploy_config
 
 __all__ = [
     "Deployment", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "delete", "deployment",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run", "shutdown", "status", "start_http", "start_grpc",
+    "deploy_config",
 ]
 
 

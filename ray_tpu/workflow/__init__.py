@@ -111,7 +111,9 @@ def step(_fn=None, *, name: Optional[str] = None, max_retries: int = 3,
 
 
 # --------------------------------------------------------------------------
-# Storage
+# Storage — local fs by default, any fsspec URL otherwise (s3://,
+# gs://, memory://...): the reference's workflow_storage supports fs/s3
+# backends the same way.
 # --------------------------------------------------------------------------
 
 
@@ -119,29 +121,98 @@ def _storage_root() -> str:
     return os.environ.get(_STORAGE_ENV, _DEFAULT_STORAGE)
 
 
+_FS_CACHE: Dict[str, tuple] = {}
+
+
+def _fs():
+    """(filesystem, base): None fs = plain local-os fast path. Cached per
+    root — storage ops (including event polls) must not re-parse the URL
+    every call."""
+    root = _storage_root()
+    cached = _FS_CACHE.get(root)
+    if cached is not None:
+        return cached
+    if "://" in root:
+        import fsspec
+
+        fs, path = fsspec.core.url_to_fs(root)
+        out = (fs, path)
+    else:
+        out = (None, root)
+    _FS_CACHE[root] = out
+    return out
+
+
+def _join(*parts: str) -> str:
+    fs, base = _fs()
+    if fs is not None:
+        return "/".join((base,) + parts)
+    return os.path.join(base, *parts)
+
+
 def _wf_dir(workflow_id: str) -> str:
-    return os.path.join(_storage_root(), workflow_id)
+    return _join(workflow_id)
 
 
 def _result_path(workflow_id: str, step_id: str) -> str:
-    return os.path.join(_wf_dir(workflow_id), f"step_{step_id}.pkl")
+    return _join(workflow_id, f"step_{step_id}.pkl")
+
+
+def _exists(path: str) -> bool:
+    fs, _root = _fs()
+    return fs.exists(path) if fs is not None else os.path.exists(path)
+
+
+def _read_bytes(path: str) -> bytes:
+    fs, _root = _fs()
+    if fs is not None:
+        with fs.open(path, "rb") as f:
+            return f.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _makedirs(path: str) -> None:
+    fs, _root = _fs()
+    if fs is not None:
+        fs.makedirs(path, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def _write_atomic(path: str, data: bytes) -> None:
+    fs, _root = _fs()
+    if fs is not None:
+        # Object stores write whole objects (already atomic-ish); local
+        # fsspec filesystems get tmp+mv.
+        _makedirs(path.rsplit("/", 1)[0])
+        with fs.open(path, "wb") as f:
+            f.write(data)
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: a crash never leaves half a result
+
+
+def _dumps(value: Any) -> bytes:
+    # cloudpickle: continuation markers carry step DAGs whose functions
+    # may be locally defined (plain pickle rejects them).
+    import cloudpickle
+
+    return cloudpickle.dumps(value, protocol=5)
 
 
 def _load_result(workflow_id: str, step_id: str):
     path = _result_path(workflow_id, step_id)
-    if not os.path.exists(path):
+    if not _exists(path):
         return False, None
-    with open(path, "rb") as f:
-        return True, pickle.load(f)
+    return True, pickle.loads(_read_bytes(path))
 
 
 def _save_result(workflow_id: str, step_id: str, value: Any) -> None:
-    path = _result_path(workflow_id, step_id)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(value, f, 5)
-    os.replace(tmp, path)  # atomic: a crash never leaves half a result
+    _write_atomic(_result_path(workflow_id, step_id), _dumps(value))
 
 
 # --------------------------------------------------------------------------
@@ -159,6 +230,17 @@ def _execute(node: StepNode, workflow_id: str,
         return memo[sid]
     done, value = _load_result(workflow_id, sid)
     if done:
+        if isinstance(value, Continuation):
+            # Crash happened after the outer step finished but before its
+            # continuation completed: resume INTO the continuation — the
+            # outer (possibly side-effecting) step never replays.
+            value = _execute(value.dag, workflow_id, memo)
+            _save_result(workflow_id, sid, value)
+        memo[sid] = value
+        return value
+    if isinstance(node, EventNode):
+        value = _await_event(workflow_id, node.event_name, node.timeout)
+        _save_result(workflow_id, sid, value)
         memo[sid] = value
         return value
     # Resolve upstream deps depth-first.
@@ -188,6 +270,14 @@ def _execute(node: StepNode, workflow_id: str,
         raise RuntimeError(
             f"workflow step {node.name!r} failed after "
             f"{attempts} attempts") from last_err
+    if isinstance(value, Continuation):
+        # DYNAMIC workflow (reference: workflow.continuation): checkpoint
+        # the MARKER first — the outer step is done and must never replay
+        # even if we crash mid-continuation — then run the new DAG (its
+        # steps checkpoint under their own ids) and record the final
+        # value under the original step.
+        _save_result(workflow_id, sid, value)
+        value = _execute(value.dag, workflow_id, memo)
     _save_result(workflow_id, sid, value)
     memo[sid] = value
     return value
@@ -199,11 +289,10 @@ def run(dag: StepNode, *, workflow_id: str) -> Any:
     if not isinstance(dag, StepNode):
         raise TypeError("workflow.run expects a bound step DAG "
                         "(@workflow.step + .bind())")
-    os.makedirs(_wf_dir(workflow_id), exist_ok=True)
+    _makedirs(_wf_dir(workflow_id))
     # Persist the terminal step id so resume() can verify the DAG matches.
-    meta = os.path.join(_wf_dir(workflow_id), "meta.pkl")
-    with open(meta, "wb") as f:
-        pickle.dump({"output_step": dag.step_id()}, f, 5)
+    _write_atomic(_join(workflow_id, "meta.pkl"),
+                  _dumps({"output_step": dag.step_id()}))
     return _execute(dag, workflow_id, {})
 
 
@@ -211,11 +300,10 @@ def resume(workflow_id: str, dag: StepNode) -> Any:
     """Continue an interrupted workflow: completed steps load from
     storage; only unfinished steps execute (reference: workflow.resume —
     this runtime re-binds the DAG since code isn't stored)."""
-    meta = os.path.join(_wf_dir(workflow_id), "meta.pkl")
-    if not os.path.exists(meta):
+    meta = _join(workflow_id, "meta.pkl")
+    if not _exists(meta):
         raise KeyError(f"no workflow {workflow_id!r} in {_storage_root()}")
-    with open(meta, "rb") as f:
-        expected = pickle.load(f)["output_step"]
+    expected = pickle.loads(_read_bytes(meta))["output_step"]
     if dag.step_id() != expected:
         raise ValueError(
             "resumed DAG differs from the stored workflow (step ids "
@@ -225,13 +313,103 @@ def resume(workflow_id: str, dag: StepNode) -> Any:
 
 def get_status(workflow_id: str) -> Dict[str, Any]:
     d = _wf_dir(workflow_id)
-    if not os.path.isdir(d):
-        raise KeyError(f"no workflow {workflow_id!r}")
-    steps = [n for n in os.listdir(d) if n.startswith("step_")]
+    fs, _root = _fs()
+    if fs is not None:
+        if not fs.exists(d):
+            raise KeyError(f"no workflow {workflow_id!r}")
+        names = [str(p["name"] if isinstance(p, dict) else p)
+                 .rsplit("/", 1)[-1] for p in fs.ls(d)]
+    else:
+        if not os.path.isdir(d):
+            raise KeyError(f"no workflow {workflow_id!r}")
+        names = os.listdir(d)
+    steps = [n for n in names if n.startswith("step_")]
     return {"workflow_id": workflow_id, "steps_completed": len(steps)}
 
 
 def delete(workflow_id: str) -> None:
+    fs, _root = _fs()
+    if fs is not None:
+        try:
+            fs.rm(_wf_dir(workflow_id), recursive=True)
+        except Exception:
+            pass
+        return
     import shutil
 
     shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
+# Dynamic workflows + events (reference: workflow.continuation,
+# workflow event listeners / wait_for_event)
+# --------------------------------------------------------------------------
+
+
+class Continuation:
+    """Returned BY a step to extend the workflow dynamically: the
+    executor runs the new DAG and records its output as the step's
+    result (reference: workflow.continuation)."""
+
+    def __init__(self, dag: StepNode):
+        if not isinstance(dag, StepNode):
+            raise TypeError("Continuation expects a bound step DAG")
+        self.dag = dag
+
+
+def continuation(dag: StepNode) -> Continuation:
+    return Continuation(dag)
+
+
+class EventNode(StepNode):
+    """A step that completes when an external event arrives (reference:
+    workflow.wait_for_event): durable — once observed, the payload is
+    checkpointed like any step result."""
+
+    def __init__(self, event_name: str, timeout: Optional[float] = None):
+        def _event_placeholder():  # never runs; identity only
+            return event_name
+
+        super().__init__(_event_placeholder, (), {},
+                         name=f"event[{event_name}]")
+        self.event_name = event_name
+        self.timeout = timeout
+
+    def step_id(self) -> str:
+        h = hashlib.sha1()
+        h.update(b"event:" + self.event_name.encode())
+        return h.hexdigest()[:20]
+
+
+def wait_for_event(event_name: str,
+                   timeout: Optional[float] = None) -> EventNode:
+    return EventNode(event_name, timeout)
+
+
+def _event_path(workflow_id: str, event_name: str) -> str:
+    return _join(workflow_id, f"event_{event_name}.pkl")
+
+
+def send_event(workflow_id: str, event_name: str, payload: Any = None) -> None:
+    """Deliver an external event to a (possibly waiting) workflow — any
+    process with storage access can send (the durable-signal role of the
+    reference's event system)."""
+    _makedirs(_wf_dir(workflow_id))
+    _write_atomic(_event_path(workflow_id, event_name), _dumps(payload))
+
+
+def _await_event(workflow_id: str, event_name: str,
+                 timeout: Optional[float]) -> Any:
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    path = _event_path(workflow_id, event_name)
+    pause = 0.05
+    while not _exists(path):
+        if deadline is not None and _time.monotonic() > deadline:
+            raise TimeoutError(
+                f"workflow event {event_name!r} not delivered within "
+                f"{timeout}s")
+        _time.sleep(pause)
+        pause = min(pause * 1.5, 1.0)
+    return pickle.loads(_read_bytes(path))

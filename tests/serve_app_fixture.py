@@ -1,0 +1,32 @@
+"""Importable serve application for YAML-deploy tests (the
+import_path target — reference configs point at modules the same way)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="Adder")
+class Adder:
+    def __init__(self, bias: int = 0):
+        self.bias = bias
+
+    def __call__(self, payload):
+        return {"sum": payload.get("x", 0) + self.bias}
+
+
+@serve.deployment(name="Front")
+class Front:
+    def __init__(self, adder):
+        self._adder = adder
+
+    def __call__(self, payload):
+        out = self._adder.remote(payload).result(timeout=30)
+        return {"front": True, **out}
+
+
+#: bound graph referenced as tests.serve_app_fixture:app
+app = Front.bind(Adder.bind(5))
+
+
+def build(bias: int = 5):
+    """Builder form: import_path tests.serve_app_fixture:build + args."""
+    return Front.bind(Adder.bind(bias))

@@ -78,3 +78,59 @@ def test_dag_same_node_still_uses_shm(cluster):
                for c in dag._output_channels)
     assert dag.execute(41).get(timeout=30) == 42
     dag.teardown()
+
+
+def test_dag_overlap_comm_subprocess():
+    """The sender-thread path (dag_overlap_comm=1) runs the full cross-
+    node pipeline correctly — exercised in a subprocess because workers
+    read the flag from their spawn environment."""
+    import subprocess
+    import sys
+
+    code = """
+import os, sys, time, collections
+sys.path.insert(0, %r)
+import ray_tpu
+from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
+from ray_tpu.dag import InputNode
+rt = ray_tpu.init(num_cpus=2)
+node = rt.add_node(num_cpus=2)
+deadline = time.time() + 30
+while time.time() < deadline and len(
+        [n for n in rt.nodes() if n["alive"]]) < 2:
+    time.sleep(0.25)
+
+@ray_tpu.remote
+class S:
+    def f(self, x):
+        return x + 1
+
+a = S.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+    node_id=rt.node_id, soft=False)).remote()
+b = S.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+    node_id=node.node_id, soft=False)).remote()
+with InputNode() as inp:
+    out = b.f.bind(a.f.bind(inp))
+dag = out.experimental_compile()
+w = collections.deque()
+got = []
+for i in range(30):
+    w.append(dag.execute(i))
+    if len(w) >= 4:
+        got.append(w.popleft().get(timeout=60))
+while w:
+    got.append(w.popleft().get(timeout=60))
+assert got == [i + 2 for i in range(30)], got[:5]
+dag.teardown()
+ray_tpu.shutdown()
+print("OVERLAP_OK")
+"""
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env = dict(_os.environ, RTPU_DAG_OVERLAP_COMM="1",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code % repo],
+                         capture_output=True, text=True, timeout=180,
+                         env=env)
+    assert "OVERLAP_OK" in out.stdout, out.stderr[-800:]

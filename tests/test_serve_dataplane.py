@@ -202,3 +202,40 @@ def test_grpc_ingress_unary_and_streaming(cluster):
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
     chan.close()
     serve.delete("gsvc")
+
+
+def test_yaml_declarative_deploy(cluster, tmp_path):
+    """serve.deploy_config: YAML applications with import_path + per-
+    deployment overrides (reference: ServeDeploySchema + `serve deploy`)."""
+    cfg_path = tmp_path / "serve.yaml"
+    cfg_path.write_text("""
+applications:
+  - name: calc
+    import_path: tests.serve_app_fixture:build
+    args: {bias: 100}
+    deployments:
+      - name: Adder
+        num_replicas: 2
+        ray_actor_options: {num_cpus: 0}
+      - name: Front
+        max_ongoing_requests: 4
+""")
+    handles = serve.deploy_config(str(cfg_path))
+    assert set(handles) == {"calc"}
+    out = handles["calc"].remote({"x": 1}).result(timeout=60)
+    assert out == {"front": True, "sum": 101}
+    # Overrides landed: Adder scaled to 2 replicas.
+    status = serve.status()
+    assert status["Adder"]["num_replicas"] == 2
+    # Bound-graph form (module attr `app`) deploys too.
+    handles2 = serve.deploy_config(
+        {"applications": [{"name": "calc2",
+                           "import_path":
+                               "tests.serve_app_fixture:app"}]})
+    out2 = handles2["calc2"].remote({"x": 2}).result(timeout=60)
+    assert out2 == {"front": True, "sum": 7}
+    for name in ("calc", "calc2", "Adder", "Front"):
+        try:
+            serve.delete(name)
+        except Exception:
+            pass

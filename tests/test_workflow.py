@@ -106,3 +106,78 @@ def test_diamond_dag_shares_step(cluster, tmp_path):
     assert workflow.run(dag, workflow_id="wf-diamond") == 11 * 12
     # The shared base step executed ONCE (diamond dedup via step ids).
     assert counter.read_text() == "x"
+
+
+def test_dynamic_continuation(cluster):
+    """A step returning workflow.continuation(...) extends the DAG at
+    runtime (reference: dynamic workflows); the final value checkpoints
+    under the ORIGINAL step so resume never replays."""
+    from ray_tpu import workflow
+
+    calls = {"n": 0}
+
+    @workflow.step
+    def double(x):
+        return x * 2
+
+    @workflow.step
+    def maybe_expand(x):
+        if x < 8:
+            return workflow.continuation(maybe_expand.bind(
+                workflow.StepNode(double._fn, (x,), {}, "double", 3)))
+        return x
+
+    out = workflow.run(maybe_expand.bind(1), workflow_id="wf-dyn")
+    assert out == 8  # 1 -> 2 -> 4 -> 8 through dynamic expansion
+
+
+def test_events_wait_and_send(cluster):
+    """wait_for_event blocks a branch until send_event delivers; the
+    payload checkpoints durably (a resumed run does not re-wait)."""
+    import threading
+    import time as _time
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def combine(a, ev):
+        return {"a": a, "event": ev}
+
+    @workflow.step
+    def base():
+        return 10
+
+    dag = combine.bind(base.bind(),
+                       workflow.wait_for_event("go", timeout=30))
+
+    def sender():
+        _time.sleep(1.0)
+        workflow.send_event("wf-ev", "go", {"ok": True})
+
+    t = threading.Thread(target=sender)
+    t.start()
+    out = workflow.run(dag, workflow_id="wf-ev")
+    t.join()
+    assert out == {"a": 10, "event": {"ok": True}}
+    # Resume: event result is checkpointed; completes instantly.
+    out2 = workflow.resume("wf-ev", dag)
+    assert out2 == out
+
+
+def test_fsspec_memory_storage(cluster, monkeypatch):
+    """Storage roots may be fsspec URLs (reference: workflow storage on
+    fs/s3) — memory:// exercises the non-local path end-to-end."""
+    from ray_tpu import workflow
+
+    monkeypatch.setenv("RTPU_WORKFLOW_STORAGE", "memory://wfroot")
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(add.bind(1, 2), 4)
+    assert workflow.run(dag, workflow_id="wf-mem") == 7
+    st = workflow.get_status("wf-mem")
+    assert st["steps_completed"] == 2
+    assert workflow.resume("wf-mem", dag) == 7
+    workflow.delete("wf-mem")
