@@ -1,0 +1,179 @@
+"""Vision Transformer (ViT) classifier, TPU-first pure-functional JAX.
+
+A third model family alongside the Llama decoder and Mixtral MoE
+(reference analog: the reference orchestrates vision models through its
+libraries rather than shipping one — e.g. image classification examples
+over Train/Data; this framework carries the model natively so the same
+mesh/sharding machinery, logical-axis rules and jitted train steps cover
+vision workloads too).
+
+Design mirrors models/llama.py: a frozen config, `param_logical_axes`
+naming every parameter dimension for the mesh sharding rules
+(parallel/mesh.py DEFAULT_RULES — "embed"/"heads"/"mlp" shard over tp,
+"layers" over pp when enabled), stacked-layer params driven by
+`lax.scan` so the encoder compiles once regardless of depth, and bf16
+matmuls with fp32 layernorms/softmax for MXU-friendly execution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def param_count(self) -> int:
+        d, f, l = self.d_model, self.d_ff, self.n_layers
+        patch_in = 3 * self.patch_size ** 2
+        # Per layer: qkv+o projections + 2 mlp mats + ln1/ln2 gains.
+        per_layer = 4 * d * d + 2 * d * f + 2 * d
+        return (patch_in * d + (self.n_patches + 1) * d
+                + d           # cls_token
+                + l * per_layer
+                + d           # ln_out
+                + d * self.num_classes)
+
+
+VIT_B_16 = ViTConfig()
+VIT_L_16 = ViTConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+
+
+def tiny_config(**kw) -> ViTConfig:
+    base = dict(image_size=32, patch_size=8, num_classes=10, d_model=64,
+                n_layers=2, n_heads=4, d_ff=128, dtype=jnp.float32)
+    base.update(kw)
+    return ViTConfig(**base)
+
+
+def param_logical_axes(cfg: ViTConfig) -> Params:
+    return {
+        "patch_embed": ("patch_in", "embed"),
+        "pos_embed": ("seq", "embed"),
+        "cls_token": ("embed",),
+        "blocks": {
+            "ln1": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "heads", "head_dim"),
+            "wv": ("layers", "embed", "heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "ln2": ("layers", "embed"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "ln_out": ("embed",),
+        "head": ("embed", "classes"),
+    }
+
+
+def init_params(cfg: ViTConfig, key: jax.Array) -> Params:
+    d, hd, h, f, l = (cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.d_ff,
+                      cfg.n_layers)
+    patch_in = 3 * cfg.patch_size ** 2
+    keys = jax.random.split(key, 9)
+    dt = cfg.dtype
+
+    def norm(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    return {
+        "patch_embed": norm(keys[0], (patch_in, d), patch_in),
+        "pos_embed": (jax.random.normal(
+            keys[1], (cfg.n_patches + 1, d), jnp.float32) * 0.02
+        ).astype(dt),
+        "cls_token": jnp.zeros((d,), dt),
+        "blocks": {
+            "ln1": jnp.zeros((l, d), dt),
+            "wq": norm(keys[2], (l, d, h, hd), d),
+            "wk": norm(keys[3], (l, d, h, hd), d),
+            "wv": norm(keys[4], (l, d, h, hd), d),
+            "wo": norm(keys[5], (l, h, hd, d), h * hd),
+            "ln2": jnp.zeros((l, d), dt),
+            "w_up": norm(keys[6], (l, d, f), d),
+            "w_down": norm(keys[7], (l, f, d), f),
+        },
+        "ln_out": jnp.zeros((d,), dt),
+        "head": norm(keys[8], (d, cfg.num_classes), d),
+    }
+
+
+def _ln(x, gain):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+            * (1.0 + gain.astype(jnp.float32))).astype(x.dtype)
+
+
+def patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[B, H, W, 3] -> [B, n_patches, patch_in] (NHWC)."""
+    B = images.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = images.reshape(B, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, g * g, p * p * 3)
+
+
+def _block(x, layer, cfg: ViTConfig):
+    B, S, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    y = _ln(x, layer["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", y, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", y, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", y, layer["wv"])
+    # Bidirectional attention (no mask): fp32 softmax for stability.
+    att = jnp.einsum("bshk,bthk->bhst", q, k) * (hd ** -0.5)
+    att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhst,bthk->bshk", att, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
+    y = _ln(x, layer["ln2"])
+    y = jax.nn.gelu(y @ layer["w_up"])
+    return x + y @ layer["w_down"]
+
+
+def forward(params: Params, images: jnp.ndarray,
+            cfg: ViTConfig) -> jnp.ndarray:
+    """[B, H, W, 3] float images -> [B, num_classes] logits."""
+    x = patchify(images.astype(cfg.dtype), cfg) @ params["patch_embed"]
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"], (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+
+    def body(x, layer):
+        return _block(x, layer, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _ln(x, params["ln_out"])
+    return (x[:, 0, :] @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Params, images: jnp.ndarray, labels: jnp.ndarray,
+            cfg: ViTConfig) -> jnp.ndarray:
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean()

@@ -105,6 +105,8 @@ DEFAULT_RULES: Dict[str, Optional[object]] = {
     "stages": "pp",            # pipeline stage dim
     "experts": "ep",           # MoE expert dim
     "kv_len": None,
+    "patch_in": None,          # ViT flattened-patch input dim
+    "classes": "tp",           # classifier head over tensor parallel
 }
 
 

@@ -151,6 +151,33 @@ class _Coordinator:
         self._rendezvous("barrier", seq, rank, None, lambda parts: True)
         return True
 
+    # Point-to-point: a per-(src, dst, tag) mailbox slot. send parks the
+    # value; recv collects (blocking) — both sides may arrive in either
+    # order (reference: collective.py send :531 / recv :594).
+
+    def p2p_send(self, src: int, dst: int, tag: int, value) -> bool:
+        # Per-key FIFO: back-to-back sends with one tag must QUEUE, not
+        # clobber (a lost message + a 300s recv hang otherwise).
+        key = ("p2p", src, dst, tag)
+        with self._cv:
+            self._ops.setdefault(key, []).append(value)
+            self._cv.notify_all()
+        return True
+
+    def p2p_recv(self, src: int, dst: int, tag: int,
+                 timeout: float = 300.0):
+        key = ("p2p", src, dst, tag)
+        with self._cv:
+            if not self._cv.wait_for(
+                    lambda: self._ops.get(key), timeout):
+                raise TimeoutError(
+                    f"recv from rank {src} (tag {tag}) timed out")
+            q = self._ops[key]
+            value = q.pop(0)
+            if not q:
+                del self._ops[key]
+            return value
+
 
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
@@ -229,6 +256,23 @@ def broadcast(tensor, root: int = 0, group_name: str = "default"):
 def barrier(group_name: str = "default") -> None:
     ctx, seq = _op(group_name)
     ray_tpu.get(ctx.coordinator.barrier.remote(ctx.rank, seq), timeout=600)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    """Point-to-point send to dst_rank (reference: collective.send
+    :531). Tags disambiguate concurrent transfers between one pair."""
+    ctx = _ctx(group_name)
+    ray_tpu.get(ctx.coordinator.p2p_send.remote(
+        ctx.rank, dst_rank, tag, np.asarray(tensor)), timeout=600)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    """Blocking point-to-point receive from src_rank (reference:
+    collective.recv :594)."""
+    ctx = _ctx(group_name)
+    return ray_tpu.get(ctx.coordinator.p2p_recv.remote(
+        src_rank, ctx.rank, tag), timeout=600)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
