@@ -317,19 +317,31 @@ class TaskPoolMapOperator(Operator):
 class ActorPoolMapOperator(Operator):
     """map_batches over a pool of stateful actors (the reference's GPU/TPU
     inference pattern: construct the model once per actor, stream batches
-    through it). ``fn`` is a class; each actor calls it once per block."""
+    through it). ``fn`` is a class; each actor calls it once per block.
+
+    ``pool_size`` may be an (min, max) tuple: the pool AUTOSCALES between
+    the bounds on queue pressure (reference: ActorPoolStrategy(min_size,
+    max_size) + execution/autoscaler's op-level scaling) — upscale when
+    the in-flight window saturates for consecutive dispatches, downscale
+    an idle actor when pressure stays low."""
 
     def __init__(self, fn_cls: type, *, batch_size: Optional[int] = None,
                  fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
                  fn_kwargs: Optional[Dict[str, Any]] = None,
-                 pool_size: int = 2, num_cpus: float = 1.0,
+                 pool_size: Any = 2, num_cpus: float = 1.0,
                  resources: Optional[Dict[str, float]] = None,
                  name: str = "map_batches(actors)"):
         self._fn_cls = fn_cls
         self._ctor_kwargs = fn_constructor_kwargs or {}
         self._kwargs = fn_kwargs or {}
         self._batch_size = batch_size
-        self._pool_size = pool_size
+        if isinstance(pool_size, (tuple, list)):
+            self._pool_min, self._pool_max = int(pool_size[0]), int(
+                pool_size[1])
+        else:
+            self._pool_min = self._pool_max = int(pool_size)
+        if not (1 <= self._pool_min <= self._pool_max):
+            raise ValueError(f"invalid pool bounds {pool_size!r}")
         self._num_cpus = num_cpus
         self._resources = resources
         self.name = name
@@ -353,45 +365,78 @@ class ActorPoolMapOperator(Operator):
         if self._resources:
             opts["resources"] = self._resources
         pool = [actor_cls.options(**opts).remote()
-                for _ in range(self._pool_size)]
+                for _ in range(self._pool_min)]
         try:
             # Round-robin dispatch, FIFO completion (per-actor ordering is
             # guaranteed by the actor runtime, cross-actor by the window).
             # num_returns=2 as above: blocks stay off the driver.
             window: collections.deque = collections.deque()
+            retired: list = []
             holding = 0
             i = 0
-            for ref, meta in upstream:
-                est = meta.size_bytes or cfg.data_block_size_estimate
-                while window and budget is not None and not budget.can_admit(
-                        est, holding):
-                    block_ref, meta_ref, est0 = window.popleft()
-                    m = ray_tpu.get(meta_ref)
-                    budget.release(est0)
-                    holding -= est0
-                    yield block_ref, m
-                if budget is not None:
-                    budget.acquire(est)
-                    holding += est
-                window.append((*pool[i % len(pool)].transform.options(
-                    num_returns=2).remote(ref), est))
-                i += 1
-                if len(window) >= 2 * len(pool):
-                    block_ref, meta_ref, est0 = window.popleft()
-                    m = ray_tpu.get(meta_ref)
-                    if budget is not None:
-                        budget.release(est0)
-                        holding -= est0
-                    yield block_ref, m
-            while window:
-                block_ref, meta_ref, est0 = window.popleft()
+            saturated_rounds = 0
+            idle_rounds = 0
+
+            def reap_retired():
+                # A retired actor dies only after its in-flight
+                # transforms drained (killing earlier would fail them).
+                if not retired:
+                    return  # common fixed-pool case: free
+                live = {id(a) for _b, _m, _e, a in window}
+                for a in list(retired):
+                    if id(a) not in live:
+                        retired.remove(a)
+                        try:
+                            ray_tpu.kill(a)
+                        except Exception:
+                            pass
+
+            def pop_one():
+                nonlocal holding
+                block_ref, meta_ref, est0, _actor = window.popleft()
                 m = ray_tpu.get(meta_ref)
                 if budget is not None:
                     budget.release(est0)
                     holding -= est0
-                yield block_ref, m
+                reap_retired()
+                return block_ref, m
+
+            for ref, meta in upstream:
+                est = meta.size_bytes or cfg.data_block_size_estimate
+                while window and budget is not None and not budget.can_admit(
+                        est, holding):
+                    yield pop_one()
+                if budget is not None:
+                    budget.acquire(est)
+                    holding += est
+                actor = pool[i % len(pool)]
+                window.append((*actor.transform.options(
+                    num_returns=2).remote(ref), est, actor))
+                i += 1
+                # Op-level autoscaling on queue pressure (reference:
+                # execution/autoscaler + ActorPoolStrategy bounds).
+                if len(window) >= 2 * len(pool):
+                    saturated_rounds += 1
+                    idle_rounds = 0
+                    if (saturated_rounds >= 3
+                            and len(pool) < self._pool_max):
+                        pool.append(actor_cls.options(**opts).remote())
+                        saturated_rounds = 0
+                else:
+                    saturated_rounds = 0
+                    if len(window) <= len(pool) // 2:
+                        idle_rounds += 1
+                        if idle_rounds >= 8 and len(pool) > self._pool_min:
+                            retired.append(pool.pop())  # kill on drain
+                            idle_rounds = 0
+                    else:
+                        idle_rounds = 0
+                if len(window) >= 2 * len(pool):
+                    yield pop_one()
+            while window:
+                yield pop_one()
         finally:
-            for a in pool:
+            for a in pool + retired:
                 try:
                     ray_tpu.kill(a)
                 except Exception:

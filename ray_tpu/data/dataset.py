@@ -52,11 +52,18 @@ class Dataset:
         """Stateless fn -> task pool; class fn -> actor pool (the
         reference's `compute=ActorPoolStrategy` fork, chosen by fn type)."""
         if isinstance(fn, type):
+            # concurrency may be (min, max): the actor pool AUTOSCALES
+            # between the bounds on queue pressure (reference:
+            # ActorPoolStrategy(min_size, max_size)).
             return self._with_op(ActorPoolMapOperator(
                 fn, batch_size=batch_size,
                 fn_constructor_kwargs=fn_constructor_kwargs,
                 fn_kwargs=fn_kwargs, pool_size=concurrency or 2,
                 num_cpus=num_cpus, resources=resources))
+        if isinstance(concurrency, (tuple, list)):
+            raise ValueError(
+                "(min, max) concurrency autoscales ACTOR pools — pass a "
+                "class to map_batches, or an int for stateless tasks")
         return self._with_op(TaskPoolMapOperator(
             fn, batch_size=batch_size, fn_kwargs=fn_kwargs,
             concurrency=concurrency or 4))

@@ -41,6 +41,7 @@ class AsyncResult:
         self._single = single
         self._results: List[Any] = []
         self._done = False
+        self._error: Optional[BaseException] = None
 
     def _pump(self, block: bool) -> None:
         while self._pending or self._refs:
@@ -55,6 +56,10 @@ class AsyncResult:
     def get(self, timeout: Optional[float] = None):
         import time
 
+        from ray_tpu.exceptions import GetTimeoutError
+
+        if self._error is not None:
+            raise self._error  # stdlib: every get() re-raises the failure
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._done:
             while self._pending and len(self._refs) < self._window:
@@ -62,10 +67,20 @@ class AsyncResult:
             if not self._refs:
                 self._done = True
                 break
-            ref = self._refs.pop(0)
+            ref = self._refs[0]
             t = (None if deadline is None
                  else max(0.001, deadline - time.monotonic()))
-            self._results.append(ray_tpu.get(ref, timeout=t))
+            try:
+                value = ray_tpu.get(ref, timeout=t)
+            except (GetTimeoutError, TimeoutError):
+                # Not consumed: the ref stays at the front so a later
+                # get() retries instead of silently dropping the chunk.
+                raise
+            except BaseException as e:  # noqa: BLE001 — sticky task error
+                self._error = e
+                raise
+            self._refs.pop(0)
+            self._results.append(value)
         if self._single:
             return self._results[0][0]  # one chunk of one item
         return [x for chunk in self._results for x in chunk]
@@ -77,8 +92,12 @@ class AsyncResult:
             pass
 
     def ready(self) -> bool:
-        if self._done:
+        if self._done or self._error is not None:
             return True
+        # Pump submissions: polling ready() on a fresh result must start
+        # the work (stdlib pools run eagerly).
+        while self._pending and len(self._refs) < self._window:
+            self._refs.append(self._submit(self._pending.pop(0)))
         if self._pending:
             return False
         if not self._refs:

@@ -178,3 +178,26 @@ def test_write_csv_json_roundtrip(cluster, tmp_path):
     assert len(json_files) == 2
     back = rdata.read_json(str(tmp_path / "out_json"))
     assert sorted(int(r["k"]) for r in back.take_all()) == list(range(20))
+
+
+def test_actor_pool_autoscales_between_bounds(cluster):
+    """concurrency=(1, 3): the pool grows under sustained queue pressure
+    and never exceeds max; results stay exact and ordered (reference:
+    ActorPoolStrategy min/max + op-level autoscaling)."""
+    import os as _os
+
+    class Slowish:
+        def __call__(self, b):
+            import time as _t
+
+            _t.sleep(0.05)
+            return {"id": b["id"], "pid": np.full(len(b["id"]),
+                                                  _os.getpid())}
+
+    ds = rdata.range(120, parallelism=24).map_batches(
+        Slowish, concurrency=(1, 3), num_cpus=0)
+    rows = ds.take_all()
+    assert [r["id"] for r in rows] == list(range(120))
+    pids = {r["pid"] for r in rows}
+    # Scaled past the min of 1 under pressure.
+    assert len(pids) >= 2, pids
