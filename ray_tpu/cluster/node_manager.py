@@ -212,8 +212,13 @@ class NodeManager:
             try:
                 with self._lock:
                     avail = dict(self.available)
+                # The reply wait must NOT exceed the period: a single
+                # dropped reply would otherwise stall this loop for the
+                # full timeout while the head's miss window
+                # (threshold x period) expires — one lost packet became a
+                # false node death under RPC chaos.
                 acked = self._head.call("heartbeat", self.node_id, avail,
-                                        timeout=5)
+                                        timeout=period)
                 if acked is False:
                     # The head doesn't know us: it restarted and lost its
                     # node table (nodes are ephemeral state — reference:

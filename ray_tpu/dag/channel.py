@@ -222,12 +222,31 @@ class CrossNodeChannel:
                 pause = min(pause * 2, 0.01)
         oid = _msg_oid(self.channel_id, seq)
         store.put_bytes(oid, payload)
-        ok = rt.node.retrying_call("push_object", oid.binary(),
-                                   self.reader_node_addr, 30000,
-                                   timeout=40)
-        # Local copy served its purpose once pushed; drop it so channels
-        # never accumulate in the writer's store.
-        store.delete(oid)
+        # A False reply may be one dropped inner transfer RPC (chaos, a
+        # transient peer hiccup), not a dead reader: retry before
+        # declaring the channel closed. Double-pushes are safe — the
+        # reader consumes each seq once and ring-cleans ghosts. The outer
+        # per-try window EXCEEDS the handler's internal wait
+        # (timeout_ms/1000 + 5) so slow-but-succeeding transfers are not
+        # spuriously retried; transport exceptions become the same
+        # ChannelClosedError as exhausted retries, and the local copy is
+        # dropped on EVERY exit (leaks otherwise).
+        ok = False
+        try:
+            for attempt in range(3):
+                try:
+                    ok = rt.node.retrying_call(
+                        "push_object", oid.binary(),
+                        self.reader_node_addr, 10000, timeout=18)
+                except Exception:
+                    ok = False
+                if ok:
+                    break
+                time.sleep(0.2 * (attempt + 1))
+        finally:
+            # Local copy served its purpose once pushed; drop it so
+            # channels never accumulate in the writer's store.
+            store.delete(oid)
         if not ok:
             raise ChannelClosedError(
                 f"push to {self.reader_node_addr} failed (seq={seq})")
@@ -271,7 +290,7 @@ class CrossNodeChannel:
         try:
             store.put_bytes(ack, b"\x01")
             rt.node.retrying_call("push_object", ack.binary(),
-                                  self.writer_node_addr, 10000, timeout=20)
+                                  self.writer_node_addr, 5000, timeout=12)
             store.delete(ack)
         except Exception:
             pass
