@@ -242,7 +242,8 @@ class CrossNodeChannel:
                     ok = False
                 if ok:
                     break
-                time.sleep(0.2 * (attempt + 1))
+                if attempt < 2:
+                    time.sleep(0.2 * (attempt + 1))
         finally:
             # Local copy served its purpose once pushed; drop it so
             # channels never accumulate in the writer's store.

@@ -10,6 +10,7 @@ pipeline-wide memory budget (`data_memory_budget_bytes`).
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.llm import build_llm_processor
 from ray_tpu.data.dataset import (Dataset, GroupedData,
                                   MaterializedDataset,
                                   StreamSplitIterator, from_arrow,
@@ -23,6 +24,6 @@ __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "Dataset", "GroupedData",
     "MaterializedDataset", "StreamSplitIterator", "from_arrow",
     "from_generators", "from_items",
-    "from_numpy", "from_pandas", "range", "read_binary_files", "read_csv",
+    "from_numpy", "from_pandas", "build_llm_processor", "range", "read_binary_files", "read_csv",
     "read_images", "read_json", "read_numpy", "read_parquet", "read_text",
 ]

@@ -97,6 +97,51 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._with_op(LimitOperator(n))
 
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (this one's blocks, then each other's —
+        reference: Dataset.union). Plans concatenate lazily: each input
+        keeps its own op chain, materialized per-branch at iteration."""
+        branches = [self] + list(others)
+
+        def gen(upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+            yield from upstream
+            for ds in branches[1:]:
+                yield from ds._stream()
+
+        return self._with_op(DriverOperator(
+            gen, name=f"union({len(branches)})"))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets row-by-row (reference:
+        Dataset.zip); right columns clashing with left names get an
+        ``_1`` suffix. Row counts must match."""
+
+        def gen(upstream: Iterator[RefBundle]) -> Iterator[RefBundle]:
+            import itertools as _it
+
+            left_rows = _rows_of(upstream)
+            right_rows = _rows_of(other._stream())
+            sentinel = object()
+            batch: List[Dict[str, Any]] = []
+            for l, r in _it.zip_longest(left_rows, right_rows,
+                                        fillvalue=sentinel):
+                if l is sentinel or r is sentinel:
+                    raise ValueError(
+                        "zip() requires equal row counts")
+                row = dict(l)
+                for k, v in r.items():
+                    row[k if k not in row else f"{k}_1"] = v
+                batch.append(row)
+                if len(batch) >= 4096:
+                    blk = BlockAccessor.normalize(batch)
+                    yield ray_tpu.put(blk), BlockMetadata.of(blk)
+                    batch = []
+            if batch:
+                blk = BlockAccessor.normalize(batch)
+                yield ray_tpu.put(blk), BlockMetadata.of(blk)
+
+        return self._with_op(DriverOperator(gen, name="zip"))
+
     def explain(self) -> str:
         """The OPTIMIZED execution plan as a string — fused map chains
         appear as one ``fused_map[...]`` stage, pushed-down limits appear
@@ -263,8 +308,28 @@ class Dataset:
             yield window.popleft()
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
-        for ref, _meta in self._stream():
-            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+        yield from _rows_of(self._stream())
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False,
+                           device: Optional[str] = None
+                           ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference: iter_torch_batches,
+        dataset.py:4198) — numeric columns convert zero-copy via
+        from_numpy; object columns pass through untouched."""
+        import numpy as _np
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                if isinstance(v, _np.ndarray) and v.dtype.kind in "biufc":
+                    t = torch.from_numpy(_np.ascontiguousarray(v))
+                    out[k] = t.to(device) if device else t
+                else:
+                    out[k] = v
+            yield out
 
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out = []
@@ -763,6 +828,11 @@ def from_arrow(table, *, parallelism: int = 4) -> Dataset:
         {name: np.asarray(col) for name, col in
          zip(table.column_names, table.columns)},
         parallelism=parallelism)
+
+
+def _rows_of(stream) -> Iterator[Dict[str, Any]]:
+    for ref, _meta in stream:
+        yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
 
 
 def _expand_paths(paths, suffixes) -> List[str]:

@@ -201,3 +201,65 @@ def test_actor_pool_autoscales_between_bounds(cluster):
     pids = {r["pid"] for r in rows}
     # Scaled past the min of 1 under pressure.
     assert len(pids) >= 2, pids
+
+
+def test_union_and_zip(cluster):
+    a = rdata.from_items([{"x": i} for i in range(5)], parallelism=2)
+    b = rdata.from_items([{"x": i + 100} for i in range(3)], parallelism=1)
+    u = a.union(b)
+    assert [r["x"] for r in u.take_all()] == [0, 1, 2, 3, 4, 100, 101, 102]
+    c = rdata.from_items([{"x": i * 10, "y": i} for i in range(5)],
+                         parallelism=2)
+    z = a.zip(c)
+    rows = z.take_all()
+    assert [r["x"] for r in rows] == [0, 1, 2, 3, 4]
+    assert [r["x_1"] for r in rows] == [0, 10, 20, 30, 40]
+    assert [r["y"] for r in rows] == [0, 1, 2, 3, 4]
+    with pytest.raises(Exception):
+        a.zip(b).take_all()  # row-count mismatch
+
+
+def test_iter_torch_batches(cluster):
+    torch = pytest.importorskip("torch")
+    ds = rdata.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "f": b["id"].astype(np.float32) / 2})
+    total = 0
+    for batch in ds.iter_torch_batches(batch_size=32):
+        assert isinstance(batch["id"], torch.Tensor)
+        assert batch["f"].dtype == torch.float32
+        total += len(batch["id"])
+    assert total == 100
+
+
+def test_llm_batch_inference_processor(cluster):
+    """Data+LLM batch inference: preprocess -> native continuous-batching
+    engine in an actor pool -> postprocess (reference: data/llm.py
+    build_llm_processor over engine workers)."""
+    from ray_tpu.data.llm import build_llm_processor
+
+    processor = build_llm_processor(
+        preprocess=lambda row: {"qid": row["qid"],
+                                "prompt_ids": [2 + (row["qid"] % 5),
+                                               3, 4]},
+        engine_kwargs={"max_batch": 2, "max_len": 64},
+        max_new_tokens=4,
+        postprocess=lambda row: {"qid": row["qid"],
+                                 "n_generated": len(row["generated_ids"])},
+        concurrency=1,
+        batch_size=4)
+    ds = rdata.from_items([{"qid": i} for i in range(8)], parallelism=2)
+    rows = processor(ds).take_all()
+    assert sorted(r["qid"] for r in rows) == list(range(8))
+    assert all(r["n_generated"] == 4 for r in rows)
+
+
+def test_iter_torch_batches_string_passthrough(cluster):
+    pytest.importorskip("torch")
+    ds = rdata.from_items([{"s": f"w{i}", "n": i} for i in range(6)],
+                          parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=3))
+    import torch as _torch
+
+    assert all(isinstance(b["n"], _torch.Tensor) for b in batches)
+    # String columns pass through untouched (torch can't hold them).
+    assert list(batches[0]["s"]) == ["w0", "w1", "w2"]
