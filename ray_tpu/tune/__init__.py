@@ -8,6 +8,7 @@ session; ASHA prunes losers at successive-halving rungs.
 
 from ray_tpu.train.session import get_checkpoint, report  # session API
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import (Searcher, TPESearcher, choice,
@@ -16,7 +17,8 @@ from ray_tpu.tune.search import (Searcher, TPESearcher, choice,
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining", "Searcher", "TPESearcher",
     "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",

@@ -221,3 +221,118 @@ class MedianStoppingRule:
             best = max(mine)
             decisions[trial_id] = CONTINUE if best >= median else STOP
         return decisions
+
+
+class HyperBandScheduler:
+    """Synchronous HyperBand (reference: python/ray/tune/schedulers/
+    hyperband.py): trials are dealt round-robin into brackets with
+    different exploration/exploitation trade-offs; bracket s halves its
+    cohort every ``R / eta^(s-k)`` iterations, so aggressive brackets
+    stop most trials early while conservative ones let everything run
+    long. A rung is judged ONCE, when every live cohort member has
+    reached it (terminal trials are dropped from readiness via
+    on_trial_complete, so a dead peer can never block its bracket);
+    losers are stopped wherever they are — including trials that passed
+    the rung in earlier rounds (the tuner applies decisions to any
+    trial, not just the round's reporters).
+
+    NOTE: synchronous halving prunes BELOW max_t only when a bracket's
+    cohort runs concurrently (the tuner's lockstep rounds provide this
+    when max_concurrent_trials >= the trial count; the reference gets it
+    by pausing trials at rungs). With fewer slots, early trials finish
+    before their peers arrive and only the stragglers get pruned —
+    prefer ASHAScheduler for heavily queued experiments."""
+
+    def __init__(self, metric: str, mode: str = "max", max_t: int = 81,
+                 reduction_factor: int = 3):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+        self.eta = reduction_factor
+        self.max_t = max_t
+        # Integer bracket count: float log loses a bracket on exact
+        # powers (log(243, 3) == 4.999...).
+        s = 0
+        while self.eta ** (s + 1) <= max_t:
+            s += 1
+        self._s_max = s
+        #: bracket s -> rung iterations (ascending), e.g. R=81, eta=3,
+        #: s=2 -> [9, 27, 81]
+        self._bracket_rungs = {
+            b: [max(1, int(max_t / (reduction_factor ** k)))
+                for k in range(b, -1, -1)]
+            for b in range(self._s_max + 1)
+        }
+        self._next_bracket = 0
+        self._trial_bracket: Dict[str, int] = {}
+        #: trial -> score per iteration
+        self._scores: Dict[str, Dict[int, float]] = {}
+        self._stopped: set = set()
+        self._finished: set = set()
+        self._judged: set = set()  # (bracket, rung) pairs already halved
+
+    def register(self, trial_id: str, config) -> None:
+        self._trial_bracket[trial_id] = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % (self._s_max + 1)
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        """Terminal (finished/errored) trials leave their cohort — their
+        absence must not stall readiness forever."""
+        self._finished.add(trial_id)
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        v = float(metrics[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metrics: Dict[str, Any]) -> str:
+        return self.on_batch([(trial_id, iteration, metrics)])[trial_id]
+
+    def on_batch(self, results) -> Dict[str, str]:
+        decisions: Dict[str, str] = {}
+        touched: set = set()
+        for trial_id, iteration, metrics in results:
+            self._trial_bracket.setdefault(trial_id, 0)
+            self._scores.setdefault(trial_id, {})[iteration] = \
+                self._score(metrics)
+            bracket = self._trial_bracket[trial_id]
+            if iteration >= self.max_t:
+                decisions[trial_id] = STOP
+                self._stopped.add(trial_id)
+            else:
+                decisions[trial_id] = CONTINUE
+            touched.add(bracket)
+        # Judge every unjudged non-final rung whose cohort is complete —
+        # decisions may target trials OUTSIDE this batch (stragglers that
+        # passed the rung earlier).
+        for bracket in touched:
+            rungs = self._bracket_rungs[bracket]
+            # Cohort for RANKING includes terminal trials whose rung
+            # score was recorded (they just can't be stopped again);
+            # readiness requires every non-terminal member at the rung.
+            members = [t for t, b in self._trial_bracket.items()
+                       if b == bracket and t not in self._stopped]
+            if len(members) < 2:
+                continue
+            for rung in rungs[:-1]:
+                if (bracket, rung) in self._judged:
+                    continue
+                live = [t for t in members if t not in self._finished]
+                if not all(rung in self._scores.get(t, {})
+                           for t in live):
+                    break  # live cohort still climbing toward this rung
+                scored = [t for t in members
+                          if rung in self._scores.get(t, {})]
+                if len(scored) < 2:
+                    break
+                self._judged.add((bracket, rung))
+                ranked = sorted(scored,
+                                key=lambda t: -self._scores[t][rung])
+                keep = max(1, len(ranked) // self.eta)
+                for loser in ranked[keep:]:
+                    decisions[loser] = STOP
+                    self._stopped.add(loser)
+                members = [t for t in members
+                           if t not in self._stopped]
+        return decisions

@@ -313,6 +313,18 @@ class Tuner:
                     [(t.trial_id, t.iteration, m)
                      for t, m in round_results])
                 by_id = {t.trial_id: t for t in trials}
+                # Apply EVERY decision, not just this round's reporters:
+                # cohort schedulers (HyperBand) judge stragglers when the
+                # cohort completes a rung, stopping trials that reported
+                # in EARLIER rounds.
+                for tid, d in decisions.items():
+                    t = by_id.get(tid)
+                    if (t is not None and not t.done
+                            and d == sched_mod.STOP
+                            and all(t is not rt_ for rt_, _m
+                                    in round_results)):
+                        t.done = True
+                        t.stopped_early = True
                 for t, _m in round_results:
                     d = decisions.get(t.trial_id)
                     if d == sched_mod.STOP:
@@ -348,13 +360,19 @@ class Tuner:
                     ray_tpu.kill(t.actor)
                 except Exception:
                     pass
-            if searcher is not None:
-                for t in trials:
-                    if t.done and t.trial_id not in reported_done:
-                        reported_done.add(t.trial_id)
+            sched_complete = getattr(scheduler, "on_trial_complete", None)
+            for t in trials:
+                if t.done and t.trial_id not in reported_done:
+                    reported_done.add(t.trial_id)
+                    if searcher is not None:
                         searcher.on_trial_complete(
                             t.trial_id,
                             t.history[-1] if t.history else None)
+                    if sched_complete is not None:
+                        # Cohort schedulers must drop terminal trials
+                        # from readiness checks (a dead peer would block
+                        # its bracket's halving forever).
+                        sched_complete(t.trial_id)
             self._snapshot(trials, searcher)
 
         results = [TrialResult(

@@ -157,3 +157,64 @@ def test_tuner_with_tpe_and_median_stopping(cluster, tmp_path):
     state = json.loads(
         (tmp_path / "tpe_exp" / "experiment_state.json").read_text())
     assert state.get("searcher", {}).get("obs"), "searcher state missing"
+
+
+def test_hyperband_brackets_and_halving():
+    """Unit: bracket assignment round-robins; a full cohort at a rung
+    keeps the top 1/eta and stops the rest; trials at max_t stop."""
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    hb = HyperBandScheduler("acc", max_t=9, reduction_factor=3)
+    # 3 brackets (s_max=2): trials deal round-robin.
+    for i in range(6):
+        hb.register(f"t{i}", {})
+    assert hb._trial_bracket["t0"] != hb._trial_bracket["t1"] or \
+        hb._s_max == 0
+    # Bracket of t0: find its first (non-final) rung and feed the cohort.
+    b = hb._trial_bracket["t0"]
+    cohort = [t for t, bb in hb._trial_bracket.items() if bb == b]
+    rungs = hb._bracket_rungs[b]
+    if len(rungs) > 1 and len(cohort) >= 2:
+        rung = rungs[0]
+        batch = [(t, rung, {"acc": float(i)})
+                 for i, t in enumerate(cohort)]
+        decisions = hb.on_batch(batch)
+        stops = [t for t, d in decisions.items() if d == "STOP"]
+        keeps = [t for t, d in decisions.items() if d == "CONTINUE"]
+        assert keeps and stops  # halving happened
+        # The kept trial(s) scored highest.
+        best = max(cohort, key=lambda t: hb._scores[t][rung])
+        assert best in keeps
+    # max_t always stops.
+    d = hb.on_batch([("t0", 9, {"acc": 1.0})])
+    assert d["t0"] == "STOP"
+
+
+def test_hyperband_end_to_end(cluster):
+    """Tuner + HyperBand: the aggressive bracket prunes its loser at the
+    first rung (STRICTLY below max_t); the best config wins. Cohorts run
+    concurrently (sync halving's requirement — see the scheduler note)."""
+
+    def objective(config):
+        for step in range(3):
+            tune.report({"acc": config["q"] - 0.01 * step})
+
+    # max_t=3, eta=3 -> brackets b0 rungs [3], b1 rungs [1, 3].
+    # 4 trials deal b0={q=.2,.8}, b1={q=.4,1.0}: b1 halves at rung 1.
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.2, 0.4, 0.8, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max", num_samples=1,
+            max_concurrent_trials=4,  # whole population concurrent
+            scheduler=tune.HyperBandScheduler("acc", max_t=3,
+                                              reduction_factor=3)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["q"] == 1.0
+    # REAL rung pruning: q=0.4 (bracket 1's loser) stopped strictly
+    # below max_t (stopping at max_t would satisfy a broken scheduler).
+    pruned_below_max = [r for r in grid
+                        if r.stopped_early and len(r.history) < 3]
+    assert pruned_below_max, [len(r.history) for r in grid]
+    assert any(r.config["q"] == 0.4 for r in pruned_below_max)
