@@ -65,6 +65,13 @@ def init(
             from ray_tpu.core.local_runtime import LocalRuntime
 
             rt = LocalRuntime(num_cpus=num_cpus)
+        elif address is not None and address.startswith("client://"):
+            # Remote-driver tier (reference: ray client, util/client/):
+            # this process is NOT part of the cluster; everything rides
+            # one framed-RPC connection to a gateway.
+            from ray_tpu.client.runtime import ClientRuntime
+
+            rt = ClientRuntime(address)
         else:
             from ray_tpu.core.cluster_runtime import ClusterRuntime
 

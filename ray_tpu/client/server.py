@@ -1,0 +1,280 @@
+"""Client gateway: serves remote drivers over framed RPC.
+
+Parity target: the reference's Ray Client server
+(reference: python/ray/util/client/server/server.py — RayletServicer with
+per-client object/actor tracking, server.py:—; proxier.py multiplexes
+clients). Redesigned: the gateway IS a cluster driver (``ClusterCore``), so
+client-held references pin objects through the ordinary ownership/borrow
+machinery rather than a parallel tracking table.
+
+Session model: every connected peer gets a ``_Session`` holding
+  - ``held``: oid-bytes -> server-side ObjectRef (a real local ref in the
+    gateway's refcounter; released when the client drops its handle or
+    disconnects),
+  - ``actors``: actor ids created by this session (non-detached ones are
+    killed on disconnect, mirroring ray client's ownership cleanup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.cluster.protocol import RpcServer, blocking_rpc
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.resources import ResourceSet
+
+
+class _Session:
+    __slots__ = ("held", "actors", "lock")
+
+    def __init__(self):
+        self.held: Dict[bytes, ObjectRef] = {}
+        self.actors: List[Tuple[bytes, bool]] = []  # (actor_id, detached)
+        self.lock = threading.Lock()
+
+
+class ClientGateway:
+    """RPC handler object for one gateway server (any number of clients)."""
+
+    def __init__(self, runtime):
+        self.rt = runtime
+        self._sessions: Dict[int, _Session] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ session
+
+    def _session(self, conn) -> _Session:
+        key = id(conn)
+        with self._lock:
+            s = self._sessions.get(key)
+            if s is None:
+                s = self._sessions[key] = _Session()
+            return s
+
+    def on_peer_disconnect(self, conn) -> None:
+        with self._lock:
+            s = self._sessions.pop(id(conn), None)
+        if s is None:
+            return
+        with s.lock:
+            held, s.held = s.held, {}
+            actors, s.actors = list(s.actors), []
+        held.clear()  # drops the gateway-side local refs
+        for aid, detached in actors:
+            if not detached:
+                try:
+                    self.rt.kill_actor(ActorID(aid), no_restart=True)
+                except Exception:
+                    pass
+
+    def _hold(self, s: _Session, ref: ObjectRef) -> Tuple[bytes, Optional[str]]:
+        with s.lock:
+            s.held[ref.binary()] = ref
+        return ref.binary(), ref.owner_address
+
+    def _ref_of(self, s: _Session, oid: bytes, owner: Optional[str]) -> ObjectRef:
+        with s.lock:
+            ref = s.held.get(oid)
+        if ref is not None:
+            return ref
+        return ObjectRef(ObjectID(oid), owner)
+
+    # ------------------------------------------------------------ handshake
+
+    def rpc_client_hello(self, conn, protocol_version: int) -> Dict[str, Any]:
+        self._session(conn)
+        return {
+            "protocol_version": 1,
+            "num_nodes": len(self.rt.nodes()),
+        }
+
+    def rpc_ping(self, conn) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------ objects
+
+    @blocking_rpc
+    def rpc_put(self, conn, value: Any) -> Tuple[bytes, Optional[str]]:
+        s = self._session(conn)
+        return self._hold(s, self.rt.put(value))
+
+    @blocking_rpc
+    def rpc_get(self, conn, oids: List[Tuple[bytes, Optional[str]]],
+                timeout: Optional[float]) -> List[Any]:
+        s = self._session(conn)
+        refs = [self._ref_of(s, o, owner) for o, owner in oids]
+        vals = self.rt.get(refs, timeout=timeout)
+        return vals
+
+    @blocking_rpc
+    def rpc_wait(self, conn, oids: List[Tuple[bytes, Optional[str]]],
+                 num_returns: int, timeout: Optional[float],
+                 fetch_local: bool) -> Tuple[List[bytes], List[bytes]]:
+        s = self._session(conn)
+        refs = [self._ref_of(s, o, owner) for o, owner in oids]
+        ready, pending = self.rt.wait(refs, num_returns=num_returns,
+                                      timeout=timeout, fetch_local=fetch_local)
+        return [r.binary() for r in ready], [r.binary() for r in pending]
+
+    def rpc_release(self, conn, oids: List[bytes]) -> None:
+        s = self._session(conn)
+        with s.lock:
+            for o in oids:
+                s.held.pop(o, None)
+
+    def rpc_hold(self, conn,
+                 oids: List[Tuple[bytes, Optional[str]]]) -> None:
+        """Pin refs the client received nested inside values: register the
+        gateway as a borrower with each owner and keep a local ref for the
+        session (the encode-side transfer pin only covers ~30s)."""
+        s = self._session(conn)
+        for o, owner in oids:
+            with s.lock:
+                if o in s.held:
+                    continue
+            oid = ObjectID(o)
+            self.rt.on_ref_deserialized(oid, owner)
+            with s.lock:
+                s.held.setdefault(o, ObjectRef(oid, owner))
+
+    # ------------------------------------------------------------ tasks
+
+    def rpc_submit_task(self, conn, func, args, kwargs,
+                        opts: Dict[str, Any]) -> List[Tuple[bytes, Optional[str]]]:
+        s = self._session(conn)
+        resources = opts.get("resources")
+        refs = self.rt.submit_task(
+            func, args, kwargs,
+            num_returns=opts.get("num_returns", 1),
+            resources=ResourceSet.from_dict(resources) if resources else None,
+            max_retries=opts.get("max_retries", 0),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            name=opts.get("name", ""),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return [self._hold(s, r) for r in refs]
+
+    @blocking_rpc
+    def rpc_cancel(self, conn, oid: bytes, owner: Optional[str],
+                   force: bool, recursive: bool) -> None:
+        s = self._session(conn)
+        self.rt.cancel(self._ref_of(s, oid, owner), force=force,
+                       recursive=recursive)
+
+    # ------------------------------------------------------------ actors
+
+    @blocking_rpc
+    def rpc_create_actor(self, conn, cls, args, kwargs,
+                         opts: Dict[str, Any]) -> bytes:
+        s = self._session(conn)
+        resources = opts.get("resources")
+        aid = self.rt.create_actor(
+            cls, args, kwargs,
+            name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=opts.get("concurrency_groups"),
+            max_restarts=opts.get("max_restarts", 0),
+            resources=ResourceSet.from_dict(resources) if resources else None,
+            lifetime=opts.get("lifetime"),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            get_if_exists=opts.get("get_if_exists", False),
+            runtime_env=opts.get("runtime_env"),
+            release_resources=bool(opts.get("release_resources", False)),
+        )
+        detached = opts.get("lifetime") == "detached"
+        with s.lock:
+            s.actors.append((aid.binary(), detached))
+        return aid.binary()
+
+    def rpc_submit_actor_task(self, conn, aid: bytes, method_name: str,
+                              args, kwargs, num_returns: int
+                              ) -> List[Tuple[bytes, Optional[str]]]:
+        s = self._session(conn)
+        refs = self.rt.submit_actor_task(ActorID(aid), method_name, args,
+                                         kwargs, num_returns=num_returns)
+        return [self._hold(s, r) for r in refs]
+
+    @blocking_rpc
+    def rpc_get_actor(self, conn, name: str,
+                      namespace: str) -> Tuple[bytes, Any]:
+        aid = self.rt.get_actor(name, namespace)
+        return aid.binary(), self.rt.actor_class_of(aid)
+
+    def rpc_kill_actor(self, conn, aid: bytes, no_restart: bool) -> None:
+        self.rt.kill_actor(ActorID(aid), no_restart=no_restart)
+
+    def rpc_list_actors(self, conn):
+        return self.rt.list_actors()
+
+    # ------------------------------------------------------------ cluster
+
+    def rpc_nodes(self, conn):
+        return self.rt.nodes()
+
+    def rpc_cluster_resources(self, conn) -> Tuple[Dict[str, float],
+                                                   Dict[str, float]]:
+        return self.rt.cluster_resources(), self.rt.available_resources()
+
+    def rpc_kv(self, conn, op: str, namespace: str, key: bytes,
+               value: Optional[bytes], opts: Optional[Dict[str, Any]] = None
+               ) -> Any:
+        opts = opts or {}
+        if op == "put":
+            return self.rt.kv_put(key.decode(), value, namespace=namespace,
+                                  overwrite=opts.get("overwrite", True))
+        if op == "get":
+            return self.rt.kv_get(key.decode(), namespace=namespace)
+        if op == "del":
+            return self.rt.kv_del(key.decode(), namespace=namespace)
+        if op == "keys":
+            return self.rt.kv_keys(key.decode(), namespace=namespace)
+        raise ValueError(f"unknown kv op: {op}")
+
+
+def start_gateway(runtime=None, host: str = "127.0.0.1",
+                  port: int = 0) -> RpcServer:
+    """Serve the current (or given) driver runtime to remote clients.
+
+    Returns the started RpcServer; ``.address`` is what clients dial with
+    ``ray_tpu.init(address="client://" + address)``.
+    """
+    if runtime is None:
+        from ray_tpu.core.runtime_context import require_runtime
+
+        runtime = require_runtime()
+    server = RpcServer(ClientGateway(runtime), host=host, port=port)
+    return server.start()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="ray_tpu client gateway (remote-driver tier)")
+    parser.add_argument("--head", required=True,
+                        help="head address (host:port) of the cluster to join")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+
+    ray_tpu.init(address=args.head)
+    from ray_tpu.core.runtime_context import require_runtime
+
+    server = start_gateway(require_runtime(), host=args.host, port=args.port)
+    sys.stdout.write(f"CLIENT_ADDRESS {server.address}\n")
+    sys.stdout.flush()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

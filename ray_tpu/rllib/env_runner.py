@@ -43,8 +43,12 @@ class EnvRunner:
                         else params_ref)
         return True
 
-    def sample(self) -> Dict[str, np.ndarray]:
-        """Collect one [T, B] rollout with the current weights."""
+    def sample(self, include_metrics: bool = False) -> Dict[str, np.ndarray]:
+        """Collect one [T, B] rollout with the current weights.
+
+        ``include_metrics`` piggybacks get_metrics() on the return (under
+        a "metrics" key) so async consumers (IMPALA) never have to queue a
+        separate get_metrics call behind an in-flight rollout."""
         import jax
 
         assert self._params is not None, "set_weights() before sample()"
@@ -79,13 +83,16 @@ class EnvRunner:
                 self._ep_return[i] = 0.0
         # Bootstrap value for the final observation (GAE tail).
         _, _, last_v = self._sample_fn(self._params, self.obs, self._key)
-        return {
+        batch = {
             "obs": obs, "actions": actions, "logp": logps,
             "values": values, "rewards": rewards,
             "terminated": terminated, "truncated": truncated,
             "bootstrap_value": bootstrap,
             "last_value": np.asarray(last_v),
         }
+        if include_metrics:
+            batch["metrics"] = self.get_metrics()
+        return batch
 
     def get_metrics(self) -> Dict[str, Any]:
         completed, self._completed = self._completed, []

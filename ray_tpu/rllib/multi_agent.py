@@ -1,0 +1,318 @@
+"""Multi-agent environments + runner + independent-learner training.
+
+Parity target: reference MultiAgentEnv / MultiAgentEnvRunner
+(reference: rllib/env/multi_agent_env.py, rllib/env/multi_agent_env_runner.py)
+and the policy-mapping contract (config.multi_agent(policies=...,
+policy_mapping_fn=...)). Scope-for-design: independent learning — each
+policy id owns its own jitted PPO learner; agents sharing a policy id share
+parameters and pool experience (parameter sharing), the standard baseline
+the reference's multi-agent stack defaults to.
+
+A multi-agent vector env steps a dict of per-agent action arrays and
+returns dict-of-arrays observations. All agents act every step (turn-based
+games can mask via zero rewards); per-agent episode boundaries are shared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.learner import PPOLearner
+
+
+class MultiAgentVecEnv:
+    """B copies of an N-agent environment stepped in lockstep.
+
+    Contract mirrors VectorEnv but dict-keyed by agent id (the reference's
+    per-agent dones + "__all__" convention, rllib/env/multi_agent_env.py):
+      reset() -> {agent: obs [B, obs_size]}
+      step({agent: actions [B]}) ->
+          (obs_dict, reward_dict, dones: {agent: [B] bool}, info)
+    info carries per-agent "terminated"/"truncated"/"final_obs" dicts.
+    Agents' episode boundaries are independent (each sub-env auto-resets
+    on its own done).
+    """
+
+    num_envs: int
+    agent_ids: Tuple[str, ...]
+    observation_sizes: Dict[str, int]
+    num_actions: Dict[str, int]
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class IndependentEnsembleEnv(MultiAgentVecEnv):
+    """N independent single-agent envs presented as one multi-agent env
+    (the simplest true multi-agent wiring; each agent's episodes run and
+    reset independently)."""
+
+    def __init__(self, env_specs: Dict[str, Union[str, Callable]],
+                 num_envs: int = 8, seed: int = 0):
+        self.num_envs = num_envs
+        self._envs: Dict[str, VectorEnv] = {
+            aid: make_env(spec, num_envs=num_envs, seed=seed + 17 * i)
+            for i, (aid, spec) in enumerate(sorted(env_specs.items()))
+        }
+        self.agent_ids = tuple(sorted(env_specs))
+        self.observation_sizes = {a: e.observation_size
+                                  for a, e in self._envs.items()}
+        self.num_actions = {a: e.num_actions for a, e in self._envs.items()}
+
+    def reset(self, seed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        return {a: e.reset(seed) for a, e in self._envs.items()}
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        obs, rewards, dones = {}, {}, {}
+        term: Dict[str, np.ndarray] = {}
+        trunc: Dict[str, np.ndarray] = {}
+        final_obs: Dict[str, np.ndarray] = {}
+        for a, e in self._envs.items():
+            obs[a], rewards[a], d, info = e.step(actions[a])
+            dones[a] = d
+            term[a] = info.get("terminated", d)
+            trunc[a] = info.get("truncated", np.zeros_like(d))
+            final_obs[a] = info.get("final_obs", obs[a])
+        return obs, rewards, dones, {
+            "terminated": term, "truncated": trunc, "final_obs": final_obs,
+        }
+
+
+class MultiAgentEnvRunner:
+    """Samples [T, B] rollouts per agent with per-policy weights.
+
+    Parity: rllib/env/multi_agent_env_runner.py — one env, N policies,
+    policy_mapping_fn routes agents onto policies.
+    """
+
+    def __init__(self, env_ctor, num_envs: int, rollout_len: int,
+                 policy_mapping: Dict[str, str], seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib import models
+
+        self.env: MultiAgentVecEnv = env_ctor(num_envs=num_envs, seed=seed)
+        self.rollout_len = rollout_len
+        self.policy_mapping = dict(policy_mapping)
+        self.obs = self.env.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._sample_fn = jax.jit(models.sample_action)
+        self._weights: Dict[str, Any] = {}
+        self._ep_return = {a: np.zeros(num_envs, np.float64)
+                           for a in self.env.agent_ids}
+        self._completed: Dict[str, List[float]] = {a: []
+                                                   for a in self.env.agent_ids}
+
+    def set_weights(self, weights_ref) -> bool:
+        w = (ray_tpu.get(weights_ref)
+             if isinstance(weights_ref, ray_tpu.ObjectRef) else weights_ref)
+        self._weights.update(w)
+        return True
+
+    def sample(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """One rollout -> {agent_id: single-agent batch} (each feedable to
+        the single-agent learners unchanged)."""
+        import jax
+
+        T, B = self.rollout_len, self.env.num_envs
+        agents = self.env.agent_ids
+        buf = {a: {
+            "obs": np.empty((T, B, self.env.observation_sizes[a]), np.float32),
+            "actions": np.empty((T, B), np.int32),
+            "logp": np.empty((T, B), np.float32),
+            "values": np.empty((T, B), np.float32),
+            "rewards": np.empty((T, B), np.float32),
+            "terminated": np.zeros((T, B), np.bool_),
+            "truncated": np.zeros((T, B), np.bool_),
+            "bootstrap_value": np.zeros((T, B), np.float32),
+        } for a in agents}
+        for t in range(T):
+            actions = {}
+            for a in agents:
+                self._key, k = jax.random.split(self._key)
+                params = self._weights[self.policy_mapping[a]]
+                act, lp, v = self._sample_fn(params, self.obs[a], k)
+                actions[a] = np.asarray(act)
+                buf[a]["obs"][t] = self.obs[a]
+                buf[a]["actions"][t] = actions[a]
+                buf[a]["logp"][t] = np.asarray(lp)
+                buf[a]["values"][t] = np.asarray(v)
+            self.obs, rewards, dones, info = self.env.step(actions)
+            for a in agents:
+                buf[a]["rewards"][t] = rewards[a]
+                buf[a]["terminated"][t] = info["terminated"][a]
+                buf[a]["truncated"][t] = info["truncated"][a]
+                if info["truncated"][a].any():
+                    fo = info["final_obs"][a]
+                    _, _, fv = self._sample_fn(
+                        self._weights[self.policy_mapping[a]], fo, self._key)
+                    buf[a]["bootstrap_value"][t] = np.where(
+                        info["truncated"][a], np.asarray(fv), 0.0)
+                self._ep_return[a] += rewards[a]
+                for i in np.flatnonzero(dones[a]):
+                    self._completed[a].append(float(self._ep_return[a][i]))
+                    self._ep_return[a][i] = 0.0
+        for a in agents:
+            _, _, last_v = self._sample_fn(
+                self._weights[self.policy_mapping[a]], self.obs[a], self._key)
+            buf[a]["last_value"] = np.asarray(last_v)
+        return buf
+
+    def get_metrics(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for a in self.env.agent_ids:
+            completed, self._completed[a] = self._completed[a], []
+            out[a] = {
+                "episode_return_mean":
+                    float(np.mean(completed)) if completed else None,
+                "num_episodes": len(completed),
+            }
+        return out
+
+
+@dataclasses.dataclass
+class MultiAgentPPOConfig:
+    env: Callable = None                    # ctor(num_envs=, seed=)
+    policies: Tuple[str, ...] = ()          # policy ids
+    policy_mapping: Dict[str, str] = None   # agent id -> policy id
+    num_env_runners: int = 0
+    num_envs_per_runner: int = 8
+    rollout_len: int = 128
+    hidden: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 256
+    max_grad_norm: float = 0.5
+    seed: int = 0
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """Independent PPO per policy id; agents mapped to a shared policy pool
+    experience (parameter sharing)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        self.config = config
+        probe: MultiAgentVecEnv = config.env(num_envs=1, seed=config.seed)
+        mapping = config.policy_mapping or {
+            a: a for a in probe.agent_ids}
+        policies = config.policies or tuple(sorted(set(mapping.values())))
+        self.policy_mapping = mapping
+        self.learners: Dict[str, PPOLearner] = {}
+        for i, pid in enumerate(policies):
+            # The policy's obs/action space comes from any agent mapped to it
+            # (the reference requires mapped agents to share spaces too).
+            agent = next(a for a in probe.agent_ids if mapping[a] == pid)
+            self.learners[pid] = PPOLearner(
+                probe.observation_sizes[agent], probe.num_actions[agent],
+                hidden=config.hidden, lr=config.lr, gamma=config.gamma,
+                gae_lambda=config.gae_lambda, clip_eps=config.clip_eps,
+                vf_coef=config.vf_coef, entropy_coef=config.entropy_coef,
+                num_epochs=config.num_epochs,
+                minibatch_size=config.minibatch_size,
+                max_grad_norm=config.max_grad_norm, seed=config.seed + i)
+        self._local: Optional[MultiAgentEnvRunner] = None
+        self._actors: List[Any] = []
+        if config.num_env_runners == 0:
+            self._local = MultiAgentEnvRunner(
+                config.env, config.num_envs_per_runner, config.rollout_len,
+                mapping, config.seed)
+        else:
+            remote_cls = ray_tpu.remote(MultiAgentEnvRunner)
+            self._actors = [
+                remote_cls.remote(config.env, config.num_envs_per_runner,
+                                  config.rollout_len, mapping,
+                                  config.seed + 1000 * i)
+                for i in range(config.num_env_runners)
+            ]
+        self._sync_weights()
+        self._iteration = 0
+        self._total_steps = 0
+
+    def _sync_weights(self) -> None:
+        w = {pid: l.get_weights() for pid, l in self.learners.items()}
+        if self._local is not None:
+            self._local.set_weights(w)
+            return
+        ref = ray_tpu.put(w)
+        ray_tpu.get([a.set_weights.remote(ref) for a in self._actors])
+
+    def training_step(self) -> Dict[str, Dict[str, float]]:
+        if self._local is not None:
+            rollouts = [self._local.sample()]
+        else:
+            rollouts = ray_tpu.get([a.sample.remote() for a in self._actors])
+        # Pool experience per policy id across agents and runners.
+        stats: Dict[str, Dict[str, float]] = {}
+        for pid, learner in self.learners.items():
+            batches = [r[a] for r in rollouts for a in r
+                       if self.policy_mapping[a] == pid]
+            merged = _concat_agent_batches(batches)
+            stats[pid] = learner.update_from_batch(merged)
+            self._total_steps += int(np.prod(merged["actions"].shape))
+        self._sync_weights()
+        return stats
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        learner_stats = self.training_step()
+        self._iteration += 1
+        if self._local is not None:
+            metrics = [self._local.get_metrics()]
+        else:
+            metrics = ray_tpu.get(
+                [a.get_metrics.remote() for a in self._actors])
+        per_agent: Dict[str, Any] = {}
+        for a in metrics[0]:
+            returns = [m[a]["episode_return_mean"] for m in metrics
+                       if m[a].get("episode_return_mean") is not None]
+            per_agent[a] = {
+                "episode_return_mean":
+                    float(np.mean(returns)) if returns else None,
+                "num_episodes": sum(m[a].get("num_episodes", 0)
+                                    for m in metrics),
+            }
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_this_iter_s": time.monotonic() - t0,
+            "env_runners": per_agent,
+            "learners": learner_stats,
+        }
+
+    def get_weights(self) -> Dict[str, Any]:
+        return {pid: l.get_weights() for pid, l in self.learners.items()}
+
+    def stop(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def _concat_agent_batches(batches: List[Dict[str, np.ndarray]]
+                          ) -> Dict[str, np.ndarray]:
+    if len(batches) == 1:
+        return batches[0]
+    out: Dict[str, np.ndarray] = {}
+    for key in batches[0]:
+        axis = 0 if key == "last_value" else 1
+        out[key] = np.concatenate([b[key] for b in batches], axis=axis)
+    return out

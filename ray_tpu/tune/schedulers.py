@@ -287,6 +287,11 @@ class HyperBandScheduler:
 
     def on_result(self, trial_id: str, iteration: int,
                   metrics: Dict[str, Any]) -> str:
+        """One-trial-at-a-time protocol: halving decisions that target
+        OTHER trials (stragglers judged when this report completed a rung)
+        are delivered on each loser's NEXT report via _stopped — on_batch
+        marks them stopped, and any report from a stopped trial returns
+        STOP below, so no decision is lost."""
         return self.on_batch([(trial_id, iteration, metrics)])[trial_id]
 
     def on_batch(self, results) -> Dict[str, str]:
@@ -297,7 +302,9 @@ class HyperBandScheduler:
             self._scores.setdefault(trial_id, {})[iteration] = \
                 self._score(metrics)
             bracket = self._trial_bracket[trial_id]
-            if iteration >= self.max_t:
+            if trial_id in self._stopped or iteration >= self.max_t:
+                # Already judged out in an earlier round (its STOP may have
+                # been addressed to a batch it wasn't part of) — or done.
                 decisions[trial_id] = STOP
                 self._stopped.add(trial_id)
             else:

@@ -1,0 +1,142 @@
+"""T5 encoder-decoder family: shapes, masking semantics, learning gate, and
+sharded execution on the virtual CPU mesh (mirrors tests/test_model_llama.py
+/ test_model_vit.py structure)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import t5
+from ray_tpu.parallel.mesh import MeshSpec, logical_spec, make_mesh
+
+
+def test_forward_shapes_and_determinism():
+    cfg = t5.tiny_config()
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    enc = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 12)))
+    dec = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 8)))
+    logits = t5.forward(params, enc, dec, cfg)
+    assert logits.shape == (2, 8, 256)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(t5.forward(params, enc, dec, cfg)),
+                               rtol=1e-6)
+
+
+def test_decoder_causality():
+    """Changing a future decoder token must not change earlier logits."""
+    cfg = t5.tiny_config()
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    enc = jnp.ones((1, 6), jnp.int32)
+    dec_a = jnp.asarray([[1, 2, 3, 4, 5, 6]], jnp.int32)
+    dec_b = dec_a.at[0, 4].set(99)
+    la = t5.forward(params, enc, dec_a, cfg)
+    lb = t5.forward(params, enc, dec_b, cfg)
+    np.testing.assert_allclose(np.asarray(la[:, :4]), np.asarray(lb[:, :4]),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(la[:, 4:]), np.asarray(lb[:, 4:]))
+
+
+def test_encoder_mask_blocks_padding():
+    """Masked encoder positions must not influence decoder logits."""
+    cfg = t5.tiny_config()
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    dec = jnp.ones((1, 4), jnp.int32)
+    enc_a = jnp.asarray([[5, 6, 7, 0]], jnp.int32)
+    enc_b = jnp.asarray([[5, 6, 7, 200]], jnp.int32)
+    mask = jnp.asarray([[True, True, True, False]])
+    la = t5.forward(params, enc_a, dec, cfg, enc_mask=mask)
+    lb = t5.forward(params, enc_b, dec, cfg, enc_mask=mask)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_param_axes_cover_params():
+    cfg = t5.tiny_config()
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    axes = t5.param_logical_axes(cfg)
+    flat_p = jax.tree_util.tree_leaves_with_path(params)
+    flat_a = jax.tree_util.tree_leaves_with_path(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_a)
+    for (pp, leaf), (ap, names) in zip(sorted(flat_p, key=str),
+                                       sorted(flat_a, key=str)):
+        assert str(pp) == str(ap)
+        assert leaf.ndim == len(names), (pp, leaf.shape, names)
+
+
+def test_param_count_matches_pytree():
+    cfg = t5.tiny_config()
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(p.shape))
+                 for p in jax.tree_util.tree_leaves(params))
+    assert cfg.param_count() == actual
+
+
+def test_t5_learns_copy_task():
+    """Seq2seq learning gate: tiny T5 learns to copy the encoder input
+    (the canonical seq2seq sanity task) in a few jitted steps."""
+    cfg = t5.tiny_config(vocab_size=16)
+    params = t5.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    rng = np.random.default_rng(0)
+    enc = jnp.asarray(rng.integers(2, 16, (64, 8)).astype(np.int32))
+    # Teacher forcing: decoder input = [BOS, y0..y_{n-2}]; with the
+    # roll-based loss, predicting position i's next token = enc[i].
+    dec = jnp.concatenate([jnp.zeros((64, 1), jnp.int32), enc[:, :-1]], 1)
+
+    @jax.jit
+    def step(params, opt):
+        (loss, _), grads = jax.value_and_grad(t5.loss_fn, has_aux=True)(
+            params, enc, dec, cfg)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    first = None
+    for _ in range(150):
+        params, opt, loss = step(params, opt)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+    # Greedy generation reproduces the input prefix.
+    out = t5.greedy_generate(params, enc[:4], cfg, max_len=8, bos_id=0)
+    acc = float((out[:, 1:5] == enc[:4, :4]).mean())
+    assert acc >= 0.75, (np.asarray(out[:, 1:5]), np.asarray(enc[:4, :4]))
+
+
+def test_t5_sharded_train_step_8dev():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = t5.tiny_config()
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2), devs[:8])
+    axes = t5.param_logical_axes(cfg)
+
+    with mesh:
+        params = t5.init_params(cfg, jax.random.PRNGKey(0))
+        sharded = jax.tree_util.tree_map(
+            lambda p, names: jax.device_put(
+                p, jax.sharding.NamedSharding(mesh, logical_spec(names))),
+            params, axes,
+            is_leaf=lambda x: not isinstance(x, dict))
+        enc = jax.device_put(
+            jnp.ones((8, 16), jnp.int32),
+            jax.sharding.NamedSharding(mesh, logical_spec(("batch", "seq"))))
+        dec = jax.device_put(
+            jnp.ones((8, 8), jnp.int32),
+            jax.sharding.NamedSharding(mesh, logical_spec(("batch", "seq"))))
+
+        @jax.jit
+        def step(params, enc, dec):
+            (loss, _), grads = jax.value_and_grad(t5.loss_fn, has_aux=True)(
+                params, enc, dec, cfg, mesh=mesh)
+            return jax.tree_util.tree_map(
+                lambda p, g: p - 1e-3 * g.astype(p.dtype), params, grads
+            ), loss
+
+        new_params, loss = step(sharded, enc, dec)
+        assert np.isfinite(float(loss))
+        assert (new_params["decoder"]["w_up"].sharding
+                == sharded["decoder"]["w_up"].sharding)

@@ -170,21 +170,24 @@ def test_hyperband_brackets_and_halving():
         hb.register(f"t{i}", {})
     assert hb._trial_bracket["t0"] != hb._trial_bracket["t1"] or \
         hb._s_max == 0
-    # Bracket of t0: find its first (non-final) rung and feed the cohort.
-    b = hb._trial_bracket["t0"]
+    # Pick the bracket with the MOST rungs (t0's bracket 0 has only the
+    # final rung, which is never halved — asserting on it is dead code).
+    b = max(hb._bracket_rungs, key=lambda bb: len(hb._bracket_rungs[bb]))
     cohort = [t for t, bb in hb._trial_bracket.items() if bb == b]
     rungs = hb._bracket_rungs[b]
-    if len(rungs) > 1 and len(cohort) >= 2:
-        rung = rungs[0]
-        batch = [(t, rung, {"acc": float(i)})
-                 for i, t in enumerate(cohort)]
-        decisions = hb.on_batch(batch)
-        stops = [t for t, d in decisions.items() if d == "STOP"]
-        keeps = [t for t, d in decisions.items() if d == "CONTINUE"]
-        assert keeps and stops  # halving happened
-        # The kept trial(s) scored highest.
-        best = max(cohort, key=lambda t: hb._scores[t][rung])
-        assert best in keeps
+    assert len(rungs) > 1 and len(cohort) >= 2, (rungs, cohort)
+    rung = rungs[0]
+    batch = [(t, rung, {"acc": float(i)}) for i, t in enumerate(cohort)]
+    decisions = hb.on_batch(batch)
+    stops = [t for t, d in decisions.items() if d == "STOP"]
+    keeps = [t for t, d in decisions.items() if d == "CONTINUE"]
+    assert keeps and stops  # halving happened
+    # The kept trial(s) scored highest.
+    best = max(cohort, key=lambda t: hb._scores[t][rung])
+    assert best in keeps
+    # on_result protocol: a judged-out loser learns its STOP on its next
+    # report (straggler decisions are never lost).
+    assert hb.on_result(stops[0], rung + 1, {"acc": 99.0}) == "STOP"
     # max_t always stops.
     d = hb.on_batch([("t0", 9, {"acc": 1.0})])
     assert d["t0"] == "STOP"
