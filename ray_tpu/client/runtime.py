@@ -101,24 +101,24 @@ class ClientRuntime:
             self.flush_refs()
 
     def flush_refs(self) -> None:
-        """Reconcile the gateway session against the CURRENT local
-        refcounts. Buffered hold/drop events are not replayed in arrival
-        order (a drop-then-re-deserialize within one sweep would replay as
-        hold-then-release and unpin a live ref); instead each buffered oid
-        is resolved against its live count at flush time: count > 0 ->
-        hold, count == 0 -> release. Serialized so the flusher thread and
-        API-path callers cannot interleave their sends."""
+        """Ship buffered holds, then releases reconciled against the
+        CURRENT refcounts. Every buffered hold is sent (a hold is buffered
+        by ref deserialization BEFORE the ObjectRef is constructed —
+        filtering on count would discard the pin for a ref
+        mid-construction); a release
+        is sent only if the count is still zero, so drop-then-re-acquire
+        within one sweep nets out to "held". The hold call completes
+        before the release notify is sent, and the whole flush is
+        serialized, so the gateway always applies them in that order."""
         with self._flush_lock:
             with self._holds_lock:
                 holds, self._holds_buf = self._holds_buf, []
             dropped = self.refcount.take_dropped()
-            live_holds = [(o, owner) for o, owner in holds
-                          if self.refcount.count(o) > 0]
             releases = [o for o in set(dropped)
                         if self.refcount.count(o) == 0]
             try:
-                if live_holds:
-                    self._conn.call("hold", live_holds, timeout=30)
+                if holds:
+                    self._conn.call("hold", holds, timeout=30)
                 if releases:
                     self._conn.notify("release", releases)
             except (ConnectionLost, OSError):

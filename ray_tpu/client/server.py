@@ -29,12 +29,13 @@ from ray_tpu.core.resources import ResourceSet
 
 
 class _Session:
-    __slots__ = ("held", "actors", "lock")
+    __slots__ = ("held", "actors", "lock", "closed")
 
     def __init__(self):
         self.held: Dict[bytes, ObjectRef] = {}
         self.actors: List[Tuple[bytes, bool]] = []  # (actor_id, detached)
         self.lock = threading.Lock()
+        self.closed = False
 
 
 class ClientGateway:
@@ -42,25 +43,32 @@ class ClientGateway:
 
     def __init__(self, runtime):
         self.rt = runtime
-        self._sessions: Dict[int, _Session] = {}
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ session
 
     def _session(self, conn) -> _Session:
-        key = id(conn)
+        # The session lives ON the connection object (not in an id(conn)
+        # keyed map): a blocking handler racing on_peer_disconnect must not
+        # resurrect a cleaned-up session, and id() reuse by a later
+        # connection must not inherit state.
         with self._lock:
-            s = self._sessions.get(key)
+            s = conn.peer_info.get("client_session")
             if s is None:
-                s = self._sessions[key] = _Session()
+                s = _Session()
+                if conn.peer_info.get("client_session_closed"):
+                    s.closed = True
+                conn.peer_info["client_session"] = s
             return s
 
     def on_peer_disconnect(self, conn) -> None:
         with self._lock:
-            s = self._sessions.pop(id(conn), None)
+            s = conn.peer_info.pop("client_session", None)
+            conn.peer_info["client_session_closed"] = True
         if s is None:
             return
         with s.lock:
+            s.closed = True
             held, s.held = s.held, {}
             actors, s.actors = list(s.actors), []
         held.clear()  # drops the gateway-side local refs
@@ -73,7 +81,8 @@ class ClientGateway:
 
     def _hold(self, s: _Session, ref: ObjectRef) -> Tuple[bytes, Optional[str]]:
         with s.lock:
-            s.held[ref.binary()] = ref
+            if not s.closed:
+                s.held[ref.binary()] = ref
         return ref.binary(), ref.owner_address
 
     def _ref_of(self, s: _Session, oid: bytes, owner: Optional[str]) -> ObjectRef:
@@ -85,6 +94,7 @@ class ClientGateway:
 
     # ------------------------------------------------------------ handshake
 
+    @blocking_rpc
     def rpc_client_hello(self, conn, protocol_version: int) -> Dict[str, Any]:
         self._session(conn)
         return {
@@ -126,6 +136,7 @@ class ClientGateway:
             for o in oids:
                 s.held.pop(o, None)
 
+    @blocking_rpc
     def rpc_hold(self, conn,
                  oids: List[Tuple[bytes, Optional[str]]]) -> None:
         """Pin refs the client received nested inside values: register the
@@ -143,6 +154,7 @@ class ClientGateway:
 
     # ------------------------------------------------------------ tasks
 
+    @blocking_rpc
     def rpc_submit_task(self, conn, func, args, kwargs,
                         opts: Dict[str, Any]) -> List[Tuple[bytes, Optional[str]]]:
         s = self._session(conn)
@@ -189,9 +201,18 @@ class ClientGateway:
         )
         detached = opts.get("lifetime") == "detached"
         with s.lock:
-            s.actors.append((aid.binary(), detached))
+            closed = s.closed
+            if not closed:
+                s.actors.append((aid.binary(), detached))
+        if closed and not detached:
+            # Disconnect cleanup already ran; don't orphan the actor.
+            try:
+                self.rt.kill_actor(aid, no_restart=True)
+            except Exception:
+                pass
         return aid.binary()
 
+    @blocking_rpc
     def rpc_submit_actor_task(self, conn, aid: bytes, method_name: str,
                               args, kwargs, num_returns: int
                               ) -> List[Tuple[bytes, Optional[str]]]:
@@ -206,21 +227,26 @@ class ClientGateway:
         aid = self.rt.get_actor(name, namespace)
         return aid.binary(), self.rt.actor_class_of(aid)
 
+    @blocking_rpc
     def rpc_kill_actor(self, conn, aid: bytes, no_restart: bool) -> None:
         self.rt.kill_actor(ActorID(aid), no_restart=no_restart)
 
+    @blocking_rpc
     def rpc_list_actors(self, conn):
         return self.rt.list_actors()
 
     # ------------------------------------------------------------ cluster
 
+    @blocking_rpc
     def rpc_nodes(self, conn):
         return self.rt.nodes()
 
+    @blocking_rpc
     def rpc_cluster_resources(self, conn) -> Tuple[Dict[str, float],
                                                    Dict[str, float]]:
         return self.rt.cluster_resources(), self.rt.available_resources()
 
+    @blocking_rpc
     def rpc_kv(self, conn, op: str, namespace: str, key: bytes,
                value: Optional[bytes], opts: Optional[Dict[str, Any]] = None
                ) -> Any:

@@ -14,16 +14,20 @@ from ray_tpu.data.llm import build_llm_processor
 from ray_tpu.data.dataset import (Dataset, GroupedData,
                                   MaterializedDataset,
                                   StreamSplitIterator, from_arrow,
-                                  from_generators, from_items,
-                                  from_numpy, from_pandas,
-                                  range, read_binary_files, read_csv,
-                                  read_images, read_json, read_numpy,
-                                  read_parquet, read_text)
+                                  from_generators, from_huggingface,
+                                  from_items, from_numpy, from_pandas,
+                                  from_torch,
+                                  range, read_avro, read_binary_files,
+                                  read_csv, read_images, read_json,
+                                  read_numpy, read_parquet, read_text,
+                                  read_tfrecords, read_webdataset)
 
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "Dataset", "GroupedData",
     "MaterializedDataset", "StreamSplitIterator", "from_arrow",
-    "from_generators", "from_items",
-    "from_numpy", "from_pandas", "build_llm_processor", "range", "read_binary_files", "read_csv",
+    "from_generators", "from_huggingface", "from_items",
+    "from_numpy", "from_pandas", "from_torch", "build_llm_processor",
+    "range", "read_avro", "read_binary_files", "read_csv",
     "read_images", "read_json", "read_numpy", "read_parquet", "read_text",
+    "read_tfrecords", "read_webdataset",
 ]

@@ -422,6 +422,28 @@ class Dataset:
 
         return self._write(path, writer, ".npy")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        """One .tfrecords file per block; rows serialize as
+        tf.train.Example (reference write_tfrecords — here via the native
+        codec in data/formats.py, no tensorflow)."""
+        def writer(block: Block, out: str) -> None:
+            from ray_tpu.data import formats
+
+            formats.write_tfrecord_file(
+                out, formats.block_to_examples(block))
+
+        return self._write(path, writer, ".tfrecords")
+
+    def write_webdataset(self, path: str) -> List[str]:
+        """One .tar shard per block; columns become per-sample files named
+        <key>.<column> (reference write_webdataset)."""
+        def writer(block: Block, out: str) -> None:
+            from ray_tpu.data import formats
+
+            formats.write_webdataset_shard(out, block)
+
+        return self._write(path, writer, ".tar")
+
     # ------------------------------------------------------------ splits
 
     def split(self, n: int) -> List["MaterializedDataset"]:
@@ -814,6 +836,93 @@ def read_images(paths, *, parallelism: int = 4,
 
     return Dataset([functools.partial(read_one, f) for f in files],
                    read_parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = 4) -> Dataset:
+    """TFRecord files of tf.train.Example protos -> columnar blocks, one
+    task per file (reference read_tfrecords; native codec, no tensorflow:
+    data/formats.py)."""
+    files = _expand_paths(paths, (".tfrecords", ".tfrecord"))
+
+    def read_one(path: str) -> Block:
+        from ray_tpu.data import formats
+
+        return formats.examples_to_block(
+            list(formats.read_tfrecord_file(path)))
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = 4) -> Dataset:
+    """WebDataset .tar shards -> one row per sample, columns = file
+    extensions + ``__key__``, values = raw bytes (decode in a map stage,
+    per webdataset convention). Reference read_webdataset."""
+    files = _expand_paths(paths, (".tar",))
+
+    def read_one(path: str) -> Block:
+        from ray_tpu.data import formats
+
+        return formats.read_webdataset_shard(path)
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = 4) -> Dataset:
+    """Avro object-container files, one task per file (reference
+    read_avro; native schema-driven decoder, no fastavro)."""
+    files = _expand_paths(paths, (".avro",))
+
+    def read_one(path: str) -> Block:
+        from ray_tpu.data import formats
+
+        return BlockAccessor.normalize(formats.read_avro_file(path))
+
+    return Dataset([functools.partial(read_one, f) for f in files],
+                   read_parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *, parallelism: int = 4) -> Dataset:
+    """Materialize a torch dataset (reference from_torch). Rows may be
+    dicts (kept) or tuples (columns item_0..item_{n-1}).
+
+    Map-style datasets are walked by index over ``len()`` — bare
+    ``for row in ds`` falls back to Python's legacy __getitem__ protocol,
+    which never terminates on datasets that compute rather than index
+    (they raise no IndexError). Iterable-style datasets iterate."""
+    if hasattr(torch_dataset, "__len__") and hasattr(torch_dataset,
+                                                     "__getitem__"):
+        rows = (torch_dataset[i] for i in _range(len(torch_dataset)))
+    else:
+        rows = iter(torch_dataset)
+    items = []
+    for row in rows:
+        if isinstance(row, dict):
+            items.append(row)
+        elif isinstance(row, (tuple, list)):
+            items.append({f"item_{i}": v for i, v in enumerate(row)})
+        else:
+            items.append({"item": row})
+    return from_items(items, parallelism=parallelism)
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 4) -> Dataset:
+    """A huggingface datasets.Dataset (or anything with to_pandas/iter)
+    -> Dataset (reference from_huggingface).
+
+    A DatasetDict (load_dataset's default return) is rejected explicitly:
+    iterating it yields split NAMES, which would silently become the
+    data. Select a split first (the reference raises the same way)."""
+    to_pandas = getattr(hf_dataset, "to_pandas", None)
+    if to_pandas is not None:
+        return from_pandas(to_pandas(), parallelism=parallelism)
+    if isinstance(hf_dataset, dict) or (
+            hasattr(hf_dataset, "keys") and hasattr(hf_dataset, "values")):
+        raise TypeError(
+            "from_huggingface got a DatasetDict-like object; pick a split "
+            "first, e.g. from_huggingface(ds['train'])")
+    return from_items(list(hf_dataset), parallelism=parallelism)
 
 
 def from_pandas(df, *, parallelism: int = 4) -> Dataset:

@@ -213,6 +213,14 @@ class MultiAgentPPO:
         mapping = config.policy_mapping or {
             a: a for a in probe.agent_ids}
         policies = config.policies or tuple(sorted(set(mapping.values())))
+        unmapped = [a for a in probe.agent_ids if a not in mapping]
+        if unmapped:
+            raise ValueError(f"agents missing from policy_mapping: "
+                             f"{unmapped}")
+        orphans = [p for p in policies
+                   if not any(mapping[a] == p for a in probe.agent_ids)]
+        if orphans:
+            raise ValueError(f"policies with no mapped agent: {orphans}")
         self.policy_mapping = mapping
         self.learners: Dict[str, PPOLearner] = {}
         for i, pid in enumerate(policies):
