@@ -145,12 +145,15 @@ class ClientGateway:
         s = self._session(conn)
         for o, owner in oids:
             with s.lock:
-                if o in s.held:
+                if s.closed or o in s.held:
+                    # closed: disconnect cleanup already dropped this
+                    # session's pins — inserting now would leak them.
                     continue
             oid = ObjectID(o)
             self.rt.on_ref_deserialized(oid, owner)
             with s.lock:
-                s.held.setdefault(o, ObjectRef(oid, owner))
+                if not s.closed:
+                    s.held.setdefault(o, ObjectRef(oid, owner))
 
     # ------------------------------------------------------------ tasks
 

@@ -8,6 +8,7 @@ instead of per-call RPC (see compiled_dag.py).
 
 from ray_tpu.dag.channel import (ChannelClosedError, ChannelTimeoutError,
                                  ShmChannel)
+from ray_tpu.dag.collective_node import (CollectiveOutputNode, allreduce)
 from ray_tpu.dag.communicator import (Communicator, CpuCommunicator,
                                       JaxHostCommunicator)
 from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
@@ -16,7 +17,7 @@ from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
 
 __all__ = [
     "ChannelClosedError", "ChannelTimeoutError", "ClassMethodNode",
-    "Communicator", "CompiledDAG", "CompiledDAGRef", "CpuCommunicator",
-    "DAGNode", "InputNode", "JaxHostCommunicator", "MultiOutputNode",
-    "ShmChannel",
+    "CollectiveOutputNode", "Communicator", "CompiledDAG", "CompiledDAGRef",
+    "CpuCommunicator", "DAGNode", "InputNode", "JaxHostCommunicator",
+    "MultiOutputNode", "ShmChannel", "allreduce",
 ]

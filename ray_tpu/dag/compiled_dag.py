@@ -18,6 +18,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.channel import ChannelClosedError, ShmChannel
+from ray_tpu.dag.collective_node import CollectiveOutputNode, reduce_fn
 from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
                                   MultiOutputNode)
 
@@ -126,10 +127,68 @@ class CompiledDAG:
         self._actors: Dict[bytes, Any] = {}
         # First pass: ops + arg channels, in global topo order (preserves
         # intra-actor dependency order; the reference's dag_node_operation
-        # applies the same per-actor restriction).
+        # applies the same per-actor restriction). Collective groups are
+        # laid out per-actor from the SAME global order, so every actor
+        # enters concurrent groups in a consistent order (no cross-group
+        # deadlock by construction).
         ops_by_node: Dict[int, Dict[str, Any]] = {}
         node_actor_key: Dict[int, bytes] = {}
+        group_tree: Dict[int, Tuple[list, list]] = {}  # gid -> (up, down)
         for n in order:
+            if isinstance(n, CollectiveOutputNode):
+                group = n.group
+                if group.group_id in group_tree:
+                    continue  # whole group scheduled at first encounter
+                # Schedule EVERY rank's op NOW, atomically. Two
+                # guarantees hang off this: (a) all actors append
+                # concurrent groups in the same relative order (first
+                # topo encounter is a global order), so two groups can
+                # never interleave into a cross-group deadlock; (b) a
+                # rank whose output node is unreachable from the DAG
+                # root still runs its op (its peers' tree reads would
+                # otherwise block forever). Topo order has already
+                # visited every contribution (upstream() returns all
+                # participants), so dependencies hold for all ranks.
+                k = len(group.upstreams)
+                # Binary-tree edges rank i <-> parent (i-1)//2, one
+                # up + one down channel per non-root rank (reference
+                # analog: collective nodes lower onto a communicator;
+                # here the communicator IS the DAG's channel substrate).
+                ups: list = [None] * k
+                downs: list = [None] * k
+                for i in range(1, k):
+                    pkey = group.upstreams[(i - 1) // 2].actor \
+                        .actor_id.binary()
+                    ikey = group.upstreams[i].actor.actor_id.binary()
+                    up = self._chan()
+                    down = self._chan()
+                    chan_ends[id(up)] = [up, ikey, pkey]
+                    chan_ends[id(down)] = [down, pkey, ikey]
+                    ups[i], downs[i] = up, down
+                group_tree[group.group_id] = (ups, downs)
+                for sib in (group.output_nodes or [n]):
+                    key = sib.actor.actor_id.binary()
+                    self._actors[key] = sib.actor
+                    node_actor_key[sib._dag_id] = key
+                    r = sib.rank
+                    children = [c for c in (2 * r + 1, 2 * r + 2)
+                                if c < k]
+                    current_consumer[0] = key
+                    op = {
+                        "kind": "allreduce",
+                        "op": group.op,
+                        "method": f"allreduce-{group.op}",
+                        "args": [argspec(sib.upstream_node)],
+                        "kwargs": {},
+                        "up_parent": ups[r] if r else None,
+                        "down_parent": downs[r] if r else None,
+                        "up_children": [ups[c] for c in children],
+                        "down_children": [downs[c] for c in children],
+                        "outputs": [],
+                    }
+                    ops_by_node[sib._dag_id] = op
+                    per_actor.setdefault(key, []).append(op)
+                continue
             if not isinstance(n, ClassMethodNode):
                 continue
             key = n.actor.actor_id.binary()
@@ -147,8 +206,9 @@ class CompiledDAG:
         current_consumer[0] = "driver"
         self._output_channels = []
         for out in output_nodes:
-            if not isinstance(out, ClassMethodNode):
-                raise ValueError("DAG outputs must be actor-method nodes")
+            if not isinstance(out, (ClassMethodNode, CollectiveOutputNode)):
+                raise ValueError("DAG outputs must be actor-method or "
+                                 "collective nodes")
             ch = self._chan()
             self._output_channels.append(ch)
             chan_ends[id(ch)] = [ch, None, "driver"]
@@ -247,6 +307,13 @@ class CompiledDAG:
                 op["kwargs"] = {key: (k, swap(v) if k == "chan" else v)
                                 for key, (k, v) in op["kwargs"].items()}
                 op["outputs"] = [swap(c) for c in op["outputs"]]
+                if op.get("kind") == "allreduce":
+                    for f in ("up_parent", "down_parent"):
+                        if op[f] is not None:
+                            op[f] = swap(op[f])
+                    op["up_children"] = [swap(c) for c in op["up_children"]]
+                    op["down_children"] = [swap(c)
+                                           for c in op["down_children"]]
 
     # ------------------------------------------------------------ execute
 
@@ -295,6 +362,108 @@ def compile_dag(root: DAGNode, **kwargs) -> CompiledDAG:
 
 
 # ---------------------------------------------------------------- worker side
+
+def _execute_allreduce(op: Dict[str, Any], arg_state: tuple, seq: int,
+                       emit, read_fn) -> tuple:
+    """Tree allreduce for one seq. arg_state is ("ok", v) | ("err", e) |
+    ("stop",). Guarantees exactly one write to every channel this rank
+    writes (up_parent + down_children) and one consume of every channel it
+    reads (up_children + down_parent) in ALL outcomes — a skipped slot
+    would stall the peer at seq+capacity forever. Returns the same
+    state-tuple shape for the rank's reduced output."""
+    written: set = set()
+    consumed: set = set()
+    up_p, down_p = op["up_parent"], op["down_parent"]
+    read_list = list(op["up_children"]) + ([down_p] if down_p is not None
+                                           else [])
+    stop = arg_state[0] == "stop"
+    err = arg_state[1] if arg_state[0] == "err" else None
+    result = None
+    if not stop and err is None:
+        value = arg_state[1]
+        fn = reduce_fn(op["op"])
+        current = [None]
+
+        def tracked_read(ch):
+            current[0] = ch
+            try:
+                return read_fn(ch, seq)
+            finally:
+                # stop sentinels and error payloads consume the slot on
+                # raise; only a hard timeout (actor dying) does not, and
+                # then the loop is exiting anyway.
+                consumed.add(id(ch))
+                current[0] = None
+
+        try:
+            for ch in op["up_children"]:
+                value = fn(value, tracked_read(ch))
+            if up_p is not None:
+                emit("w", up_p, value, seq)
+                written.add(id(up_p))
+                result = tracked_read(down_p)
+            else:
+                result = value
+            for ch in op["down_children"]:
+                emit("w", ch, result, seq)
+                written.add(id(ch))
+        except ChannelClosedError:
+            stop = True
+        except BaseException as e:  # noqa: BLE001 — propagated to peers
+            err = e
+    if stop or err is not None:
+        mode = "s" if stop else "e"
+        payload = None if stop else err
+        if up_p is not None and id(up_p) not in written:
+            emit(mode, up_p, payload, seq)
+        for ch in op["down_children"]:
+            if id(ch) not in written:
+                emit(mode, ch, payload, seq)
+        for ch in read_list:
+            if id(ch) not in consumed:
+                try:
+                    ch.read(seq, timeout=5.0)
+                except Exception:
+                    pass
+        return ("stop",) if stop else ("err", err)
+    return ("ok", result)
+
+
+def _drain_op_for_stop(op: Dict[str, Any], seq: int, emit) -> None:
+    """Teardown-path unblocking for an op whose seq round is being
+    abandoned: consume its input slots, emit stop on its outputs, and for
+    collectives do the same for the tree channels."""
+    for kind, v in list(op["args"]) + list(op["kwargs"].values()):
+        if kind != "chan":
+            continue
+        try:
+            v.read(seq, timeout=0.5)
+        except Exception:
+            pass
+    if op.get("kind") == "allreduce":
+        if op["up_parent"] is not None:
+            try:
+                emit("s", op["up_parent"], None, seq)
+            except Exception:
+                pass
+        for ch in op["down_children"]:
+            try:
+                emit("s", ch, None, seq)
+            except Exception:
+                pass
+        reads = list(op["up_children"]) + (
+            [op["down_parent"]] if op["down_parent"] is not None else [])
+        for ch in reads:
+            try:
+                ch.read(seq, timeout=0.5)
+            except Exception:
+                pass
+    for out in op["outputs"]:
+        try:
+            emit("s", out, None, seq)
+        except Exception:
+            pass
+
 
 def _read_interruptible(ch, seq: int, stop_event: threading.Event):
     """Channel read that honors the kill switch: blocking in the store's
@@ -407,6 +576,28 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
                 except BaseException as e:  # noqa: BLE001
                     first_err = first_err or e
                     kwargs[k] = None
+            if op.get("kind") == "allreduce":
+                # Collective op: the tree protocol handles stop/error
+                # propagation to PEERS itself (every tree channel is
+                # written/consumed exactly once per seq in all outcomes).
+                if saw_stop:
+                    arg_state: tuple = ("stop",)
+                elif first_err is not None:
+                    arg_state = ("err", first_err)
+                else:
+                    arg_state = ("ok", args[0])
+                state = _execute_allreduce(
+                    op, arg_state, seq, emit,
+                    lambda ch, s: _read_interruptible(ch, s, stop_event))
+                if state[0] == "ok":
+                    for out in op["outputs"]:
+                        emit("w", out, state[1], seq)
+                    continue
+                if state[0] == "err":
+                    for out in op["outputs"]:
+                        emit("e", out, state[1], seq)
+                    continue
+                saw_stop = True  # fall through to the stop path below
             if saw_stop:
                 for out in op["outputs"]:
                     try:
@@ -418,19 +609,7 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
                 # wait_consumed handshake blocks until all are read.
                 idx = schedule.index(op)
                 for later in schedule[idx + 1:]:
-                    for kind, v in list(later["args"]) + list(
-                            later["kwargs"].values()):
-                        if kind != "chan":
-                            continue
-                        try:
-                            v.read(seq, timeout=0.5)
-                        except Exception:
-                            pass
-                    for out in later["outputs"]:
-                        try:
-                            emit("s", out, None, seq)
-                        except Exception:
-                            pass
+                    _drain_op_for_stop(later, seq, emit)
                 stopped = True
                 break
             if first_err is not None:
