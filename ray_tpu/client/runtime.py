@@ -96,8 +96,10 @@ class ClientRuntime:
     # ---------------------------------------------------------- plumbing
 
     def _flush_loop(self) -> None:
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
         while not self._shutdown:
-            time.sleep(0.2)
+            time.sleep(cfg.client_ref_flush_period_s)
             self.flush_refs()
 
     def flush_refs(self) -> None:

@@ -146,14 +146,19 @@ class ClientGateway:
         for o, owner in oids:
             with s.lock:
                 if s.closed or o in s.held:
-                    # closed: disconnect cleanup already dropped this
-                    # session's pins — inserting now would leak them.
                     continue
             oid = ObjectID(o)
+            # Create the local ref BEFORE the borrow registration: if the
+            # session closes mid-flight, dropping `ref` releases the
+            # borrow through the ordinary refcount path — checking closed
+            # first and skipping the ObjectRef would leave the owner-side
+            # borrow registered with nothing to ever release it.
+            ref = ObjectRef(oid, owner)
             self.rt.on_ref_deserialized(oid, owner)
             with s.lock:
                 if not s.closed:
-                    s.held.setdefault(o, ObjectRef(oid, owner))
+                    s.held.setdefault(o, ref)
+            del ref  # no-op if held; releases the pin if session closed
 
     # ------------------------------------------------------------ tasks
 

@@ -86,7 +86,9 @@ class NodeProc:
 def start_node_process(head_addr: str, resources: Optional[Dict[str, float]],
                        labels: Optional[Dict[str, str]] = None,
                        object_store_bytes: Optional[int] = None,
-                       timeout: float = 30.0) -> NodeProc:
+                       timeout: Optional[float] = None) -> NodeProc:
+    if timeout is None:
+        timeout = cfg.node_boot_timeout_s
     args = [sys.executable, "-m", "ray_tpu.cluster.node_main",
             "--head-addr", head_addr,
             "--resources", json.dumps(resources or {}),
@@ -132,7 +134,9 @@ class ClusterRuntime(ClusterCore):
                  "--persist", self._head_persist],
                 "head.log")
             self._procs.append(head_proc)
-            head_addr = _read_tagged_line(head_proc, "ADDRESS", 30)["ADDRESS"]
+            head_addr = _read_tagged_line(
+                head_proc, "ADDRESS",
+                cfg.node_boot_timeout_s)["ADDRESS"]
             self._head_proc = head_proc
             self._head_addr_str = head_addr
             # Head fault tolerance: supervise + respawn on the SAME port
@@ -186,7 +190,7 @@ class ClusterRuntime(ClusterCore):
         while not getattr(self, "_shutdown_flag", False):
             proc = self._head_proc
             if proc.poll() is None:
-                time.sleep(0.5)
+                time.sleep(cfg.head_supervisor_poll_s)
                 continue
             if getattr(self, "_shutdown_flag", False):
                 return
@@ -195,7 +199,8 @@ class ClusterRuntime(ClusterCore):
                     [sys.executable, "-m", "ray_tpu.cluster.head_main",
                      "--port", port, "--persist", self._head_persist],
                     "head.log")
-                _read_tagged_line(new_proc, "ADDRESS", 30)
+                _read_tagged_line(new_proc, "ADDRESS",
+                                  cfg.node_boot_timeout_s)
                 self._head_proc = new_proc
                 self._procs.append(new_proc)
             except Exception:

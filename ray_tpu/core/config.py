@@ -196,7 +196,30 @@ _d("tpu_slice_exclusive", bool, True,
    "enforce one-process-per-host TPU ownership when leasing TPU resources")
 _d("device_prefetch_depth", int, 2, "host->HBM prefetch pipeline depth for data")
 
+# --- serve ---
+_d("serve_reconcile_period_s", float, 1.0,
+   "controller reconciliation loop period (target-vs-running diff)")
+_d("serve_router_refresh_s", float, 2.0,
+   "router fallback replica-set poll period (long-poll push is primary)")
+_d("serve_handle_timeout_s", float, 60.0,
+   "deployment-handle call timeout (handle.remote().result() default)")
+
+# --- client tier ---
+_d("client_ref_flush_period_s", float, 0.2,
+   "remote-driver clients: hold/release reconciliation sweep period")
+
+# --- cluster lifecycle ---
+_d("node_boot_timeout_s", float, 30.0,
+   "seconds to wait for a spawned head/node process to print its address")
+_d("head_supervisor_poll_s", float, 0.5,
+   "driver-side head supervisor liveness poll period")
+
 # --- compiled DAGs ---
+_d("dag_channel_capacity", int, 8,
+   "compiled-DAG channel slots: executions pipeline up to this depth "
+   "before the driver's next execute() blocks")
+_d("dag_teardown_timeout_s", float, 10.0,
+   "teardown handshake: wait for each loop to consume its stop sentinel")
 _d("dag_overlap_comm", bool, False,
    "compiled DAGs: run channel writes on a dedicated sender thread so "
    "compute for step n+1 overlaps the send of step n (reference: "

@@ -74,8 +74,11 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, capacity: int = 8):
-        self._capacity = capacity
+    def __init__(self, root: DAGNode, capacity: Optional[int] = None):
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+        self._capacity = (capacity if capacity is not None
+                          else _cfg.dag_channel_capacity)
         self._seq = 0
         self._torn_down = False
         self._lock = threading.Lock()
@@ -345,8 +348,10 @@ class CompiledDAG:
         # Handshake, not a sleep: wait for each loop to CONSUME its stop
         # sentinel (deleting it mid-flight would leave the loop blocked on
         # a message that will never exist), then clean leftover slots.
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
         for ch in self._input_channels:
-            ch.wait_consumed(seq, timeout=10.0)
+            ch.wait_consumed(seq, timeout=_cfg.dag_teardown_timeout_s)
         for ch in self._input_channels + self._output_channels:
             ch.drain(seq + 1)
 

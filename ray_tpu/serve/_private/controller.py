@@ -219,8 +219,10 @@ class ServeController:
             self._stop_replicas(victims)
 
     def _reconcile_loop(self) -> None:
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
         while not self._shutdown:
-            time.sleep(1.0)
+            time.sleep(cfg.serve_reconcile_period_s)
             for name in list(self._deployments):
                 try:
                     self._reconcile_once(name)

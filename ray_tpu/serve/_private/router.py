@@ -20,7 +20,11 @@ from typing import Any, Dict, List, Optional
 
 class Router:
     def __init__(self, controller, deployment: str,
-                 refresh_interval_s: float = 2.0):
+                 refresh_interval_s: Optional[float] = None):
+        if refresh_interval_s is None:
+            from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+            refresh_interval_s = cfg.serve_router_refresh_s
         self._controller = controller
         self._deployment = deployment
         self._lock = threading.Lock()

@@ -12,6 +12,8 @@ from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+
+_TIMEOUT_UNSET = object()
 from ray_tpu.serve._private.router import Router
 
 _lock = threading.Lock()
@@ -56,8 +58,14 @@ class DeploymentResponse:
         self._retry = retry
         self._done = False
 
-    def result(self, timeout: Optional[float] = 60.0):
+    def result(self, timeout: Any = _TIMEOUT_UNSET):
+        """``timeout`` defaults to the serve_handle_timeout_s flag; an
+        explicit ``timeout=None`` waits without a deadline."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
         from ray_tpu.exceptions import ActorDiedError
+
+        if timeout is _TIMEOUT_UNSET:
+            timeout = cfg.serve_handle_timeout_s
 
         try:
             return ray_tpu.get(self._ref, timeout=timeout)

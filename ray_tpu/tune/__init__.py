@@ -9,16 +9,16 @@ session; ASHA prunes losers at successive-halving rungs.
 from ray_tpu.train.session import get_checkpoint, report  # session API
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler,
-                                     MedianStoppingRule,
+                                     MedianStoppingRule, PB2,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (Searcher, TPESearcher, choice,
-                                 grid_search, loguniform, randint,
-                                 uniform)
+from ray_tpu.tune.search import (BOHBSearcher, Searcher, TPESearcher,
+                                 choice, grid_search, loguniform,
+                                 randint, uniform)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
-    "MedianStoppingRule",
+    "ASHAScheduler", "BOHBSearcher", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PB2",
     "PopulationBasedTraining", "Searcher", "TPESearcher",
     "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",

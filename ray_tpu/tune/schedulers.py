@@ -167,6 +167,105 @@ class PopulationBasedTraining:
         return decisions
 
 
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (Parker-Holder et al. 2020; reference:
+    python/ray/tune/schedulers/pb2.py): PBT's exploit step, but explore
+    picks the next hyperparameters by a GP-UCB bandit over observed
+    (config -> score-improvement) data instead of random perturbation —
+    far more sample-efficient for small populations.
+
+    ``hyperparam_bounds`` maps each tuned key to [low, high]; explore
+    proposes within those bounds. The GP is a small exact RBF regressor
+    over normalized configs with UCB acquisition (kappa sqrt-growth in
+    data size, matching the time-varying bandit schedule's spirit)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Dict[str, Any] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 n_candidates: int = 64):
+        if not hyperparam_bounds:
+            raise ValueError("hyperparam_bounds must be non-empty")
+        for k, b in hyperparam_bounds.items():
+            if (not isinstance(b, (list, tuple)) or len(b) != 2
+                    or not float(b[0]) < float(b[1])):
+                raise ValueError(f"bounds for {k!r} must be [low, high]")
+        self.bounds = {k: (float(b[0]), float(b[1]))
+                       for k, b in hyperparam_bounds.items()}
+        # The base class's mutations/resample machinery never runs — PB2
+        # replaces _explore wholesale — but its constructor requires a
+        # non-empty mutations dict; pass an inert marker per tuned key.
+        super().__init__(metric, mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={k: "pb2-gp"
+                                               for k in self.bounds},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.n_candidates = n_candidates
+        #: (normalized config vector, score delta) observations
+        self._gp_data: List[tuple] = []
+        self._prev_score: Dict[str, float] = {}
+
+    # -------------------------------------------------------------- data
+
+    def _vec(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in sorted(self.bounds.items()):
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo))
+        return out
+
+    def on_batch(self, results) -> Dict[str, Any]:
+        # Record per-trial score improvements BEFORE the base class
+        # updates _latest (the GP models "what config change helped").
+        for trial_id, _it, metrics in results:
+            if self.metric not in metrics:
+                continue
+            score = self._score(metrics)
+            prev = self._prev_score.get(trial_id)
+            if prev is not None:
+                cfg = self._configs.get(trial_id)
+                if cfg is not None:
+                    self._gp_data.append((self._vec(cfg), score - prev))
+                    if len(self._gp_data) > 100:
+                        self._gp_data.pop(0)
+            self._prev_score[trial_id] = score
+        return super().on_batch(results)
+
+    # ------------------------------------------------------------ explore
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        if len(self._gp_data) < 4:
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = self._rng.uniform(lo, hi)
+            return out
+        X = np.asarray([d[0] for d in self._gp_data])
+        y = np.asarray([d[1] for d in self._gp_data])
+        y_std = y.std() or 1.0
+        yn = (y - y.mean()) / y_std
+        ell, noise = 0.3, 1e-2
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-0.5 * d2 / ell**2) + noise * np.eye(len(X))
+        Kinv = np.linalg.inv(K)
+        alpha = Kinv @ yn
+
+        cand = np.asarray([
+            [self._rng.random() for _ in self.bounds]
+            for _ in range(self.n_candidates)
+        ])
+        cd2 = ((cand[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-0.5 * cd2 / ell**2)
+        mu = Kc @ alpha
+        var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Kc, Kinv, Kc), 1e-9)
+        kappa = 0.5 * np.sqrt(np.log(len(X) + 1.0))
+        best = int(np.argmax(mu + kappa * np.sqrt(var)))
+        for i, (k, (lo, hi)) in enumerate(sorted(self.bounds.items())):
+            out[k] = lo + float(cand[best, i]) * (hi - lo)
+        return out
+
+
 class MedianStoppingRule:
     """Median stopping (reference: python/ray/tune/schedulers/
     median_stopping_rule.py): a trial stops when its best metric so far

@@ -293,3 +293,46 @@ class TPESearcher(Searcher):
         if score is None:
             return
         self._obs.append({"config": cfg, "score": float(score)})
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model component (Falkner et al. 2018), for pairing with
+    HyperBandScheduler (reference: python/ray/tune/search/bohb/
+    bohb_search.py + schedulers/hb_bohb.py).
+
+    Multi-fidelity twist on TPE: each observation records the budget
+    (training_iteration) the trial reached — HyperBand stops losers at
+    low rungs, so completions arrive at mixed fidelities. Suggestions are
+    modeled on the HIGHEST budget tier that has accumulated ``n_initial``
+    observations (higher-fidelity scores are more trustworthy); until any
+    tier has enough, sampling stays random."""
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or result is None:
+            return
+        score = result.get(self.metric)
+        if score is None:
+            return
+        self._obs.append({
+            "config": cfg, "score": float(score),
+            "budget": int(result.get("training_iteration", 0) or 0),
+        })
+
+    def _model_obs(self) -> List[Dict[str, Any]]:
+        budgets = sorted({o.get("budget", 0) for o in self._obs},
+                         reverse=True)
+        for b in budgets:
+            sub = [o for o in self._obs if o.get("budget", 0) >= b]
+            if len(sub) >= self.n_initial:
+                return sub
+        return []
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        full = self._obs
+        self._obs = self._model_obs()
+        try:
+            return super().suggest(trial_id)
+        finally:
+            self._obs = full

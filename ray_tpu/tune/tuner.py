@@ -365,9 +365,15 @@ class Tuner:
                 if t.done and t.trial_id not in reported_done:
                     reported_done.add(t.trial_id)
                     if searcher is not None:
-                        searcher.on_trial_complete(
-                            t.trial_id,
-                            t.history[-1] if t.history else None)
+                        # Merge the fidelity reached into the final result
+                        # (reports carry only user metrics): multi-fidelity
+                        # searchers (BOHB) tier observations by it.
+                        last = None
+                        if t.history:
+                            last = dict(t.history[-1])
+                            last.setdefault("training_iteration",
+                                            t.iteration)
+                        searcher.on_trial_complete(t.trial_id, last)
                     if sched_complete is not None:
                         # Cohort schedulers must drop terminal trials
                         # from readiness checks (a dead peer would block

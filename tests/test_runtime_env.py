@@ -98,7 +98,7 @@ def test_actor_runtime_env(cluster):
 
 def test_unsupported_runtime_env_raises(cluster):
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        @ray_tpu.remote(runtime_env={"conda": {"deps": []}})
+        @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
         def f():
             return 1
 

@@ -1,0 +1,174 @@
+"""uv / conda runtime-env plugins (reference analog:
+python/ray/tests/test_runtime_env_uv.py, test_runtime_env_conda_and_pip.py
+— the reference's conda tests stub the binary the same way, since CI
+images don't ship it; this image ships neither uv nor conda, so both
+tools are driven through RTPU_*_BIN stub executables that delegate to
+venv/pip, exercising the real command construction, cache keying, and
+atomic-publish paths)."""
+
+import os
+import stat
+import sys
+
+import pytest
+
+import ray_tpu
+from tests.test_runtime_env import _build_tiny_wheel
+
+UV_STUB = """#!/bin/sh
+# stub uv: "uv venv [--system-site-packages] --python PY DIR" and
+# "uv pip install --python PY [args...]"
+echo "$@" >> "$RTPU_UV_STUB_LOG"
+cmd="$1"; shift
+if [ "$cmd" = "venv" ]; then
+    py=""; dir=""; flags=""
+    while [ $# -gt 0 ]; do
+        case "$1" in
+            --system-site-packages) flags="--system-site-packages";;
+            --python) py="$2"; shift;;
+            *) dir="$1";;
+        esac
+        shift
+    done
+    exec "$py" -m venv $flags "$dir"
+elif [ "$cmd" = "pip" ]; then
+    sub="$1"; shift   # install
+    py=""
+    args=""
+    while [ $# -gt 0 ]; do
+        case "$1" in
+            --python) py="$2"; shift;;
+            *) args="$args $1";;
+        esac
+        shift
+    done
+    exec "$py" -m pip $sub --quiet --disable-pip-version-check $args
+fi
+exit 2
+"""
+
+CONDA_STUB = """#!/bin/sh
+# stub conda: "conda run -n NAME python -c CODE" and
+# "conda env create -p DIR -f FILE"
+echo "$@" >> "$RTPU_CONDA_STUB_LOG"
+if [ "$1" = "run" ]; then
+    shift; shift; name="$1"; shift  # -n NAME
+    exec "$@"
+elif [ "$1" = "env" ] && [ "$2" = "create" ]; then
+    dir="$4"; spec="$6"
+    %PYTHON% -m venv "$dir" || exit 1
+    cp "$spec" "$dir/conda-spec.json"
+    exit 0
+fi
+exit 2
+"""
+
+
+@pytest.fixture(scope="module")
+def stub_cluster(tmp_path_factory):
+    """Cluster whose node processes inherit stub uv/conda binaries (env
+    must be set BEFORE init so spawned nodes see it)."""
+    base = tmp_path_factory.mktemp("stubs")
+    uv = base / "uv"
+    uv.write_text(UV_STUB)
+    conda = base / "conda"
+    conda.write_text(CONDA_STUB.replace("%PYTHON%", sys.executable))
+    for p in (uv, conda):
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    old = {}
+    env = {
+        "RTPU_UV_BIN": str(uv),
+        "RTPU_CONDA_BIN": str(conda),
+        "RTPU_UV_STUB_LOG": str(base / "uv.log"),
+        "RTPU_CONDA_STUB_LOG": str(base / "conda.log"),
+        # Fresh cache per module: cached interpreters from other runs
+        # would skip the code paths under test.
+        "RTPU_RUNTIME_ENV_DIR": str(base / "envs"),
+    }
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield base
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_uv_env_installs_and_isolates(stub_cluster, tmp_path):
+    wheels = _build_tiny_wheel(tmp_path, name="rtpu_uvtest_pkg",
+                               version="2.0.0")
+    env = {"uv": {"packages": ["rtpu_uvtest_pkg"], "no_index": True,
+                  "find_links": wheels}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def with_pkg():
+        import rtpu_uvtest_pkg
+
+        return rtpu_uvtest_pkg.marker()
+
+    @ray_tpu.remote
+    def without_pkg():
+        try:
+            import rtpu_uvtest_pkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(with_pkg.remote(), timeout=180) == "installed-2.0.0"
+    assert ray_tpu.get(without_pkg.remote(), timeout=60) == "isolated"
+    log = (stub_cluster / "uv.log").read_text()
+    assert "venv --system-site-packages" in log
+    assert "pip install" in log and "--no-index" in log
+
+
+def test_conda_dict_spec_creates_prefix_env(stub_cluster):
+    from ray_tpu.core.runtime_env import (resolve_python_executable,
+                                          validate_runtime_env)
+
+    env = validate_runtime_env(
+        {"conda": {"dependencies": ["python"], "name": "spec-env"}})
+    python = resolve_python_executable(env)
+    assert python and os.path.exists(python)
+    # The spec file conda saw carries the dict.
+    spec = os.path.join(os.path.dirname(os.path.dirname(python)),
+                        "conda-spec.json")
+    assert os.path.exists(spec)
+    # Cache hit returns the same interpreter without re-creating.
+    assert resolve_python_executable(env) == python
+
+
+def test_conda_named_env_resolves_interpreter(stub_cluster):
+    from ray_tpu.core.runtime_env import (resolve_python_executable,
+                                          validate_runtime_env)
+
+    env = validate_runtime_env({"conda": "prod-env"})
+    # Stub `conda run` executes the command with the host python.
+    assert resolve_python_executable(env) == sys.executable
+    log = (stub_cluster / "conda.log").read_text()
+    assert "run -n prod-env" in log
+
+
+def test_interpreter_sources_mutually_exclusive():
+    from ray_tpu.core.runtime_env import validate_runtime_env
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_runtime_env({"pip": ["x"], "uv": ["y"]})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_runtime_env({"conda": "base", "py_executable": "/x"})
+
+
+def test_missing_tool_raises(monkeypatch, tmp_path):
+    from ray_tpu.core.runtime_env import (resolve_python_executable,
+                                          validate_runtime_env)
+
+    monkeypatch.delenv("RTPU_UV_BIN", raising=False)
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    monkeypatch.setenv("RTPU_RUNTIME_ENV_DIR", str(tmp_path / "envs"))
+    env = validate_runtime_env({"uv": ["somepkg"]})
+    with pytest.raises(RuntimeError, match="uv executable"):
+        resolve_python_executable(env)
