@@ -55,6 +55,7 @@ class NodeInfo:
         self.store_name = store_name
         self.alive = True
         self.last_heartbeat = time.monotonic()
+        self.sync_version = -1  # versioned resource view (delta sync)
 
     def view(self) -> Dict[str, Any]:
         return {"node_id": self.node_id, "address": self.address,
@@ -187,7 +188,16 @@ class HeadServer:
 
     def rpc_subscribe(self, conn, channel: str):
         with self._lock:
-            self._subscribers.setdefault(channel, []).append(conn)
+            subs = self._subscribers.setdefault(channel, [])
+            if conn not in subs:  # idempotent: resubscribes must not dup
+                subs.append(conn)
+        return True
+
+    def rpc_unsubscribe(self, conn, channel: str):
+        with self._lock:
+            subs = self._subscribers.get(channel)
+            if subs and conn in subs:
+                subs.remove(conn)
         return True
 
     def on_peer_disconnect(self, conn) -> None:
@@ -207,15 +217,36 @@ class HeadServer:
         self._publish("NODE", {"event": "added", "node_id": node_id})
         return True
 
-    def rpc_heartbeat(self, conn, node_id: str, available: Dict[str, float]):
+    def rpc_heartbeat(self, conn, node_id: str, available: Dict[str, float],
+                      version: Optional[int] = None,
+                      is_delta: bool = False):
+        """Versioned resource sync (reference: ray_syncer's versioned
+        NodeState views, common/ray_syncer/ray_syncer.h:83): a delta
+        carries only the resources whose availability CHANGED since the
+        last acked version. Version gaps (head restart, lost beat) NACK
+        with "resync" and the node's next beat is a full snapshot."""
         with self._lock:
             n = self._nodes.get(node_id)
             if n is None:
                 return False
             n.last_heartbeat = time.monotonic()
-            n.available = dict(available)
+            if is_delta:
+                if version is None or version != n.sync_version + 1:
+                    return "resync"
+                n.available.update(available)
+            else:
+                n.available = dict(available)
+            if version is not None:
+                n.sync_version = version
             if not n.alive:
                 n.alive = True  # node recovered
+        return True
+
+    def rpc_publish(self, conn, channel: str, payload: Any):
+        """Worker-side publishers (reference: per-worker publishers in
+        src/ray/pubsub/ — any process may publish; the head fans out to
+        channel subscribers)."""
+        self._publish(channel, payload)
         return True
 
     def rpc_drain_node(self, conn, node_id: str):

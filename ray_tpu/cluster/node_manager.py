@@ -208,17 +208,35 @@ class NodeManager:
 
     def _heartbeat_loop(self) -> None:
         period = cfg.health_check_period_ms / 1000.0
+        last_sent: Dict[str, float] = {}
+        version = 0
         while not self._stop.wait(period):
             try:
                 with self._lock:
                     avail = dict(self.available)
+                # Delta sync (reference: ray_syncer versioned views): ship
+                # only resources whose availability changed since the last
+                # ACKED beat; the head NACKs version gaps with "resync"
+                # and the next beat falls back to a full snapshot.
+                if last_sent:
+                    payload = {k: v for k, v in avail.items()
+                               if last_sent.get(k) != v}
+                    is_delta = True
+                else:
+                    payload, is_delta = avail, False
                 # The reply wait must NOT exceed the period: a single
                 # dropped reply would otherwise stall this loop for the
                 # full timeout while the head's miss window
                 # (threshold x period) expires — one lost packet became a
                 # false node death under RPC chaos.
-                acked = self._head.call("heartbeat", self.node_id, avail,
-                                        timeout=period)
+                acked = self._head.call("heartbeat", self.node_id, payload,
+                                        version, is_delta, timeout=period)
+                if acked is True:
+                    last_sent = avail
+                    version += 1
+                elif acked == "resync":
+                    last_sent = {}  # next beat: full snapshot, same version
+                    continue
                 if acked is False:
                     # The head doesn't know us: it restarted and lost its
                     # node table (nodes are ephemeral state — reference:

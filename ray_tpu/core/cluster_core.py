@@ -473,7 +473,12 @@ class ClusterCore:
         self.refcount.flush_deferred()
 
     def _release_object(self, oid: ObjectID) -> None:
-        self.memory_store.delete([oid])
+        memory_only = self.memory_store.delete([oid])
+        if memory_only:
+            # Small inlined result: it never touched the shm store — skip
+            # the C delete + spill-unlink syscalls (per-task-return hot
+            # path; the shm attempt was ~1/4 of release cost).
+            return
         if self.store.delete(oid):
             try:
                 self.head.notify("object_removed", oid.binary(), self.node_id)

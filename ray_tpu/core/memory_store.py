@@ -172,11 +172,19 @@ class MemoryStore:
                         break
         return out
 
-    def delete(self, object_ids: List[ObjectID]) -> None:
+    def delete(self, object_ids: List[ObjectID]) -> List[ObjectID]:
+        """Returns the subset whose record was MEMORY-RESIDENT (present
+        and not a plasma stub): a released small result needs no shm-store
+        delete / unlink syscalls — the caller can skip them (hot on the
+        task-release path: every small task return pays this)."""
+        memory_only: List[ObjectID] = []
         with self._lock:
             for oid in object_ids:
-                self._objects.pop(oid, None)
+                rec = self._objects.pop(oid, None)
                 self._callbacks.pop(oid, None)
+                if rec is not None and not rec.in_plasma:
+                    memory_only.append(oid)
+        return memory_only
 
     def size(self) -> int:
         with self._lock:

@@ -12,9 +12,9 @@ from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve._private.router import Router
 
 _TIMEOUT_UNSET = object()
-from ray_tpu.serve._private.router import Router
 
 _lock = threading.Lock()
 

@@ -17,8 +17,14 @@ _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: Dict[str, "Metric"] = {}
 
 
+_EMPTY_KEY: Tuple = ()
+
+
 def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
-    return tuple(sorted((labels or {}).items()))
+    # No-label counters ride per-task hot paths: skip dict+sort+tuple.
+    if not labels:
+        return _EMPTY_KEY
+    return tuple(sorted(labels.items()))
 
 
 class Metric:
