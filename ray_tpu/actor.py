@@ -127,6 +127,8 @@ class ActorClass:
             scheduling_strategy=opts.get("scheduling_strategy"),
             get_if_exists=opts.get("get_if_exists", False),
             runtime_env=opts.get("runtime_env"),
+            allow_out_of_order_execution=opts.get(
+                "allow_out_of_order_execution", False),
         )
         return ActorHandle(actor_id, self.method_num_returns())
 

@@ -90,6 +90,12 @@ def init(
 def shutdown() -> None:
     rt = get_runtime()
     if rt is not None:
+        try:
+            from ray_tpu.util import pubsub
+
+            pubsub.close()  # stop the rejoin loop before the head dies
+        except Exception:
+            pass
         rt.shutdown()
         runtime_context.set_runtime(None)
 

@@ -197,6 +197,7 @@ class ClientRuntime:
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
                      concurrency_groups: Optional[Dict[str, int]] = None,
+                     allow_out_of_order_execution: bool = False,
                      ) -> ActorID:
         self.flush_refs()
         opts = {
@@ -210,6 +211,7 @@ class ClientRuntime:
             "get_if_exists": get_if_exists,
             "runtime_env": runtime_env,
             "release_resources": release_resources,
+            "allow_out_of_order_execution": allow_out_of_order_execution,
         }
         aid = self._call("create_actor", cls, tuple(args), dict(kwargs),
                          opts, timeout=120)

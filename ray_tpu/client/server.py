@@ -206,6 +206,8 @@ class ClientGateway:
             get_if_exists=opts.get("get_if_exists", False),
             runtime_env=opts.get("runtime_env"),
             release_resources=bool(opts.get("release_resources", False)),
+            allow_out_of_order_execution=bool(
+                opts.get("allow_out_of_order_execution", False)),
         )
         detached = opts.get("lifetime") == "detached"
         with s.lock:

@@ -16,6 +16,10 @@ def main() -> None:
     import faulthandler
     import signal
 
+    from ray_tpu.core.process_util import bind_to_parent
+
+    bind_to_parent()  # PDEATHSIG armed in the CHILD (no preexec_fn fork)
+
     faulthandler.register(signal.SIGUSR1)
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")

@@ -38,14 +38,6 @@ from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
 
 
-def _die_with_parent():
-    """PR_SET_PDEATHSIG: workers die if the node manager dies."""
-    try:
-        import ctypes
-
-        ctypes.CDLL("libc.so.6").prctl(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
-    except Exception:
-        pass
 
 
 class WorkerProc:
@@ -236,8 +228,7 @@ class NodeManager:
                     version += 1
                 elif acked == "resync":
                     last_sent = {}  # next beat: full snapshot, same version
-                    continue
-                if acked is False:
+                elif acked is False:
                     # The head doesn't know us: it restarted and lost its
                     # node table (nodes are ephemeral state — reference:
                     # RayletNotifyGCSRestart re-registration). Re-register;
@@ -245,6 +236,7 @@ class NodeManager:
                     self._head.retrying_call(
                         "register_node", self.node_id, self.address,
                         self.total, self.labels, self.store_name, timeout=10)
+                    last_sent = {}  # fresh NodeInfo: full snapshot next
             except Exception:
                 try:
                     self._head.reconnect()
@@ -453,7 +445,10 @@ class NodeManager:
         log_dir = cfg.log_dir
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
-        env = dict(os.environ)
+        from ray_tpu.core.process_util import spawn_env
+
+        env = spawn_env()  # worker arms PDEATHSIG itself (no preexec_fn:
+        # fork-with-threads is the JAX deadlock class)
         env["RTPU_WORKER_ID"] = worker_id
         spawn_cwd = apply_to_spawn_env(runtime_env, env) or os.getcwd()
         if not tpu:
@@ -486,7 +481,6 @@ class NodeManager:
              "--worker-id", worker_id],
             stdout=logf, stderr=logf, env=env,
             cwd=spawn_cwd,
-            preexec_fn=_die_with_parent,
         )
         w = WorkerProc(proc, worker_id, tpu=tpu,
                        env_hash=runtime_env_hash(runtime_env))

@@ -53,10 +53,16 @@ class _OrderState:
 class _HostedActor:
     def __init__(self, actor_id: ActorID, instance: Any, max_concurrency: int,
                  is_async: bool,
-                 concurrency_groups: Optional[Dict[str, int]] = None):
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 out_of_order: bool = False):
         self.actor_id = actor_id
         self.instance = instance
         self.max_concurrency = max_concurrency
+        # Out-of-order execution (reference:
+        # out_of_order_actor_submit_queue.h): calls run as they ARRIVE —
+        # a chaos-delayed seq never gates its successors. Dedup still
+        # applies (at-least-once pushes), ordering guarantees don't.
+        self.out_of_order = out_of_order
         self.is_async = is_async
         self.lock = threading.Lock()
         self.pool = ThreadPoolExecutor(
@@ -491,7 +497,8 @@ class WorkerRuntime(ClusterCore):
         finally:
             runtime_context.set_worker_context(prev)
         hosted = _HostedActor(actor_id, instance, max_conc, is_async,
-                              spec.get("concurrency_groups"))
+                              spec.get("concurrency_groups"),
+                              out_of_order=spec.get("out_of_order", False))
         if is_async:
             self._start_actor_loop(hosted)
         with self._hosted_lock:
@@ -553,19 +560,33 @@ class WorkerRuntime(ClusterCore):
                 st.expected = min_pending
             else:
                 st.expected = max(st.expected, min_pending)
-            # Seqs below the horizon were completed/failed at the submitter:
-            # drop any stale buffered ones so the scan below can't stall.
-            for s in [s for s in st.buf if s < st.expected]:
-                del st.buf[s]
-            for seq, spec in specs:
-                if seq < st.expected or seq in st.buf:
-                    continue  # duplicate of an executed/buffered push
-                st.buf[seq] = spec
-            runnable = []
-            while st.expected in st.buf:
-                s = st.expected
-                runnable.append((st.buf.pop(s), s))
-                st.expected += 1
+            if hosted.out_of_order:
+                # Dedup via the horizon + the buffered-seen set, but run
+                # immediately: buf marks "already dispatched" seqs (pruned
+                # as min_pending advances past them).
+                for seq_ot in [x for x in st.buf if x < st.expected]:
+                    del st.buf[seq_ot]
+                runnable = []
+                for seq, spec in specs:
+                    if seq < st.expected or seq in st.buf:
+                        continue
+                    st.buf[seq] = True
+                    runnable.append((spec, seq))
+            else:
+                # Seqs below the horizon were completed/failed at the
+                # submitter: drop stale buffered ones so the scan below
+                # can't stall.
+                for s in [s for s in st.buf if s < st.expected]:
+                    del st.buf[s]
+                for seq, spec in specs:
+                    if seq < st.expected or seq in st.buf:
+                        continue  # duplicate of an executed/buffered push
+                    st.buf[seq] = spec
+                runnable = []
+                while st.expected in st.buf:
+                    s = st.expected
+                    runnable.append((st.buf.pop(s), s))
+                    st.expected += 1
         if hosted.is_async and hosted.loop is not None:
             # Async actors: schedule the runnable burst onto the actor's
             # event loop in ONE threadsafe hop (pool.submit +
@@ -780,6 +801,10 @@ class WorkerRuntime(ClusterCore):
 def main() -> None:
     import faulthandler
     import signal
+
+    from ray_tpu.core.process_util import bind_to_parent
+
+    bind_to_parent()  # PDEATHSIG armed in the CHILD (no preexec_fn fork)
 
     faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
     p = argparse.ArgumentParser()

@@ -1882,6 +1882,7 @@ class ClusterCore:
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
                      concurrency_groups: Optional[Dict[str, int]] = None,
+                     allow_out_of_order_execution: bool = False,
                      ) -> ActorID:
         from ray_tpu.core.runtime_env import validate_runtime_env
 
@@ -1901,6 +1902,7 @@ class ClusterCore:
             "concurrency_groups": dict(concurrency_groups or {}),
             "owner_addr": self.owner_addr,
             "release_resources": release_resources,
+            "out_of_order": bool(allow_out_of_order_execution),
         })
         # Constructor-arg refs must outlive this call: the head re-ships
         # spec_blob on every actor RESTART, long after the caller's local

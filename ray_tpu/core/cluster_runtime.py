@@ -29,28 +29,19 @@ from ray_tpu.core.ids import JobID
 
 
 def _spawn(args: List[str], log_name: str) -> subprocess.Popen:
+    from ray_tpu.core.process_util import spawn_env
+
     os.makedirs(cfg.log_dir, exist_ok=True)
     logf = open(os.path.join(cfg.log_dir, log_name), "ab", buffering=0)
-    env = dict(os.environ)
+    env = spawn_env()  # child arms PDEATHSIG itself (see process_util:
+    # preexec_fn would force fork()-with-threads, the JAX deadlock class)
     # Children must import ray_tpu from wherever the driver imported it
     # (repo checkouts aren't pip-installed).
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(args, stdout=subprocess.PIPE, stderr=logf,
-                            env=env, cwd=os.getcwd(),
-                            preexec_fn=_die_with_parent)
-
-
-def _die_with_parent():
-    """PR_SET_PDEATHSIG: the child gets SIGTERM if the driver dies, so a
-    SIGKILL'd driver never leaks a cluster."""
-    try:
-        import ctypes
-
-        ctypes.CDLL("libc.so.6").prctl(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
-    except Exception:
-        pass
+                            env=env, cwd=os.getcwd())
 
 
 def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float) -> Dict[str, str]:

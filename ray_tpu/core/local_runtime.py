@@ -293,7 +293,9 @@ class LocalRuntime:
                      max_restarts: int = 0, resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
-                     concurrency_groups=None) -> "ActorID":
+                     concurrency_groups=None,
+                     allow_out_of_order_execution: bool = False
+                     ) -> "ActorID":
         # Local mode runs every method on one pool; concurrency groups
         # only isolate executors in cluster workers.
         import inspect

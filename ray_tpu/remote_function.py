@@ -18,6 +18,7 @@ _VALID_OPTIONS = {
     "runtime_env", "max_concurrency", "max_restarts", "max_task_retries",
     "lifetime", "namespace", "get_if_exists", "placement_group",
     "max_calls", "concurrency_groups", "label_selector",
+    "allow_out_of_order_execution",
     "generator_backpressure_num_objects",
 }
 

@@ -99,10 +99,17 @@ class _PubSubHub:
     def subscribe(self, channel: str, handler: Callable) -> Subscription:
         with self._lock:
             self._handlers.setdefault(channel, []).append(handler)
-        # _ensure_client resubscribes every handler channel on a fresh
-        # connection; the explicit call covers the existing-connection
-        # case (head-side registration is idempotent either way).
-        self._ensure_client().call("subscribe", channel, timeout=10)
+        try:
+            # _ensure_client resubscribes every handler channel on a fresh
+            # connection; the explicit call covers the existing-connection
+            # case (head-side registration is idempotent either way).
+            self._ensure_client().call("subscribe", channel, timeout=10)
+        except BaseException:
+            # No Subscription is returned on failure, so nothing could
+            # ever remove the handler — an orphan would double-deliver
+            # after a successful retry.
+            self._remove(channel, handler)
+            raise
         return Subscription(self, channel, handler)
 
     def _remove(self, channel: str, handler: Callable) -> None:
@@ -142,14 +149,28 @@ def _get_hub() -> _PubSubHub:
     rt = require_runtime()
     head_addr = getattr(rt, "head_addr", None)
     if head_addr is None:
+        if getattr(rt, "is_client", False):
+            raise RuntimeError(
+                "pubsub is not proxied through the client:// gateway yet; "
+                "subscribe/publish from a process inside the cluster")
         raise RuntimeError("pubsub requires a cluster runtime "
                            "(local_mode has no head broker)")
     with _hub_lock:
-        if _hub is None or _hub._head_addr != head_addr:
+        if _hub is None or _hub._head_addr != head_addr or _hub._closed:
             if _hub is not None:
                 _hub.close()
             _hub = _PubSubHub(head_addr)
         return _hub
+
+
+def close() -> None:
+    """Tear down this process's hub (called by ray_tpu.shutdown): stops
+    the rejoin loop so a dead head isn't reconnect-polled forever."""
+    global _hub
+    with _hub_lock:
+        if _hub is not None:
+            _hub.close()
+            _hub = None
 
 
 def subscribe(channel: str, handler: Callable[[Any], None]) -> Subscription:

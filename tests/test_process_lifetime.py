@@ -1,0 +1,67 @@
+"""Process-lifetime binding without preexec_fn (VERDICT weak #7: os.fork
+warnings from fork-with-JAX-threads were a known deadlock class; reference
+analog: raylet/worker death-signal plumbing)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import warnings
+
+
+def test_no_fork_warnings_on_cluster_spawn():
+    """Spawning head/node/workers must not take the raw-fork path (the
+    JAX-multithreaded-fork RuntimeWarning class)."""
+    code = textwrap.dedent("""
+        import warnings, sys
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            import ray_tpu
+            ray_tpu.init(num_cpus=1)
+
+            @ray_tpu.remote
+            def f():
+                return 1
+
+            assert ray_tpu.get(f.remote(), timeout=60) == 1
+            ray_tpu.shutdown()
+        bad = [x for x in w if "fork" in str(x.message)]
+        sys.exit(1 if bad else 0)
+    """)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_sigkilled_driver_leaks_no_cluster():
+    """PDEATHSIG is armed by the CHILD (bind_to_parent): a SIGKILL'd
+    driver's head/node processes must still die."""
+    driver = textwrap.dedent("""
+        import sys, time
+        import ray_tpu
+        ray_tpu.init(num_cpus=1)
+        from ray_tpu.core.runtime_context import require_runtime
+        pids = [p.pid for p in require_runtime()._procs]
+        print("PIDS " + " ".join(map(str, pids)), flush=True)
+        time.sleep(120)
+    """)
+    p = subprocess.Popen([sys.executable, "-c", driver],
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        line = p.stdout.readline()
+        assert line.startswith("PIDS"), line
+        pids = [int(x) for x in line.split()[1:]]
+        assert pids
+    finally:
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait()
+    deadline = time.time() + 20
+    alive = pids
+    while time.time() < deadline:
+        alive = [pid for pid in pids if os.path.exists(f"/proc/{pid}")]
+        if not alive:
+            break
+        time.sleep(0.5)
+    assert not alive, f"cluster processes leaked: {alive}"
