@@ -15,12 +15,14 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
 from ray_tpu.train.session import (get_checkpoint, get_context,
                                    get_dataset_shard, report)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
     "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
     "DataParallelTrainer", "FailureConfig", "JaxTrainer", "Result",
-    "RunConfig", "ScalingConfig", "TrainWorkerError", "WorkerGroup",
+    "RunConfig", "ScalingConfig", "TorchTrainer", "TrainWorkerError",
+    "WorkerGroup",
     "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
     "report", "save_pytree",
 ]

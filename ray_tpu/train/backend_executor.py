@@ -87,12 +87,15 @@ class BackendExecutor:
                        checkpoint_path: Optional[str] = None,
                        dataset_shards: Optional[List[Dict[str, Any]]] = None,
                        ) -> None:
+        import uuid as _uuid
+
+        gang_id = _uuid.uuid4().hex[:12]  # fresh per gang start
         n = len(self._group.workers)
         waits = []
         for rank, w in enumerate(self._group.workers):
             ctx = TrainContextConfig(
                 world_size=n, world_rank=rank, node_rank=rank,
-                experiment_path=experiment_path)
+                experiment_path=experiment_path, gang_id=gang_id)
             shards = dataset_shards[rank] if dataset_shards else None
             waits.append(w.start_training.remote(
                 train_fn, config, ctx, checkpoint_path, shards))

@@ -66,3 +66,7 @@ class TrainContextConfig:
     coordinator: Optional[str] = None          # jax.distributed coordinator
     experiment_path: str = ""
     trial_info: Optional[Dict[str, Any]] = None
+    #: unique per gang START (fresh on every restart/resize): backends
+    #: needing a per-attempt rendezvous scope key on it (torch DDP).
+    gang_id: str = ""
+

@@ -57,6 +57,10 @@ class TrainContext:
     def get_trial_info(self) -> Optional[Dict[str, Any]]:
         return self._cfg.trial_info
 
+    def get_gang_id(self) -> str:
+        """Unique per gang start (fresh across restarts/resizes)."""
+        return self._cfg.gang_id
+
 
 class TrainSession:
     """Owns the user-loop thread and the result handoff queue."""

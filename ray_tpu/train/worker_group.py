@@ -20,6 +20,33 @@ from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
 from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 
+def node_ip() -> str:
+    """Route-based discovery: the address another host would reach this one
+    on (gethostbyname(gethostname()) returns 127.0.1.1 on common /etc/hosts
+    layouts, which breaks cross-host coordination)."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 class TrainWorkerActor:
     """Hosted inside each train-worker actor process."""
 
@@ -27,29 +54,10 @@ class TrainWorkerActor:
         self._session: Optional[TrainSession] = None
 
     def node_ip(self) -> str:
-        import socket
-
-        # Route-based discovery: the address another host would reach us on
-        # (gethostbyname(gethostname()) returns 127.0.1.1 on common
-        # /etc/hosts layouts, which breaks cross-host coordination).
-        try:
-            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            try:
-                s.connect(("8.8.8.8", 80))
-                return s.getsockname()[0]
-            finally:
-                s.close()
-        except OSError:
-            return socket.gethostbyname(socket.gethostname())
+        return node_ip()
 
     def free_port(self) -> int:
-        import socket
-
-        s = socket.socket()
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
+        return free_port()
 
     def setup_jax_distributed(self, coordinator: str, num_processes: int,
                               process_id: int) -> bool:
