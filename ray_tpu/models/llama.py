@@ -50,6 +50,13 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Single-token decode steps dispatch to the hand-written Pallas
+    # decode-attention kernel on TPU (ops/decode_attention.py — measured
+    # faster than the XLA-fused path at serving shapes). False = always
+    # use the generic masked-attention path; "interpret" = run the same
+    # kernel glue under the Pallas interpreter off-TPU (test coverage for
+    # the dispatch itself).
+    use_decode_kernel: Any = True
     # jax.checkpoint policy name: "nothing" = full per-layer remat (lowest
     # HBM — backward recomputes the block from its input), "dots" = save
     # non-batch matmul outputs (faster bwd, +O(layers*S*d_ff) HBM).
@@ -193,15 +200,35 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
 
     new_kv = None
     if cache_kv is not None:
-        ck, cv = cache_kv
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        ck, cv = cache_kv  # [B, KH, S, D] (engine-native, see init_kv_cache)
+        ck = lax.dynamic_update_slice(
+            ck, k.swapaxes(1, 2).astype(ck.dtype), (0, 0, cache_index, 0))
+        cv = lax.dynamic_update_slice(
+            cv, v.swapaxes(1, 2).astype(cv.dtype), (0, 0, cache_index, 0))
         new_kv = (ck, cv)
-        kv_len = ck.shape[1]
-        kv_pos = jnp.broadcast_to(jnp.arange(kv_len), (x.shape[0], kv_len))
-        kv_mask = kv_pos < (cache_index + k.shape[1])
-        attn = causal_attention(q, ck, cv, q_positions=positions,
-                                kv_positions=kv_pos, kv_mask=kv_mask)
+        if (k.shape[1] == 1 and cfg.use_decode_kernel
+                and (jax.default_backend() == "tpu"
+                     or cfg.use_decode_kernel == "interpret")):
+            # Serving decode step: one query over the cache prefix — the
+            # Pallas kernel streams the native-layout cache directly
+            # (ops/decode_attention.py). "interpret" runs the same glue
+            # under the Pallas interpreter off-TPU (test escape hatch).
+            from ray_tpu.ops.decode_attention import decode_attention
+
+            lengths = jnp.broadcast_to(cache_index + 1, (x.shape[0],))
+            s_cache = ck.shape[2]
+            attn = decode_attention(
+                q[:, 0], ck, cv, lengths.astype(jnp.int32),
+                layout="bksd", block_s=min(2048, s_cache),
+                interpret=cfg.use_decode_kernel == "interpret")[:, None]
+        else:
+            kv_len = ck.shape[2]
+            kv_pos = jnp.broadcast_to(jnp.arange(kv_len),
+                                      (x.shape[0], kv_len))
+            kv_mask = kv_pos < (cache_index + k.shape[1])
+            attn = causal_attention(q, ck.swapaxes(1, 2), cv.swapaxes(1, 2),
+                                    q_positions=positions,
+                                    kv_positions=kv_pos, kv_mask=kv_mask)
     else:
         attn = _attention_dispatch(q, k, v, positions, positions, cfg, mesh,
                                    standard_positions=standard_positions)
@@ -323,8 +350,14 @@ def loss_from_hidden(params: Params, x: jnp.ndarray, tokens: jnp.ndarray,
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int,
                   dtype=None) -> Dict[str, jnp.ndarray]:
+    """KV cache in the ENGINE-NATIVE [layers, B, KH, S, D] layout: the
+    Pallas decode kernel streams [B, KH, S, D] directly (storing [B, S,
+    KH, D] cost two full-cache transposes per decoded token — measured
+    on v5e). Activations transpose per step instead: new k/v are [B, T,
+    KH, D] with tiny T, and the read-side swap feeding the generic
+    attention path folds into the dot's dimension numbers."""
     dt = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 

@@ -74,6 +74,18 @@ def _wrap_with_torch_backend(user_fn: Callable, backend: str,
         os.environ["RANK"] = str(rank)
         os.environ["WORLD_SIZE"] = str(world)
         dist.init_process_group(backend, rank=rank, world_size=world)
+        if rank == 0:
+            # Group formed = every rank has read the address; drop the KV
+            # entry (rpc_kv_put writes through to the durable store — a
+            # long-lived cluster must not accumulate one key per gang).
+            try:
+                from ray_tpu.core.runtime_context import require_runtime
+
+                require_runtime().head.retrying_call(
+                    "kv_del", _RDZV_NS, f"{rdzv_id}:{gang}".encode(),
+                    timeout=30)
+            except Exception:
+                pass
         try:
             user_fn(config)
         finally:
@@ -139,8 +151,13 @@ def prepare_data_loader(loader):
     ds = loader.dataset
     if not hasattr(ds, "__len__") or loader.batch_size is None:
         return loader
-    # Preserve the original shuffling intent: a sequential sampler means
-    # shuffle=False (DistributedSampler defaults to True).
+    # Only the two default samplers are replaceable without changing what
+    # the user asked for; a custom sampler (weighted, subset, ...) must
+    # survive — return the loader unchanged rather than silently retrain
+    # on a uniform distribution.
+    if not isinstance(loader.sampler,
+                      (tud.SequentialSampler, tud.RandomSampler)):
+        return loader
     shuffle = not isinstance(loader.sampler, tud.SequentialSampler)
     sampler = tud.distributed.DistributedSampler(
         ds, num_replicas=dist.get_world_size(), rank=dist.get_rank(),
