@@ -102,6 +102,8 @@ class HeadServer:
         import collections as _collections
 
         self._unmet_demand = _collections.deque(maxlen=512)
+        # Span sink for distributed tracing (util/tracing.py).
+        self._trace_ring = _collections.deque(maxlen=cfg.trace_ring_size)
         # submitter id -> (monotonic, [(resources, count)]) backlog reports
         self._backlogs: Dict[str, Tuple[float, list]] = {}
         # Cluster-wide task-event ring (reference: GcsTaskManager,
@@ -241,6 +243,18 @@ class HeadServer:
             if not n.alive:
                 n.alive = True  # node recovered
         return True
+
+    def rpc_trace_spans(self, conn, spans):
+        """Span sink (reference: trace export to the collector): every
+        process flushes finished spans here; ring-bounded."""
+        with self._lock:
+            self._trace_ring.extend(spans)
+        return True
+
+    def rpc_get_trace(self, conn, trace_id: str):
+        with self._lock:
+            return [s for s in self._trace_ring
+                    if s.get("trace_id") == trace_id]
 
     def rpc_publish(self, conn, channel: str, payload: Any):
         """Worker-side publishers (reference: per-worker publishers in

@@ -239,12 +239,37 @@ class WorkerRuntime(ClusterCore):
                 try:
                     func = (self._fetch_function(spec["func_digest"])
                             if "func_digest" in spec else spec["func"])
+                    traced = cfg.tracing_enabled and spec.get("trace")
                     if spec.get("streaming"):
-                        self._execute_streaming(owner, task_id, func, args,
-                                                kwargs, span,
-                                                spec.get("stream_ahead"))
+                        if traced:
+                            from ray_tpu.util import tracing
+
+                            try:
+                                with tracing.remote_span(
+                                        f"task:{name}", spec["trace"]):
+                                    self._execute_streaming(
+                                        owner, task_id, func, args, kwargs,
+                                        span, spec.get("stream_ahead"))
+                            finally:
+                                tracing.flush()
+                        else:
+                            self._execute_streaming(
+                                owner, task_id, func, args, kwargs, span,
+                                spec.get("stream_ahead"))
                         return
-                    result = func(*args, **kwargs)
+                    if traced:
+                        from ray_tpu.util import tracing
+
+                        # finally: a FAILED task's span (the one operators
+                        # most need) must ship now, not at the next flush.
+                        try:
+                            with tracing.remote_span(f"task:{name}",
+                                                     spec["trace"]):
+                                result = func(*args, **kwargs)
+                        finally:
+                            tracing.flush()
+                    else:
+                        result = func(*args, **kwargs)
                     self._send_results(owner, task_id, return_ids,
                                        value=result, span=span())
                     return

@@ -1198,6 +1198,12 @@ class ClusterCore:
         spec["args"] = tuple(args)
         spec["kwargs"] = dict(kwargs)
         spec["return_ids"] = [o.binary() for o in return_ids]
+        if cfg.tracing_enabled:
+            from ray_tpu.util import tracing
+
+            ctx = tracing.current()
+            if ctx is not None:
+                spec["trace"] = ctx
         spec_blob = SERIALIZER.encode(spec)
         if tmpl.spread:
             sched_key = _sched_key(tmpl.func, tmpl.resources, tmpl.strategy)

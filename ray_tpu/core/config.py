@@ -67,8 +67,17 @@ class Config:
             self._values[name] = value
 
     def apply_system_config(self, overrides: Dict[str, Any]) -> None:
+        """Driver-side _system_config: applied locally AND exported as
+        RTPU_* env vars so every process this one spawns (head, nodes,
+        workers) inherits the overrides — the docstring's "serialized to
+        workers" contract; without the export only the driver saw them."""
         for k, v in overrides.items():
             self.set(k, v)
+            if v is True or v is False:
+                raw = "1" if v else "0"
+            else:
+                raw = str(v)
+            os.environ[_ENV_PREFIX + k.upper()] = raw
 
     def snapshot(self) -> Dict[str, Any]:
         """Serializable view shipped to spawned workers."""
@@ -236,6 +245,11 @@ _d("metrics_export_port", int, 0,
    "port, -1 disables the exporter")
 _d("task_events_buffer_size", int, 10_000, "ring buffer of per-task state events")
 _d("event_stats_enabled", bool, True, "per-handler latency accounting")
+_d("tracing_enabled", bool, False,
+   "distributed spans: task specs carry the submitter's trace context, "
+   "executors open child spans, spans flush to the head trace ring "
+   "(reference: the opt-in OpenTelemetry hooks in util/tracing/)")
+_d("trace_ring_size", int, 20_000, "head-side retained span cap")
 
 # --- logging ---
 _d("log_dir", str, "/tmp/ray_tpu/logs", "per-process log files")

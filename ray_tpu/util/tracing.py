@@ -1,0 +1,175 @@
+"""Distributed tracing: spans that follow tasks across processes.
+
+Parity target: the reference's opt-in OpenTelemetry integration
+(reference: python/ray/util/tracing/tracing_helper.py — monkeypatched
+submit/execute hooks propagating a trace context through task metadata)
+re-designed in-runtime: when ``tracing_enabled`` is on, every task spec
+carries its submitter's (trace_id, span_id); executors open a child span
+around the user function, and finished spans flush to the head's trace
+ring. ``get_trace`` assembles the cross-process tree; ``to_chrome_trace``
+renders it for chrome://tracing (alongside util/timeline.py's scheduler-
+level events).
+
+    from ray_tpu.util import tracing
+    with tracing.trace("pipeline-run") as t:
+        ray_tpu.get(step.remote(x))      # worker spans parent to this one
+    spans = tracing.get_trace(t.trace_id)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+_local = threading.local()
+_buffer: List[Dict[str, Any]] = []
+_buffer_lock = threading.Lock()
+_FLUSH_AT = 64
+
+
+def enabled() -> bool:
+    return bool(cfg.tracing_enabled)
+
+
+def current() -> Optional[Dict[str, str]]:
+    """The active span's wire context {trace_id, span_id}, or None."""
+    span = getattr(_local, "span", None)
+    if span is None:
+        return None
+    return {"trace_id": span["trace_id"], "span_id": span["span_id"]}
+
+
+def _record(span: Dict[str, Any]) -> None:
+    with _buffer_lock:
+        _buffer.append(span)
+        flush_now = len(_buffer) >= _FLUSH_AT
+    if flush_now:
+        flush()
+
+
+def flush() -> None:
+    """Ship buffered spans to the head (best-effort; spans are telemetry)."""
+    with _buffer_lock:
+        spans, _buffer[:] = list(_buffer), []
+    if not spans:
+        return
+    try:
+        from ray_tpu.core.runtime_context import get_runtime
+
+        rt = get_runtime()
+        if rt is None or not hasattr(rt, "head"):
+            return
+        rt.head.notify("trace_spans", spans)
+    except Exception:
+        pass
+
+
+class _SpanHandle:
+    def __init__(self, span: Dict[str, Any]):
+        self._span = span
+        self.trace_id = span["trace_id"]
+        self.span_id = span["span_id"]
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self._span["attrs"][key] = value
+
+
+@contextlib.contextmanager
+def trace(name: str, attrs: Optional[Dict[str, Any]] = None):
+    """Open a ROOT span (a fresh trace id). No-op handle when disabled."""
+    with _span_impl(name, attrs, new_trace=True) as h:
+        yield h
+
+
+@contextlib.contextmanager
+def span(name: str, attrs: Optional[Dict[str, Any]] = None):
+    """Open a child span of the current context (or a root if none)."""
+    with _span_impl(name, attrs, new_trace=False) as h:
+        yield h
+
+
+@contextlib.contextmanager
+def _span_impl(name, attrs, new_trace: bool,
+               remote_parent: Optional[Dict[str, str]] = None):
+    if not enabled():
+        yield _SpanHandle({"trace_id": "", "span_id": "", "attrs": {}})
+        return
+    parent = getattr(_local, "span", None)
+    if remote_parent is not None:
+        trace_id = remote_parent["trace_id"]
+        parent_id = remote_parent["span_id"]
+    elif parent is not None and not new_trace:
+        trace_id = parent["trace_id"]
+        parent_id = parent["span_id"]
+    else:
+        trace_id = uuid.uuid4().hex[:16]
+        parent_id = ""
+    rec = {
+        "trace_id": trace_id,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent_id,
+        "name": name,
+        "start": time.time(),
+        "end": None,
+        "attrs": dict(attrs or {}),
+        "ok": True,
+    }
+    token = parent
+    _local.span = rec
+    try:
+        yield _SpanHandle(rec)
+    except BaseException:
+        rec["ok"] = False
+        raise
+    finally:
+        rec["end"] = time.time()
+        _local.span = token
+        _record(rec)
+
+
+@contextlib.contextmanager
+def remote_span(name: str, wire_ctx: Optional[Dict[str, str]]):
+    """Executor-side: a span parented to a context that crossed the wire
+    (the task spec's trace field). Used by the worker runtime."""
+    with _span_impl(name, None, new_trace=False,
+                    remote_parent=wire_ctx) as h:
+        yield h
+
+
+# ---------------------------------------------------------------- queries
+
+
+def get_trace(trace_id: str, timeout: float = 10.0) -> List[Dict[str, Any]]:
+    """All spans of a trace collected at the head (flushes local first)."""
+    from ray_tpu.core.runtime_context import require_runtime
+
+    flush()
+    rt = require_runtime()
+    return rt.head.retrying_call("get_trace", trace_id, timeout=timeout)
+
+
+def to_chrome_trace(trace_id: str, path: Optional[str] = None):
+    """Render one trace as chrome://tracing JSON (one row per span name)."""
+    import json
+
+    spans = get_trace(trace_id)
+    events = []
+    for s in spans:
+        events.append({
+            "name": s["name"], "ph": "X", "pid": "trace",
+            "tid": s["name"].split(":")[0],
+            "ts": s["start"] * 1e6,
+            "dur": max(((s["end"] or s["start"]) - s["start"]) * 1e6, 1),
+            "args": dict(s.get("attrs") or {},
+                         span_id=s["span_id"], parent=s["parent_id"],
+                         ok=s.get("ok", True)),
+        })
+    if path:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+    return events

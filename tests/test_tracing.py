@@ -1,0 +1,127 @@
+"""Distributed tracing spans (reference analog: the opt-in OpenTelemetry
+integration in python/ray/util/tracing/ — context propagation through task
+metadata, executor-side child spans)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def traced_cluster():
+    import os
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    rt = ray_tpu.init(num_cpus=2, ignore_reinit_error=True,
+                      _system_config={"tracing_enabled": True})
+    yield rt
+    ray_tpu.shutdown()
+    # _system_config exports RTPU_* env for child processes; undo so the
+    # rest of the suite (same pytest process) runs untraced.
+    GLOBAL_CONFIG.set("tracing_enabled", False)
+    os.environ.pop("RTPU_TRACING_ENABLED", None)
+
+
+def test_span_context_propagates_to_workers(traced_cluster):
+    """Driver root span -> task child span (another process), linked by
+    trace_id/parent_id at the head's trace ring."""
+    @ray_tpu.remote
+    def traced_work(x):
+        from ray_tpu.util import tracing as t
+
+        with t.span("inner-compute") as s:
+            s.set_attribute("x", x)
+        t.flush()
+        return x * 2
+
+    with tracing.trace("pipeline") as root:
+        assert ray_tpu.get(traced_work.remote(21), timeout=60) == 42
+    trace_id = root.trace_id
+    assert trace_id
+
+    deadline = time.time() + 15
+    spans = []
+    while time.time() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if len(spans) >= 3:
+            break
+        time.sleep(0.3)
+    names = {s["name"] for s in spans}
+    assert "pipeline" in names, names
+    assert any(n.startswith("task:") for n in names), names
+    assert "inner-compute" in names, names
+    by_id = {s["span_id"]: s for s in spans}
+    task_span = next(s for s in spans if s["name"].startswith("task:"))
+    # The executor-side span parents to the DRIVER's root across the wire.
+    assert task_span["parent_id"] == root.span_id
+    inner = next(s for s in spans if s["name"] == "inner-compute")
+    assert inner["parent_id"] == task_span["span_id"]
+    assert inner["attrs"] == {"x": 21}
+    assert by_id[inner["parent_id"]]["trace_id"] == trace_id
+
+
+def test_nested_tasks_chain_spans(traced_cluster):
+    """task -> nested task: the chain stays on one trace."""
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    def mid():
+        return ray_tpu.get(leaf.remote()) + 1
+
+    with tracing.trace("root") as root:
+        assert ray_tpu.get(mid.remote(), timeout=60) == 2
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        spans = tracing.get_trace(root.trace_id)
+        if len([s for s in spans if s["name"].startswith("task:")]) >= 2:
+            break
+        time.sleep(0.3)
+    task_spans = [s for s in spans if s["name"].startswith("task:")]
+    assert len(task_spans) >= 2, spans
+    # leaf's span parents to mid's span, not to the root directly.
+    leaf_span = next(s for s in task_spans if "leaf" in s["name"])
+    mid_span = next(s for s in task_spans if "mid" in s["name"])
+    assert leaf_span["parent_id"] == mid_span["span_id"]
+
+
+def test_chrome_trace_export(traced_cluster, tmp_path):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with tracing.trace("export-me") as root:
+        ray_tpu.get(f.remote(), timeout=60)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if len(tracing.get_trace(root.trace_id)) >= 2:
+            break
+        time.sleep(0.3)
+    out = str(tmp_path / "trace.json")
+    events = tracing.to_chrome_trace(root.trace_id, out)
+    assert events and all(e["ph"] == "X" for e in events)
+    import json
+
+    assert json.load(open(out))["traceEvents"]
+
+
+def test_disabled_tracing_is_free():
+    """Without the flag, spans are no-op handles and nothing buffers."""
+    import ray_tpu.core.config as c
+
+    assert not c.GLOBAL_CONFIG.tracing_enabled or True  # flag may be on
+    # Direct check of the library behavior with the flag off:
+    old = c.GLOBAL_CONFIG.get("tracing_enabled")
+    c.GLOBAL_CONFIG.set("tracing_enabled", False)
+    try:
+        with tracing.trace("nothing") as h:
+            assert h.trace_id == ""
+        assert tracing.current() is None
+    finally:
+        c.GLOBAL_CONFIG.set("tracing_enabled", old)
