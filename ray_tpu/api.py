@@ -98,6 +98,7 @@ def shutdown() -> None:
             pass
         rt.shutdown()
         runtime_context.set_runtime(None)
+        GLOBAL_CONFIG.clear_exported_env()
 
 
 def put(value: Any, *, _owner=None) -> ObjectRef:

@@ -240,36 +240,35 @@ class WorkerRuntime(ClusterCore):
                     func = (self._fetch_function(spec["func_digest"])
                             if "func_digest" in spec else spec["func"])
                     traced = cfg.tracing_enabled and spec.get("trace")
-                    if spec.get("streaming"):
-                        if traced:
-                            from ray_tpu.util import tracing
-
-                            try:
-                                with tracing.remote_span(
-                                        f"task:{name}", spec["trace"]):
-                                    self._execute_streaming(
-                                        owner, task_id, func, args, kwargs,
-                                        span, spec.get("stream_ahead"))
-                            finally:
-                                tracing.flush()
-                        else:
-                            self._execute_streaming(
-                                owner, task_id, func, args, kwargs, span,
-                                spec.get("stream_ahead"))
-                        return
                     if traced:
-                        from ray_tpu.util import tracing
+                        from ray_tpu.util import tracing as _tracing
 
-                        # finally: a FAILED task's span (the one operators
-                        # most need) must ship now, not at the next flush.
-                        try:
-                            with tracing.remote_span(f"task:{name}",
-                                                     spec["trace"]):
-                                result = func(*args, **kwargs)
-                        finally:
-                            tracing.flush()
+                        span_cm = _tracing.remote_span(f"task:{name}",
+                                                       spec["trace"])
                     else:
-                        result = func(*args, **kwargs)
+                        import contextlib as _contextlib
+
+                        span_cm = _contextlib.nullcontext()
+                    # finally-flush: a FAILED task's span (the one
+                    # operators most need) must ship now, not at the
+                    # next buffer high-water mark.
+                    try:
+                        with span_cm as span_h:
+                            if spec.get("streaming"):
+                                ok = self._execute_streaming(
+                                    owner, task_id, func, args, kwargs,
+                                    span, spec.get("stream_ahead"))
+                                if not ok and span_h is not None and \
+                                        hasattr(span_h, "_span"):
+                                    # streaming converts exceptions into
+                                    # stream_end records; reflect the
+                                    # failure on the span ourselves.
+                                    span_h._span["ok"] = False
+                                return
+                            result = func(*args, **kwargs)
+                    finally:
+                        if traced:
+                            _tracing.flush()
                     self._send_results(owner, task_id, return_ids,
                                        value=result, span=span())
                     return
@@ -292,7 +291,7 @@ class WorkerRuntime(ClusterCore):
 
 
     def _execute_streaming(self, owner: str, task_id, func, args, kwargs,
-                           span, stream_ahead=None) -> None:
+                           span, stream_ahead=None) -> bool:
         """Run a streaming-generator task: each yield seals one object and
         ships to the owner INCREMENTALLY (reference: streaming-generator
         execution feeding task_manager.h:212 refs) — the full output never
@@ -363,6 +362,7 @@ class WorkerRuntime(ClusterCore):
             err = capture_exception(e)
         self._enqueue_done(owner, ("stream_end",
                                    (task_id_bytes, index, err, span())))
+        return err is None
 
     def _resolve_args(self, args, kwargs):
         def res(a):

@@ -44,6 +44,7 @@ class Config:
         self._flags: Dict[str, _Flag] = {}
         self._values: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        self._exported_env: set = set()
 
     def define(self, name: str, typ: type, default: Any, doc: str = "") -> None:
         flag = _Flag(name, typ, default, doc)
@@ -77,7 +78,19 @@ class Config:
                 raw = "1" if v else "0"
             else:
                 raw = str(v)
-            os.environ[_ENV_PREFIX + k.upper()] = raw
+            env_key = _ENV_PREFIX + k.upper()
+            if env_key not in os.environ:
+                self._exported_env.add(env_key)
+            os.environ[env_key] = raw
+
+    def clear_exported_env(self) -> None:
+        """Drop env exports this process's apply_system_config created
+        (called by shutdown so a later init — or unrelated subprocesses —
+        start from defaults, not a previous cluster's overrides). Values
+        the USER set in the environment before init are left alone."""
+        for env_key in self._exported_env:
+            os.environ.pop(env_key, None)
+        self._exported_env.clear()
 
     def snapshot(self) -> Dict[str, Any]:
         """Serializable view shipped to spawned workers."""

@@ -16,6 +16,7 @@ from ray_tpu.serve._private.router import Router
 
 _TIMEOUT_UNSET = object()
 
+
 _lock = threading.Lock()
 
 
@@ -311,19 +312,114 @@ def deployment(_cls: Optional[type] = None, *, name: Optional[str] = None,
 
 
 def run(target: Deployment, *, name: Optional[str] = None,
-        _blocking: bool = True) -> DeploymentHandle:
+        _blocking: bool = True,
+        _local_testing_mode: bool = False) -> "DeploymentHandle":
     """Deploy (or update) and return a handle (reference serve.run :499).
 
     Composition: bound Deployments may appear in another deployment's
     ``.bind(...)`` args — each is deployed and replaced by a
     DeploymentHandle before the parent's replicas construct (reference:
     deployment graphs via DeploymentNode/handle injection), so deployments
-    call deployments through ordinary handles."""
+    call deployments through ordinary handles.
+
+    ``_local_testing_mode`` (reference: serve/_private/local_testing_mode
+    .py): construct the app IN-PROCESS — no cluster, controller, replicas
+    or RPC — returning handles with the same .remote()/.result() surface.
+    For unit-testing deployment logic with zero infrastructure."""
     if not isinstance(target, Deployment):
         raise TypeError("serve.run expects a Deployment "
                         "(apply @serve.deployment and .bind() first)")
+    if _local_testing_mode:
+        return _build_local(target)
     controller = _get_or_start_controller()
     return _deploy_graph(controller, target, name or target.name)
+
+
+class _LocalResponse:
+    """Matches DeploymentResponse's surface for local-mode calls. Each
+    call runs on its OWN thread: composed deployments block a calling
+    thread in .result() while the sub-call runs, so a shared bounded pool
+    would deadlock under fan-out (all threads waiting on work queued
+    behind them)."""
+
+    def __init__(self, fn, args, kwargs):
+        import concurrent.futures as _f
+
+        self._fut: "_f.Future" = _f.Future()
+
+        def run():
+            try:
+                self._fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — delivered to result()
+                self._fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True,
+                         name="serve-local-call").start()
+
+    def result(self, timeout: Any = _TIMEOUT_UNSET):
+        """Same default-deadline contract as the real handle."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        if timeout is _TIMEOUT_UNSET:
+            timeout = cfg.serve_handle_timeout_s
+        return self._fut.result(timeout=timeout)
+
+    async def result_async(self, timeout: Optional[float] = None):
+        import asyncio
+
+        return await asyncio.wait_for(asyncio.wrap_future(self._fut),
+                                      timeout)
+
+
+class _LocalMethod:
+    def __init__(self, fn, stream: bool = False):
+        self._fn = fn
+        self._stream = stream
+
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return iter(self._fn(*args, **kwargs))
+        return _LocalResponse(self._fn, args, kwargs)
+
+
+class LocalDeploymentHandle:
+    """In-process handle: calls hit the instance directly (one thread per
+    call, so .remote() stays non-blocking like the real handle)."""
+
+    def __init__(self, instance: Any, method_name: str = "__call__",
+                 stream: bool = False):
+        self._instance = instance
+        self._method = method_name
+        self._stream = stream
+
+    def __getattr__(self, item: str) -> _LocalMethod:
+        return _LocalMethod(getattr(self._instance, item))
+
+    def remote(self, *args, **kwargs):
+        return _LocalMethod(getattr(self._instance, self._method),
+                            self._stream).remote(*args, **kwargs)
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                **_ignored) -> "LocalDeploymentHandle":
+        """Honors the routing options the real handle honors (a local
+        handle that silently called __call__ for options(method_name=...)
+        would defeat the mode's emulate-production purpose)."""
+        return LocalDeploymentHandle(
+            self._instance,
+            method_name=self._method if method_name is None else method_name,
+            stream=self._stream if stream is None else stream)
+
+
+def _build_local(dep: Deployment) -> LocalDeploymentHandle:
+    def resolve(v):
+        if isinstance(v, Deployment):
+            return _build_local(v)
+        return v
+
+    args = tuple(resolve(a) for a in dep._init_args)
+    kwargs = {k: resolve(v) for k, v in dep._init_kwargs.items()}
+    return LocalDeploymentHandle(dep._cls(*args, **kwargs))
 
 
 def _deploy_graph(controller, dep: Deployment,

@@ -19,6 +19,7 @@ level events).
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
 import uuid
@@ -26,7 +27,11 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
-_local = threading.local()
+# ContextVar, not threading.local: concurrent asyncio coroutines on one
+# event-loop thread must not cross-contaminate span parentage (same reason
+# core/runtime_context uses ContextVar for the worker context).
+_current_span: "contextvars.ContextVar[Optional[Dict[str, Any]]]" = \
+    contextvars.ContextVar("rtpu_span", default=None)
 _buffer: List[Dict[str, Any]] = []
 _buffer_lock = threading.Lock()
 _FLUSH_AT = 64
@@ -38,7 +43,7 @@ def enabled() -> bool:
 
 def current() -> Optional[Dict[str, str]]:
     """The active span's wire context {trace_id, span_id}, or None."""
-    span = getattr(_local, "span", None)
+    span = _current_span.get()
     if span is None:
         return None
     return {"trace_id": span["trace_id"], "span_id": span["span_id"]}
@@ -99,7 +104,7 @@ def _span_impl(name, attrs, new_trace: bool,
     if not enabled():
         yield _SpanHandle({"trace_id": "", "span_id": "", "attrs": {}})
         return
-    parent = getattr(_local, "span", None)
+    parent = _current_span.get()
     if remote_parent is not None:
         trace_id = remote_parent["trace_id"]
         parent_id = remote_parent["span_id"]
@@ -119,8 +124,7 @@ def _span_impl(name, attrs, new_trace: bool,
         "attrs": dict(attrs or {}),
         "ok": True,
     }
-    token = parent
-    _local.span = rec
+    token = _current_span.set(rec)
     try:
         yield _SpanHandle(rec)
     except BaseException:
@@ -128,7 +132,7 @@ def _span_impl(name, attrs, new_trace: bool,
         raise
     finally:
         rec["end"] = time.time()
-        _local.span = token
+        _current_span.reset(token)
         _record(rec)
 
 

@@ -1,0 +1,58 @@
+"""serve local testing mode (reference: serve/_private/local_testing_mode
+.py — run an app in-process with zero cluster infrastructure)."""
+
+import pytest
+
+from ray_tpu import serve
+
+
+def test_local_mode_needs_no_cluster():
+    """No ray_tpu.init anywhere: the app constructs and serves in-process."""
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def plus(self, x, y):
+            return x + y
+
+    h = serve.run(Doubler.bind(), _local_testing_mode=True)
+    assert h.remote(21).result(timeout=10) == 42
+    assert h.plus.remote(1, y=2).result(timeout=10) == 3
+
+
+def test_local_mode_composition():
+    """Bound sub-deployments arrive as local handles, same as the real
+    data plane's handle injection."""
+    @serve.deployment
+    class Tokenizer:
+        def __call__(self, text):
+            return text.split()
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, tok):
+            self.tok = tok
+
+        def __call__(self, text):
+            return len(self.tok.remote(text).result(timeout=10))
+
+    h = serve.run(Pipeline.bind(Tokenizer.bind()),
+                  _local_testing_mode=True)
+    assert h.remote("a b c d").result(timeout=10) == 4
+
+
+def test_local_mode_async_result():
+    import asyncio
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), _local_testing_mode=True)
+
+    async def go():
+        return await h.remote("hi").result_async(timeout=10)
+
+    assert asyncio.run(go()) == "hi"
