@@ -1,0 +1,311 @@
+"""DDPM-style diffusion U-Net, TPU-first (pure-functional JAX pytree params).
+
+Fifth model family in the zoo (decoder llama/mixtral, seq2seq t5, vision
+vit): image GENERATION, the conv-heavy workload class — convolutions map
+onto the MXU like matmuls when channel dims stay wide and batched, so the
+same logical-axis sharding tables apply ("channels" shards like "mlp").
+The reference framework orchestrates torch diffusion models it does not
+own (reference: python/ray/train — framework-agnostic orchestration; the
+air examples run stable-diffusion fine-tunes through it); here the model
+is native so sharding/remat are co-designed.
+
+Pieces:
+- sinusoidal timestep embedding -> 2-layer MLP, injected per resblock
+- U-Net: conv downs (stride-2) / residual blocks with GroupNorm-lite /
+  conv ups (resize + conv) with skip concats
+- DDPM cosine schedule, epsilon-prediction loss, ancestral sampler
+  (lax.scan over steps — O(1) compile in step count)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax import lax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionConfig:
+    image_size: int = 32
+    channels: int = 3
+    base_width: int = 64
+    widths: Tuple[int, ...] = (64, 128, 256)   # per resolution level
+    time_dim: int = 128
+    num_steps: int = 1000                      # diffusion timesteps
+    norm_groups: int = 8
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        levels = len(self.widths)
+        down_factor = 2 ** (levels - 1)
+        if self.image_size % down_factor:
+            raise ValueError(
+                f"image_size {self.image_size} must be divisible by "
+                f"2**(len(widths)-1) = {down_factor} (the up path would "
+                f"resize past a mismatched skip resolution)")
+        if self.time_dim % 2:
+            raise ValueError("time_dim must be even (sin/cos halves)")
+        for w in self.widths:
+            if w % min(self.norm_groups, w):
+                raise ValueError(
+                    f"width {w} not divisible by norm_groups "
+                    f"{self.norm_groups}")
+
+    def param_count(self) -> int:
+        def conv(cin, cout, k=3):
+            return k * k * cin * cout
+
+        def block(cin, cout):
+            n = (cin + conv(cin, cout) + self.time_dim * cout + cout
+                 + conv(cout, cout))
+            if cin != cout:
+                n += cin * cout  # skip projection only when widths change
+            return n
+
+        td = self.time_dim
+        total = (td * td * 2 + td * 2) + (td * 2 * td + td)  # time mlp
+        total += conv(self.channels, self.widths[0])
+        n_lvls = len(self.widths)
+        for i in range(n_lvls):
+            cin = self.widths[i - 1] if i else self.widths[0]
+            total += block(cin, self.widths[i])
+            if i < n_lvls - 1:
+                total += conv(self.widths[i], self.widths[i])
+        total += block(self.widths[-1], self.widths[-1])  # mid
+        for i in reversed(range(n_lvls - 1)):
+            total += conv(self.widths[i + 1], self.widths[i])
+            total += block(self.widths[i] * 2, self.widths[i])
+        total += self.widths[0] + conv(self.widths[0], self.channels)
+        return total
+
+
+def tiny_config(**kw) -> DiffusionConfig:
+    base = dict(image_size=8, channels=1, base_width=16,
+                widths=(16, 32), time_dim=32, num_steps=64, norm_groups=4)
+    base.update(kw)
+    return DiffusionConfig(**base)
+
+
+# ---------------------------------------------------------------- schedule
+
+def cosine_schedule(cfg: DiffusionConfig) -> Dict[str, jnp.ndarray]:
+    """Nichol & Dhariwal cosine alphas (re-derived)."""
+    t = jnp.linspace(0, 1, cfg.num_steps + 1)
+    f = jnp.cos((t + 0.008) / 1.008 * jnp.pi / 2) ** 2
+    alpha_bar = f / f[0]
+    betas = jnp.clip(1 - alpha_bar[1:] / alpha_bar[:-1], 0, 0.999)
+    alphas = 1.0 - betas
+    return {
+        "betas": betas,
+        "alphas": alphas,
+        "alpha_bar": jnp.cumprod(alphas),
+    }
+
+
+# ---------------------------------------------------------------- params
+
+def _conv_axes():
+    return ("kh", "kw", "c_in", "channels")
+
+
+def param_logical_axes(cfg: DiffusionConfig) -> Params:
+    def block_axes(has_skip: bool):
+        out = {
+            "norm1": ("channels",), "conv1": _conv_axes(),
+            "time_proj": ("embed", "channels"),
+            "norm2": ("channels",), "conv2": _conv_axes(),
+        }
+        if has_skip:
+            out["skip"] = ("c_in", "channels")
+        return out
+
+    tree: Params = {
+        "time_mlp": {"w1": ("embed", "mlp"), "b1": ("mlp",),
+                     "w2": ("mlp", "embed"), "b2": ("embed",)},
+        "conv_in": _conv_axes(),
+        "downs": [], "ups": [],
+        "mid": block_axes(False),
+        "norm_out": ("channels",),
+        # Output conv maps back to IMAGE channels (1-3): never sharded.
+        "conv_out": ("kh", "kw", "c_in", None),
+    }
+    n = len(cfg.widths)
+    for i in range(n):
+        cin = cfg.widths[i - 1] if i else cfg.widths[0]
+        level = {"block": block_axes(cin != cfg.widths[i])}
+        if i < n - 1:
+            level["down"] = _conv_axes()
+        tree["downs"].append(level)
+    for i in range(n - 1):
+        tree["ups"].append({"up": _conv_axes(),
+                            "block": block_axes(True)})
+    return tree
+
+
+def init_params(cfg: DiffusionConfig, key: jax.Array) -> Params:
+    dt = cfg.dtype
+    counter = [0]
+
+    def nk():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    def conv(cin, cout, k=3):
+        fan = k * k * cin
+        return (jax.random.normal(nk(), (k, k, cin, cout), jnp.float32)
+                * fan ** -0.5).astype(dt)
+
+    def dense(cin, cout):
+        return (jax.random.normal(nk(), (cin, cout), jnp.float32)
+                * cin ** -0.5).astype(dt)
+
+    def block(cin, cout):
+        out = {
+            "norm1": jnp.ones((cin,), dt),
+            "conv1": conv(cin, cout),
+            "time_proj": dense(cfg.time_dim, cout),
+            "norm2": jnp.ones((cout,), dt),
+            "conv2": conv(cout, cout),
+        }
+        if cin != cout:  # identity residual needs no projection
+            out["skip"] = dense(cin, cout)
+        return out
+
+    td = cfg.time_dim
+    params: Params = {
+        "time_mlp": {"w1": dense(td, td * 2), "b1": jnp.zeros((td * 2,), dt),
+                     "w2": dense(td * 2, td), "b2": jnp.zeros((td,), dt)},
+        "conv_in": conv(cfg.channels, cfg.widths[0]),
+        "downs": [], "ups": [],
+        "mid": block(cfg.widths[-1], cfg.widths[-1]),
+        "norm_out": jnp.ones((cfg.widths[0],), dt),
+        "conv_out": conv(cfg.widths[0], cfg.channels),
+    }
+    n = len(cfg.widths)
+    for i in range(n):
+        level = {"block": block(cfg.widths[i - 1] if i else cfg.widths[0],
+                                cfg.widths[i])}
+        if i < n - 1:
+            level["down"] = conv(cfg.widths[i], cfg.widths[i])
+        params["downs"].append(level)
+    for i in reversed(range(n - 1)):
+        params["ups"].append({
+            "up": conv(cfg.widths[i + 1], cfg.widths[i]),
+            # after skip-concat the block sees widths[i] (up) + widths[i]
+            "block": block(cfg.widths[i] * 2, cfg.widths[i]),
+        })
+    return params
+
+
+# ---------------------------------------------------------------- forward
+
+def _group_norm(x, scale, groups: int):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    xg = x.reshape(b, h, w, g, c // g).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + 1e-5)
+    return (xg.reshape(b, h, w, c) * scale).astype(x.dtype)
+
+
+def _conv2d(x, w, stride: int = 1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _resblock(x, p, temb, cfg: DiffusionConfig):
+    h = _conv2d(jax.nn.silu(_group_norm(x, p["norm1"], cfg.norm_groups)),
+                p["conv1"])
+    h = h + (temb @ p["time_proj"])[:, None, None, :].astype(h.dtype)
+    h = _conv2d(jax.nn.silu(_group_norm(h, p["norm2"], cfg.norm_groups)),
+                p["conv2"])
+    return h + (x @ p["skip"] if "skip" in p else x)
+
+
+def _time_embedding(t, cfg: DiffusionConfig):
+    """Sinusoidal timestep features -> MLP. t: [B] float in [0, steps)."""
+    half = cfg.time_dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    ang = t[:, None].astype(jnp.float32) * freqs[None, :]
+    emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    return emb.astype(cfg.dtype)
+
+
+def forward(params: Params, x: jnp.ndarray, t: jnp.ndarray,
+            cfg: DiffusionConfig) -> jnp.ndarray:
+    """Predict the noise eps: x [B,H,W,C], t [B] -> [B,H,W,C]."""
+    mlp = params["time_mlp"]
+    temb = _time_embedding(t, cfg)
+    temb = jax.nn.silu(temb @ mlp["w1"] + mlp["b1"]) @ mlp["w2"] + mlp["b2"]
+
+    h = _conv2d(x.astype(cfg.dtype), params["conv_in"])
+    skips = []
+    for level in params["downs"]:
+        h = _resblock(h, level["block"], temb, cfg)
+        if "down" in level:
+            # Only pre-downsample activations become skips: the deepest
+            # level feeds mid directly at the same resolution.
+            skips.append(h)
+            h = _conv2d(h, level["down"], stride=2)
+    h = _resblock(h, params["mid"], temb, cfg)
+    for up in params["ups"]:
+        b, hh, ww, c = h.shape
+        h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+        h = _conv2d(h, up["up"])
+        h = jnp.concatenate([h, skips.pop()], axis=-1)
+        h = _resblock(h, up["block"], temb, cfg)
+    h = jax.nn.silu(_group_norm(h, params["norm_out"], cfg.norm_groups))
+    return _conv2d(h, params["conv_out"]).astype(jnp.float32)
+
+
+def loss_fn(params: Params, x0: jnp.ndarray, key: jax.Array,
+            cfg: DiffusionConfig,
+            schedule: Optional[Dict[str, jnp.ndarray]] = None
+            ) -> Tuple[jnp.ndarray, Dict]:
+    """Epsilon-prediction MSE at uniformly sampled timesteps."""
+    sched = schedule if schedule is not None else cosine_schedule(cfg)
+    b = x0.shape[0]
+    kt, ke = jax.random.split(key)
+    t = jax.random.randint(kt, (b,), 0, cfg.num_steps)
+    eps = jax.random.normal(ke, x0.shape, jnp.float32)
+    ab = sched["alpha_bar"][t][:, None, None, None]
+    xt = jnp.sqrt(ab) * x0 + jnp.sqrt(1 - ab) * eps
+    pred = forward(params, xt, t.astype(jnp.float32), cfg)
+    loss = jnp.mean((pred - eps) ** 2)
+    return loss, {"loss": loss}
+
+
+def sample(params: Params, key: jax.Array, cfg: DiffusionConfig,
+           batch: int = 4,
+           schedule: Optional[Dict[str, jnp.ndarray]] = None) -> jnp.ndarray:
+    """Ancestral DDPM sampling via lax.scan (static shapes, one compile)."""
+    sched = schedule if schedule is not None else cosine_schedule(cfg)
+    shape = (batch, cfg.image_size, cfg.image_size, cfg.channels)
+    key, k0 = jax.random.split(key)
+    x = jax.random.normal(k0, shape, jnp.float32)
+
+    def step(carry, t):
+        x, key = carry
+        key, kn = jax.random.split(key)
+        tb = jnp.full((batch,), t, jnp.float32)
+        eps = forward(params, x, tb, cfg)
+        alpha = sched["alphas"][t]
+        ab = sched["alpha_bar"][t]
+        mean = (x - (1 - alpha) / jnp.sqrt(1 - ab) * eps) / jnp.sqrt(alpha)
+        noise = jnp.where(t > 0,
+                          jnp.sqrt(sched["betas"][t])
+                          * jax.random.normal(kn, shape, jnp.float32),
+                          jnp.zeros(shape, jnp.float32))
+        return (mean + noise, key), None
+
+    (x, _), _ = lax.scan(step, (x, key),
+                         jnp.arange(cfg.num_steps - 1, -1, -1))
+    return x

@@ -107,6 +107,10 @@ DEFAULT_RULES: Dict[str, Optional[object]] = {
     "kv_len": None,
     "patch_in": None,          # ViT flattened-patch input dim
     "classes": "tp",           # classifier head over tensor parallel
+    "kh": None,                # conv kernel spatial dims (diffusion UNet)
+    "kw": None,
+    "c_in": None,              # conv input channels
+    "channels": "tp",          # conv output channels over tensor parallel
 }
 
 
