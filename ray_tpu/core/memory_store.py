@@ -158,19 +158,11 @@ class MemoryStore:
                     return ready
                 self._cv.wait(timeout=remaining)
 
-    def ready_subset(self, object_ids, limit: int) -> Set[ObjectID]:
-        """First ``limit`` already-present ids, one lock pass, no waiting:
-        the fast path for wait() over mostly-ready ref lists (the
-        reference-shaped pop-1-of-1k wait loop is O(n^2) callback churn
-        without this)."""
-        out: Set[ObjectID] = set()
-        with self._lock:
-            for oid in object_ids:
-                if oid in self._objects:
-                    out.add(oid)
-                    if len(out) >= limit:
-                        break
-        return out
+    def objects_view(self):
+        """The live id->record dict for GIL-atomic membership probes (the
+        wait() hot path fuses readiness into its validation pass; callers
+        must only do `in` checks, never read values or iterate)."""
+        return self._objects
 
     def delete(self, object_ids: List[ObjectID]) -> List[ObjectID]:
         """Returns the subset whose record was MEMORY-RESIDENT (present

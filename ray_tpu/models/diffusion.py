@@ -34,7 +34,6 @@ Params = Dict[str, Any]
 class DiffusionConfig:
     image_size: int = 32
     channels: int = 3
-    base_width: int = 64
     widths: Tuple[int, ...] = (64, 128, 256)   # per resolution level
     time_dim: int = 128
     num_steps: int = 1000                      # diffusion timesteps
@@ -86,7 +85,7 @@ class DiffusionConfig:
 
 
 def tiny_config(**kw) -> DiffusionConfig:
-    base = dict(image_size=8, channels=1, base_width=16,
+    base = dict(image_size=8, channels=1,
                 widths=(16, 32), time_dim=32, num_steps=64, norm_groups=4)
     base.update(kw)
     return DiffusionConfig(**base)

@@ -8,7 +8,8 @@ import optax
 import pytest
 
 from ray_tpu.models import diffusion
-from ray_tpu.parallel.mesh import MeshSpec, logical_spec, make_mesh
+from ray_tpu.parallel.mesh import (MeshSpec, logical_spec, make_mesh,
+                                   param_shardings)
 
 
 def test_forward_shapes_and_determinism():
@@ -115,13 +116,8 @@ def test_diffusion_sharded_train_step_8dev():
 
     with mesh:
         params = diffusion.init_params(cfg, jax.random.PRNGKey(0))
-        # Default leaf detection: params' leaves are arrays, so the axes
-        # tree's TUPLES arrive whole at each mapped call (the dict-only
-        # models used a custom is_leaf; diffusion's tree mixes lists).
         sharded = jax.tree_util.tree_map(
-            lambda p, names: jax.device_put(
-                p, jax.sharding.NamedSharding(mesh, logical_spec(names))),
-            params, axes)
+            jax.device_put, params, param_shardings(mesh, axes))
         x0 = jax.device_put(
             jnp.ones((8, 8, 8, 1), jnp.float32),
             jax.sharding.NamedSharding(
