@@ -31,10 +31,16 @@ def test_infeasible_demand_triggers_scale_up_then_idle_reap(cluster):
     # Infeasible on the 2-CPU head node: the lease layer records unmet
     # demand at the head while the task stays queued.
     refs = [big.remote() for _ in range(2)]
-    time.sleep(1.0)
 
-    did = scaler.step()
-    assert did["launched"], "no scale-up despite infeasible demand"
+    # The demand report rides the lease/spillback path asynchronously: on
+    # a loaded host one fixed sleep raced it (suite-order flake). Poll the
+    # scale-up decision instead of betting on a single instant.
+    deadline = time.monotonic() + 30
+    launched = []
+    while time.monotonic() < deadline and not launched:
+        time.sleep(1.0)
+        launched = scaler.step()["launched"]
+    assert launched, "no scale-up despite infeasible demand"
     # The queued tasks complete on the new capacity.
     nids = ray_tpu.get(refs, timeout=120)
     assert len(provider.non_terminated_nodes()) >= 1
