@@ -40,6 +40,11 @@ class Autoscaler:
         self._provider = provider
         self.config = config or AutoscalerConfig()
         self._idle_since: Dict[str, float] = {}
+        # Drained from the head but the provider terminate failed: the
+        # node is gone from the cluster state, so the main reap loop can
+        # never see it again — retried explicitly each pass until the
+        # provider call succeeds (else the VM leaks and bills forever).
+        self._pending_terminate: set = set()
         self._launched = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -183,8 +188,36 @@ class Autoscaler:
         reaped: List[str] = []
         reaped_hosts = 0
         by_cluster_id = {n["node_id"]: n for n in state["nodes"]}
+        for pid in list(self._pending_terminate):
+            # A drained-but-unterminated node's heartbeat re-registers it
+            # with the head (the head acked False after the drain), so it
+            # may be alive again with fresh work routed to it — re-drain
+            # before the terminate retry, never terminate a routable node.
+            for cid in self._cluster_ids_of(pid):
+                if cid in by_cluster_id and by_cluster_id[cid]["alive"]:
+                    try:
+                        self._rt.head.retrying_call(
+                            "drain_node", cid, timeout=10)
+                    except Exception:
+                        pass
+            try:
+                self._provider.terminate_node(pid)
+            except Exception:
+                continue
+            self._pending_terminate.discard(pid)
+            self._managed.pop(pid, None)
+            self._idle_since.pop(pid, None)
+            reaped.append(pid)
+            # A re-registered node was alive in THIS snapshot: charge its
+            # hosts against the min_nodes floor or the main loop below
+            # could reap another node and undershoot min_nodes.
+            reaped_hosts += len(
+                [cid for cid in self._cluster_ids_of(pid)
+                 if cid in by_cluster_id and by_cluster_id[cid]["alive"]])
         alive_total = len([n for n in state["nodes"] if n["alive"]])
         for pid in list(self._managed):
+            if pid in self._pending_terminate:
+                continue
             nodes = [by_cluster_id.get(cid)
                      for cid in self._cluster_ids_of(pid)]
             nodes = [n for n in nodes if n is not None and n["alive"]]
@@ -211,7 +244,17 @@ class Autoscaler:
                             "drain_node", n["node_id"], timeout=10)
                     except Exception:
                         pass
-                self._provider.terminate_node(pid)
+                # Only report the node reaped once the provider actually
+                # dropped it. Drain removes the node from the head's
+                # state, so a failed terminate afterwards moves the pid to
+                # _pending_terminate (retried above) rather than relying
+                # on this loop ever seeing the node again.
+                try:
+                    self._provider.terminate_node(pid)
+                except Exception:
+                    self._pending_terminate.add(pid)
+                    reaped_hosts += len(nodes)
+                    continue
                 self._managed.pop(pid, None)
                 self._idle_since.pop(pid, None)
                 reaped.append(pid)

@@ -210,7 +210,11 @@ class NodeManager:
                 # only resources whose availability changed since the last
                 # ACKED beat; the head NACKs version gaps with "resync"
                 # and the next beat falls back to a full snapshot.
-                if last_sent:
+                # A key that vanished from avail (dynamic resource
+                # deleted) can't ride a delta — the head would keep the
+                # stale entry forever. Fall back to a full snapshot when
+                # the key set shrinks.
+                if last_sent and last_sent.keys() <= avail.keys():
                     payload = {k: v for k, v in avail.items()
                                if last_sent.get(k) != v}
                     is_delta = True

@@ -44,7 +44,9 @@ class Config:
         self._flags: Dict[str, _Flag] = {}
         self._values: Dict[str, Any] = {}
         self._lock = threading.Lock()
-        self._exported_env: set = set()
+        # env overrides we exported: env_key -> value seen before the
+        # export (None if the key was absent) so shutdown can restore it.
+        self._exported_env: dict = {}
 
     def define(self, name: str, typ: type, default: Any, doc: str = "") -> None:
         flag = _Flag(name, typ, default, doc)
@@ -79,17 +81,20 @@ class Config:
             else:
                 raw = str(v)
             env_key = _ENV_PREFIX + k.upper()
-            if env_key not in os.environ:
-                self._exported_env.add(env_key)
+            if env_key not in self._exported_env:
+                self._exported_env[env_key] = os.environ.get(env_key)
             os.environ[env_key] = raw
 
     def clear_exported_env(self) -> None:
         """Drop env exports this process's apply_system_config created
         (called by shutdown so a later init — or unrelated subprocesses —
         start from defaults, not a previous cluster's overrides). Values
-        the USER set in the environment before init are left alone."""
-        for env_key in self._exported_env:
-            os.environ.pop(env_key, None)
+        the USER set in the environment before init are restored."""
+        for env_key, prior in self._exported_env.items():
+            if prior is None:
+                os.environ.pop(env_key, None)
+            else:
+                os.environ[env_key] = prior
         self._exported_env.clear()
 
     def snapshot(self) -> Dict[str, Any]:

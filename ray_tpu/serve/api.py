@@ -392,8 +392,14 @@ class LocalDeploymentHandle:
         self._method = method_name
         self._stream = stream
 
-    def __getattr__(self, item: str) -> _LocalMethod:
-        return _LocalMethod(getattr(self._instance, item))
+    def __getattr__(self, item: str) -> "LocalDeploymentHandle":
+        # Mirror the real DeploymentHandle: attribute access routes
+        # through options() so the handle's _stream flag survives —
+        # handle.options(stream=True).method.remote() must stream in
+        # local testing mode exactly as it does in production.
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(method_name=item)
 
     def remote(self, *args, **kwargs):
         return _LocalMethod(getattr(self._instance, self._method),

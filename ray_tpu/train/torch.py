@@ -67,17 +67,17 @@ def _wrap_with_torch_backend(user_fn: Callable, backend: str,
         rank = ctx.get_world_rank()
         world = ctx.get_world_size()
         gang = ctx.get_gang_id() if hasattr(ctx, "get_gang_id") else ""
-        addr = _rendezvous(f"{rdzv_id}:{gang}", rank, world)
-        host, port = addr.rsplit(":", 1)
-        os.environ["MASTER_ADDR"] = host
-        os.environ["MASTER_PORT"] = port
-        os.environ["RANK"] = str(rank)
-        os.environ["WORLD_SIZE"] = str(world)
-        dist.init_process_group(backend, rank=rank, world_size=world)
-        if rank == 0:
-            # Group formed = every rank has read the address; drop the KV
-            # entry (rpc_kv_put writes through to the durable store — a
-            # long-lived cluster must not accumulate one key per gang).
+        # free_port() probes by bind-and-close, so another process can
+        # steal the port before gloo rebinds it. Rank 0 catches the bind
+        # failure and republishes a fresh port (overwriting the KV entry);
+        # other ranks re-read the KV on a failed/timed-out join so they
+        # chase the republished address instead of a dead one.
+        import datetime as _dt
+
+        def _drop_rdzv_key() -> None:
+            """Rank 0: drop the durable KV entry (rpc_kv_put writes
+            through to the durable store — a long-lived cluster must not
+            accumulate one key per gang, whether the gang formed or not)."""
             try:
                 from ray_tpu.core.runtime_context import require_runtime
 
@@ -86,6 +86,45 @@ def _wrap_with_torch_backend(user_fn: Callable, backend: str,
                     timeout=30)
             except Exception:
                 pass
+
+        last_err: Optional[BaseException] = None
+        for attempt in range(5):
+            # Retry re-reads poll with a short deadline: after rank 0 has
+            # failed for good it deletes the key, and a 120 s poll per
+            # remaining attempt would stall gang teardown for minutes.
+            addr = _rendezvous(f"{rdzv_id}:{gang}", rank, world,
+                               timeout_s=120.0 if attempt == 0 else 15.0)
+            host, port = addr.rsplit(":", 1)
+            os.environ["MASTER_ADDR"] = host
+            os.environ["MASTER_PORT"] = port
+            os.environ["RANK"] = str(rank)
+            os.environ["WORLD_SIZE"] = str(world)
+            try:
+                dist.init_process_group(
+                    backend, rank=rank, world_size=world,
+                    timeout=_dt.timedelta(seconds=120))
+                last_err = None
+                break
+            except (RuntimeError, OSError, ValueError) as e:
+                # ValueError: a failed attempt can leave the default group
+                # registered ("initialize ... twice"); tear it down so the
+                # next attempt starts clean.
+                last_err = e
+                try:
+                    if dist.is_initialized():
+                        dist.destroy_process_group()
+                except Exception:
+                    pass
+                if rank == 0:
+                    continue  # republish a fresh port next iteration
+                time.sleep(1.0)  # wait for rank 0's republish, then re-read
+        if rank == 0:
+            # Success: group formed = every rank has read the address.
+            # Failure: the success-path cleanup would never run. Either
+            # way the key must go.
+            _drop_rdzv_key()
+        if last_err is not None:
+            raise last_err
         try:
             user_fn(config)
         finally:

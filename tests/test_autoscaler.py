@@ -47,14 +47,113 @@ def test_infeasible_demand_triggers_scale_up_then_idle_reap(cluster):
     new_nodes = set(provider.non_terminated_nodes())
     assert set(nids) <= new_nodes, "tasks did not run on autoscaled nodes"
 
-    # Idle reap: no demand; after idle_timeout the node drains + dies.
+    # Idle reap: no demand; after idle_timeout the nodes drain + die.
+    # Each launched node's idle timer starts when IT is first seen idle
+    # (the one that ran tasks goes idle later), so reaps can land in
+    # different steps — poll until the provider is empty, not until the
+    # first reap.
     deadline = time.monotonic() + 60
     reaped = []
-    while time.monotonic() < deadline and not reaped:
+    while time.monotonic() < deadline and provider.non_terminated_nodes():
         time.sleep(1.0)
-        reaped = scaler.step()["reaped"]
+        reaped += scaler.step()["reaped"]
     assert reaped, "idle autoscaled node was never reaped"
     assert not provider.non_terminated_nodes()
+
+
+class _FakeHead:
+    """Head stub: serves a canned get_demand state, records drains."""
+
+    def __init__(self, state):
+        self.state = state
+        self.drained = []
+
+    def retrying_call(self, method, *args, timeout=None):
+        if method == "get_demand":
+            return self.state
+        if method == "drain_node":
+            # Like the real head: a drained node leaves the node table,
+            # so later get_demand calls no longer list it.
+            self.drained.append(args[0])
+            self.state["nodes"] = [n for n in self.state["nodes"]
+                                   if n["node_id"] != args[0]]
+            return None
+        raise AssertionError(method)
+
+
+class _FakeRT:
+    def __init__(self, state):
+        self.head = _FakeHead(state)
+
+
+class _MockProvider:
+    """Provider stub: tracks nodes in a set; terminate can be failed."""
+
+    node_types = {"cpu": {"CPU": 4.0}}
+
+    def __init__(self, nodes, fail_terminate=False):
+        self.nodes = set(nodes)
+        self.fail_terminate = fail_terminate
+        self.terminated = []
+
+    def create_node(self, node_type):
+        raise AssertionError("no scale-up expected")
+
+    def terminate_node(self, pid):
+        if self.fail_terminate:
+            raise RuntimeError("cloud API error")
+        self.nodes.discard(pid)
+        self.terminated.append(pid)
+
+    def non_terminated_nodes(self):
+        return sorted(self.nodes)
+
+
+def _idle_state(node_ids):
+    return {
+        "unmet": [],
+        "nodes": [{"node_id": nid, "alive": True,
+                   "resources": {"CPU": 4.0}, "available": {"CPU": 4.0},
+                   "labels": {}} for nid in node_ids],
+    }
+
+
+def test_reap_terminates_via_provider_deterministic():
+    """A reported reap implies the provider no longer lists the node
+    (VERDICT r4: reap must terminate through the provider, then report)."""
+    state = _idle_state(["n1"])
+    rt = _FakeRT(state)
+    provider = _MockProvider(["n1"])
+    scaler = Autoscaler(rt, provider, AutoscalerConfig(
+        max_nodes=4, min_nodes=0, idle_timeout_s=0.0))
+    scaler._managed["n1"] = None
+
+    did = scaler.step()
+    assert did["reaped"] == ["n1"]
+    assert provider.non_terminated_nodes() == []
+    assert rt.head.drained == ["n1"]
+    # Every pid ever reported reaped is gone from the provider.
+    assert not (set(did["reaped"])
+                & set(provider.non_terminated_nodes()))
+
+
+def test_reap_not_reported_when_provider_terminate_fails():
+    state = _idle_state(["n1"])
+    rt = _FakeRT(state)
+    provider = _MockProvider(["n1"], fail_terminate=True)
+    scaler = Autoscaler(rt, provider, AutoscalerConfig(
+        max_nodes=4, min_nodes=0, idle_timeout_s=0.0))
+    scaler._managed["n1"] = None
+
+    did = scaler.step()
+    assert did["reaped"] == []
+    assert provider.non_terminated_nodes() == ["n1"]
+    # Node stays managed, so the reap retries on a later pass.
+    assert "n1" in scaler._managed
+    provider.fail_terminate = False
+    did = scaler.step()
+    assert did["reaped"] == ["n1"]
+    assert provider.non_terminated_nodes() == []
 
 
 def test_scale_up_respects_max_nodes(cluster):
