@@ -1,24 +1,40 @@
-"""Benchmark: Llama-class pretrain step on the available TPU chip(s).
+"""Benchmark: BOTH north-star metrics (BASELINE.md) on the available chip.
 
-Prints ONE JSON line:
-  {"metric": "train_mfu_llama1b", "value": <MFU>, "unit": "mfu",
-   "vs_baseline": <MFU / 0.40>, ...extras}
+Prints one JSON line per row, then ONE final merged line (the driver
+records the tail line):
 
-The north-star target from BASELINE.json is >=40% MFU on Llama-class
-pretrain (reference has no TPU/LLM numbers checked in; 0.40 is the target
-ratio denominator). Extras report tokens/s/chip for context.
+  {"metric": "train_mfu_llama8b_proxy", "value": <MFU>, "unit": "mfu",
+   "vs_baseline": <MFU/0.40>, "train_mfu_llama1b": ...,
+   "llm_decode_tokens_per_s": ..., "serve_llm_requests_per_s": ...,
+   "serve_llm_p50_ttft_ms": ..., "serve_llm_p99_ttft_ms": ..., ...}
 
-Structure: the measurement runs in a CHILD subprocess (``--child``); the
-parent supervises with retry + backoff. Rationale: a TPU backend init
-failure is cached for the life of a JAX process, so retrying in-process
-is useless — and the round-3 driver run lost its only hardware number to
-exactly one flaky init. On persistent failure the parent diagnoses which
-processes hold the TPU device files and emits a structured failure record
-(still one JSON line) instead of a traceback.
+Rows:
+- train_mfu_llama1b — full Llama-3-1B pretrain step, measured directly.
+- train_mfu_llama8b_proxy — 8B-class MFU via a two-depth layer scan:
+  one v5e chip (16 GB HBM) cannot hold 8B params + optimizer state, so
+  the step is measured at two depths of the TRUE 8B layer geometry
+  (d=4096, d_ff=14336, GQA 32/8, vocab 128k, seq 2048, full remat,
+  chunked CE, SGD) and the per-layer time from the depth differential is
+  extrapolated to 32 layers. The differential cancels the embed/head/CE
+  cost shared by both runs; method fields are recorded in the row.
+- llm_decode_tokens_per_s — the native continuous-batching engine
+  (serve/llm.py) decoding with Llama-1B weights on the chip.
+- serve_llm_* — req/s + p50/p99 TTFT through the FULL serve stack
+  (controller/router/replica, tiny engine) in a CPU child process; the
+  reference publishes no serve numbers (it delegates to vLLM), so these
+  are absolute, tracked round-over-round.
+
+Structure: measurements run in CHILD subprocesses; the parent supervises
+with retry + backoff. A TPU backend init failure is cached for the life
+of a JAX process, so retrying in-process is useless — and a wedged axon
+tunnel HANGS rather than fails, hence the probe phase. On persistent
+failure the parent emits a structured failure record (still one JSON
+line) instead of a traceback.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -38,7 +54,8 @@ PEAK_FLOPS = [
 
 ATTEMPTS = 4
 BACKOFFS_S = (10, 30, 60)  # between attempts
-CHILD_TIMEOUT_S = 1500     # first TPU compile can take minutes
+CHILD_TIMEOUT_S = 2100     # first TPU compiles (4 programs) can take minutes
+SERVE_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 
 
@@ -50,78 +67,220 @@ def peak_flops_for(device_kind: str) -> float:
     return 197e12
 
 
-def child_main() -> None:
+# --------------------------------------------------------------------------
+# train + decode child (owns the TPU)
+# --------------------------------------------------------------------------
+
+def _timed_steps(step, state, tokens, warmup: int, iters: int):
+    """Returns (seconds_per_step, last_loss). Through the remote-TPU
+    tunnel block_until_ready is not a reliable barrier — only a host
+    fetch is; fetch the loss scalar once per timed region."""
+    for _ in range(warmup):
+        state, metrics = step(state, tokens)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, tokens)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return dt / iters, loss, state
+
+
+def _bench_train(cfg, batch, seq, warmup, iters, devices, tx=None):
     import numpy as np
 
-    _pin_platform()
     import jax
 
-    from ray_tpu.models import llama
     from ray_tpu.parallel import spmd
     from ray_tpu.parallel.mesh import MeshSpec, make_mesh
 
-    devices = jax.devices()
-    n_chips = len(devices)
-    on_tpu = devices[0].platform == "tpu"
-    kind = devices[0].device_kind
-
-    if on_tpu:
-        cfg = llama.LLAMA3_1B
-        batch, seq = 8, 2048
-        cfg = llama.LlamaConfig(
-            **{**cfg.__dict__, "max_seq_len": seq}
-        )
-        warmup, iters = 2, 10
-    else:
-        cfg = llama.tiny_config(max_seq_len=256)
-        batch, seq = 4, 256
-        warmup, iters = 1, 3
-
-    mesh = make_mesh(MeshSpec(fsdp=n_chips), devices) if n_chips > 1 else \
+    n = len(devices)
+    mesh = make_mesh(MeshSpec(fsdp=n), devices) if n > 1 else \
         make_mesh(MeshSpec(), devices[:1])
-    tx = spmd.default_optimizer(lr=1e-4)
-
+    tx = tx or spmd.default_optimizer(lr=1e-4)
     with jax.sharding.set_mesh(mesh):
         state = spmd.sharded_init(cfg, mesh, jax.random.PRNGKey(0), tx)
         step = spmd.make_train_step(cfg, mesh, tx)
         rng = np.random.default_rng(0)
         tokens = jax.device_put(
             rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
-            spmd.data_sharding(mesh),
-        )
-        # NOTE: through the remote-TPU tunnel, block_until_ready is not a
-        # reliable execution barrier — only a host fetch is. Fetch the loss
-        # scalar once per timed region (per-fetch overhead ~75ms, amortized
-        # over `iters` steps).
-        for _ in range(warmup):
-            state, metrics = step(state, tokens)
-        float(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, metrics = step(state, tokens)
-        loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
-        assert np.isfinite(loss), f"non-finite loss {loss}"
+            spmd.data_sharding(mesh))
+        step_s, loss, state = _timed_steps(step, state, tokens, warmup, iters)
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    del state
+    return step_s
 
-    tokens_per_s = batch * seq * iters / dt
-    tokens_per_s_chip = tokens_per_s / n_chips
-    flops_tok = cfg.flops_per_token(seq)
-    mfu = tokens_per_s_chip * flops_tok / peak_flops_for(kind)
 
-    print(json.dumps({
+def _bench_8b_proxy(on_tpu: bool, devices, kind: str) -> dict:
+    """Two-depth layer scan of the true 8B layer geometry; projects MFU
+    at n_layers=32 from the per-layer time differential."""
+    import optax
+
+    from ray_tpu.models import llama
+
+    if on_tpu:
+        base = dataclasses.replace(llama.LLAMA3_8B, max_seq_len=2048)
+        batch, seq, warmup, iters = 4, 2048, 2, 6
+        depth_pairs = [(2, 6), (2, 4)]  # fallback shrinks HBM footprint
+    else:
+        base = llama.tiny_config(max_seq_len=256)
+        batch, seq, warmup, iters = 2, 256, 1, 2
+        depth_pairs = [(1, 2)]
+    # SGD: adamw's moment buffers alone would not fit next to 8B-geometry
+    # params at depth 6 on a 16 GB chip; optimizer choice does not move
+    # the matmul-bound step time materially (method recorded in the row).
+    tx = optax.sgd(1e-4)
+    last_err = None
+    for d_lo, d_hi in depth_pairs:
+        try:
+            t_lo = _bench_train(dataclasses.replace(base, n_layers=d_lo),
+                                batch, seq, warmup, iters, devices, tx)
+            t_hi = _bench_train(dataclasses.replace(base, n_layers=d_hi),
+                                batch, seq, warmup, iters, devices, tx)
+        except Exception as e:  # noqa: BLE001 - OOM at this depth: shrink
+            last_err = e
+            continue
+        per_layer = (t_hi - t_lo) / (d_hi - d_lo)
+        full_layers = llama.LLAMA3_8B.n_layers if on_tpu else 4
+        t_full = t_lo + (full_layers - d_lo) * per_layer
+        tokens_per_s = batch * seq / t_full
+        full_cfg = dataclasses.replace(base, n_layers=full_layers)
+        mfu = (tokens_per_s * full_cfg.flops_per_token(seq)
+               / peak_flops_for(kind) / len(devices))
+        return {
+            "metric": "train_mfu_llama8b_proxy",
+            "value": round(mfu, 4),
+            "unit": "mfu",
+            "vs_baseline": round(mfu / 0.40, 4),
+            "tokens_per_s_per_chip": round(tokens_per_s / len(devices), 1),
+            "projected_step_time_s": round(t_full, 4),
+            "method": (f"layer-scan: measured depths {d_lo},{d_hi} of 8B "
+                       f"geometry (d4096/ff14336/GQA32-8/vocab128k), "
+                       f"extrapolated to {full_layers} layers; SGD; full "
+                       f"remat; chunked CE"),
+            "measured_step_s": {str(d_lo): round(t_lo, 4),
+                                str(d_hi): round(t_hi, 4)},
+            "batch": batch, "seq": seq,
+        }
+    return {"metric": "train_mfu_llama8b_proxy", "value": 0.0,
+            "unit": "mfu", "vs_baseline": 0.0,
+            "error": f"all depth pairs failed: {last_err!r:.300}"}
+
+
+def _bench_decode(on_tpu: bool) -> dict:
+    """Steady-state decode throughput of the native LLM engine."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    if on_tpu:
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=512,
+                                  use_decode_kernel=True)
+        max_batch, new_tokens, seconds = 8, 48, 8.0
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        max_batch, new_tokens, seconds = 4, 8, 2.0
+    engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
+                       prompt_buckets=[32])
+    rng = np.random.default_rng(0)
+
+    hi = min(1000, cfg.vocab_size - 1)
+
+    def prompt():
+        return [int(t) for t in rng.integers(1, hi, 16)]
+
+    engine.generate(prompt(), max_new_tokens=2)  # compile prefill+decode
+    stop_at = time.perf_counter() + seconds
+    counts = [0] * max_batch
+
+    def client(i):
+        while time.perf_counter() < stop_at:
+            out = engine.generate(prompt(), max_new_tokens=new_tokens,
+                                  timeout=300)
+            counts[i] += len(out["token_ids"])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(max_batch)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    engine.close()
+    tps = sum(counts) / elapsed
+    return {"metric": "llm_decode_tokens_per_s", "value": round(tps, 1),
+            "unit": "tokens/s",
+            "config": "llama3-1b" if on_tpu else "tiny-cpu",
+            "max_batch": max_batch}
+
+
+def child_main() -> None:
+    _pin_platform()
+    import jax
+
+    from ray_tpu.models import llama
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    kind = devices[0].device_kind
+
+    # --- row 1: Llama-1B full-model MFU (round-over-round continuity) ---
+    if on_tpu:
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=2048)
+        batch, seq, warmup, iters = 8, 2048, 2, 10
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        batch, seq, warmup, iters = 4, 256, 1, 3
+    step_s = _bench_train(cfg, batch, seq, warmup, iters, devices)
+    tokens_per_s_chip = batch * seq / step_s / len(devices)
+    mfu1b = tokens_per_s_chip * cfg.flops_per_token(seq) / peak_flops_for(kind)
+    row_1b = {
         "metric": "train_mfu_llama1b",
-        "value": round(mfu, 4),
+        "value": round(mfu1b, 4),
         "unit": "mfu",
-        "vs_baseline": round(mfu / 0.40, 4),
+        "vs_baseline": round(mfu1b / 0.40, 4),
         "tokens_per_s_per_chip": round(tokens_per_s_chip, 1),
-        "step_time_s": round(dt / iters, 4),
+        "step_time_s": round(step_s, 4),
         "device": kind,
-        "n_chips": n_chips,
+        "n_chips": len(devices),
         "config": "llama3-1b" if on_tpu else "tiny-cpu",
-        "batch": batch,
-        "seq": seq,
-    }))
+        "batch": batch, "seq": seq,
+    }
+    print(json.dumps(row_1b), flush=True)
 
+    # --- row 2: 8B-class projected MFU (north star) ---------------------
+    try:
+        row_8b = _bench_8b_proxy(on_tpu, devices, kind)
+    except Exception as e:  # noqa: BLE001
+        row_8b = {"metric": "train_mfu_llama8b_proxy", "value": 0.0,
+                  "unit": "mfu", "vs_baseline": 0.0,
+                  "error": repr(e)[:300]}
+    print(json.dumps(row_8b), flush=True)
+
+    # --- row 3: engine decode throughput on the chip --------------------
+    try:
+        row_dec = _bench_decode(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        row_dec = {"metric": "llm_decode_tokens_per_s", "value": 0.0,
+                   "unit": "tokens/s", "error": repr(e)[:300]}
+    print(json.dumps(row_dec), flush=True)
+
+
+def serve_child_main() -> None:
+    """Full-stack serve bench; runs on CPU (the TPU child owns the chip)."""
+    from ray_tpu.serve.benchmark import run_benchmark
+
+    rows = run_benchmark(seconds=6.0, concurrency=4)
+    print(json.dumps({"metric": "serve_llm", **rows}), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent supervisor
+# --------------------------------------------------------------------------
 
 def accel_holders() -> list:
     """Which processes hold TPU device files open (/dev/accel*, /dev/vfio*).
@@ -178,15 +337,30 @@ def probe_main() -> None:
     print(f"probe-ok {d[0].platform} {d[0].device_kind}")
 
 
-def _run(args: list, timeout_s: int):
+def _run(args: list, timeout_s: int, env_extra: dict = None):
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__)] + args,
-        capture_output=True, text=True, timeout=timeout_s,
+        capture_output=True, text=True, timeout=timeout_s, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)))
+
+
+def _json_lines(text: str) -> list:
+    out = []
+    for ln in text.splitlines():
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                pass
+    return out
 
 
 def main() -> int:
     errors = []
+    rows = []
     for attempt in range(ATTEMPTS):
         # Phase 1: probe. A wedged axon tunnel HANGS in init (observed:
         # >20min asleep in nanosleep) rather than raising — without this,
@@ -214,11 +388,8 @@ def main() -> int:
             errors.append(f"attempt {attempt}: timeout {CHILD_TIMEOUT_S}s")
             continue
         if proc.returncode == 0:
-            # Forward exactly the child's JSON line.
-            line = [ln for ln in proc.stdout.splitlines()
-                    if ln.startswith("{")][-1]
-            print(line)
-            return 0
+            rows = _json_lines(proc.stdout)
+            break
         tail = (proc.stderr or proc.stdout).strip().splitlines()[-6:]
         errors.append(f"attempt {attempt} rc={proc.returncode}: "
                       + " | ".join(tail))
@@ -226,24 +397,73 @@ def main() -> int:
               f"retrying", file=sys.stderr)
         if attempt < ATTEMPTS - 1:
             time.sleep(BACKOFFS_S[min(attempt, len(BACKOFFS_S) - 1)])
-    # Persistent failure: structured record, not a traceback. value 0.0
-    # plus an explicit error field — never a silently-plausible number.
-    print(json.dumps({
-        "metric": "train_mfu_llama1b",
-        "value": 0.0,
-        "unit": "mfu",
-        "vs_baseline": 0.0,
-        "error": "TPU backend init failed after retries",
-        "attempts": ATTEMPTS,
-        "attempt_errors": errors[-2:],
-        "accel_holders": accel_holders(),
-    }))
-    return 1
+
+    if not rows:
+        # Persistent failure: structured record, not a traceback. value 0.0
+        # plus an explicit error field — never a silently-plausible number.
+        print(json.dumps({
+            "metric": "train_mfu_llama8b_proxy",
+            "value": 0.0,
+            "unit": "mfu",
+            "vs_baseline": 0.0,
+            "error": "TPU backend init failed after retries",
+            "attempts": ATTEMPTS,
+            "attempt_errors": errors[-2:],
+            "accel_holders": accel_holders(),
+        }))
+        return 1
+
+    for r in rows:  # echo the child's rows for human readers / logs
+        print(json.dumps(r), flush=True)
+
+    # Phase 3: serve stack bench on CPU (chip-independent; never blocks
+    # the hardware rows).
+    serve_row = None
+    try:
+        sproc = _run(["--serve-child"], SERVE_TIMEOUT_S,
+                     env_extra={"JAX_PLATFORMS": "cpu"})
+        if sproc.returncode == 0:
+            lines = _json_lines(sproc.stdout)
+            serve_row = lines[-1] if lines else None
+        else:
+            serve_row = {"metric": "serve_llm", "error": "rc=%d: %s" % (
+                sproc.returncode,
+                " | ".join((sproc.stderr or sproc.stdout)
+                           .strip().splitlines()[-3:]))}
+    except subprocess.TimeoutExpired:
+        serve_row = {"metric": "serve_llm",
+                     "error": f"timeout {SERVE_TIMEOUT_S}s"}
+    if serve_row is not None:
+        print(json.dumps(serve_row), flush=True)
+
+    # Final merged line (the driver parses the tail line): headline is the
+    # 8B north star when it measured, else the 1B row.
+    by_metric = {r.get("metric"): r for r in rows}
+    head = by_metric.get("train_mfu_llama8b_proxy")
+    if not head or not head.get("value"):
+        head = by_metric.get("train_mfu_llama1b", rows[-1])
+    merged = dict(head)
+    r1b = by_metric.get("train_mfu_llama1b", {})
+    merged.setdefault("device", r1b.get("device"))
+    merged.setdefault("n_chips", r1b.get("n_chips"))
+    merged["train_mfu_llama1b"] = r1b.get("value")
+    dec = by_metric.get("llm_decode_tokens_per_s", {})
+    merged["llm_decode_tokens_per_s"] = dec.get("value")
+    if serve_row and "error" not in serve_row:
+        for k in ("serve_llm_requests_per_s", "serve_llm_tokens_per_s",
+                  "serve_llm_p50_ttft_ms", "serve_llm_p99_ttft_ms"):
+            merged[k] = serve_row.get(k)
+    elif serve_row:
+        merged["serve_error"] = serve_row["error"]
+    print(json.dumps(merged))
+    return 0
 
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
         sys.exit(child_main())
+    if "--serve-child" in sys.argv:
+        sys.exit(serve_child_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

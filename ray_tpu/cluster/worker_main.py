@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import collections
 import inspect
+import os
 import sys
 import threading
 import time
@@ -138,6 +139,9 @@ class WorkerRuntime(ClusterCore):
         # semantics for non-force cancel). FIFO-bounded like _seen_tasks.
         self._cancelled: set = set()
         self._cancelled_order = collections.deque()
+        # Task ids currently INSIDE user code: force-cancel consults this
+        # to decide between a cooperative skip and a process kill.
+        self._executing: set = set()
         # The runtime must be installed BEFORE registration: a lease can
         # arrive (and a task execute) the instant the node manager sees us.
         runtime_context.set_runtime(self)
@@ -236,6 +240,7 @@ class WorkerRuntime(ClusterCore):
                                        span=span())
                     return
                 t_start = time.time()
+                self._executing.add(task_id.binary())
                 try:
                     func = (self._fetch_function(spec["func_digest"])
                             if "func_digest" in spec else spec["func"])
@@ -286,6 +291,8 @@ class WorkerRuntime(ClusterCore):
                                        error=capture_exception(e),
                                        span=span())
                     return
+                finally:
+                    self._executing.discard(task_id.binary())
         finally:
             runtime_context.set_worker_context(prev)
 
@@ -786,9 +793,19 @@ class WorkerRuntime(ClusterCore):
             self._send_results(owner, task_id, return_ids, error=err,
                                actor_ctx=actor_ctx)
 
-    def rpc_cancel_task(self, conn, task_id_bytes: bytes):
+    def rpc_cancel_task(self, conn, task_id_bytes: bytes,
+                        force: bool = False):
         """Cooperative cancel: a task that has not started is skipped; a
-        running one completes (no preemption, reference non-force cancel)."""
+        running one completes (no preemption, reference non-force cancel).
+        ``force`` mirrors ray.cancel(force=True): if the task is INSIDE
+        user code, the worker process exits — the owner's conn-lost hook
+        re-enqueues its tasks and the re-dispatch check converts the
+        cancelled one into TaskCancelledError (never a silent retry)."""
+        if force and task_id_bytes in self._executing:
+            import threading as _threading
+
+            # Delay lets the RPC reply flush before the process dies.
+            _threading.Timer(0.05, os._exit, (1,)).start()
         self._cancelled.add(task_id_bytes)
         self._cancelled_order.append(task_id_bytes)
         while len(self._cancelled_order) > 4096:

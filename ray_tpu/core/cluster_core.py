@@ -1280,10 +1280,11 @@ class ClusterCore:
         with self._streams_lock:
             self._streams.pop(task_id_bytes, None)
 
-    def _mark_cancelled(self, task_id: TaskID) -> None:
+    def _mark_cancelled(self, task_id: TaskID, force: bool = False) -> None:
         """Shared cancel bookkeeping: remember the id (bounded) and tell
         the executing worker, if dispatched (used by cancel() and stream
-        abandonment)."""
+        abandonment). ``force`` rides the same (single) notify — the
+        worker exits if the task is inside user code."""
         self._cancelled.add(task_id)
         self._cancelled_order.append(task_id)
         while len(self._cancelled_order) > cfg.cancelled_ids_max:
@@ -1293,7 +1294,7 @@ class ClusterCore:
         if info is not None and info.worker_addr:
             try:
                 self._pool.get(info.worker_addr).notify(
-                    "cancel_task", task_id.binary())
+                    "cancel_task", task_id.binary(), force)
             except Exception:
                 pass
 
@@ -1428,16 +1429,27 @@ class ClusterCore:
             batch: List[Tuple[tuple, _Lease]] = []
             with self._lease_lock:
                 depth = cfg.max_tasks_in_flight_per_worker
+                # The per-worker pipeline hides push RTT for short tasks —
+                # it is NOT parallel capacity. While the cluster might
+                # still grant fresh workers, dispatch at most ONE task per
+                # lease (a long task queued behind another serializes, and
+                # pushed tasks never migrate); only once leases are being
+                # declined (backoff active) or the request budget is
+                # exhausted does pipelining onto busy workers kick in.
+                saturated = (time.monotonic() < kq.next_lease_attempt
+                             or kq.pending_lease_requests
+                             >= cfg.max_pending_lease_requests_per_scheduling_key)
+                cap = depth if saturated else 1
                 while kq.queue:
-                    lease = None
+                    best = None
                     for l in kq.leases:
-                        if not l.broken and l.inflight < depth:
-                            lease = l
-                            break
-                    if lease is None:
+                        if not l.broken and l.inflight < cap and (
+                                best is None or l.inflight < best.inflight):
+                            best = l
+                    if best is None:
                         break
-                    lease.inflight += 1
-                    batch.append((kq.queue.popleft(), lease))
+                    best.inflight += 1
+                    batch.append((kq.queue.popleft(), best))
                 queue_len = len(kq.queue)
                 sample = kq.queue[0][1] if kq.queue else None
             if batch:
@@ -1481,14 +1493,21 @@ class ClusterCore:
         with self._lease_lock:
             if time.monotonic() < kq.next_lease_attempt:
                 return
-            depth = cfg.max_tasks_in_flight_per_worker
-            capacity = sum(depth - l.inflight for l in kq.leases
-                           if not l.broken) + kq.pending_lease_requests * depth
-            want = 0
-            while (capacity + want * depth < queue_len
-                   and kq.pending_lease_requests + want
-                   < cfg.max_pending_lease_requests_per_scheduling_key):
-                want += 1
+            # Parallelism-first sizing: one WORKER per runnable task (the
+            # per-worker pipeline is an RTT-hiding optimization, not
+            # parallel capacity — sizing by pipeline depth left 4 sleeping
+            # tasks sharing one worker). Tasks already pipelined beyond
+            # one-per-lease count as backlog too. A saturated node
+            # declines the extras and the declined-lease backoff bounds
+            # the request rate.
+            healthy = [l for l in kq.leases if not l.broken]
+            idle = sum(1 for l in healthy if l.inflight == 0)
+            excess = sum(max(0, l.inflight - 1) for l in healthy)
+            shortfall = (queue_len + excess - idle
+                         - kq.pending_lease_requests)
+            want = min(max(0, shortfall),
+                       cfg.max_pending_lease_requests_per_scheduling_key
+                       - kq.pending_lease_requests)
             kq.pending_lease_requests += want
             if sample.strategy is None and kq.lease_fail_deadline is None:
                 kq.lease_fail_deadline = (
@@ -1870,17 +1889,23 @@ class ClusterCore:
                recursive: bool = True):
         """Cancel the task that produces `ref`: queued tasks are failed
         with TaskCancelledError immediately; dispatched ones get a
-        cooperative cancel RPC to their worker (skipped if not yet
-        started; running user code is never preempted — reference
-        non-force semantics, core_worker Cancel path)."""
+        cancel RPC to their worker — cooperative by default (skipped if
+        not yet started; running user code is never preempted), while
+        ``force=True`` kills the executing worker the way the reference's
+        ray.cancel(force=True) does (core_worker Cancel path +
+        force_kill): the conn-lost re-enqueue then converts the task to
+        TaskCancelledError at re-dispatch."""
         from ray_tpu.exceptions import TaskCancelledError
 
         task_id = ref.id().task_id()
         tid_bytes = task_id.binary()
         # Mark FIRST (closes the race with a concurrent dispatch: the
         # push path re-checks _cancelled right before pushing), then
-        # remove from queues / notify the worker.
-        self._mark_cancelled(task_id)
+        # remove from queues. _mark_cancelled notifies the dispatched
+        # worker exactly once (pending there -> skipped; running + force
+        # -> worker exits and the re-dispatch converts the task to
+        # TaskCancelledError).
+        self._mark_cancelled(task_id, force=force)
         # Still queued? Remove + fail its returns.
         with self._lease_lock:
             for kq in self._key_queues.values():

@@ -39,6 +39,51 @@ def test_task_roundtrip_and_parallelism(cluster):
     assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(40)]
 
 
+def test_long_tasks_run_concurrently(cluster):
+    """N sleeping tasks on an N-CPU cluster overlap instead of
+    pipelining onto one worker (the per-worker pipeline hides RTT for
+    short tasks; it must not serialize long ones)."""
+
+    @ray_tpu.remote
+    def nap():
+        time.sleep(1.0)
+        return 1
+
+    assert sum(ray_tpu.get([nap.remote() for _ in range(4)],
+                           timeout=60)) == 4  # warm the pool
+    t0 = time.monotonic()
+    assert sum(ray_tpu.get([nap.remote() for _ in range(4)],
+                           timeout=60)) == 4
+    elapsed = time.monotonic() - t0
+    assert elapsed < 3.0, f"sleep tasks serialized ({elapsed:.1f}s)"
+
+
+def test_force_cancel_kills_running_task(cluster):
+    """ray_tpu.cancel(force=True) interrupts user code mid-flight
+    (reference: ray.cancel force_kill) and frees the worker's CPU."""
+    from ray_tpu.exceptions import TaskCancelledError, WorkerCrashedError
+
+    @ray_tpu.remote
+    def stuck():
+        time.sleep(300)
+        return "never"
+
+    ref = stuck.remote()
+    time.sleep(1.0)  # let it reach user code
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((TaskCancelledError, TaskError,
+                        WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=30)
+
+    # The CPU the stuck task held is free again: fresh work completes.
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get([ok.remote() for _ in range(4)],
+                       timeout=60) == [42] * 4
+
+
 def test_nested_tasks(cluster):
     @ray_tpu.remote
     def add(a, b):

@@ -164,6 +164,120 @@ def test_events_wait_and_send(cluster):
     assert out2 == out
 
 
+def test_independent_branches_run_concurrently(cluster):
+    """Two slow sibling branches complete in ~1x branch time, not 2x
+    (reference: workflow_executor runs ready steps concurrently)."""
+    import time as _time
+
+    @workflow.step
+    def slow(tag):
+        _time.sleep(1.5)
+        return tag
+
+    @workflow.step
+    def join(a, b):
+        return a + b
+
+    dag = join.bind(slow.bind("l"), slow.bind("r"))
+    t0 = _time.monotonic()
+    assert workflow.run(dag, workflow_id="wf-par") == "lr"
+    elapsed = _time.monotonic() - t0
+    # Serial execution would take >= 3.0s; concurrent ~1.5s + overhead.
+    assert elapsed < 2.8, f"branches ran serially ({elapsed:.1f}s)"
+
+
+def test_run_async_list_and_status(cluster):
+    import time as _time
+
+    @workflow.step
+    def gate(path):
+        while not os.path.exists(path):
+            _time.sleep(0.05)
+        return "done"
+
+    gate_path = os.path.join(
+        os.environ["RTPU_WORKFLOW_STORAGE"], "gate-async")
+    handle = workflow.run_async(gate.bind(gate_path),
+                                workflow_id="wf-async")
+    assert not handle.done()
+    st = workflow.get_status("wf-async")
+    assert st["status"] == "RUNNING"
+    listed = {w["workflow_id"]: w for w in workflow.list_all()}
+    assert listed["wf-async"]["status"] == "RUNNING"
+    with open(gate_path, "w") as f:
+        f.write("go")
+    assert handle.result(timeout=30) == "done"
+    assert workflow.get_status("wf-async")["status"] == "SUCCEEDED"
+    assert {w["workflow_id"] for w in
+            workflow.list_all(status_filter="SUCCEEDED")} >= {"wf-async"}
+
+
+def test_cancel_running_workflow(cluster):
+    import time as _time
+
+    @workflow.step
+    def forever():
+        _time.sleep(600)
+        return "never"
+
+    handle = workflow.run_async(forever.bind(), workflow_id="wf-cancel")
+    _time.sleep(0.5)  # let the step launch
+    workflow.cancel("wf-cancel")
+    with pytest.raises(workflow.WorkflowCancelledError):
+        handle.result(timeout=30)
+    assert workflow.get_status("wf-cancel")["status"] == "CANCELED"
+
+
+def test_retry_exceptions_discriminates(cluster, tmp_path):
+    """retry_exceptions=False: a deterministic user bug runs the step
+    ONCE (no side-effect replay); an allowlisted type still retries."""
+    no_retry_marker = tmp_path / "noretry.txt"
+
+    @workflow.step(max_retries=3, retry_exceptions=False)
+    def buggy():
+        with open(no_retry_marker, "a") as f:
+            f.write("ran\n")
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(RuntimeError, match="failed after 1 attempts"):
+        workflow.run(buggy.bind(), workflow_id="wf-noretry")
+    assert no_retry_marker.read_text().count("ran") == 1
+
+    allow_marker = tmp_path / "allow.txt"
+
+    @workflow.step(max_retries=2, retry_exceptions=(ConnectionError,))
+    def flaky():
+        with open(allow_marker, "a") as f:
+            f.write("ran\n")
+        if allow_marker.read_text().count("ran") < 2:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert workflow.run(flaky.bind(), workflow_id="wf-allow") == "ok"
+    assert allow_marker.read_text().count("ran") == 2
+
+    deny_marker = tmp_path / "deny.txt"
+
+    @workflow.step(max_retries=3, retry_exceptions=(ConnectionError,))
+    def wrong_type():
+        with open(deny_marker, "a") as f:
+            f.write("ran\n")
+        raise KeyError("not allowlisted")
+
+    with pytest.raises(RuntimeError, match="failed after 1 attempts"):
+        workflow.run(wrong_type.bind(), workflow_id="wf-deny")
+    assert deny_marker.read_text().count("ran") == 1
+
+
+def test_get_output_after_completion(cluster):
+    @workflow.step
+    def make():
+        return {"answer": 42}
+
+    workflow.run(make.bind(), workflow_id="wf-out")
+    assert workflow.get_output("wf-out") == {"answer": 42}
+
+
 def test_fsspec_memory_storage(cluster, monkeypatch):
     """Storage roots may be fsspec URLs (reference: workflow storage on
     fs/s3) — memory:// exercises the non-local path end-to-end."""
