@@ -195,12 +195,16 @@ def _bench_decode(on_tpu: bool) -> dict:
     engine.generate(prompt(), max_new_tokens=2)  # compile prefill+decode
     stop_at = time.perf_counter() + seconds
     counts = [0] * max_batch
+    client_errors = []
 
     def client(i):
-        while time.perf_counter() < stop_at:
-            out = engine.generate(prompt(), max_new_tokens=new_tokens,
-                                  timeout=300)
-            counts[i] += len(out["token_ids"])
+        try:
+            while time.perf_counter() < stop_at:
+                out = engine.generate(prompt(), max_new_tokens=new_tokens,
+                                      timeout=300)
+                counts[i] += len(out["token_ids"])
+        except Exception as e:  # noqa: BLE001 — recorded, never silent
+            client_errors.append(repr(e)[:200])
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,))
@@ -211,11 +215,19 @@ def _bench_decode(on_tpu: bool) -> dict:
         t.join()
     elapsed = time.perf_counter() - t0
     engine.close()
+    if client_errors and not sum(counts):
+        raise RuntimeError(f"all decode clients failed: {client_errors[0]}")
     tps = sum(counts) / elapsed
-    return {"metric": "llm_decode_tokens_per_s", "value": round(tps, 1),
-            "unit": "tokens/s",
-            "config": "llama3-1b" if on_tpu else "tiny-cpu",
-            "max_batch": max_batch}
+    row = {"metric": "llm_decode_tokens_per_s", "value": round(tps, 1),
+           "unit": "tokens/s",
+           "config": "llama3-1b" if on_tpu else "tiny-cpu",
+           "max_batch": max_batch}
+    if client_errors:
+        # Dead clients deflate throughput: a plausible-but-wrong number
+        # must carry the evidence (module invariant).
+        row["client_errors"] = len(client_errors)
+        row["client_error_sample"] = client_errors[0]
+    return row
 
 
 def child_main() -> None:

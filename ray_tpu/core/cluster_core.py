@@ -174,7 +174,7 @@ class _KeyQueue:
 
     __slots__ = ("key", "queue", "leases", "dispatcher_running",
                  "pending_lease_requests", "wake", "lease_fail_deadline",
-                 "lease_backoff", "next_lease_attempt")
+                 "lease_backoff", "next_lease_attempt", "avg_task_s")
 
     def __init__(self, key: tuple):
         import collections
@@ -190,6 +190,10 @@ class _KeyQueue:
         # pick_node RPC + requester thread every 50ms per scheduling key.
         self.lease_backoff = 0.0
         self.next_lease_attempt = 0.0
+        # EWMA of observed execution seconds for this key: decides
+        # whether dispatch pipelines (short tasks) or holds one-per-lease
+        # (long tasks). None until the first completion reports.
+        self.avg_task_s = None
 
 
 class _ActorConn:
@@ -995,7 +999,9 @@ class ClusterCore:
             else:
                 puts.append((oid, PlasmaStub(oid), False))
         if info is not None:
-            self._lease_task_finished(info.sched_key, info.worker_addr)
+            self._lease_task_finished(
+                info.sched_key, info.worker_addr,
+                max(0.0, span[1] - span[0]) if span is not None else None)
 
     def rpc_task_done(self, conn, task_id_bytes: bytes,
                       results: List[Tuple[bytes, str, Any]],
@@ -1430,16 +1436,17 @@ class ClusterCore:
             with self._lease_lock:
                 depth = cfg.max_tasks_in_flight_per_worker
                 # The per-worker pipeline hides push RTT for short tasks —
-                # it is NOT parallel capacity. While the cluster might
-                # still grant fresh workers, dispatch at most ONE task per
-                # lease (a long task queued behind another serializes, and
-                # pushed tasks never migrate); only once leases are being
-                # declined (backoff active) or the request budget is
-                # exhausted does pipelining onto busy workers kick in.
-                saturated = (time.monotonic() < kq.next_lease_attempt
-                             or kq.pending_lease_requests
-                             >= cfg.max_pending_lease_requests_per_scheduling_key)
-                cap = depth if saturated else 1
+                # it is NOT parallel capacity. Duration-gated: once this
+                # key's observed exec-time EWMA says tasks are SHORT,
+                # pipeline to full depth (frame/wake amortization is the
+                # single-core throughput ceiling); while tasks are long —
+                # or unmeasured — hold one per lease, because a long task
+                # queued behind another serializes (pushed tasks never
+                # migrate) and a queued task goes to the FIRST lease that
+                # frees, which no fixed assignment beats.
+                short = (kq.avg_task_s is not None
+                         and kq.avg_task_s < cfg.pipeline_short_task_s)
+                cap = depth if short else 1
                 while kq.queue:
                     best = None
                     for l in kq.leases:
@@ -1779,11 +1786,15 @@ class ClusterCore:
 
     # ------------------------------------------------------------------ leases
 
-    def _lease_task_finished(self, sched_key: tuple, worker_addr: str) -> None:
+    def _lease_task_finished(self, sched_key: tuple, worker_addr: str,
+                             exec_s: Optional[float] = None) -> None:
         with self._lease_lock:
             kq = self._key_queues.get(sched_key)
             if kq is None:
                 return
+            if exec_s is not None:
+                kq.avg_task_s = (exec_s if kq.avg_task_s is None
+                                 else 0.8 * kq.avg_task_s + 0.2 * exec_s)
             for l in kq.leases:
                 if l.worker_addr == worker_addr and l.inflight > 0:
                     l.inflight -= 1

@@ -136,6 +136,9 @@ _d("lease_linger_ms", int, 100,
    "how long an idle lease is kept before returning the worker to its "
    "node (covers sync submit-get loops); long lingers serialize worker "
    "handoff between competing submitters")
+_d("pipeline_short_task_s", float, 0.05,
+   "exec-time EWMA below this pipelines tasks onto busy workers (RTT "
+   "amortization); above it, one task per lease (parallelism first)")
 _d("max_tasks_in_flight_per_worker", int, 16,
    "pipelined task pushes per leased worker (reference: "
    "RAY_max_tasks_in_flight_per_worker); bigger batches amortize frame + "

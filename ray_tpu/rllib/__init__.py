@@ -5,17 +5,23 @@ update), PPO/PPOConfig (algorithm loop), register_env.
 """
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
-from ray_tpu.rllib.env import CartPoleVecEnv, VectorEnv, make_env, register_env
+from ray_tpu.rllib.env import (CartPoleVecEnv, PendulumVecEnv, VectorEnv,
+                               make_env, register_env)
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.learner import PPOLearner
 from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.offline import (BC, BCConfig, BCLearner, CQL, CQLConfig,
+                                   CQLLearner, OfflineData)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
                                           ReplayBuffer)
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
 
 __all__ = [
-    "CartPoleVecEnv", "VectorEnv", "make_env", "register_env",
-    "EnvRunner", "EnvRunnerGroup", "PPOLearner", "PPO", "PPOConfig",
-    "DQN", "DQNConfig", "DQNLearner", "LearnerGroup",
-    "PrioritizedReplayBuffer", "ReplayBuffer",
+    "CartPoleVecEnv", "PendulumVecEnv", "VectorEnv", "make_env",
+    "register_env", "EnvRunner", "EnvRunnerGroup", "PPOLearner",
+    "PPO", "PPOConfig", "DQN", "DQNConfig", "DQNLearner", "LearnerGroup",
+    "SAC", "SACConfig", "SACLearner",
+    "BC", "BCConfig", "BCLearner", "CQL", "CQLConfig", "CQLLearner",
+    "OfflineData", "PrioritizedReplayBuffer", "ReplayBuffer",
 ]

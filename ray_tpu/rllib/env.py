@@ -22,11 +22,19 @@ class VectorEnv:
     terminal reward/done for that index and the NEXT observation is the
     reset state (matching gymnasium's VectorEnv autoreset contract that the
     reference's EnvRunner relies on).
+
+    Discrete envs set ``num_actions`` (actions are [B] ints); continuous
+    envs set ``action_size``/``action_low``/``action_high`` instead
+    (actions are [B, action_size] floats) — the same split gymnasium's
+    Discrete/Box spaces give the reference's runners.
     """
 
     num_envs: int
     observation_size: int
-    num_actions: int
+    num_actions: int = 0          # discrete action count (0 = continuous)
+    action_size: int = 0          # continuous action dim (0 = discrete)
+    action_low: float = -1.0
+    action_high: float = 1.0
 
     def reset(self, seed: Optional[int] = None) -> np.ndarray:
         raise NotImplementedError
@@ -118,7 +126,77 @@ class CartPoleVecEnv(VectorEnv):
                  "final_obs": final_obs})
 
 
-_ENV_REGISTRY = {"CartPole": CartPoleVecEnv}
+class PendulumVecEnv(VectorEnv):
+    """Inverted-pendulum swing-up, vectorized in numpy — the canonical
+    continuous-control test env (SAC's CartPole). Standard dynamics
+    (gymnasium Pendulum-v1): state (theta, theta_dot), observation
+    (cos, sin, theta_dot), torque action in [-2, 2], cost
+    theta^2 + 0.1*theta_dot^2 + 0.001*torque^2; 200-step episodes,
+    truncation only (no termination)."""
+
+    GRAVITY = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+
+    observation_size = 3
+    num_actions = 0
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, num_envs: int = 8, max_steps: int = 200,
+                 seed: int = 0):
+        self.num_envs = num_envs
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._theta = np.zeros(num_envs, np.float64)
+        self._theta_dot = np.zeros(num_envs, np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _reset_indices(self, idx: np.ndarray) -> None:
+        self._theta[idx] = self._rng.uniform(-np.pi, np.pi, len(idx))
+        self._theta_dot[idx] = self._rng.uniform(-1.0, 1.0, len(idx))
+        self._steps[idx] = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._theta_dot], axis=1).astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_indices(np.arange(self.num_envs))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = ((self._theta + np.pi) % (2 * np.pi)) - np.pi  # wrap to +-pi
+        cost = th ** 2 + 0.1 * self._theta_dot ** 2 + 0.001 * u ** 2
+        g, m, l, dt = self.GRAVITY, self.MASS, self.LENGTH, self.DT
+        th_dot = self._theta_dot + dt * (
+            3 * g / (2 * l) * np.sin(self._theta)
+            + 3.0 / (m * l ** 2) * u)
+        th_dot = np.clip(th_dot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = self._theta + dt * th_dot
+        self._theta_dot = th_dot
+        self._steps += 1
+
+        truncated = self._steps >= self.max_steps
+        terminated = np.zeros(self.num_envs, np.bool_)
+        done = truncated.copy()
+        final_obs = self._obs()
+        if done.any():
+            self._reset_indices(np.flatnonzero(done))
+        return (self._obs(), (-cost).astype(np.float32), done,
+                {"terminated": terminated, "truncated": truncated,
+                 "final_obs": final_obs})
+
+
+_ENV_REGISTRY = {"CartPole": CartPoleVecEnv, "Pendulum": PendulumVecEnv}
 
 
 def register_env(name: str, ctor) -> None:

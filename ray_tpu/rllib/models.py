@@ -85,3 +85,65 @@ def epsilon_greedy_action(params: Params, obs: jax.Array, key: jax.Array,
     random_a = jax.random.randint(ka, greedy.shape, 0, q.shape[-1])
     explore = jax.random.uniform(kr, greedy.shape) < epsilon
     return jnp.where(explore, random_a, greedy)
+
+
+# --------------------------------------------- continuous control (SAC)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_squashed_gaussian_params(key: jax.Array, obs_size: int,
+                                  act_size: int,
+                                  hidden: int = 64) -> Params:
+    """Tanh-squashed Gaussian actor (reference: SAC's RLModule actor —
+    sac_rl_module get_exploration_action_dist): one trunk, mean and
+    log-std heads."""
+    kt, km, ks = jax.random.split(key, 3)
+    return {
+        "trunk": init_mlp_params(kt, (obs_size, hidden, hidden)),
+        "mean": init_mlp_params(km, (hidden, act_size)),
+        "log_std": init_mlp_params(ks, (hidden, act_size)),
+    }
+
+
+def squashed_gaussian_sample(params: Params, obs: jax.Array,
+                             key: jax.Array, act_scale: float = 1.0):
+    """(action [..., A] in [-scale, scale], logp [...]) with the tanh
+    change-of-variables correction."""
+    h = mlp_apply(params["trunk"], obs, 2)
+    h = jnp.tanh(h)
+    mean = mlp_apply(params["mean"], h, 1)
+    log_std = jnp.clip(mlp_apply(params["log_std"], h, 1),
+                       LOG_STD_MIN, LOG_STD_MAX)
+    u = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+    # log N(u; mean, std)
+    logp = (-0.5 * ((u - mean) / jnp.exp(log_std)) ** 2
+            - log_std - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+    # tanh correction: log(1 - tanh(u)^2) in the numerically stable form
+    # 2*(log2 - u - softplus(-2u)).
+    logp = logp - (2.0 * (jnp.log(2.0) - u
+                          - jax.nn.softplus(-2.0 * u))).sum(-1)
+    return jnp.tanh(u) * act_scale, logp
+
+
+def squashed_gaussian_mode(params: Params, obs: jax.Array,
+                           act_scale: float = 1.0) -> jax.Array:
+    """Deterministic action (evaluation): tanh(mean)."""
+    h = jnp.tanh(mlp_apply(params["trunk"], obs, 2))
+    return jnp.tanh(mlp_apply(params["mean"], h, 1)) * act_scale
+
+
+def init_twin_q_params(key: jax.Array, obs_size: int, act_size: int,
+                       hidden: int = 64) -> Params:
+    """Two independent Q(s, a) critics (reference: SAC twin-Q)."""
+    k1, k2 = jax.random.split(key)
+    sizes = (obs_size + act_size, hidden, hidden, 1)
+    return {"q1": init_mlp_params(k1, sizes),
+            "q2": init_mlp_params(k2, sizes)}
+
+
+def twin_q_apply(params: Params, obs: jax.Array,
+                 action: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    x = jnp.concatenate([obs, action], axis=-1)
+    return (mlp_apply(params["q1"], x, 3)[..., 0],
+            mlp_apply(params["q2"], x, 3)[..., 0])

@@ -1,0 +1,419 @@
+"""Offline RL: experience datasets + BC + discrete CQL.
+
+Parity target: the reference's offline-RL stack
+(reference: rllib/offline/offline_data.py OfflineData — Ray-Data-backed
+experience reading/sampling, offline_prelearner.py batch conversion;
+rllib/algorithms/bc/bc.py BC behavior cloning; rllib/algorithms/cql/
+cql.py + cql_torch_learner.py conservative Q-learning). TPU-first: the
+experience store IS a ray_tpu.data Dataset of transition columns (numpy
+blocks stream through the shm object plane, exactly like any other
+dataset), and both learners are jitted pytree updates on the
+models.py MLPs — the same learner protocol DQN/SAC/PPO use, so
+LearnerGroup data-parallelism composes unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rllib.dqn import DQNLearner
+from ray_tpu.rllib.env import make_env
+
+_COLUMNS = ("obs", "actions", "rewards", "next_obs", "dones")
+
+
+class OfflineData:
+    """Experience container bridging RL to the data plane.
+
+    (reference: offline_data.py OfflineData wraps a ray.data Dataset and
+    hands sampled batches to learners). Build it from collected
+    transition batches, a live replay buffer, or any ray_tpu.data
+    Dataset with the transition columns.
+    """
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+        self._cached: Optional[Dict[str, np.ndarray]] = None
+
+    # ------------------------------------------------------ constructors
+
+    @classmethod
+    def from_batches(cls, batches) -> "OfflineData":
+        """From transition dicts as produced by the env runners."""
+        from ray_tpu import data as rdata
+
+        merged = {
+            k: np.concatenate([np.asarray(b[k]) for b in batches])
+            for k in _COLUMNS}
+        return cls(rdata.from_numpy(merged))
+
+    @classmethod
+    def from_buffer(cls, buffer) -> "OfflineData":
+        """Snapshot a live ReplayBuffer's contents (the replay-buffer ->
+        dataset bridge)."""
+        from ray_tpu import data as rdata
+
+        n = len(buffer)
+        arrays = {
+            "obs": buffer._obs[:n].copy(),
+            "actions": buffer._actions[:n].copy(),
+            "rewards": buffer._rewards[:n].copy(),
+            "next_obs": buffer._next_obs[:n].copy(),
+            "dones": buffer._dones[:n].copy(),
+        }
+        return cls(rdata.from_numpy(arrays))
+
+    # ------------------------------------------------------------ access
+
+    def _materialize(self) -> Dict[str, np.ndarray]:
+        """Offline batches are sampled i.i.d. every step; stream once,
+        then sample from host memory (the reference similarly
+        materializes/caches episodes per learner)."""
+        if self._cached is None:
+            parts: Dict[str, list] = {k: [] for k in _COLUMNS}
+            for block in self.dataset.iter_batches(batch_size=None):
+                for k in _COLUMNS:
+                    parts[k].append(np.asarray(block[k]))
+            self._cached = {k: np.concatenate(v) for k, v in parts.items()}
+        return self._cached
+
+    def __len__(self) -> int:
+        return len(self._materialize()["actions"])
+
+    def sample(self, batch_size: int,
+               rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        data = self._materialize()
+        idx = rng.integers(0, len(data["actions"]), batch_size)
+        return {k: v[idx] for k, v in data.items()}
+
+    def iter_epochs(self, batch_size: int, epochs: int,
+                    seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """Shuffled epoch iteration (BC-style supervised passes)."""
+        data = self._materialize()
+        n = len(data["actions"])
+        rng = np.random.default_rng(seed)
+        for _ in range(epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n - batch_size + 1, batch_size):
+                idx = perm[lo:lo + batch_size]
+                yield {k: v[idx] for k, v in data.items()}
+
+
+# --------------------------------------------------------------------------
+# Behavior cloning
+# --------------------------------------------------------------------------
+
+
+class BCLearnerState(NamedTuple):
+    params: Any
+    opt_state: Any
+
+
+class BCLearner:
+    """Discrete behavior cloning: cross-entropy on dataset actions
+    (reference: bc.py BC's supervised -logp objective)."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 hidden: int = 64, lr: float = 1e-3, seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.rllib import models
+
+        self._tx = optax.adam(lr)
+        params = models.init_q_params(jax.random.PRNGKey(seed), obs_size,
+                                      num_actions, hidden)
+        self.state = BCLearnerState(params, self._tx.init(params))
+        self._grads_fn = jax.jit(self._compute_grads_impl)
+        self._apply_fn = jax.jit(self._apply_grads_impl)
+
+    def get_weights(self):
+        return self.state.params
+
+    def set_weights(self, params) -> None:
+        self.state = self.state._replace(params=params)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        grads, stats, _ = self.compute_grads(batch)
+        self.apply_grads(grads)
+        return stats
+
+    def compute_grads(self, batch: Dict[str, np.ndarray]):
+        grads, (loss, acc) = self._grads_fn(self.state, batch)
+        return grads, {"loss": float(loss),
+                       "action_accuracy": float(acc)}, None
+
+    def apply_grads(self, grads) -> None:
+        self.state = self._apply_fn(self.state, grads)
+
+    def _compute_grads_impl(self, state: BCLearnerState, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import models
+
+        obs = batch["obs"]
+        actions = batch["actions"]
+
+        def loss_fn(params):
+            logits = models.q_apply(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None], axis=-1)[:, 0]
+            acc = (jnp.argmax(logits, -1) == actions).mean()
+            return nll.mean(), acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return grads, (loss, acc)
+
+    def _apply_grads_impl(self, state: BCLearnerState, grads):
+        import optax
+
+        updates, opt_state = self._tx.update(grads, state.opt_state,
+                                             state.params)
+        return BCLearnerState(optax.apply_updates(state.params, updates),
+                              opt_state)
+
+
+# --------------------------------------------------------------------------
+# Discrete CQL
+# --------------------------------------------------------------------------
+
+
+class CQLLearner(DQNLearner):
+    """Conservative Q-learning on the double-DQN TD update
+    (reference: cql_torch_learner.py — TD loss + cql_alpha *
+    (logsumexp_a Q(s,a) - Q(s, a_data)), the discrete CQL(H)
+    regularizer that pushes Q down on out-of-distribution actions)."""
+
+    def __init__(self, obs_size: int, num_actions: int, *,
+                 cql_alpha: float = 1.0, **kw):
+        self.cql_alpha = cql_alpha
+        super().__init__(obs_size, num_actions, **kw)
+
+    def _compute_grads_impl(self, state, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import models
+
+        obs = batch["obs"]
+        actions = batch["actions"]
+        rewards = batch["rewards"]
+        next_obs = batch["next_obs"]
+        dones = batch["dones"]
+
+        next_a = jnp.argmax(models.q_apply(state.params, next_obs), axis=-1)
+        next_q = jnp.take_along_axis(
+            models.q_apply(state.target_params, next_obs),
+            next_a[:, None], axis=-1)[:, 0]
+        targets = rewards + self.gamma * (1.0 - dones) * next_q
+        targets = jax.lax.stop_gradient(targets)
+
+        def loss_fn(params):
+            q_all = models.q_apply(params, obs)
+            q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+            td = q - targets
+            d = self.huber_delta
+            hub = jnp.where(jnp.abs(td) <= d, 0.5 * td ** 2,
+                            d * (jnp.abs(td) - 0.5 * d))
+            conservative = (jax.scipy.special.logsumexp(q_all, axis=-1)
+                            - q).mean()
+            return hub.mean() + self.cql_alpha * conservative, (q.mean(), td)
+
+        (loss, (qmean, td)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return grads, (loss, qmean, td)
+
+
+# --------------------------------------------------------------------------
+# Algorithm drivers
+# --------------------------------------------------------------------------
+
+
+def _evaluate_greedy(params, env_spec, *, episodes: int = 8,
+                     seed: int = 123) -> float:
+    """Roll the greedy policy; returns mean episode return (the
+    reference's evaluation EnvRunner role for offline algos, which can
+    never score themselves from their fixed dataset)."""
+    import jax
+
+    from ray_tpu.rllib import models
+
+    env = make_env(env_spec, num_envs=episodes, seed=seed)
+    act = jax.jit(lambda p, o: models.q_apply(p, o).argmax(-1))
+    obs = env.reset(seed=seed)
+    ep_return = np.zeros(episodes, np.float64)
+    total = np.full(episodes, np.nan)
+    for _ in range(2000):
+        obs, r, done, _info = env.step(np.asarray(act(params, obs)))
+        ep_return += r * np.isnan(total)  # only first episode per slot
+        for i in np.flatnonzero(done):
+            if np.isnan(total[i]):
+                total[i] = ep_return[i]
+        if not np.isnan(total).any():
+            break
+    return float(np.nanmean(np.where(np.isnan(total), ep_return, total)))
+
+
+@dataclasses.dataclass
+class BCConfig:
+    """(reference: BCConfig fluent API, trimmed)."""
+
+    env: Union[str, Callable] = "CartPole"   # for evaluation only
+    data: Optional[OfflineData] = None       # set via .offline_data()
+    hidden: int = 64
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    updates_per_iteration: int = 100
+    num_learners: int = 0
+    seed: int = 0
+
+    def training(self, *, lr: float = None, train_batch_size: int = None,
+                 updates_per_iteration: int = None) -> "BCConfig":
+        for name, val in (("lr", lr),
+                          ("train_batch_size", train_batch_size),
+                          ("updates_per_iteration", updates_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def offline_data(self, data: OfflineData) -> "BCConfig":
+        self.data = data
+        return self
+
+    def environment(self, env) -> "BCConfig":
+        self.env = env
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class _OfflineAlgo:
+    """Shared offline train loop: sample from the dataset, update the
+    learner group, evaluate greedily on the real env."""
+
+    def __init__(self, config, learner_factory):
+        from ray_tpu.rllib.learner_group import LearnerGroup
+
+        self.config = config
+        if config.data is None:
+            raise ValueError(
+                "no offline data configured: pass an OfflineData via "
+                "config.offline_data(...) before build()")
+        self.data: OfflineData = config.data
+        self.learner_group = LearnerGroup(
+            learner_factory, num_learners=config.num_learners)
+        self._rng = np.random.default_rng(config.seed)
+        self._iteration = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        stats: Dict[str, Any] = {}
+        for _ in range(self.config.updates_per_iteration):
+            batch = self.data.sample(self.config.train_batch_size,
+                                     self._rng)
+            stats = self.learner_group.update_from_batch(batch)
+            stats.pop("td_errors", None)
+        return stats
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        stats = self.training_step()
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "time_this_iter_s": time.monotonic() - t0,
+            "learners": {"default_policy": stats},
+        }
+
+    def evaluate(self, episodes: int = 8) -> Dict[str, Any]:
+        ret = _evaluate_greedy(self.learner_group.get_weights(),
+                               self.config.env, episodes=episodes,
+                               seed=self.config.seed + 777)
+        return {"env_runners": {"episode_return_mean": ret}}
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self) -> None:
+        self.learner_group.stop()
+
+
+class BC(_OfflineAlgo):
+    """(reference: BC(Algorithm) — pure supervised policy extraction)."""
+
+    def __init__(self, config: BCConfig):
+        probe = make_env(config.env, num_envs=1, seed=config.seed)
+        obs_size, num_actions = probe.observation_size, probe.num_actions
+
+        def factory():
+            return BCLearner(obs_size, num_actions, hidden=config.hidden,
+                             lr=config.lr, seed=config.seed)
+
+        super().__init__(config, factory)
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    """(reference: CQLConfig fluent API, trimmed to the discrete case)."""
+
+    env: Union[str, Callable] = "CartPole"
+    data: Optional[OfflineData] = None       # set via .offline_data()
+    hidden: int = 64
+    lr: float = 1e-3
+    gamma: float = 0.99
+    cql_alpha: float = 1.0
+    target_update_freq: int = 200
+    train_batch_size: int = 256
+    updates_per_iteration: int = 100
+    num_learners: int = 0
+    seed: int = 0
+
+    def training(self, *, lr: float = None, gamma: float = None,
+                 cql_alpha: float = None, train_batch_size: int = None,
+                 target_network_update_freq: int = None,
+                 updates_per_iteration: int = None) -> "CQLConfig":
+        for name, val in (("lr", lr), ("gamma", gamma),
+                          ("cql_alpha", cql_alpha),
+                          ("train_batch_size", train_batch_size),
+                          ("target_update_freq",
+                           target_network_update_freq),
+                          ("updates_per_iteration", updates_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def offline_data(self, data: OfflineData) -> "CQLConfig":
+        self.data = data
+        return self
+
+    def environment(self, env) -> "CQLConfig":
+        self.env = env
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL(_OfflineAlgo):
+    """(reference: CQL(Algorithm) — offline TD with the conservative
+    regularizer; discrete variant)."""
+
+    def __init__(self, config: CQLConfig):
+        probe = make_env(config.env, num_envs=1, seed=config.seed)
+        obs_size, num_actions = probe.observation_size, probe.num_actions
+
+        def factory():
+            return CQLLearner(
+                obs_size, num_actions, cql_alpha=config.cql_alpha,
+                hidden=config.hidden, lr=config.lr, gamma=config.gamma,
+                target_update_freq=config.target_update_freq,
+                seed=config.seed)
+
+        super().__init__(config, factory)

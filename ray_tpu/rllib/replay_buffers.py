@@ -19,11 +19,17 @@ class ReplayBuffer:
     """Uniform ring replay buffer over [obs, action, reward, next_obs,
     done] transitions."""
 
-    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0,
+                 action_size: int = 0):
+        """``action_size`` 0 = discrete scalar int actions (DQN); N > 0 =
+        continuous [N]-float actions (SAC)."""
         self.capacity = int(capacity)
         self._obs = np.empty((capacity, obs_size), np.float32)
         self._next_obs = np.empty((capacity, obs_size), np.float32)
-        self._actions = np.empty((capacity,), np.int32)
+        if action_size:
+            self._actions = np.empty((capacity, action_size), np.float32)
+        else:
+            self._actions = np.empty((capacity,), np.int32)
         self._rewards = np.empty((capacity,), np.float32)
         self._dones = np.empty((capacity,), np.float32)
         self._size = 0

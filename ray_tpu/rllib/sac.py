@@ -1,0 +1,455 @@
+"""SAC: soft actor-critic for continuous control.
+
+Parity target: the reference SAC family
+(reference: rllib/algorithms/sac/sac.py SAC/SACConfig,
+sac/sac_learner.py + torch/sac_torch_learner.py — twin-Q critics with
+Polyak-averaged targets, tanh-squashed Gaussian actor, automatic entropy
+temperature tuned toward a target entropy, sample->store->replay->update
+training_step). TPU-first: actor, twin critics, temperature, and Polyak
+update all advance inside ONE jitted step over a single state pytree —
+the grads path is split (compute_grads/apply_grads) at the critic/actor
+level so a LearnerGroup can allreduce between halves, same cut as
+dqn.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class SACLearnerState(NamedTuple):
+    actor: Any
+    critic: Any
+    target_critic: Any
+    log_alpha: Any
+    actor_opt: Any
+    critic_opt: Any
+    alpha_opt: Any
+    key: Any
+
+
+class SACLearner:
+    """Twin-Q soft actor-critic over jitted updates."""
+
+    def __init__(self, obs_size: int, act_size: int, *, hidden: int = 64,
+                 actor_lr: float = 3e-4, critic_lr: float = 3e-4,
+                 alpha_lr: float = 3e-4, gamma: float = 0.99,
+                 tau: float = 0.005, act_scale: float = 1.0,
+                 target_entropy: Optional[float] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib import models
+
+        self.gamma = gamma
+        self.tau = tau
+        self.act_scale = act_scale
+        # Reference default: -dim(A) (sac.py target_entropy="auto").
+        self.target_entropy = (-float(act_size) if target_entropy is None
+                               else float(target_entropy))
+        self._actor_tx = optax.adam(actor_lr)
+        self._critic_tx = optax.adam(critic_lr)
+        self._alpha_tx = optax.adam(alpha_lr)
+        k_actor, k_critic, k_run = jax.random.split(
+            jax.random.PRNGKey(seed), 3)
+        actor = models.init_squashed_gaussian_params(
+            k_actor, obs_size, act_size, hidden)
+        critic = models.init_twin_q_params(k_critic, obs_size, act_size,
+                                           hidden)
+        self.state = SACLearnerState(
+            actor=actor,
+            critic=critic,
+            target_critic=jax.tree.map(jnp.copy, critic),
+            log_alpha=jnp.zeros((), jnp.float32),
+            actor_opt=self._actor_tx.init(actor),
+            critic_opt=self._critic_tx.init(critic),
+            alpha_opt=self._alpha_tx.init(jnp.zeros((), jnp.float32)),
+            key=k_run,
+        )
+        self._grads_fn = jax.jit(self._compute_grads_impl)
+        self._apply_fn = jax.jit(self._apply_grads_impl)
+
+    # ------------------------------------------------------------- weights
+
+    def get_weights(self):
+        return self.state.actor
+
+    def set_weights(self, actor) -> None:
+        self.state = self.state._replace(actor=actor)
+
+    # -------------------------------------------------------------- update
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        grads, stats, _ = self.compute_grads(batch)
+        self.apply_grads(grads)
+        return stats
+
+    def compute_grads(self, batch: Dict[str, np.ndarray]):
+        """(grads dict {actor, critic, alpha}, scalar stats, None) — the
+        multi-learner allreduce cut; the trailing None fills the
+        td_errors slot of the LearnerGroup learner protocol (SAC has no
+        per-row priorities)."""
+        self.state, grads, stats = self._grads_fn(self.state, batch)
+        return grads, {k: float(v) for k, v in stats.items()}, None
+
+    def apply_grads(self, grads) -> None:
+        self.state = self._apply_fn(self.state, grads)
+
+    # ---------------------------------------------------------------- impl
+
+    def _compute_grads_impl(self, state: SACLearnerState, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import models
+
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, next_obs = batch["rewards"], batch["next_obs"]
+        dones = batch["dones"]
+        key, k_next, k_pi = jax.random.split(state.key, 3)
+        alpha = jnp.exp(state.log_alpha)
+
+        # Soft TD target: r + gamma * (min_i Q_i(s', a') - alpha*logp(a')).
+        next_a, next_logp = models.squashed_gaussian_sample(
+            state.actor, next_obs, k_next, self.act_scale)
+        tq1, tq2 = models.twin_q_apply(state.target_critic, next_obs,
+                                       next_a)
+        target = rewards + self.gamma * (1.0 - dones) * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+
+        def critic_loss_fn(critic):
+            q1, q2 = models.twin_q_apply(critic, obs, actions)
+            return (((q1 - target) ** 2).mean()
+                    + ((q2 - target) ** 2).mean()), q1.mean()
+
+        (critic_loss, q_mean), critic_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(state.critic)
+
+        def actor_loss_fn(actor):
+            a, logp = models.squashed_gaussian_sample(
+                actor, obs, k_pi, self.act_scale)
+            q1, q2 = models.twin_q_apply(state.critic, obs, a)
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp.mean()
+
+        (actor_loss, logp_mean), actor_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(state.actor)
+
+        # Temperature: push entropy toward target_entropy (reference:
+        # sac_learner's alpha loss -log_alpha * (logp + target_entropy)).
+        def alpha_loss_fn(log_alpha):
+            return (-log_alpha * jax.lax.stop_gradient(
+                logp_mean + self.target_entropy))
+
+        alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(
+            state.log_alpha)
+
+        grads = {"actor": actor_grads, "critic": critic_grads,
+                 "alpha": alpha_grad}
+        stats = {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                 "alpha_loss": alpha_loss, "alpha": alpha,
+                 "q_mean": q_mean, "entropy": -logp_mean}
+        return state._replace(key=key), grads, stats
+
+    def _apply_grads_impl(self, state: SACLearnerState, grads):
+        import jax
+        import optax
+
+        c_up, c_opt = self._critic_tx.update(grads["critic"],
+                                             state.critic_opt, state.critic)
+        critic = optax.apply_updates(state.critic, c_up)
+        a_up, a_opt = self._actor_tx.update(grads["actor"],
+                                            state.actor_opt, state.actor)
+        actor = optax.apply_updates(state.actor, a_up)
+        al_up, al_opt = self._alpha_tx.update(grads["alpha"],
+                                              state.alpha_opt,
+                                              state.log_alpha)
+        log_alpha = optax.apply_updates(state.log_alpha, al_up)
+        # Polyak averaging (reference: tau target_network_update).
+        tau = self.tau
+        target = jax.tree.map(lambda t, p: (1 - tau) * t + tau * p,
+                              state.target_critic, critic)
+        return state._replace(actor=actor, critic=critic,
+                              target_critic=target, log_alpha=log_alpha,
+                              actor_opt=a_opt, critic_opt=c_opt,
+                              alpha_opt=al_opt)
+
+
+class _SACRunner:
+    """Stochastic-policy transition collector over a continuous vector
+    env (the off-policy EnvRunner role, sampling from the live actor)."""
+
+    def __init__(self, env_spec, num_envs: int, seed: int = 0,
+                 warmup_uniform_steps: int = 0):
+        import jax
+
+        from ray_tpu.rllib import models
+
+        self.env = make_env(env_spec, num_envs=num_envs, seed=seed)
+        assert self.env.action_size, "SAC requires a continuous env"
+        self.obs = self.env.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._scale = float(self.env.action_high)
+        self._sample = jax.jit(lambda p, o, k: models.
+                               squashed_gaussian_sample(p, o, k,
+                                                        self._scale)[0])
+        self._params = None
+        self._rng = np.random.default_rng(seed)
+        self._uniform_left = int(warmup_uniform_steps)
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed: list = []
+
+    def set_weights(self, params_ref) -> bool:
+        import ray_tpu
+
+        self._params = (ray_tpu.get(params_ref)
+                        if isinstance(params_ref, ray_tpu.ObjectRef)
+                        else params_ref)
+        return True
+
+    def sample_transitions(self, n_steps: int) -> Dict[str, np.ndarray]:
+        import jax
+
+        assert self._params is not None
+        B = self.env.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(n_steps):
+            if self._uniform_left > 0:
+                # Uniform warmup (reference: random_steps_sampled... /
+                # SACConfig's initial exploration) seeds the buffer with
+                # diverse actions before the actor knows anything.
+                a = self._rng.uniform(self.env.action_low,
+                                      self.env.action_high,
+                                      (B, self.env.action_size)
+                                      ).astype(np.float32)
+                self._uniform_left -= 1
+            else:
+                self._key, k = jax.random.split(self._key)
+                a = np.asarray(self._sample(self._params, self.obs, k))
+            prev_obs = self.obs
+            self.obs, r, done, info = self.env.step(a)
+            terminated = info.get("terminated", done)
+            final_obs = info.get("final_obs", self.obs)
+            next_obs = np.where(done[:, None], final_obs, self.obs)
+            obs_l.append(prev_obs)
+            act_l.append(a)
+            rew_l.append(r)
+            next_l.append(next_obs)
+            done_l.append(terminated.astype(np.float32))
+            self._ep_return += r
+            for i in np.flatnonzero(done):
+                self._completed.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+        return {
+            "obs": np.concatenate(obs_l),
+            "actions": np.concatenate(act_l),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l),
+            "dones": np.concatenate(done_l),
+            "steps": n_steps * B,
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        return {
+            "episode_return_mean":
+                float(np.mean(completed)) if completed else None,
+            "num_episodes": len(completed),
+        }
+
+
+@dataclasses.dataclass
+class SACConfig:
+    """Builder-style config (reference: SACConfig fluent API)."""
+
+    env: Union[str, Callable] = "Pendulum"
+    num_env_runners: int = 0
+    num_envs_per_runner: int = 8
+    rollout_steps: int = 16
+    hidden: int = 64
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    target_entropy: Optional[float] = None
+    buffer_capacity: int = 100_000
+    learning_starts: int = 1_000
+    warmup_uniform_steps: int = 64   # per runner, env steps
+    train_batch_size: int = 128
+    updates_per_iteration: int = 64
+    num_learners: int = 0
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int = None,
+                    num_envs_per_env_runner: int = None,
+                    rollout_fragment_length: int = None) -> "SACConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_steps = rollout_fragment_length
+        return self
+
+    def training(self, *, actor_lr: float = None, critic_lr: float = None,
+                 alpha_lr: float = None, gamma: float = None,
+                 tau: float = None, train_batch_size: int = None,
+                 target_entropy: float = None,
+                 num_steps_sampled_before_learning_starts: int = None,
+                 updates_per_iteration: int = None,
+                 buffer_capacity: int = None) -> "SACConfig":
+        for name, val in (("actor_lr", actor_lr), ("critic_lr", critic_lr),
+                          ("alpha_lr", alpha_lr), ("gamma", gamma),
+                          ("tau", tau),
+                          ("train_batch_size", train_batch_size),
+                          ("target_entropy", target_entropy),
+                          ("learning_starts",
+                           num_steps_sampled_before_learning_starts),
+                          ("updates_per_iteration", updates_per_iteration),
+                          ("buffer_capacity", buffer_capacity)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def learners(self, *, num_learners: int = None) -> "SACConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """The algorithm object (reference: SAC(Algorithm), training_step:
+    sample -> store -> replay -> twin-Q/actor/alpha update -> Polyak ->
+    weight sync)."""
+
+    def __init__(self, config: SACConfig):
+        import ray_tpu
+        from ray_tpu.rllib.learner_group import LearnerGroup
+
+        self.config = config
+        probe = make_env(config.env, num_envs=1, seed=config.seed)
+        assert probe.action_size, "SAC requires a continuous-action env"
+        obs_size, act_size = probe.observation_size, probe.action_size
+        act_scale = float(probe.action_high)
+
+        def factory():
+            return SACLearner(
+                obs_size, act_size, hidden=config.hidden,
+                actor_lr=config.actor_lr, critic_lr=config.critic_lr,
+                alpha_lr=config.alpha_lr, gamma=config.gamma,
+                tau=config.tau, act_scale=act_scale,
+                target_entropy=config.target_entropy, seed=config.seed)
+
+        self.learner_group = LearnerGroup(
+            factory, num_learners=config.num_learners)
+        self.buffer = ReplayBuffer(config.buffer_capacity, obs_size,
+                                   seed=config.seed, action_size=act_size)
+        if config.num_env_runners == 0:
+            self._local_runner: Optional[_SACRunner] = _SACRunner(
+                config.env, config.num_envs_per_runner, config.seed,
+                config.warmup_uniform_steps)
+            self._runner_actors = []
+        else:
+            self._local_runner = None
+            cls = ray_tpu.remote(_SACRunner)
+            self._runner_actors = [
+                cls.remote(config.env, config.num_envs_per_runner,
+                           config.seed + 1000 * i,
+                           config.warmup_uniform_steps)
+                for i in range(config.num_env_runners)]
+        self._sync_runner_weights()
+        self._iteration = 0
+        self._total_steps = 0
+
+    def _sync_runner_weights(self) -> None:
+        import ray_tpu
+
+        w = self.learner_group.get_weights()
+        if self._local_runner is not None:
+            self._local_runner.set_weights(w)
+            return
+        ref = ray_tpu.put(w)
+        ray_tpu.get([a.set_weights.remote(ref)
+                     for a in self._runner_actors])
+
+    def _collect(self) -> int:
+        import ray_tpu
+
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample_transitions(
+                self.config.rollout_steps)]
+        else:
+            batches = ray_tpu.get([
+                a.sample_transitions.remote(self.config.rollout_steps)
+                for a in self._runner_actors])
+        steps = 0
+        for b in batches:
+            self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
+                                  b["next_obs"], b["dones"])
+            steps += int(b["steps"])
+        return steps
+
+    def training_step(self) -> Dict[str, Any]:
+        self._total_steps += self._collect()
+        stats: Dict[str, Any] = {}
+        if len(self.buffer) >= self.config.learning_starts:
+            for _ in range(self.config.updates_per_iteration):
+                batch = self.buffer.sample(self.config.train_batch_size)
+                stats = self.learner_group.update_from_batch(batch)
+            self._sync_runner_weights()
+        return stats
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        learner_stats = self.training_step()
+        self._iteration += 1
+        if self._local_runner is not None:
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            import ray_tpu
+
+            metrics = ray_tpu.get([a.get_metrics.remote()
+                                   for a in self._runner_actors])
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m.get("episode_return_mean") is not None]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_this_iter_s": time.monotonic() - t0,
+            "env_runners": {
+                "episode_return_mean":
+                    float(np.mean(returns)) if returns else None,
+                "num_episodes": sum(m.get("num_episodes", 0)
+                                    for m in metrics),
+            },
+            "learners": {"default_policy": learner_stats},
+        }
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        self.learner_group.stop()
+        for a in self._runner_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -509,6 +509,16 @@ class _GraphRun:
                 if not progressed and not self.running:
                     _time.sleep(0.02)
             return self.results[root_sid]
+        except BaseException:
+            # A permanently failed step must not strand sibling branches:
+            # a long-running step would otherwise hold its worker for its
+            # full duration after the workflow is already FAILED.
+            for ref in list(self.running):
+                try:
+                    ray_tpu.cancel(ref, force=True)
+                except Exception:
+                    pass
+            raise
         finally:
             if self._stop is not None:
                 self._stop.set()  # unblock event-wait threads
