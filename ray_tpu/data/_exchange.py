@@ -23,7 +23,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.block import (Block, BlockAccessor, BlockMetadata,
+                                col_len, col_slice, col_sort_indices,
+                                col_sorted_sample, col_take, col_tolist,
+                                col_unique_inverse, is_arrow_col)
 
 # --------------------------------------------------------------------------
 # Remote stage functions (module-level: pickled by reference, tiny specs)
@@ -40,13 +43,13 @@ def _partition_block(block: Block, assignment_fn_blob, n: int,
     acc = BlockAccessor(block)
     rows = acc.num_rows()
     if rows == 0:
-        empty = {k: v[:0] for k, v in block.items()}
+        empty = {k: col_slice(v, 0, 0) for k, v in block.items()}
         return tuple(empty for _ in range(n)) if n > 1 else empty
     part_ids = assignment_fn_blob(block, block_index)
     out = []
     for j in range(n):
         idx = np.flatnonzero(part_ids == j)
-        out.append({k: v[idx] for k, v in block.items()})
+        out.append({k: col_take(v, idx) for k, v in block.items()})
     return tuple(out) if n > 1 else out[0]
 
 
@@ -58,20 +61,17 @@ def _merge_blocks(finalize_fn_blob, *pieces: Block):
     inline so the driver never fetches block bytes for bookkeeping."""
     merged = BlockAccessor.concat(list(pieces))
     if not merged and pieces:
-        merged = {k: v[:0] for k, v in pieces[0].items()}
+        merged = {k: col_slice(v, 0, 0) for k, v in pieces[0].items()}
     if finalize_fn_blob:
         merged = finalize_fn_blob(merged)
     return merged, BlockMetadata.of(merged)
 
 
 @ray_tpu.remote
-def _sample_keys(block: Block, key: str, k: int) -> np.ndarray:
-    """Sort sample stage: up to k evenly-spaced key values."""
-    col = block[key]
-    if len(col) <= k:
-        return np.sort(col)
-    idx = np.linspace(0, len(col) - 1, k).astype(np.int64)
-    return np.sort(col[idx])
+def _sample_keys(block: Block, key: str, k: int):
+    """Sort sample stage: up to k evenly-spaced key values (numpy array
+    or, for arrow key columns, a sorted python list)."""
+    return col_sorted_sample(block[key], k)
 
 
 # --------------------------------------------------------------------------
@@ -172,10 +172,14 @@ def shuffle_exchange(bundles, num_outputs: int, seed: Optional[int]):
         mix = 0
         if block:
             first = next(iter(block.values()))
-            mix = zlib.crc32(np.ascontiguousarray(first[:64]).tobytes())
+            if is_arrow_col(first):
+                mix = zlib.crc32(repr(col_tolist(
+                    col_slice(first, 0, 64))).encode())
+            else:
+                mix = zlib.crc32(np.ascontiguousarray(first[:64]).tobytes())
         rng = np.random.default_rng([int(base) & 0xFFFFFFFF, 7, n, mix])
         perm = rng.permutation(n)
-        return {k: v[perm] for k, v in block.items()}
+        return {k: col_take(v, perm) for k, v in block.items()}
 
     return exchange(bundles, assign, num_outputs, finalize)
 
@@ -194,15 +198,46 @@ def sort_exchange(bundles, key: str, descending: bool, num_outputs: int):
     nonempty = [s for s in samples if len(s)]
     if not nonempty:
         return bundles  # no rows anywhere: nothing to sort
-    allkeys = np.sort(np.concatenate(nonempty))
+    # Arrow key columns sample as python lists (kept as a python
+    # boundary list — no numpy coercion, which would stringify or
+    # width-truncate); numpy keys stay numpy arrays.
+    arrow_mode = any(isinstance(s, list) for s in nonempty)
+    if arrow_mode:
+        merged = sorted(v for s in nonempty
+                        for v in (s if isinstance(s, list) else s.tolist()))
+        n_keys = len(merged)
+    else:
+        allkeys = np.sort(np.concatenate(nonempty))
+        n_keys = len(allkeys)
     # Positional sample quantiles, not np.quantile: interpolation rejects
     # non-numeric dtypes, but sort keys may be strings/datetimes.
-    pos = np.linspace(0, len(allkeys) - 1,
+    pos = np.linspace(0, n_keys - 1,
                       num_outputs + 1)[1:-1].astype(np.int64)
-    boundaries = allkeys[pos]
+    boundaries = ([merged[i] for i in pos] if arrow_mode
+                  else allkeys[pos])
 
     def assign(block: Block, block_index: int) -> np.ndarray:
-        part = np.searchsorted(boundaries, block[key], side="right")
+        col = block[key]
+        if is_arrow_col(col):
+            # bisect over the python boundary list: correct for any
+            # comparable key type (strings, datetimes, decimals) with no
+            # dtype coercion; nulls sort last globally -> the final
+            # output partition.
+            import bisect
+
+            bounds = (list(boundaries) if not isinstance(boundaries, list)
+                      else boundaries)
+            part = np.empty(col_len(col), np.int64)
+            for i, v in enumerate(col_tolist(col)):
+                if v is None:
+                    part[i] = num_outputs - 1
+                elif descending:
+                    part[i] = ((num_outputs - 1)
+                               - bisect.bisect_right(bounds, v))
+                else:
+                    part[i] = bisect.bisect_right(bounds, v)
+            return part
+        part = np.searchsorted(boundaries, col, side="right")
         if descending:
             part = (num_outputs - 1) - part
         return part
@@ -210,10 +245,8 @@ def sort_exchange(bundles, key: str, descending: bool, num_outputs: int):
     def finalize(block: Block) -> Block:
         if not block:
             return block
-        order = np.argsort(block[key], kind="stable")
-        if descending:
-            order = order[::-1]
-        return {k: v[order] for k, v in block.items()}
+        order = col_sort_indices(block[key], descending)
+        return {k: col_take(v, order) for k, v in block.items()}
 
     return exchange(bundles, assign, num_outputs, finalize)
 
@@ -249,13 +282,18 @@ def groupby_exchange(bundles, key: str, num_outputs: int,
                 return zlib.crc32(x)
             if isinstance(x, str):
                 return zlib.crc32(x.encode("utf-8", "surrogatepass"))
+            if x is None:
+                return -0x5DB1_57E5  # nulls form their own group
             raise TypeError(
                 f"groupby key values must be str/bytes/numeric, got "
                 f"{type(x).__name__}: partition assignment for arbitrary "
                 f"objects is not process-stable")
 
         col = block[key]
-        if col.dtype.kind in "iub":
+        if is_arrow_col(col):
+            h = np.array([scalar_hash(x) for x in col_tolist(col)],
+                         np.int64)
+        elif col.dtype.kind in "iub":
             h = col.astype(np.int64)
         elif col.dtype.kind == "f":
             # -0.0 == 0.0 must land in one partition: normalize the bit
@@ -291,14 +329,20 @@ def make_group_aggregator(specs: List[Tuple[str, Optional[str], str]]):
                 cols[out_name] = np.empty(0)
             return cols
         keys = block[key]
-        uniq, inverse = np.unique(keys, return_inverse=True)
+        uniq, inverse = col_unique_inverse(keys)
         g = len(uniq)
         out: Dict[str, np.ndarray] = {key: uniq}
         for agg, vcol, out_name in specs:
             if agg == "count":
                 out[out_name] = np.bincount(inverse, minlength=g)
                 continue
-            vals = block[vcol].astype(np.float64)
+            vcol_raw = block[vcol]
+            if is_arrow_col(vcol_raw):
+                # e.g. map_groups outputs or exotic schemas: null -> NaN.
+                vals = vcol_raw.to_numpy(zero_copy_only=False).astype(
+                    np.float64)
+            else:
+                vals = vcol_raw.astype(np.float64)
             if agg == "sum":
                 out[out_name] = np.bincount(inverse, weights=vals,
                                             minlength=g)

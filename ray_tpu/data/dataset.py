@@ -26,7 +26,8 @@ from ray_tpu.data._streaming import (ActorPoolMapOperator, DriverOperator,
                                      InputOperator, LimitOperator, Operator,
                                      RefBundle, TaskPoolMapOperator,
                                      execute_plan, explain_plan)
-from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.block import (Block, BlockAccessor, BlockMetadata,
+                                col_take, col_unique_inverse)
 
 
 class Dataset:
@@ -220,7 +221,7 @@ class Dataset:
             rng = (np.random.default_rng([rng_seed, _block_index])
                    if rng_seed is not None else np.random.default_rng())
             perm = rng.permutation(n)
-            return {k: v[perm] for k, v in batch.items()}
+            return {k: col_take(v, perm) for k, v in batch.items()}
 
         ds = self._with_op(TaskPoolMapOperator(batch_fn, name="shuffle",
                                                pass_index=True))
@@ -386,7 +387,7 @@ class Dataset:
             import pyarrow.parquet as pq
 
             pq.write_table(
-                pa.table({k: pa.array(v) for k, v in block.items()}), out)
+                pa.table(dict(block)), out)  # numpy + arrow cols both ok
 
         return self._write(path, writer, ".parquet")
 
@@ -394,11 +395,12 @@ class Dataset:
         def writer(block: Block, out: str) -> None:
             import csv
 
-            cols = list(block.keys())
+            rows = _rowable(block)
+            cols = list(rows.keys())
             with open(out, "w", newline="") as f:
                 w = csv.writer(f)
                 w.writerow(cols)
-                for row in zip(*(block[c] for c in cols)):
+                for row in zip(*(rows[c] for c in cols)):
                     w.writerow(row)
 
         return self._write(path, writer, ".csv")
@@ -407,9 +409,10 @@ class Dataset:
         def writer(block: Block, out: str) -> None:
             import json
 
-            cols = list(block.keys())
+            rows = _rowable(block)
+            cols = list(rows.keys())
             with open(out, "w") as f:
-                for row in zip(*(block[c] for c in cols)):
+                for row in zip(*(rows[c] for c in cols)):
                     f.write(json.dumps({c: (v.item()
                                             if hasattr(v, "item") else v)
                                         for c, v in zip(cols, row)}) + "\n")
@@ -526,12 +529,12 @@ class GroupedData:
             if acc.num_rows() == 0:
                 return block
             keys = block[k]
-            uniq, inverse = np.unique(keys, return_inverse=True)
+            uniq, inverse = col_unique_inverse(keys)
             outs = []
             for gi in _range(len(uniq)):
                 idx = np.flatnonzero(inverse == gi)
                 outs.append(BlockAccessor.normalize(
-                    fn({c: v[idx] for c, v in block.items()})))
+                    fn({c: col_take(v, idx) for c, v in block.items()})))
             return BlockAccessor.concat(outs)
 
         return self._ds._exchange_op(
@@ -722,8 +725,7 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None,
         import pyarrow.parquet as pq
 
         table = pq.read_table(path, columns=columns)
-        return {name: np.asarray(col) for name, col in
-                zip(table.column_names, table.columns)}
+        return _arrow_table_to_block(table)
 
     return Dataset([functools.partial(read_one, f) for f in files],
                    read_parallelism=parallelism)
@@ -931,13 +933,56 @@ def from_pandas(df, *, parallelism: int = 4) -> Dataset:
                       parallelism=parallelism)
 
 
-def from_arrow(table, *, parallelism: int = 4) -> Dataset:
-    """One Dataset from a pyarrow Table (reference from_arrow)."""
-    return from_numpy(
-        {name: np.asarray(col) for name, col in
-         zip(table.column_names, table.columns)},
-        parallelism=parallelism)
+def _arrow_table_to_block(table) -> Block:
+    """Auto-select the per-column representation (reference:
+    block.py:57's Arrow-vs-numeric BlockAccessor split): numeric/bool
+    null-free columns become numpy (zero-copy, device-ready);
+    string/binary/nested/nullable columns stay pyarrow Arrays — never
+    numpy object arrays."""
+    import pyarrow.types as pt
 
+    out: Block = {}
+    for name, col in zip(table.column_names, table.columns):
+        t = col.type
+        numericish = (pt.is_integer(t) or pt.is_floating(t)
+                      or pt.is_boolean(t) or pt.is_temporal(t))
+        if numericish and col.null_count == 0:
+            out[name] = np.asarray(col)
+        elif (pt.is_integer(t) or pt.is_floating(t)) and col.null_count:
+            # Nullable numerics stay NUMPY (NaN-filled float64): every
+            # numeric consumer — aggregations, device_put — keeps
+            # working; only string/binary/nested/temporal-null columns
+            # take the arrow representation.
+            out[name] = col.to_numpy(zero_copy_only=False).astype(
+                np.float64)
+        else:
+            out[name] = col.combine_chunks()
+    return out
+
+
+def from_arrow(table, *, parallelism: int = 4) -> Dataset:
+    """One Dataset from a pyarrow Table (reference from_arrow). Column
+    representation follows the reader auto-selection: numeric -> numpy,
+    string/nested/nullable -> pyarrow."""
+    block = _arrow_table_to_block(table)
+    n = BlockAccessor(block).num_rows()
+    per = max(1, (n + parallelism - 1) // parallelism)
+    tasks = []
+    for start in _range(0, n, per):
+        end = min(start + per, n)
+        tasks.append(functools.partial(
+            lambda s, e: BlockAccessor(block).slice(s, e), start, end))
+    return Dataset(tasks, read_parallelism=parallelism)
+
+
+
+def _rowable(block: Block) -> Dict[str, Any]:
+    """Row-iterating sinks need python values: arrow columns -> lists
+    (numpy columns iterate natively)."""
+    from ray_tpu.data.block import is_arrow_col
+
+    return {k: (v.to_pylist() if is_arrow_col(v) else v)
+            for k, v in block.items()}
 
 def _rows_of(stream) -> Iterator[Dict[str, Any]]:
     for ref, _meta in stream:

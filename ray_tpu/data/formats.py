@@ -261,11 +261,15 @@ def examples_to_block(records: List[bytes]) -> Dict[str, np.ndarray]:
 
 
 def block_to_examples(block: Dict[str, np.ndarray]) -> List[bytes]:
-    cols = list(block.keys())
-    n = len(next(iter(block.values()))) if block else 0
+    from ray_tpu.data.block import is_arrow_col
+
+    rows = {k: (v.to_pylist() if is_arrow_col(v) else v)
+            for k, v in block.items()}
+    cols = list(rows.keys())
+    n = len(next(iter(rows.values()))) if rows else 0
     out = []
     for i in range(n):
-        out.append(encode_example({c: block[c][i] for c in cols}))
+        out.append(encode_example({c: rows[c][i] for c in cols}))
     return out
 
 
