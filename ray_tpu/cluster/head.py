@@ -101,7 +101,8 @@ class HeadServer:
         # resource state demand the same way).
         import collections as _collections
 
-        self._unmet_demand = _collections.deque(maxlen=512)
+        self._unmet_demand = _collections.deque(
+            maxlen=cfg.head_demand_window_max)
         # Span sink for distributed tracing (util/tracing.py).
         self._trace_ring = _collections.deque(maxlen=cfg.trace_ring_size)
         # submitter id -> (monotonic, [(resources, count)]) backlog reports
@@ -588,10 +589,12 @@ class HeadServer:
             try:
                 # Worker-side create_actor is idempotent (hosted check).
                 worker.retrying_call("create_actor", info.actor_id,
-                                     info.spec_blob, lease_id, timeout=60)
+                                     info.spec_blob, lease_id,
+                                     timeout=cfg.lease_grant_push_timeout_s)
             except BaseException:
                 try:
-                    node.retrying_call("return_lease", lease_id, timeout=5)
+                    node.retrying_call("return_lease", lease_id,
+                                       timeout=cfg.rpc_control_timeout_s)
                 except Exception:
                     pass
                 raise
@@ -830,7 +833,8 @@ class HeadServer:
                     for node, idx, bundle in reserved:
                         try:
                             self._pool.get(node.address).retrying_call(
-                                "release_bundle", pg_id, idx, timeout=5)
+                                "release_bundle", pg_id, idx,
+                                timeout=cfg.rpc_control_timeout_s)
                         except Exception:
                             pass
                     if not isinstance(e, _TransientReservationFailure):
@@ -840,7 +844,7 @@ class HeadServer:
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"placement group infeasible: {strategy} {bundles}")
-            time.sleep(0.1)
+            time.sleep(cfg.pg_bundle_retry_sleep_s)
         with self._lock:
             self._pgs[pg_id] = {"bundles": bundles, "strategy": strategy,
                                 "name": name,
@@ -863,7 +867,8 @@ class HeadServer:
             if n is not None:
                 try:
                     self._pool.get(n.address).retrying_call(
-                        "release_bundle", pg_id, idx, timeout=5)
+                        "release_bundle", pg_id, idx,
+                                timeout=cfg.rpc_control_timeout_s)
                 except Exception:
                     pass
         return True

@@ -40,8 +40,65 @@ from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
 
 
 
+class _ForkedProc:
+    """Popen-shaped handle over a zygote-forked worker, held via a PIDFD
+    (the zygote auto-reaps, so the raw pid is reusable the moment the
+    worker exits — probing/signalling by pid could hit an unrelated
+    process; the pidfd pins the identity). Matches the WorkerProc.proc
+    surface: poll/terminate/kill/wait/pid."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except OSError:
+            # Already exited and reaped before we got here.
+            self._pidfd = None
+            self.returncode = -1
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        import select
+
+        r, _w, _x = select.select([self._pidfd], [], [], 0)
+        if r:  # pidfd readable == process exited
+            self.returncode = -1
+            try:
+                os.close(self._pidfd)
+            except OSError:
+                pass
+            self._pidfd = None
+        return self.returncode
+
+    def terminate(self) -> None:
+        self._signal(15)
+
+    def kill(self) -> None:
+        self._signal(9)
+
+    def _signal(self, sig: int) -> None:
+        if self.returncode is not None or self._pidfd is None:
+            return
+        try:
+            import signal as _signal_mod
+
+            _signal_mod.pidfd_send_signal(self._pidfd, sig)
+        except OSError:
+            self.returncode = -1
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
 class WorkerProc:
-    def __init__(self, proc: subprocess.Popen, worker_id: str,
+    def __init__(self, proc, worker_id: str,
                  tpu: bool = False, env_hash: str = ""):
         self.proc = proc
         self.worker_id = worker_id
@@ -93,7 +150,7 @@ class NodeManager:
         # tasks queue at the raylet, cluster_task_manager.cc).
         self._avail_cond = threading.Condition(self._lock)
         self._spawning = 0
-        self._max_concurrent_spawns = 4
+        self._max_concurrent_spawns = cfg.max_concurrent_worker_spawns
         # FIFO worker handoff: lease requests queue here and are served
         # oldest-first when a worker registers or is returned — a racing
         # herd of cv-waiters would let a hot scheduling key starve nested
@@ -154,6 +211,10 @@ class NodeManager:
         import queue as _queue
 
         self._spawn_requests: "_queue.Queue" = _queue.Queue()
+        # Worker zygote (default-env CPU workers fork from a pre-imported
+        # template; ~0.4 s interpreter+import CPU -> ~10 ms per worker).
+        self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_lock = threading.Lock()
         threading.Thread(target=self._spawner_loop, daemon=True,
                          name=f"node-spawner-{node_id[:8]}").start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
@@ -187,9 +248,16 @@ class NodeManager:
                 pass
         for w in workers:
             try:
-                w.proc.wait(timeout=2)
+                w.proc.wait(timeout=cfg.worker_graceful_shutdown_s)
             except Exception:
                 w.proc.kill()
+        with self._zygote_lock:
+            if self._zygote is not None:
+                try:
+                    self._zygote.kill()  # children follow via PDEATHSIG
+                except Exception:
+                    pass
+                self._zygote = None
         self._server.stop()
         self._pool.close_all()
         try:
@@ -239,7 +307,8 @@ class NodeManager:
                     # the next heartbeat restores our availability view.
                     self._head.retrying_call(
                         "register_node", self.node_id, self.address,
-                        self.total, self.labels, self.store_name, timeout=10)
+                        self.total, self.labels, self.store_name,
+                        timeout=cfg.rpc_state_timeout_s)
                     last_sent = {}  # fresh NodeInfo: full snapshot next
             except Exception:
                 try:
@@ -475,6 +544,18 @@ class NodeManager:
             print(f"runtime_env materialization failed: {e}",
                   file=sys.stderr, flush=True)
             raise
+        # Default-env CPU workers fork from the zygote when available
+        # (interpreter+imports paid once per host, not per worker).
+        if (not tpu and not runtime_env and cfg.worker_zygote_enabled
+                and sys.platform.startswith("linux")
+                and py == sys.executable):
+            forked = self._zygote_spawn(worker_id, env)
+            if forked is not None:
+                w = WorkerProc(forked, worker_id, tpu=False,
+                               env_hash=runtime_env_hash(runtime_env))
+                with self._lock:
+                    self._workers[worker_id] = w
+                return w
         logf = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
             [py, "-m", "ray_tpu.cluster.worker_main",
@@ -491,6 +572,60 @@ class NodeManager:
         with self._lock:
             self._workers[worker_id] = w
         return w
+
+    # ----------------------------------------------------------- zygote
+
+    def _zygote_spawn(self, worker_id: str, env: dict):
+        """Fork one worker off the zygote; returns a _ForkedProc, or None
+        to fall back to a cold Popen (zygote dead/unresponsive)."""
+        import json as _json
+        import selectors as _selectors
+
+        with self._zygote_lock:
+            try:
+                if self._zygote is None or self._zygote.poll() is not None:
+                    zlog = open(os.path.join(
+                        cfg.log_dir, f"zygote-{self.node_id[:8]}.log"),
+                        "ab", buffering=0)
+                    self._zygote = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "ray_tpu.cluster.worker_main", "--zygote",
+                         "--node-addr", self.address,
+                         "--head-addr", self.head_addr,
+                         "--node-id", self.node_id,
+                         "--store-name", self.store_name],
+                        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                        stderr=zlog, env=env)
+                z = self._zygote
+                z.stdin.write(
+                    (_json.dumps({"worker_id": worker_id}) + "\n").encode())
+                z.stdin.flush()
+                sel = _selectors.DefaultSelector()
+                sel.register(z.stdout, _selectors.EVENT_READ)
+                # First fork waits out the zygote's own import warmup.
+                if not sel.select(timeout=cfg.zygote_spawn_timeout_s):
+                    raise TimeoutError("zygote unresponsive")
+                line = z.stdout.readline()
+                sel.close()
+                if not line:
+                    raise RuntimeError("zygote EOF")
+                resp = _json.loads(line)
+                return _ForkedProc(int(resp["pid"]))
+            except Exception:
+                # Only a DEAD zygote is discarded with a kill. A live one
+                # that merely missed the deadline (CPU-starved host) is
+                # ABANDONED instead: its forked workers hold PDEATHSIG
+                # against it, so killing it would take down every healthy
+                # worker on the node; orphaned it keeps its children alive
+                # and dies with the node manager.
+                z = self._zygote
+                self._zygote = None
+                if z is not None and z.poll() is not None:
+                    try:
+                        z.kill()  # reap the corpse's pipes
+                    except Exception:
+                        pass
+                return None
 
     def rpc_register_worker(self, conn, worker_id: str, address: str):
         """A freshly-spawned worker joins the idle pool (leases claim workers
@@ -656,7 +791,7 @@ class NodeManager:
                     entry = self._lease_grants[req_id] = [threading.Event(),
                                                           None]
                     self._lease_grant_order.append(req_id)
-                    while len(self._lease_grant_order) > 4096:
+                    while len(self._lease_grant_order) > cfg.lease_grant_dedup_max:
                         old = self._lease_grant_order.popleft()
                         self._lease_grants.pop(old, None)
                 else:
@@ -874,7 +1009,8 @@ class NodeManager:
         deadline = time.monotonic() + timeout_ms / 1000.0
         while time.monotonic() < deadline:
             try:
-                locs = self._head.call("object_locations", oid_bytes, timeout=5)
+                locs = self._head.call("object_locations", oid_bytes,
+                                   timeout=cfg.rpc_control_timeout_s)
             except Exception:
                 locs = []
             for node_id, addr in locs:
@@ -884,7 +1020,7 @@ class NodeManager:
                     return True
             if self.store.contains(oid):
                 return True
-            time.sleep(0.05)
+            time.sleep(cfg.spill_restore_poll_s)
         return self.store.contains(oid)
 
     def _pull_from(self, oid, addr: str, deadline: float) -> bool:

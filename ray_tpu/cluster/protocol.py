@@ -66,7 +66,7 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     chunks = []
     while n:
         try:
-            b = sock.recv(min(n, 1 << 20))
+            b = sock.recv(min(n, cfg.rpc_recv_chunk_bytes))
         except OSError:
             return None
         if not b:
@@ -154,6 +154,9 @@ class RpcServer:
         class _Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+            # Connection storms (scale tests: hundreds of workers
+            # registering at once) overflow the default backlog of 5.
+            request_queue_size = cfg.rpc_listen_backlog
 
         self._server = _Server((host, port), _Handler)
         self.address = "%s:%d" % self._server.server_address

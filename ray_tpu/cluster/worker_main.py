@@ -840,23 +840,98 @@ class WorkerRuntime(ClusterCore):
         os._exit(0)
 
 
+def _zygote_child(args, worker_id: str) -> None:
+    """Post-fork worker setup: own PDEATHSIG (vs the zygote), own log
+    file, then the normal worker runtime."""
+    import signal as _signal
+
+    from ray_tpu.core.process_util import PARENT_PID_VAR, bind_to_parent
+
+    _signal.signal(_signal.SIGCHLD, _signal.SIG_DFL)
+    # The inherited RTPU_PARENT_PID names the NODE MANAGER (the zygote's
+    # spawner); this process's parent is the zygote — retarget before
+    # bind_to_parent's stale-parent check silently exits us.
+    os.environ[PARENT_PID_VAR] = str(os.getppid())
+    bind_to_parent()  # zygote dies -> its workers die (chain to the node)
+    os.environ["RTPU_WORKER_ID"] = worker_id
+    log_path = os.path.join(cfg.log_dir, f"worker-{worker_id[:8]}.log")
+    os.makedirs(cfg.log_dir, exist_ok=True)
+    fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+    WorkerRuntime(args.head_addr, args.node_addr, args.node_id,
+                  args.store_name, worker_id)
+    while True:
+        time.sleep(3600)
+
+
+def zygote_main(args) -> None:
+    """Worker ZYGOTE (reference analog: the worker pool's prestart —
+    here taken further because Python pays ~0.4 s of interpreter+import
+    CPU per cold worker, the whole cost of an actor on a busy host):
+    import everything ONCE, then fork() per spawn request (~10 ms). The
+    zygote stays single-threaded and never imports jax, so the classic
+    fork-with-threads deadlock cannot occur; each child re-arms
+    PDEATHSIG against the zygote, which itself dies with the node
+    manager — the same lifetime chain as cold-spawned workers.
+
+    Protocol (line JSON on stdio): {"worker_id": w} -> {"worker_id": w,
+    "pid": p}. The node manager holds one zygote per default-env host
+    and falls back to cold spawns if the zygote dies."""
+    import json as _json
+    import signal as _signal
+
+    from ray_tpu.core.process_util import bind_to_parent
+
+    bind_to_parent()
+    _signal.signal(_signal.SIGCHLD, _signal.SIG_IGN)  # auto-reap children
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = _json.loads(line)
+        except ValueError:
+            continue
+        wid = req["worker_id"]
+        pid = os.fork()
+        if pid == 0:
+            try:
+                _zygote_child(args, wid)
+            except BaseException:  # noqa: BLE001
+                traceback.print_exc()
+            finally:
+                os._exit(0)
+        sys.stdout.write(_json.dumps({"worker_id": wid, "pid": pid}) + "\n")
+        sys.stdout.flush()
+
+
 def main() -> None:
     import faulthandler
     import signal
 
     from ray_tpu.core.process_util import bind_to_parent
 
-    bind_to_parent()  # PDEATHSIG armed in the CHILD (no preexec_fn fork)
-
-    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
     p = argparse.ArgumentParser()
     p.add_argument("--node-addr", required=True)
     p.add_argument("--head-addr", required=True)
     p.add_argument("--node-id", required=True)
     p.add_argument("--store-name", required=True)
-    p.add_argument("--worker-id", required=True)
+    p.add_argument("--worker-id", default="")
+    p.add_argument("--zygote", action="store_true")
     args = p.parse_args()
 
+    if args.zygote:
+        zygote_main(args)
+        return
+
+    bind_to_parent()  # PDEATHSIG armed in the CHILD (no preexec_fn fork)
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
     WorkerRuntime(args.head_addr, args.node_addr, args.node_id,
                   args.store_name, args.worker_id)  # installs itself
     try:

@@ -512,7 +512,7 @@ class ClusterCore:
 
     def _put_plasma(self, oid: ObjectID, header: bytes, buffers) -> None:
         total = SERIALIZER.encode_total_size(header, buffers)
-        deadline = time.monotonic() + 60.0
+        deadline = time.monotonic() + cfg.put_create_retry_deadline_s
         while True:
             try:
                 mv = self.store.create_buffer(oid, total)
@@ -590,7 +590,7 @@ class ClusterCore:
                 # the arena (out-of-core exchanges run at exactly this
                 # pressure). Back off briefly and retry within the
                 # deadline instead of failing the task.
-                time.sleep(0.2)
+                time.sleep(cfg.object_poll_interval_s)
                 buf = self.store.get(oid, timeout_ms=5000)
             if buf is None:
                 raise GetTimeoutError(f"object {oid.hex()} unavailable")
@@ -802,7 +802,7 @@ class ClusterCore:
                     mark(oid)
                     pending.discard(oid)
             except Exception:
-                time.sleep(0.2)
+                time.sleep(cfg.object_poll_interval_s)
 
     # --------------------------------------------------------- recovery
 
@@ -844,7 +844,7 @@ class ClusterCore:
                 return True  # a recovery attempt is already in flight
             self._recovering[task_key] = now
             # Bounded memory: drop stale entries opportunistically.
-            if len(self._recovering) > 4096:
+            if len(self._recovering) > cfg.recovering_ids_max:
                 cutoff = now - 300.0
                 self._recovering = {k: v for k, v in
                                     self._recovering.items() if v > cutoff}
@@ -1558,7 +1558,8 @@ class ClusterCore:
             if orphaned:
                 try:
                     self._pool.get(lease.node_addr).retrying_call(
-                        "return_lease", lease.lease_id, timeout=5)
+                        "return_lease", lease.lease_id,
+                        timeout=cfg.rpc_control_timeout_s)
                 except Exception:
                     pass
                 return
@@ -1575,7 +1576,9 @@ class ClusterCore:
                 f"no feasible node for {sample.resources}"))
         else:
             with self._lease_lock:
-                kq.lease_backoff = min(max(kq.lease_backoff * 2, 0.1), 0.5)
+                kq.lease_backoff = min(max(kq.lease_backoff * 2,
+                               cfg.lease_backoff_base_s),
+                           cfg.lease_backoff_max_s)
                 kq.next_lease_attempt = time.monotonic() + kq.lease_backoff
             time.sleep(0.05)
             kq.wake.set()
@@ -1610,7 +1613,8 @@ class ClusterCore:
                 "push_tasks",
                 [(tid, info.spec_blob) for tid, info in survivors])
             self._push_acks.append(
-                [waiter, survivors, lease, kq, 0, time.monotonic() + 5.0])
+                [waiter, survivors, lease, kq, 0,
+                 time.monotonic() + cfg.push_ack_timeout_s])
             self._push_ack_event.set()
         except BaseException:
             with self._inflight_lock:
@@ -1646,7 +1650,7 @@ class ClusterCore:
                         # thread (stranding every future unacked push).
                         if all(not e[0]._event.is_set()
                                for e in list(self._push_acks)):
-                            time.sleep(0.01)
+                            time.sleep(cfg.push_ack_idle_poll_s)
                         continue
                     self._retry_push(entry)
                     continue
@@ -1848,7 +1852,8 @@ class ClusterCore:
                     # Acked + retried: a lost return would leak the
                     # lease's resources on the node forever.
                     self._pool.get(l.node_addr).retrying_call(
-                        "return_lease", l.lease_id, not l.broken, timeout=5)
+                        "return_lease", l.lease_id, not l.broken,
+                        timeout=cfg.rpc_control_timeout_s)
                 except Exception:
                     pass
 
@@ -1971,7 +1976,7 @@ class ClusterCore:
                 "register_actor", actor_id.binary(), name, namespace,
                 spec_blob, max_restarts, resources, get_if_exists,
                 _strategy_dict(scheduling_strategy), runtime_env,
-                timeout=120)
+                timeout=cfg.actor_connect_timeout_s)
         except BaseException:
             self._release_submitted_args(b"actor-args:" + actor_id.binary())
             raise

@@ -136,6 +136,9 @@ _d("lease_linger_ms", int, 100,
    "how long an idle lease is kept before returning the worker to its "
    "node (covers sync submit-get loops); long lingers serialize worker "
    "handoff between competing submitters")
+_d("worker_zygote_enabled", bool, True,
+   "default-env CPU workers fork from a pre-imported zygote process "
+   "(linux; ~10ms/worker instead of ~0.4s interpreter+import CPU)")
 _d("pipeline_short_task_s", float, 0.05,
    "exec-time EWMA below this pipelines tasks onto busy workers (RTT "
    "amortization); above it, one task per lease (parallelism first)")
@@ -275,3 +278,66 @@ _d("trace_ring_size", int, 20_000, "head-side retained span cap")
 # --- logging ---
 _d("log_dir", str, "/tmp/ray_tpu/logs", "per-process log files")
 _d("log_to_driver", bool, True, "ship worker stdout/stderr lines to the driver")
+_d("log_monitor_poll_s", float, 0.5,
+   "driver log-shipper scan period over worker log files")
+
+# --- rpc / control plane (breadth: reference ray_config_def.h RPC and
+# timeout families — gcs_rpc_server_request_timeout_seconds,
+# gcs_server_request_timeout_seconds, timeout knobs per subsystem) ---
+_d("rpc_control_timeout_s", float, 5.0,
+   "standard control-RPC deadline (lease return, bundle release, "
+   "object-location queries, drains)")
+_d("rpc_state_timeout_s", float, 10.0,
+   "registration/report RPC deadline (node/worker register, ref "
+   "bookkeeping, location publishes)")
+_d("rpc_recv_chunk_bytes", int, 1 << 20,
+   "max bytes per socket recv() in the frame reader")
+_d("rpc_listen_backlog", int, 128, "server socket accept backlog")
+_d("pubsub_retry_delay_s", float, 0.5,
+   "subscriber reconnect backoff after a dropped long-poll")
+
+# --- scheduling breadth ---
+_d("lease_grant_push_timeout_s", float, 60.0,
+   "head -> node deadline for pushing a granted actor lease spec")
+_d("lease_backoff_base_s", float, 0.1,
+   "declined-lease backoff floor per scheduling key")
+_d("lease_backoff_max_s", float, 0.5,
+   "declined-lease backoff ceiling per scheduling key")
+_d("lease_grant_dedup_max", int, 4096,
+   "node-side FIFO window of lease ids for duplicate-grant detection")
+_d("max_concurrent_worker_spawns", int, 4,
+   "cold worker spawns in flight per node (zygote forks are not "
+   "bounded by this; reference: worker_maximum_startup_concurrency)")
+_d("zygote_spawn_timeout_s", float, 60.0,
+   "deadline for a zygote fork round-trip (first covers import warmup)")
+_d("worker_graceful_shutdown_s", float, 2.0,
+   "SIGTERM-to-SIGKILL grace for workers at node shutdown")
+_d("pg_bundle_retry_sleep_s", float, 0.1,
+   "head retry pause between placement-group bundle placement passes")
+_d("head_demand_window_max", int, 512,
+   "ring of recent unmet demands kept for the autoscaler demand report")
+
+# --- core worker breadth ---
+_d("put_create_retry_deadline_s", float, 60.0,
+   "how long put() waits out a concurrent writer holding the same "
+   "object slot before failing")
+_d("object_poll_interval_s", float, 0.2,
+   "sleep between remote-object readiness probes in get()/wait() "
+   "fallback polling")
+_d("recovering_ids_max", int, 4096,
+   "FIFO window of object ids currently under lineage reconstruction "
+   "(dedups concurrent recovery triggers)")
+_d("push_ack_timeout_s", float, 5.0,
+   "deadline for a worker's ack of a pushed task group before the "
+   "group re-dispatches elsewhere")
+_d("actor_connect_timeout_s", float, 120.0,
+   "waiting for a created actor's address to publish before the first "
+   "method call fails")
+_d("push_ack_idle_poll_s", float, 0.01,
+   "push-ack reaper pause when no ack is outstanding-but-ready")
+
+# --- store breadth ---
+_d("object_store_slots", int, 1 << 16,
+   "shm store object-table slots (max resident objects per node)")
+_d("spill_restore_poll_s", float, 0.05,
+   "pull-manager pause between spilled-object restore attempts")

@@ -155,10 +155,14 @@ class ShmStore:
     # -- lifecycle ---------------------------------------------------------
 
     @classmethod
-    def create(cls, name: str, capacity: int, n_slots: int = 1 << 16,
+    def create(cls, name: str, capacity: int, n_slots: int = 0,
                unlink_existing: bool = True,
                prefault: bool = True) -> "ShmStore":
         lib = _load_lib()
+        if not n_slots:
+            from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+            n_slots = _cfg.object_store_slots
         h = lib.rtpu_store_create(name.encode(), capacity, n_slots,
                                   1 if unlink_existing else 0, 0)
         if not h:

@@ -65,6 +65,14 @@ def col_concat(cols: Sequence[Any]):
     return np.concatenate(list(cols))
 
 
+def rows_view(block: Block) -> Dict[str, Any]:
+    """Row-iterable view: arrow columns -> python lists, numpy columns
+    pass through (the one place row materialization lives — every row
+    sink and iter_rows routes here)."""
+    return {k: (v.to_pylist() if is_arrow_col(v) else v)
+            for k, v in block.items()}
+
+
 def col_tolist(col: Any) -> list:
     if is_arrow_col(col):
         return col.to_pylist()
@@ -186,8 +194,7 @@ class BlockAccessor:
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         n = self.num_rows()
         keys = list(self._b)
-        cols = {k: (v.to_pylist() if is_arrow_col(v) else v)
-                for k, v in self._b.items()}
+        cols = rows_view(self._b)
         for i in range(n):
             yield {k: cols[k][i] for k in keys}
 

@@ -27,7 +27,8 @@ from ray_tpu.data._streaming import (ActorPoolMapOperator, DriverOperator,
                                      RefBundle, TaskPoolMapOperator,
                                      execute_plan, explain_plan)
 from ray_tpu.data.block import (Block, BlockAccessor, BlockMetadata,
-                                col_take, col_unique_inverse)
+                                col_take, col_unique_inverse,
+                                rows_view)
 
 
 class Dataset:
@@ -395,7 +396,7 @@ class Dataset:
         def writer(block: Block, out: str) -> None:
             import csv
 
-            rows = _rowable(block)
+            rows = rows_view(block)
             cols = list(rows.keys())
             with open(out, "w", newline="") as f:
                 w = csv.writer(f)
@@ -409,7 +410,7 @@ class Dataset:
         def writer(block: Block, out: str) -> None:
             import json
 
-            rows = _rowable(block)
+            rows = rows_view(block)
             cols = list(rows.keys())
             with open(out, "w") as f:
                 for row in zip(*(rows[c] for c in cols)):

@@ -261,10 +261,9 @@ def examples_to_block(records: List[bytes]) -> Dict[str, np.ndarray]:
 
 
 def block_to_examples(block: Dict[str, np.ndarray]) -> List[bytes]:
-    from ray_tpu.data.block import is_arrow_col
+    from ray_tpu.data.block import rows_view
 
-    rows = {k: (v.to_pylist() if is_arrow_col(v) else v)
-            for k, v in block.items()}
+    rows = rows_view(block)
     cols = list(rows.keys())
     n = len(next(iter(rows.values()))) if rows else 0
     out = []

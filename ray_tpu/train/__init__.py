@@ -16,13 +16,17 @@ from ray_tpu.train.session import (get_checkpoint, get_context,
                                    get_dataset_shard, report)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train.torch import TorchTrainer
+from ray_tpu.train.huggingface import (RayTrainReportCallback,
+                                       TransformersTrainer,
+                                       prepare_trainer)
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
     "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
-    "DataParallelTrainer", "FailureConfig", "JaxTrainer", "Result",
-    "RunConfig", "ScalingConfig", "TorchTrainer", "TrainWorkerError",
-    "WorkerGroup",
+    "DataParallelTrainer", "FailureConfig", "JaxTrainer",
+    "RayTrainReportCallback", "Result", "RunConfig", "ScalingConfig",
+    "TorchTrainer", "TrainWorkerError", "TransformersTrainer",
+    "WorkerGroup", "prepare_trainer",
     "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
     "report", "save_pytree",
 ]

@@ -11,7 +11,8 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler,
                                      MedianStoppingRule, PB2,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (BOHBSearcher, Searcher, TPESearcher,
+from ray_tpu.tune.search import (BOHBSearcher, OptunaSearch,
+                                 Searcher, TPESearcher,
                                  choice, grid_search, loguniform,
                                  randint, uniform)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
@@ -19,7 +20,7 @@ from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 __all__ = [
     "ASHAScheduler", "BOHBSearcher", "FIFOScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PB2",
-    "PopulationBasedTraining", "Searcher", "TPESearcher",
+    "PopulationBasedTraining", "OptunaSearch", "Searcher", "TPESearcher",
     "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",
     "uniform",

@@ -336,3 +336,77 @@ class BOHBSearcher(TPESearcher):
             return super().suggest(trial_id)
         finally:
             self._obs = full
+
+
+class OptunaSearch(Searcher):
+    """Optuna adapter over the Searcher seam (reference:
+    python/ray/tune/search/optuna/optuna_search.py OptunaSearch —
+    ask/tell against an optuna Study). Lazily creates the study at the
+    first suggest (direction needs the mode, which arrives via
+    set_search_properties). ``optuna`` (or any object with its
+    create_study/ask/tell surface, e.g. a test double) can be injected
+    via ``optuna_module`` — the import is gated so the tune package
+    never hard-depends on it."""
+
+    def __init__(self, sampler: Any = None, seed: Optional[int] = None,
+                 optuna_module: Any = None):
+        self._optuna = optuna_module
+        self._sampler = sampler
+        self._seed = seed
+        self._study = None
+        self._live: Dict[str, Any] = {}
+
+    def _ensure_study(self):
+        if self._study is not None:
+            return
+        ot = self._optuna
+        if ot is None:
+            try:
+                import optuna as ot  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "OptunaSearch requires the `optuna` package (pass "
+                    "optuna_module=... to inject a compatible object)"
+                ) from e
+            self._optuna = ot
+        sampler = self._sampler
+        if sampler is None and self._seed is not None:
+            try:
+                sampler = ot.samplers.TPESampler(seed=self._seed)
+            except Exception:
+                sampler = None
+        direction = "minimize" if self.mode == "min" else "maximize"
+        self._study = ot.create_study(direction=direction,
+                                      sampler=sampler)
+
+    def _suggest_param(self, trial, name: str, dom: Any):
+        if isinstance(dom, Categorical):
+            return trial.suggest_categorical(name, dom.categories)
+        if isinstance(dom, Float):
+            return trial.suggest_float(name, dom.lower, dom.upper,
+                                       log=dom.log)
+        if isinstance(dom, Integer):
+            return trial.suggest_int(name, dom.lower, dom.upper - 1)
+        return dom  # literal values pass through
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        self._ensure_study()
+        t = self._study.ask()
+        self._live[trial_id] = t
+        return {k: self._suggest_param(t, k, v)
+                for k, v in self.space.items()}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        t = self._live.pop(trial_id, None)
+        if t is None or self._study is None:
+            return
+        value = None if result is None else result.get(self.metric)
+        if value is None:
+            try:
+                state = self._optuna.trial.TrialState.FAIL
+                self._study.tell(t, state=state)
+            except Exception:
+                pass
+            return
+        self._study.tell(t, float(value))

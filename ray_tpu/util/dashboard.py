@@ -1,9 +1,13 @@
-"""Dashboard-lite: one HTML page + JSON API over the state surfaces.
+"""Dashboard-lite v2: one static HTML page + JSON API over the state
+surfaces, with a task-timeline view.
 
 Parity target: the reference dashboard's head (reference:
-python/ray/dashboard/head.py:65 + its api endpoints) trimmed to the
-operator's daily loop: nodes, resources, actors, recent tasks, jobs,
-pending demand — live from the state API, auto-refreshing. Start with:
+python/ray/dashboard/head.py:65 + modules/) trimmed to the operator's
+daily loop, with no build system: a single static page fetches /api and
+/api/timeline with plain JS, renders nodes/actors/tasks/jobs tables that
+auto-refresh in place, and draws the per-node task timeline as SVG lanes
+from util/timeline.py's chrome-trace events (the reference's task
+timeline view). Start with:
 
     from ray_tpu.util import dashboard
     port = dashboard.start(port=8265)          # inside a driver
@@ -19,84 +23,145 @@ from typing import Any, Dict, Optional
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
-<meta http-equiv="refresh" content="5">
 <style>
  body { font-family: monospace; margin: 2em; background: #fafafa; }
  h2 { border-bottom: 1px solid #ccc; padding-bottom: 2px; }
  table { border-collapse: collapse; margin-bottom: 1.5em; }
  td, th { border: 1px solid #ddd; padding: 3px 10px; text-align: left; }
  th { background: #eee; }
- .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED { color: #0a0; }
- .DEAD, .FAILED { color: #c00; }
+ .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED, .ok { color: #0a0; }
+ .DEAD, .FAILED, .error { color: #c00; }
+ #timeline { background: #fff; border: 1px solid #ddd; }
+ .bar { fill: #4a90d9; } .bar.error { fill: #c0392b; }
+ .lane-label { font-size: 11px; fill: #555; }
+ #meta { color: #777; }
 </style></head><body>
 <h1>ray_tpu cluster</h1>
-<div id="content">%CONTENT%</div>
+<p id="meta">loading&hellip;</p>
+<div id="tables"></div>
+<h2>Task timeline (last 60s)</h2>
+<svg id="timeline" width="1100" height="40"></svg>
+<script>
+function esc(v) {  // every value reaching innerHTML goes through here:
+  // task/actor names come from USER code (@remote function names) and
+  // must never execute as markup in the operator's browser.
+  return String(v).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+    .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
+}
+function td(v, cls) {
+  return '<td class="' + esc(cls || '') + '">' + esc(v) + '</td>';
+}
+function table(title, rows, cols) {
+  let h = '<h2>' + title + '</h2><table><tr>';
+  for (const c of cols) h += '<th>' + c + '</th>';
+  h += '</tr>';
+  for (const r of rows) {
+    h += '<tr>';
+    for (const c of cols) {
+      const v = r[c] === undefined || r[c] === null ? '' :
+        (typeof r[c] === 'object' ? JSON.stringify(r[c]) : r[c]);
+      h += td(v, typeof v === 'string' ? v : '');
+    }
+    h += '</tr>';
+  }
+  return h + '</table>';
+}
+async function refresh() {
+  try {
+    const api = await (await fetch('/api')).json();
+    let html = '';
+    html += table('Nodes', api.nodes.map(n => Object.assign({}, n, {
+      alive: n.alive ? 'ALIVE' : 'DEAD'})),
+      ['node_id', 'address', 'alive', 'available', 'resources']);
+    html += table('Actors', api.actors,
+      ['actor_id', 'name', 'state', 'address']);
+    html += table('Recent tasks', api.tasks.slice(-25),
+      ['task_id', 'name', 'state', 'status', 'duration_s']);
+    if (api.jobs && api.jobs.length)
+      html += table('Jobs', api.jobs,
+        ['submission_id', 'status', 'entrypoint', 'message']);
+    html += '<h2>Object store</h2><pre>' +
+      JSON.stringify(api.objects, null, 1) + '</pre>';
+    document.getElementById('tables').innerHTML = html;
+    document.getElementById('meta').textContent =
+      new Date().toLocaleTimeString() + ' — ' + api.nodes.length +
+      ' nodes, ' + api.actors.length + ' actors';
+    drawTimeline(await (await fetch('/api/timeline')).json());
+  } catch (e) {
+    document.getElementById('meta').textContent = 'refresh failed: ' + e;
+  }
+}
+function drawTimeline(events) {
+  const svg = document.getElementById('timeline');
+  const W = 1100, laneH = 18, labelW = 90;
+  const nowUs = Date.now() * 1000, windowUs = 60e6;
+  const t0 = nowUs - windowUs;
+  const spans = events.filter(e => e.ph === 'X' && e.ts + e.dur > t0);
+  const lanes = [...new Set(spans.map(e => e.pid + ':' + e.tid))].sort();
+  const H = Math.max(1, lanes.length) * laneH + 24;
+  svg.setAttribute('height', H);
+  let out = '';
+  // time grid every 10 s
+  for (let s = 0; s <= 60; s += 10) {
+    const x = labelW + (W - labelW) * s / 60;
+    out += '<line x1="' + x + '" y1="0" x2="' + x + '" y2="' + H +
+      '" stroke="#eee"/><text x="' + x + '" y="' + (H - 6) +
+      '" class="lane-label">-' + (60 - s) + 's</text>';
+  }
+  lanes.forEach((lane, i) => {
+    const y = i * laneH + 4;
+    out += '<text x="2" y="' + (y + 10) + '" class="lane-label">' +
+      esc(lane) + '</text>';
+    for (const e of spans.filter(e => e.pid + ':' + e.tid === lane)) {
+      const xs = Math.max(labelW,
+        labelW + (W - labelW) * (e.ts - t0) / windowUs);
+      const xe = Math.min(W,
+        labelW + (W - labelW) * (e.ts + e.dur - t0) / windowUs);
+      const err = e.args && e.args.status === 'error';
+      out += '<rect class="bar' + (err ? ' error' : '') + '" x="' + xs +
+        '" y="' + y + '" width="' + Math.max(1, xe - xs) +
+        '" height="' + (laneH - 6) + '"><title>' + esc(e.name) + ' (' +
+        (e.dur / 1000).toFixed(1) + 'ms)</title></rect>';
+    }
+  });
+  svg.innerHTML = out;
+}
+refresh();
+setInterval(refresh, 5000);
+</script>
 </body></html>"""
-
-
-def _render() -> str:
-    from ray_tpu.util import state
-
-    parts = []
-
-    def table(title, rows, cols):
-        out = [f"<h2>{title}</h2><table><tr>"]
-        out += [f"<th>{c}</th>" for c in cols]
-        out.append("</tr>")
-        for r in rows:
-            out.append("<tr>")
-            for c in cols:
-                v = r.get(c, "")
-                cls = v if isinstance(v, str) else ""
-                out.append(f'<td class="{cls}">{v}</td>')
-            out.append("</tr>")
-        out.append("</table>")
-        parts.append("".join(out))
-
-    nodes = state.list_nodes()
-    table("Nodes", [{**n, "alive": "ALIVE" if n["alive"] else "DEAD",
-                     "available": json.dumps(n.get("available", {})),
-                     "resources": json.dumps(n.get("resources", {}))}
-                    for n in nodes],
-          ["node_id", "address", "alive", "available", "resources"])
-    table("Actors", state.list_actors(),
-          ["actor_id", "name", "state", "address"])
-    table("Recent tasks", state.list_tasks()[-25:],
-          ["task_id", "name", "state", "duration_s"])
-    try:
-        from ray_tpu.core.runtime_context import require_runtime
-
-        rt = require_runtime()
-        jobs = []
-        try:
-            import ray_tpu
-            from ray_tpu.jobs import JOB_MANAGER_NAME
-
-            mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
-            jobs = ray_tpu.get(mgr.list.remote(), timeout=5)
-        except Exception:
-            pass
-        table("Jobs", jobs,
-              ["submission_id", "status", "entrypoint", "message"])
-        demand = rt.head.retrying_call("get_demand", 30.0, timeout=5)
-        if demand["unmet"]:
-            parts.append(f"<h2>Pending demand</h2>"
-                         f"<p>{len(demand['unmet'])} unmet requests, "
-                         f"e.g. {json.dumps(demand['unmet'][0])}</p>")
-    except Exception:
-        pass
-    summary = state.summarize_objects()
-    parts.append(f"<h2>Object store</h2><pre>"
-                 f"{json.dumps(summary, indent=1, default=str)}</pre>")
-    return "".join(parts)
 
 
 def _api_payload() -> Dict[str, Any]:
     from ray_tpu.util import state
 
+    jobs = []
+    try:
+        import ray_tpu
+        from ray_tpu.jobs import JOB_MANAGER_NAME
+
+        mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
+        jobs = ray_tpu.get(mgr.list.remote(), timeout=5)
+    except Exception:
+        pass
+    demand = []
+    try:
+        from ray_tpu.core.runtime_context import require_runtime
+
+        demand = require_runtime().head.retrying_call(
+            "get_demand", 30.0, timeout=5).get("unmet", [])
+    except Exception:
+        pass
     return {"nodes": state.list_nodes(), "actors": state.list_actors(),
             "tasks": state.list_tasks()[-100:],
-            "objects": state.summarize_objects()}
+            "objects": state.summarize_objects(),
+            "jobs": jobs, "pending_demand": demand}
+
+
+def _timeline_payload() -> list:
+    from ray_tpu.util import timeline
+
+    return timeline.dump_timeline()
 
 
 def start(host: str = "127.0.0.1", port: int = 8265) -> int:
@@ -109,12 +174,16 @@ def start(host: str = "127.0.0.1", port: int = 8265) -> int:
 
         def do_GET(self):
             try:
-                if self.path.startswith("/api"):
+                if self.path.startswith("/api/timeline"):
+                    body = json.dumps(_timeline_payload(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/api"):
                     body = json.dumps(_api_payload(),
                                       default=str).encode()
                     ctype = "application/json"
                 else:
-                    body = _PAGE.replace("%CONTENT%", _render()).encode()
+                    body = _PAGE.encode()
                     ctype = "text/html"
                 self.send_response(200)
             except Exception as e:  # noqa: BLE001 — render errors as 500

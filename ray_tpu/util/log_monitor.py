@@ -13,14 +13,18 @@ import os
 import sys
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 
 class LogMonitor:
-    def __init__(self, log_dir: str, poll_interval_s: float = 0.5,
+    def __init__(self, log_dir: str,
+                 poll_interval_s: Optional[float] = None,
                  out=None):
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
         self._dir = log_dir
-        self._poll = poll_interval_s
+        self._poll = (poll_interval_s if poll_interval_s is not None
+                      else _cfg.log_monitor_poll_s)
         self._offsets: Dict[str, int] = {}
         self._stop = threading.Event()
         self._out = out or sys.stdout

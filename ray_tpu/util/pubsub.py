@@ -81,7 +81,9 @@ class _PubSubHub:
         def rejoin():
             import time as _t
 
-            delay = 0.5
+            from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+            delay = _cfg.pubsub_retry_delay_s
             while not self._closed:
                 with self._lock:
                     if not self._handlers:

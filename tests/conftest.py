@@ -28,10 +28,29 @@ import glob  # noqa: E402
 
 import pytest  # noqa: E402
 
-# Reap object-store segments leaked by SIGKILL'd clusters of previous runs
-# (node ids are fresh uuids per cluster, so names never collide with live
-# clusters of THIS run, which start after this executes).
+# Reap object-store segments leaked by SIGKILL'd clusters of previous
+# runs — but ONLY segments no live process has mapped: a concurrently
+# running cluster (e.g. a benchmark capture on the same host) must not
+# lose its store to a test session starting next to it.
+def _mapped_segments() -> set:
+    mapped = set()
+    for _pid in os.listdir("/proc"):
+        if not _pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{_pid}/maps") as _f:
+                for _line in _f:
+                    if "/dev/shm/rtpu_store_" in _line:
+                        mapped.add(_line.rsplit("/", 1)[-1].strip())
+        except OSError:
+            continue
+    return mapped
+
+
+_live = _mapped_segments()
 for _stale in glob.glob("/dev/shm/rtpu_store_*"):
+    if os.path.basename(_stale) in _live:
+        continue
     try:
         os.unlink(_stale)
     except OSError:

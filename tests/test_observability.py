@@ -121,15 +121,26 @@ def test_dashboard_lite(cluster):
 
     ray_tpu.get(probe.remote(), timeout=30)
     port = dashboard.start(port=0)
+    # v2: a STATIC page (client-side JS renders tables + SVG timeline
+    # from /api; no build system — VERDICT r4 item 10).
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/", timeout=30) as resp:
         html = resp.read().decode()
-    assert "ray_tpu cluster" in html and "Nodes" in html
-    assert "ALIVE" in html
+    assert "ray_tpu cluster" in html
+    assert "drawTimeline" in html and "/api/timeline" in html
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/api", timeout=30) as resp:
         payload = json.loads(resp.read())
     assert payload["nodes"] and "objects" in payload
+    assert payload["nodes"][0]["alive"] is True
+    assert "jobs" in payload and "pending_demand" in payload
+    # Timeline endpoint: chrome-trace events incl. the probe task's span.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/timeline", timeout=30) as resp:
+        events = json.loads(resp.read())
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans and all("ts" in e and "dur" in e for e in spans)
+    assert any("probe" in e.get("name", "") for e in spans)
 
 
 def test_per_node_prometheus_endpoint(cluster):

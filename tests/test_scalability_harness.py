@@ -1,0 +1,29 @@
+"""Scalability harness smoke: every bench shape runs end to end at tiny
+sizes and produces sane numbers (the full-size capture runs out of band
+into PERF_r*.json — reference analog: release/benchmarks CI smoke)."""
+
+import json
+import subprocess
+import sys
+
+def test_harness_smoke_all_benchmarks(tmp_path):
+    out = str(tmp_path / "perf.json")
+    # Subprocess: the harness owns its own cluster + system config.
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.util.scalability", "--smoke",
+         "--out", out],
+        capture_output=True, text=True, timeout=800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        report = json.load(f)
+    s = report["scalability"]
+    assert s["many_actors"]["num_actors"] == 50
+    assert s["many_actors"]["actors_per_s"] > 1.0
+    assert s["many_pgs"]["pgs_per_s"] > 1.0
+    assert s["many_queued_tasks"]["end_to_end_per_s"] > 100.0
+    assert s["broadcast"]["num_nodes"] == 2
+    assert s["broadcast"]["broadcast_s"] < 120.0
+    mc = s["multi_client_drivers"]
+    assert mc["num_client_processes"] == 2
+    assert mc["aggregate_tasks_per_s"] > 100.0
+    assert "_meta" in s and "host" in s["_meta"]

@@ -328,3 +328,95 @@ def test_hyperband_end_to_end(cluster):
                         if r.stopped_early and len(r.history) < 3]
     assert pruned_below_max, [len(r.history) for r in grid]
     assert any(r.config["q"] == 0.4 for r in pruned_below_max)
+
+
+class _FakeOptunaTrial:
+    def __init__(self, rng):
+        self._rng = rng
+        self.params = {}
+
+    def suggest_categorical(self, name, cats):
+        v = self._rng.choice(list(cats))
+        self.params[name] = v
+        return v
+
+    def suggest_float(self, name, lo, hi, log=False):
+        v = self._rng.uniform(lo, hi)
+        self.params[name] = v
+        return v
+
+    def suggest_int(self, name, lo, hi):
+        v = self._rng.randint(lo, hi)
+        self.params[name] = v
+        return v
+
+
+class _FakeOptunaStudy:
+    def __init__(self, direction):
+        import random as _r
+
+        self.direction = direction
+        self._rng = _r.Random(0)
+        self.told = []
+
+    def ask(self):
+        return _FakeOptunaTrial(self._rng)
+
+    def tell(self, trial, value=None, state=None):
+        self.told.append((trial.params, value, state))
+
+
+class _FakeOptunaModule:
+    """The create_study/ask/tell surface OptunaSearch drives (optuna is
+    not baked into this image; the adapter contract is what matters)."""
+
+    def __init__(self):
+        self.studies = []
+
+    def create_study(self, direction="minimize", sampler=None):
+        s = _FakeOptunaStudy(direction)
+        self.studies.append(s)
+        return s
+
+
+def test_optuna_adapter_drives_ask_tell_seam():
+    from ray_tpu.tune import OptunaSearch
+
+    fake = _FakeOptunaModule()
+    searcher = OptunaSearch(optuna_module=fake)
+    searcher.set_search_properties("score", "max", {
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "units": tune.randint(8, 64),
+        "act": tune.choice(["relu", "tanh"]),
+        "fixed": 7,
+    })
+    for i in range(5):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert 8 <= cfg["units"] < 64
+        assert cfg["act"] in ("relu", "tanh")
+        assert cfg["fixed"] == 7
+        searcher.on_trial_complete(tid, {"score": float(i)})
+    study = fake.studies[0]
+    assert study.direction == "maximize"
+    assert len(study.told) == 5
+    assert all(v is not None for _p, v, _s in study.told)
+
+
+def test_optuna_adapter_composes_with_tuner(cluster):
+    from ray_tpu.tune import OptunaSearch, TuneConfig
+
+    fake = _FakeOptunaModule()
+
+    def objective(config):
+        tune.report({"score": -(config["x"] - 3.0) ** 2})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=6,
+                               search_alg=OptunaSearch(optuna_module=fake)),
+    ).fit()
+    assert len(grid) == 6
+    assert len(fake.studies[0].told) == 6
