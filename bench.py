@@ -183,8 +183,10 @@ def _bench_decode(on_tpu: bool) -> dict:
     else:
         cfg = llama.tiny_config(max_seq_len=256)
         max_batch, new_tokens, seconds = 4, 8, 2.0
+    # decode_chunk=8: one host sync per 8 tokens — through the remote-TPU
+    # tunnel per-token sync alone caps throughput at ~13 steps/s.
     engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
-                       prompt_buckets=[32])
+                       prompt_buckets=[32], decode_chunk=8)
     rng = np.random.default_rng(0)
 
     hi = min(1000, cfg.vocab_size - 1)
