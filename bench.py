@@ -18,7 +18,11 @@ Rows:
   extrapolated to 32 layers. The differential cancels the embed/head/CE
   cost shared by both runs; method fields are recorded in the row.
 - llm_decode_tokens_per_s — the native continuous-batching engine
-  (serve/llm.py) decoding with Llama-1B weights on the chip.
+  (serve/engine/) decoding with Llama-1B weights on the chip.
+- llm_engine — the engine suite (``--engine`` runs it standalone):
+  decode tok/s, engine-side TTFT/TPOT p50, and prefix-cache hit rate
+  under a shared-prefix workload; rows are labelled ``config:
+  "tiny-cpu"`` when not measured on hardware.
 - serve_llm_* — req/s + p50/p99 TTFT through the FULL serve stack
   (controller/router/replica, tiny engine) in a CPU child process; the
   reference publishes no serve numbers (it delegates to vLLM), so these
@@ -232,6 +236,87 @@ def _bench_decode(on_tpu: bool) -> dict:
     return row
 
 
+def _bench_engine(on_tpu: bool) -> dict:
+    """Engine suite: decode throughput + TTFT + prefix-cache hit rate
+    measured directly on the serve/engine subsystem (no serve stack).
+
+    Clients share a common prompt prefix, so slot recycling exercises
+    the prefix cache the way a chat workload (shared system prompt)
+    would; TTFT comes from the engine's own metrics (prefill + queue
+    wait), not a client-side stopwatch."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    if on_tpu:
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=512,
+                                  use_decode_kernel=True)
+        max_batch, new_tokens, seconds = 8, 48, 8.0
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        max_batch, new_tokens, seconds = 4, 8, 2.0
+    engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
+                       prompt_buckets=[32], decode_chunk=8,
+                       prefix_block=8, name="bench-engine")
+    rng = np.random.default_rng(0)
+    hi = min(1000, cfg.vocab_size - 1)
+    shared = [int(t) for t in rng.integers(1, hi, 16)]  # common prefix
+
+    def prompt():
+        return shared + [int(t) for t in rng.integers(1, hi, 8)]
+
+    engine.generate(prompt(), max_new_tokens=2)  # compile prefill+decode
+    stop_at = time.perf_counter() + seconds
+    counts = [0] * max_batch
+    client_errors = []
+
+    def client(i):
+        try:
+            while time.perf_counter() < stop_at:
+                out = engine.generate(prompt(), max_new_tokens=new_tokens,
+                                      timeout=300)
+                counts[i] += len(out["token_ids"])
+        except Exception as e:  # noqa: BLE001 — recorded, never silent
+            client_errors.append(repr(e)[:200])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(max_batch)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    stats = engine.stats()
+    engine.close()
+    if client_errors and not sum(counts):
+        raise RuntimeError(f"all engine clients failed: {client_errors[0]}")
+    row = {"metric": "llm_engine",
+           "llm_decode_tokens_per_s": round(sum(counts) / elapsed, 1),
+           "ttft_ms": stats["ttft_ms_p50"],
+           "tpot_ms": stats["tpot_ms_p50"],
+           "prefix_hit_rate": stats["prefix_hit_rate"],
+           "decode_host_syncs": stats["decode_host_syncs"],
+           "config": "llama3-1b" if on_tpu else "tiny-cpu",
+           "max_batch": max_batch, "decode_chunk": 8}
+    if client_errors:
+        row["client_errors"] = len(client_errors)
+        row["client_error_sample"] = client_errors[0]
+    return row
+
+
+def engine_child_main() -> None:
+    """Standalone engine suite (``bench.py --engine``): one JSON row."""
+    _pin_platform()
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    print(json.dumps(_bench_engine(on_tpu)), flush=True)
+
+
 def child_main() -> None:
     _pin_platform()
     import jax
@@ -282,6 +367,13 @@ def child_main() -> None:
         row_dec = {"metric": "llm_decode_tokens_per_s", "value": 0.0,
                    "unit": "tokens/s", "error": repr(e)[:300]}
     print(json.dumps(row_dec), flush=True)
+
+    # --- row 4: engine suite (decode + TTFT + prefix-cache) -------------
+    try:
+        row_eng = _bench_engine(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        row_eng = {"metric": "llm_engine", "error": repr(e)[:300]}
+    print(json.dumps(row_eng), flush=True)
 
 
 def serve_child_main() -> None:
@@ -463,6 +555,15 @@ def main() -> int:
     merged["train_mfu_llama1b"] = r1b.get("value")
     dec = by_metric.get("llm_decode_tokens_per_s", {})
     merged["llm_decode_tokens_per_s"] = dec.get("value")
+    eng = by_metric.get("llm_engine", {})
+    if "error" not in eng:
+        for k in ("ttft_ms", "prefix_hit_rate"):
+            merged[k] = eng.get(k)
+        # The engine suite's decode row supersedes the legacy row when
+        # the legacy one errored out.
+        if not merged.get("llm_decode_tokens_per_s"):
+            merged["llm_decode_tokens_per_s"] = \
+                eng.get("llm_decode_tokens_per_s")
     if serve_row and "error" not in serve_row:
         for k in ("serve_llm_requests_per_s", "serve_llm_tokens_per_s",
                   "serve_llm_p50_ttft_ms", "serve_llm_p99_ttft_ms"):
@@ -478,6 +579,8 @@ if __name__ == "__main__":
         sys.exit(child_main())
     if "--serve-child" in sys.argv:
         sys.exit(serve_child_main())
+    if "--engine" in sys.argv:
+        sys.exit(engine_child_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

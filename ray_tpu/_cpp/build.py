@@ -17,12 +17,12 @@ TARGETS = [
 ]
 
 
-def build(verbose: bool = True) -> list[str]:
+def build(verbose: bool = True, force: bool = False) -> list[str]:
     built = []
     for src, out, libs in TARGETS:
         src_p = os.path.join(HERE, src)
         out_p = os.path.join(HERE, out)
-        if (os.path.exists(out_p)
+        if (not force and os.path.exists(out_p)
                 and os.path.getmtime(out_p) >= os.path.getmtime(src_p)):
             built.append(out_p)
             continue

@@ -214,6 +214,7 @@ class NodeManager:
         # Worker zygote (default-env CPU workers fork from a pre-imported
         # template; ~0.4 s interpreter+import CPU -> ~10 ms per worker).
         self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_log = None  # the zygote's stderr log handle
         self._zygote_lock = threading.Lock()
         threading.Thread(target=self._spawner_loop, daemon=True,
                          name=f"node-spawner-{node_id[:8]}").start()
@@ -257,6 +258,7 @@ class NodeManager:
                     self._zygote.kill()  # children follow via PDEATHSIG
                 except Exception:
                     pass
+                self._close_zygote_handles(self._zygote)
                 self._zygote = None
         self._server.stop()
         self._pool.close_all()
@@ -556,6 +558,16 @@ class NodeManager:
                 with self._lock:
                     self._workers[worker_id] = w
                 return w
+            # Zygote timeout/failure: the abandoned zygote may STILL fork
+            # a worker for the requested id. The cold-spawn fallback must
+            # not collide with it — whichever registered second would be
+            # dropped as a duplicate while health polls / kills targeted
+            # the wrong pid — so it gets a FRESH id; the late fork's
+            # registration then finds no _workers entry, is rejected, and
+            # the worker exits itself.
+            worker_id = uuid.uuid4().hex
+            env["RTPU_WORKER_ID"] = worker_id
+            log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
         logf = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
             [py, "-m", "ray_tpu.cluster.worker_main",
@@ -575,6 +587,20 @@ class NodeManager:
 
     # ----------------------------------------------------------- zygote
 
+    def _close_zygote_handles(self, z) -> None:
+        """Close this side's pipe fds to an abandoned/killed zygote plus
+        the zlog handle (callers hold ``_zygote_lock``)."""
+        handles = [self._zygote_log]
+        if z is not None:
+            handles += [z.stdin, z.stdout]
+        for f in handles:
+            try:
+                if f is not None:
+                    f.close()
+            except Exception:
+                pass
+        self._zygote_log = None
+
     def _zygote_spawn(self, worker_id: str, env: dict):
         """Fork one worker off the zygote; returns a _ForkedProc, or None
         to fall back to a cold Popen (zygote dead/unresponsive)."""
@@ -584,7 +610,12 @@ class NodeManager:
         with self._zygote_lock:
             try:
                 if self._zygote is None or self._zygote.poll() is not None:
-                    zlog = open(os.path.join(
+                    if self._zygote_log is not None:
+                        try:
+                            self._zygote_log.close()
+                        except Exception:
+                            pass
+                    zlog = self._zygote_log = open(os.path.join(
                         cfg.log_dir, f"zygote-{self.node_id[:8]}.log"),
                         "ab", buffering=0)
                     self._zygote = subprocess.Popen(
@@ -617,7 +648,10 @@ class NodeManager:
                 # ABANDONED instead: its forked workers hold PDEATHSIG
                 # against it, so killing it would take down every healthy
                 # worker on the node; orphaned it keeps its children alive
-                # and dies with the node manager.
+                # and dies with the node manager. Either way this side's
+                # pipe fds and the zlog handle are closed — the zygote
+                # lingers on stdin EOF (zygote_main) precisely so the
+                # close cannot cascade into its children.
                 z = self._zygote
                 self._zygote = None
                 if z is not None and z.poll() is not None:
@@ -625,6 +659,7 @@ class NodeManager:
                         z.kill()  # reap the corpse's pipes
                     except Exception:
                         pass
+                self._close_zygote_handles(z)
                 return None
 
     def rpc_register_worker(self, conn, worker_id: str, address: str):

@@ -145,8 +145,20 @@ class WorkerRuntime(ClusterCore):
         # The runtime must be installed BEFORE registration: a lease can
         # arrive (and a task execute) the instant the node manager sees us.
         runtime_context.set_runtime(self)
-        self.node.retrying_call("register_worker", worker_id_hex,
-                                self.owner_addr, timeout=10)
+        # register_worker returns False when the node manager has no entry
+        # for this id — e.g. a zygote fork whose spawn request timed out
+        # and was replaced by a cold spawn under a fresh id. Retry briefly
+        # (the spawner inserts the _workers entry a beat after the process
+        # starts), then exit rather than linger unsupervised.
+        deadline = time.monotonic() + 10.0
+        while not self.node.retrying_call("register_worker", worker_id_hex,
+                                          self.owner_addr, timeout=10):
+            if time.monotonic() >= deadline:
+                print(f"worker {worker_id_hex[:8]} rejected by node "
+                      "manager (stale spawn id); exiting", file=sys.stderr,
+                      flush=True)
+                raise SystemExit(0)
+            time.sleep(0.25)
 
     def _seen_before(self, task_id_bytes: bytes) -> bool:
         with self._seen_lock:
@@ -906,8 +918,20 @@ def zygote_main(args) -> None:
                 traceback.print_exc()
             finally:
                 os._exit(0)
-        sys.stdout.write(_json.dumps({"worker_id": wid, "pid": pid}) + "\n")
-        sys.stdout.flush()
+        try:
+            sys.stdout.write(_json.dumps({"worker_id": wid, "pid": pid})
+                             + "\n")
+            sys.stdout.flush()
+        except OSError:
+            break  # node manager abandoned us: stop serving, linger below
+    # stdin EOF / stdout closed: the node manager abandoned this zygote
+    # (spawn-timeout fallback closes our pipes). Do NOT exit — forked
+    # workers hold PDEATHSIG against this process, so exiting would take
+    # down every healthy worker it ever forked. Linger as their anchor;
+    # our own PDEATHSIG (bind_to_parent above) still ends us with the
+    # node manager.
+    while True:
+        _signal.pause()
 
 
 def main() -> None:

@@ -189,7 +189,8 @@ def capture_exception(exc: BaseException) -> TaskError:
         cause = exc
     except Exception:
         cause = None
-    return TaskError(type(exc).__name__, tb, cause)
+    return TaskError(type(exc).__name__, tb, cause,
+                     exc_type_mro=[c.__name__ for c in type(exc).__mro__])
 
 
 SERIALIZER = Serializer()

@@ -38,7 +38,24 @@ def _load_lib():
             from ray_tpu._cpp.build import build
 
             build(verbose=False)
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            # The shipped .so was built against a different libc (e.g.
+            # `GLIBC_2.33 not found`). Rebuilding from the checked-in
+            # source fixes it, but only on explicit request: an implicit
+            # rebuild here would race (every node process dlopens this
+            # path — concurrent g++ runs into one .so corrupt it).
+            if os.environ.get("RTPU_REBUILD_NATIVE") != "1":
+                raise OSError(
+                    f"{e}\nThe prebuilt libshm_store.so does not load on "
+                    "this machine; rerun with RTPU_REBUILD_NATIVE=1 (or "
+                    "run `python ray_tpu/_cpp/build.py`) to rebuild it "
+                    "from source.") from e
+            from ray_tpu._cpp.build import build
+
+            build(verbose=False, force=True)
+            lib = ctypes.CDLL(so)
         lib.rtpu_store_create.restype = ctypes.c_void_p
         lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                           ctypes.c_uint64, ctypes.c_int,

@@ -977,14 +977,6 @@ def from_arrow(table, *, parallelism: int = 4) -> Dataset:
 
 
 
-def _rowable(block: Block) -> Dict[str, Any]:
-    """Row-iterating sinks need python values: arrow columns -> lists
-    (numpy columns iterate natively)."""
-    from ray_tpu.data.block import is_arrow_col
-
-    return {k: (v.to_pylist() if is_arrow_col(v) else v)
-            for k, v in block.items()}
-
 def _rows_of(stream) -> Iterator[Dict[str, Any]]:
     for ref, _meta in stream:
         yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()

@@ -20,10 +20,16 @@ class TaskError(RayTpuError):
     is readable even when the exception type could not be unpickled.
     """
 
-    def __init__(self, exc_type_name: str, cause_repr: str, cause=None):
+    def __init__(self, exc_type_name: str, cause_repr: str, cause=None,
+                 exc_type_mro=None):
         self.exc_type_name = exc_type_name
         self.cause_repr = cause_repr
         self.cause = cause
+        # Class names along the original exception's MRO: when the cause
+        # fails to unpickle at the retry site, isinstance checks against a
+        # retry_exceptions policy still work by NAME over the ancestry
+        # (ConnectionResetError retries under (ConnectionError,)).
+        self.exc_type_mro = list(exc_type_mro or [exc_type_name])
         super().__init__(f"task failed with {exc_type_name}:\n{cause_repr}")
 
     def __reduce__(self):
@@ -37,7 +43,8 @@ class TaskError(RayTpuError):
             cause = self.cause
         except Exception:
             cause = None
-        return (TaskError, (self.exc_type_name, self.cause_repr, cause))
+        return (TaskError, (self.exc_type_name, self.cause_repr, cause,
+                            self.exc_type_mro))
 
 
 class ActorError(RayTpuError):

@@ -24,7 +24,10 @@ from typing import Optional
 import jax
 from jax import lax
 import jax.numpy as jnp
-from jax import shard_map
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax in CI images
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import NEG_INF, online_softmax_update

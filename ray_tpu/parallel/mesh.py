@@ -131,10 +131,23 @@ def constrain(x, names: Sequence[Optional[str]],
     """`with_sharding_constraint` by logical dimension names (no-op outside jit
     over a mesh). Real spec errors (rank mismatch, unknown axis) surface —
     the no-mesh case is detected explicitly, not by matching error text."""
-    mesh = jax.sharding.get_abstract_mesh()
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract_mesh is None:
+        # Older jax (< 0.5): no ambient-mesh query; constraints only apply
+        # under an explicit set_mesh there, so pass through unsharded.
+        return x
+    mesh = get_abstract_mesh()
     if mesh is None or getattr(mesh, "empty", False) or not mesh.shape_tuple:
         return x
     return jax.lax.with_sharding_constraint(x, logical_spec(names, rules))
+
+
+def mesh_context(mesh: Mesh):
+    """``jax.sharding.set_mesh(mesh)`` where available (jax >= 0.5); on
+    older jax the physical mesh itself is the ambient-mesh context
+    manager. Use for version-portable `with mesh_context(m):` blocks."""
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
 
 
 def param_shardings(mesh: Mesh, logical_tree,

@@ -40,7 +40,14 @@ def _with_mesh_context(mesh: Mesh, fn):
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+        use_am = getattr(jax.sharding, "use_abstract_mesh", None)
+        if use_am is None:
+            # Older jax (< 0.5): no abstract-mesh context; enter the
+            # physical mesh instead (constrain() passes through there,
+            # but explicit in/out_shardings still place the arrays).
+            with mesh:
+                return fn(*args, **kwargs)
+        with use_am(mesh.abstract_mesh):
             return fn(*args, **kwargs)
 
     return wrapped

@@ -82,6 +82,9 @@ def run_benchmark(seconds: float = 10.0, concurrency: int = 8,
 
     serve.delete("bench-llm")
     return {
+        # CPU-toy numbers (tiny-llama on host): comparable round over
+        # round, NOT a hardware claim — the label keeps them honest.
+        "config": "tiny-cpu",
         "serve_llm_requests_per_s": round(rps, 2),
         "serve_llm_tokens_per_s": round(tokens_per_s, 2),
         "serve_llm_p50_ttft_ms": round(p50, 2),
@@ -97,7 +100,8 @@ def main(argv=None) -> Dict[str, float]:
     args = p.parse_args(argv)
     rows = run_benchmark(seconds=args.seconds, concurrency=args.concurrency)
     for k, v in rows.items():
-        print(f"{k:40s} {v:12,.2f}")
+        print(f"{k:40s} {v:>12}" if isinstance(v, str)
+              else f"{k:40s} {v:12,.2f}")
     if args.out:
         report = {}
         if os.path.exists(args.out):

@@ -1,0 +1,30 @@
+"""ray_tpu.serve.engine: device-resident LLM inference engine.
+
+The serving engine as a subsystem (vs the round-5 single-file
+serve/llm.py), four cooperating modules under one orchestrator:
+
+- ``decode_loop``  — jitted K-step decode scan that keeps EOS/budget
+  termination ON DEVICE; one host sync per K tokens.
+- ``kv_manager``   — slot allocation, block-granular occupancy, and
+  hash-based prefix caching over freed slots' resident KV.
+- ``scheduler``    — model-free continuous-batching admission (FIFO,
+  bucketed prefill, slot recycling, per-request token accounting).
+- ``metrics``      — TTFT/TPOT/queue-depth/prefix-hit-rate through the
+  util/metrics registry + the engine ``stats()`` snapshot.
+- ``core``         — ``InferenceEngine``, the engine-thread glue.
+
+See README.md in this package for the architecture notes;
+``serve/llm.py`` remains the compatibility facade (``LLMEngine``).
+"""
+
+from ray_tpu.serve.engine.core import InferenceEngine
+from ray_tpu.serve.engine.decode_loop import DecodeLoop
+from ray_tpu.serve.engine.kv_manager import KVCacheManager
+from ray_tpu.serve.engine.metrics import EngineMetrics
+from ray_tpu.serve.engine.scheduler import (Admission, EngineRequest,
+                                            Scheduler, bucket_for)
+
+__all__ = [
+    "Admission", "DecodeLoop", "EngineMetrics", "EngineRequest",
+    "InferenceEngine", "KVCacheManager", "Scheduler", "bucket_for",
+]

@@ -1,0 +1,205 @@
+"""Slot/KV-cache manager: block-granular accounting + prefix caching.
+
+The engine's KV cache is one static [L, B, S, KH, D]-class array in HBM
+(models/llama.py init_kv_cache); a "slot" is one batch row. This module
+owns which request holds which slot, and — the serving win — remembers
+what tokens a FREED slot still has resident so a later request sharing a
+prompt prefix can skip re-prefilling it (vLLM/PagedAttention-style
+prefix caching, restricted to slot-affinity: reuse happens when the new
+request is placed INTO the slot already holding the prefix; no
+cross-slot KV copies).
+
+Matching is block-granular and hash-based: token ids are chunked into
+``block_size``-token blocks and each block gets a chain hash
+``h_i = H(h_{i-1}, block_i)``, so a single dict probe per depth finds
+every free slot whose resident prefix covers the first i blocks
+(collisions are guarded by verifying the actual tokens). The reused
+length is clamped to len(prompt)-1 — at least one suffix token must run
+through prefill to produce the first-token logits.
+
+Pure host-side bookkeeping (no jax imports): unit-testable without a
+model, and the scheduler consults it for admission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    """Per-slot bookkeeping (device rows themselves live in the engine)."""
+    resident: Tuple[int, ...] = ()   # tokens whose KV rows [0, len) are valid
+    chain: Tuple[int, ...] = ()      # block-chain hashes over ``resident``
+    in_use: bool = False
+    length: int = 0                  # rows occupied by the CURRENT request
+
+
+class KVCacheManager:
+    """Allocates slots, tracks block occupancy, serves prefix-cache hits."""
+
+    def __init__(self, num_slots: int, max_len: int, block_size: int = 16):
+        if num_slots < 1:
+            raise ValueError("need at least one slot")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.blocks_per_slot = _ceil_div(max_len, block_size)
+        self._slots: List[SlotInfo] = [SlotInfo() for _ in range(num_slots)]
+        # Free list in LRU order: index 0 = least recently freed (evicted
+        # first on a cache miss, so hot prefixes survive longest).
+        self._free: List[int] = list(range(num_slots))
+        # chain hash -> free slots whose resident chain includes it.
+        self._index: Dict[int, Set[int]] = {}
+        # prefix-cache accounting (read by engine metrics / stats()).
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+
+    # ------------------------------------------------------------- hashing
+
+    def _chain(self, tokens: Sequence[int]) -> List[int]:
+        """Chain hashes for every COMPLETE block of ``tokens``."""
+        out: List[int] = []
+        h = 0
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
+            out.append(h)
+        return out
+
+    # ---------------------------------------------------------- allocation
+
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def used_blocks(self) -> int:
+        """Block-granular occupancy of the in-use slots."""
+        return sum(_ceil_div(s.length, self.block_size)
+                   for s in self._slots if s.in_use)
+
+    def total_blocks(self) -> int:
+        return self.num_slots * self.blocks_per_slot
+
+    def acquire(self, prompt_ids: Sequence[int],
+                fit=None) -> Optional[Tuple[int, int]]:
+        """Claim a free slot for ``prompt_ids``; returns (slot, cached_len)
+        or None when every slot is in use.
+
+        cached_len tokens of the prompt are already resident in the
+        returned slot's rows (block-aligned, < len(prompt_ids)); the
+        caller prefills only the suffix. ``fit(cached_len) -> bool``
+        lets the caller veto a reuse depth (e.g. the scheduler rejects
+        depths whose bucket-padded suffix prefill would spill past
+        max_len); reuse shrinks block by block until it fits.
+        """
+        if not self._free:
+            return None
+        bs = self.block_size
+        want = self._chain(prompt_ids)
+        best_slot, best_depth = -1, 0
+        for depth, h in enumerate(want, start=1):
+            cands = self._index.get(h)
+            if not cands:
+                break
+            # Cheap per-depth filter: compare only this depth's block —
+            # the chain hash links it to the earlier ones. The full
+            # prefix is verified ONCE below for the chosen candidate
+            # (hash collisions must not corrupt generations), keeping
+            # acquire O(prefix), not O(prefix * depths).
+            lo, hi = (depth - 1) * bs, depth * bs
+            for s in cands:
+                info = self._slots[s]
+                if (len(info.chain) >= depth and info.chain[depth - 1] == h
+                        and tuple(info.resident[lo:hi])
+                        == tuple(prompt_ids[lo:hi])):
+                    best_slot, best_depth = s, depth
+                    break
+            else:
+                break
+        if best_slot >= 0 and (tuple(
+                self._slots[best_slot].resident[:best_depth * bs])
+                != tuple(prompt_ids[:best_depth * bs])):
+            best_slot, best_depth = -1, 0  # chain-hash collision: miss
+        cached_len = 0
+        if best_slot >= 0:
+            cached_len = min(best_depth * bs, len(prompt_ids) - 1)
+            if fit is not None:
+                while cached_len > 0 and not fit(cached_len):
+                    cached_len -= bs
+                cached_len = max(cached_len, 0)
+        if cached_len > 0:
+            slot = best_slot
+            self._free.remove(slot)
+            self.hits += 1
+            self.tokens_reused += cached_len
+        else:
+            # Miss: evict the least-recently-freed slot (its prefix is the
+            # coldest) — never a slot that might serve a future hit sooner.
+            slot = self._free.pop(0)
+            cached_len = 0
+            self.misses += 1
+        self._unindex(slot)
+        info = self._slots[slot]
+        info.in_use = True
+        info.length = len(prompt_ids)
+        # Rows beyond cached_len are about to be overwritten: resident
+        # content is only trustworthy up to the reused prefix until the
+        # engine releases the slot with its final token contents.
+        info.resident = tuple(prompt_ids[:cached_len])
+        info.chain = tuple(self._chain(info.resident))
+        return slot, cached_len
+
+    def grow(self, slot: int, n: int = 1) -> None:
+        """Account ``n`` more rows written to an in-use slot (decode)."""
+        self._slots[slot].length += n
+
+    def release(self, slot: int,
+                resident_tokens: Optional[Sequence[int]] = None) -> None:
+        """Return a slot to the free pool. ``resident_tokens`` are the
+        tokens whose KV rows [0, len) are valid in the slot (prompt +
+        generated tokens that went back through the model) — they seed
+        future prefix-cache hits. None/() disables reuse for this slot.
+        """
+        info = self._slots[slot]
+        if not info.in_use:
+            return
+        info.in_use = False
+        info.length = 0
+        info.resident = tuple(resident_tokens or ())
+        info.chain = tuple(self._chain(info.resident))
+        for h in info.chain:
+            self._index.setdefault(h, set()).add(slot)
+        self._free.append(slot)
+
+    def _unindex(self, slot: int) -> None:
+        for h in self._slots[slot].chain:
+            s = self._index.get(h)
+            if s is not None:
+                s.discard(slot)
+                if not s:
+                    self._index.pop(h, None)
+
+    # ------------------------------------------------------------- stats
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_hit_rate": round(self.hit_rate(), 4),
+            "prefix_tokens_reused": self.tokens_reused,
+            "kv_used_blocks": self.used_blocks(),
+            "kv_total_blocks": self.total_blocks(),
+            "free_slots": self.free_slots(),
+        }

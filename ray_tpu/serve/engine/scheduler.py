@@ -1,0 +1,171 @@
+"""Continuous-batching admission policy, extracted model-free.
+
+The engine loop (core.py) is thin glue around this scheduler: every tick
+it asks for ``admissions()`` (waiting requests matched to free slots,
+with the prefill bucket and any prefix-cache reuse already decided) and
+for the decode roster of active requests. Keeping the policy here —
+with zero jax imports — makes admission behaviour (FIFO fairness, slot
+recycling between device chunks, bucketed prefill, per-request token
+accounting) unit-testable without compiling a model.
+
+Orca-style continuous batching (Yu et al., OSDI '22): admission happens
+between device chunks, finished requests free their slot immediately,
+and the decode roster is rebuilt per chunk so new requests join without
+head-of-line blocking on the longest generation.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import time
+from concurrent.futures import Future
+from typing import Any, Deque, Iterator, List, Optional
+
+from ray_tpu.serve.engine.kv_manager import KVCacheManager
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One generation request plus its engine-side state.
+
+    (serve/llm.py re-exports this as ``GenerationRequest`` for
+    compatibility with the pre-subsystem engine.)
+    """
+    prompt_ids: List[int]
+    max_new_tokens: int = 32
+    eos_id: Optional[int] = None
+    future: Future = dataclasses.field(default_factory=Future)
+    # Streaming consumers read tokens from here as they decode; a ("done",
+    # None) / ("error", e) record terminates the stream.
+    stream_queue: Optional[Any] = None
+    # engine state
+    slot: int = -1
+    generated: List[int] = dataclasses.field(default_factory=list)
+    length: int = 0        # tokens currently in the KV cache for this slot
+    cached_len: int = 0    # prompt prefix served from the prefix cache
+    arrival_t: float = 0.0
+    first_token_t: float = 0.0
+
+    def remaining(self) -> int:
+        """Token budget left (per-request accounting)."""
+        return max(0, self.max_new_tokens - len(self.generated))
+
+
+@dataclasses.dataclass
+class Admission:
+    """One admission decision: prefill ``request.prompt_ids[cached_len:]``
+    padded to ``bucket`` into ``slot`` at row offset ``cached_len``."""
+    request: EngineRequest
+    slot: int
+    cached_len: int
+    bucket: int
+
+
+def bucket_for(n: int, buckets: List[int]) -> int:
+    """Smallest configured bucket >= n (static prefill shapes: XLA
+    compiles once per bucket, not once per prompt length)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+class Scheduler:
+    """FIFO admission over a slot pool with prefix-aware placement."""
+
+    def __init__(self, kv: KVCacheManager, *, max_len: int,
+                 prompt_buckets: List[int]):
+        self.kv = kv
+        self.max_len = max_len
+        self.buckets = sorted(set(
+            [b for b in prompt_buckets if b <= max_len] + [max_len]))
+        self._waiting: Deque[EngineRequest] = collections.deque()
+        self.active: List[EngineRequest] = []
+        self.peak_active = 0
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, req: EngineRequest) -> None:
+        req.arrival_t = req.arrival_t or time.perf_counter()
+        self._waiting.append(req)
+
+    def drain_into(self, q: "queue.Queue[EngineRequest]") -> None:
+        """Pull every request currently in ``q`` into the waiting line
+        (the engine's thread-safe mailbox -> scheduler handoff)."""
+        while True:
+            try:
+                self.submit(q.get_nowait())
+            except queue.Empty:
+                return
+
+    def queue_depth(self) -> int:
+        return len(self._waiting)
+
+    # ---------------------------------------------------------- admission
+
+    def admissions(self) -> Iterator[Admission]:
+        """Match waiting requests to free slots, FIFO. Stops at slot
+        exhaustion — later arrivals wait for a recycled slot (admitted
+        between device chunks, never mid-chunk)."""
+        while self._waiting and self.kv.free_slots():
+            req = self._waiting.popleft()
+            plen = len(req.prompt_ids)
+            # Reuse depths whose bucket-padded suffix prefill would write
+            # past max_len are vetoed: the padded chunk lands at rows
+            # [cached, cached + bucket), and a clamped device write would
+            # silently shift the suffix KV onto the wrong rows.
+            got = self.kv.acquire(
+                req.prompt_ids,
+                fit=lambda c: (c + bucket_for(plen - c, self.buckets)
+                               <= self.max_len))
+            if got is None:  # raced to exhaustion
+                self._waiting.appendleft(req)
+                return
+            slot, cached_len = got
+            req.slot, req.cached_len = slot, cached_len
+            suffix = plen - cached_len
+            yield Admission(req, slot, cached_len,
+                            bucket_for(suffix, self.buckets))
+
+    def activate(self, req: EngineRequest) -> None:
+        """Prefill succeeded: request joins the decode roster."""
+        req.length = len(req.prompt_ids)
+        self.active.append(req)
+        self.peak_active = max(self.peak_active, len(self.active))
+
+    def abort_admission(self, req: EngineRequest) -> None:
+        """Prefill failed: recycle the slot without seeding the prefix
+        cache (its rows are in an unknown state)."""
+        self.kv.release(req.slot, resident_tokens=())
+        req.slot = -1
+
+    # ------------------------------------------------------------- decode
+
+    def is_finished(self, req: EngineRequest, last_tok: int) -> bool:
+        return (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and last_tok == req.eos_id)
+                or req.length + 1 >= self.max_len)
+
+    def finish(self, req: EngineRequest) -> None:
+        """Retire an active request; its slot returns to the pool with
+        its resident tokens recorded for prefix reuse. Rows [0, length)
+        hold KV for prompt + generated[:-1] (the final generated token
+        never went back through the model)."""
+        if req in self.active:
+            self.active.remove(req)
+        resident = list(req.prompt_ids) + list(req.generated[:-1])
+        self.kv.release(req.slot, resident_tokens=resident)
+        req.slot = -1
+
+    def fail_active(self) -> List[EngineRequest]:
+        """Device failure: retire the whole roster (slots recycled, no
+        prefix reuse) and hand the requests back for error delivery."""
+        failed = list(self.active)
+        for req in failed:
+            self.active.remove(req)
+            self.kv.release(req.slot, resident_tokens=())
+            req.slot = -1
+        return failed

@@ -1,314 +1,42 @@
-"""LLM serving: TPU continuous batching over the Llama KV-cache decoder.
+"""LLM serving facade over the ``serve/engine`` subsystem.
 
 Parity target: the reference delegates LLM serving to vLLM
 (reference python/ray/serve/llm.py:26-48 VLLMDeployment); on TPU that
-cannot be assumed (SURVEY M9), so the engine is native:
+cannot be assumed (SURVEY M9), so the engine is native. It used to live
+in this file; it is now a real subsystem — ``ray_tpu/serve/engine/``
+(decode_loop / kv_manager / scheduler / metrics, see its README) — and
+this module keeps the stable public surface:
 
-- STATIC shapes throughout (XLA compiles once per prompt-length bucket):
-  a fixed pool of `max_batch` slots shares one [L, B, max_len, KH, HD]
-  KV cache in HBM.
-- Continuous batching: every engine tick admits waiting requests into
-  free slots (bucket-padded prefill) and advances ALL active slots one
-  decode step in a single batched forward — new requests join between
-  ticks, finished ones free their slot immediately (no head-of-line
-  blocking on the longest generation).
-- Decode runs per-slot positions via vmap over the batch dim, so slots
-  at different sequence offsets advance together.
+- ``LLMEngine``            — the engine (continuous batching, static
+  shapes, device-resident K-step decode, prefix caching).
+- ``GenerationRequest``    — the request record (engine.scheduler's
+  ``EngineRequest``).
+- ``build_llm_deployment`` — a ready-to-run ``@serve.deployment``.
 
-Wrap `LLMEngine` in a `@serve.deployment` (see `build_llm_deployment`) to
-get routed, autoscaled replicas.
+Wrap ``LLMEngine`` in a deployment (see ``build_llm_deployment``) to get
+routed, autoscaled replicas.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import queue
-import threading
-from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
-import numpy as np
+from ray_tpu.serve.engine.core import InferenceEngine
+from ray_tpu.serve.engine.scheduler import (EngineRequest as
+                                            GenerationRequest)
+from ray_tpu.serve.engine.scheduler import bucket_for
 
-
-@dataclasses.dataclass
-class GenerationRequest:
-    prompt_ids: List[int]
-    max_new_tokens: int = 32
-    eos_id: Optional[int] = None
-    future: Future = dataclasses.field(default_factory=Future)
-    # Streaming consumers read tokens from here as they decode; a ("done",
-    # None) / ("error", e) record terminates the stream.
-    stream_queue: Optional[Any] = None
-    # engine state
-    slot: int = -1
-    generated: List[int] = dataclasses.field(default_factory=list)
-    length: int = 0   # tokens currently in the KV cache for this slot
+__all__ = ["GenerationRequest", "LLMEngine", "build_llm_deployment"]
 
 
-def _bucket(n: int, buckets: List[int]) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    raise ValueError(f"prompt length {n} exceeds the largest bucket "
-                     f"{buckets[-1]}")
+class LLMEngine(InferenceEngine):
+    """The slot-based continuous-batching decode engine (compat name —
+    the implementation is ``serve.engine.core.InferenceEngine``)."""
 
 
-class LLMEngine:
-    """The slot-based continuous-batching decode engine."""
-
-    def __init__(self, cfg=None, params=None, *, max_batch: int = 4,
-                 max_len: int = 512,
-                 prompt_buckets: Optional[List[int]] = None,
-                 decode_chunk: int = 1,
-                 seed: int = 0):
-        import jax
-        import jax.numpy as jnp
-
-        from ray_tpu.models import llama
-
-        self._jax, self._jnp, self._llama = jax, jnp, llama
-        self.cfg = cfg or llama.tiny_config(max_seq_len=max_len)
-        self.params = (params if params is not None
-                       else llama.init_params(self.cfg,
-                                              jax.random.PRNGKey(seed)))
-        self.max_batch = max_batch
-        self.max_len = min(max_len, self.cfg.max_seq_len)
-        # >1: decode_chunk steps run inside ONE jitted scan per host
-        # round-trip — through a remote-TPU tunnel each host fetch costs
-        # ~75 ms, so per-token sync caps throughput at ~13 steps/s no
-        # matter the model; chunking fetches K tokens per sync. EOS can
-        # overshoot by up to K-1 tokens (discarded after the fetch).
-        self.decode_chunk = max(1, int(decode_chunk))
-        self.buckets = prompt_buckets or [32, 64, 128]
-        self.cache = llama.init_kv_cache(self.cfg, max_batch, self.max_len)
-
-        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue()
-        self._free = list(range(max_batch))
-        self._active: List[GenerationRequest] = []
-        self._shutdown = False
-        self._jit_prefill: Dict[int, Any] = {}
-        self._jit_decode = None
-        self._build_fns()
-        self._thread = threading.Thread(target=self._engine_loop,
-                                        daemon=True, name="llm-engine")
-        self._thread.start()
-
-    # ------------------------------------------------------------- compile
-
-    def _build_fns(self) -> None:
-        jax, jnp, llama = self._jax, self._jnp, self._llama
-        cfg = self.cfg
-
-        def prefill(params, cache, tokens, slot):
-            """tokens [1, Pb] written into slot's rows at [0, Pb)."""
-            row = {k: jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=1)
-                   for k, v in cache.items()}
-            logits, new_row = llama.forward_with_cache(
-                params, tokens, row, 0, cfg)
-            cache = {k: jax.lax.dynamic_update_slice_in_dim(
-                cache[k], new_row[k], slot, axis=1) for k in cache}
-            return logits, cache
-
-        self._prefill_fn = jax.jit(prefill)
-
-        def decode(params, cache, tokens, lengths):
-            """One step for every slot: tokens [B,1], lengths [B]."""
-
-            def one(cache_row, tok, idx):
-                # vmap stripped the batch dim; the model wants [L,1,...].
-                row = {k: v[:, None] for k, v in cache_row.items()}
-                logits, new_row = llama.forward_with_cache(
-                    params, tok[None], row, idx, cfg)
-                return logits[0, -1], {k: v[:, 0]
-                                       for k, v in new_row.items()}
-
-            logits, new_cache = jax.vmap(
-                one, in_axes=({"k": 1, "v": 1}, 0, 0),
-                out_axes=(0, {"k": 1, "v": 1}))(cache, tokens, lengths)
-            next_ids = jnp.argmax(logits, axis=-1)
-            return next_ids, new_cache
-
-        self._decode_fn = jax.jit(decode)
-
-        def decode_chunk(params, cache, tokens, lengths):
-            """K decode steps in one program: each step feeds its token
-            back in; returns ([B, K] tokens, cache)."""
-
-            def body(carry, _):
-                cache, tok, ln = carry
-                next_ids, cache = decode(params, cache, tok, ln)
-                return (cache, next_ids[:, None].astype(jnp.int32),
-                        ln + 1), next_ids
-
-            (cache, _t, _l), toks = jax.lax.scan(
-                body, (cache, tokens, lengths), None,
-                length=self.decode_chunk)
-            return toks.T, cache  # [B, K]
-
-        self._decode_chunk_fn = (jax.jit(decode_chunk)
-                                 if self.decode_chunk > 1 else None)
-
-    # ------------------------------------------------------------- public
-
-    def generate(self, prompt_ids: List[int], max_new_tokens: int = 32,
-                 eos_id: Optional[int] = None,
-                 timeout: float = 300.0) -> Dict[str, Any]:
-        """Blocking generation (replicas call this per request; batching
-        happens inside the engine across concurrent callers)."""
-        req = GenerationRequest(list(prompt_ids), max_new_tokens, eos_id)
-        if not req.prompt_ids:
-            raise ValueError("empty prompt")
-        if not all(isinstance(t, (int, np.integer))
-                   and 0 <= t < self.cfg.vocab_size
-                   for t in req.prompt_ids):
-            raise ValueError("prompt_ids must be ints in [0, vocab_size)")
-        if len(req.prompt_ids) + max_new_tokens > self.max_len:
-            raise ValueError("prompt + max_new_tokens exceeds max_len")
-        self._queue.put(req)
-        return req.future.result(timeout=timeout)
-
-    def generate_stream(self, prompt_ids: List[int],
-                        max_new_tokens: int = 32,
-                        eos_id: Optional[int] = None,
-                        timeout: float = 300.0):
-        """Token-streaming generation: yields token ids as the engine
-        decodes them (reference: the vLLM engine's async token streams —
-        here the continuous-batching loop feeds per-request queues)."""
-        req = GenerationRequest(list(prompt_ids), max_new_tokens, eos_id,
-                                stream_queue=queue.Queue())
-        if not req.prompt_ids:
-            raise ValueError("empty prompt")
-        if len(req.prompt_ids) + max_new_tokens > self.max_len:
-            raise ValueError("prompt + max_new_tokens exceeds max_len")
-        self._queue.put(req)
-        while True:
-            kind, val = req.stream_queue.get(timeout=timeout)
-            if kind == "token":
-                yield val
-            elif kind == "done":
-                return
-            else:
-                raise val
-
-    def stats(self) -> Dict[str, Any]:
-        return {"active": len(self._active), "free_slots": len(self._free),
-                "waiting": self._queue.qsize()}
-
-    def close(self) -> None:
-        self._shutdown = True
-
-    # ------------------------------------------------------------- engine
-
-    def _admit(self) -> None:
-        jnp = self._jnp
-        while self._free and not self._queue.empty():
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                return
-            slot = self._free.pop()
-            req.slot = slot
-            try:
-                plen = len(req.prompt_ids)
-                pb = _bucket(plen, [b for b in self.buckets
-                                    if b <= self.max_len] + [self.max_len])
-                padded = np.zeros((1, pb), np.int32)
-                padded[0, :plen] = req.prompt_ids
-                logits, self.cache = self._prefill_fn(
-                    self.params, self.cache, jnp.asarray(padded), slot)
-                # First generated token: from the LAST REAL prompt pos.
-                first = int(np.argmax(np.asarray(logits)[0, plen - 1]))
-            except BaseException as e:  # noqa: BLE001 — one bad request
-                # must not kill the engine thread (every later request
-                # would hang on a dead engine).
-                self._free.append(slot)
-                if not req.future.done():
-                    req.future.set_exception(e)
-                if req.stream_queue is not None:
-                    req.stream_queue.put(("error", e))
-                continue
-            req.generated.append(first)
-            if req.stream_queue is not None:
-                req.stream_queue.put(("token", first))
-            req.length = plen
-            self._active.append(req)
-            self._maybe_finish(req, first)
-
-    def _maybe_finish(self, req: GenerationRequest, last_tok: int) -> bool:
-        done = (len(req.generated) >= req.max_new_tokens
-                or (req.eos_id is not None and last_tok == req.eos_id)
-                or req.length + 1 >= self.max_len)
-        if done and req in self._active:
-            self._active.remove(req)
-            self._free.append(req.slot)
-            if not req.future.done():
-                req.future.set_result({
-                    "token_ids": req.generated,
-                    "num_generated": len(req.generated),
-                })
-            if req.stream_queue is not None:
-                req.stream_queue.put(("done", None))
-        return done
-
-    def _engine_loop(self) -> None:
-        jnp = self._jnp
-        while not self._shutdown:
-            self._admit()
-            if not self._active:
-                try:
-                    req = self._queue.get(timeout=0.1)
-                    self._queue.put(req)  # admit on next tick
-                except queue.Empty:
-                    pass
-                continue
-            # One batched decode step for every slot (inactive slots chew
-            # on stale state; their outputs are ignored). When every
-            # active request has >= decode_chunk steps of headroom (cache
-            # space AND token budget), K steps run in one program — one
-            # host sync per K tokens; otherwise single-step (exactly two
-            # compiled decode programs total).
-            k = self.decode_chunk
-            if k > 1 and self._active:
-                headroom = min(
-                    min(self.max_len - 1 - r.length for r in self._active),
-                    min(r.max_new_tokens - len(r.generated)
-                        for r in self._active))
-                if headroom < k:
-                    k = 1
-            tokens = np.zeros((self.max_batch, 1), np.int32)
-            lengths = np.zeros((self.max_batch,), np.int32)
-            for req in self._active:
-                tokens[req.slot, 0] = req.generated[-1]
-                lengths[req.slot] = req.length
-            try:
-                if k > 1:
-                    chunk_ids, self.cache = self._decode_chunk_fn(
-                        self.params, self.cache, jnp.asarray(tokens),
-                        jnp.asarray(lengths))
-                    chunk_ids = np.asarray(chunk_ids)  # [B, k]
-                else:
-                    next_ids, self.cache = self._decode_fn(
-                        self.params, self.cache, jnp.asarray(tokens),
-                        jnp.asarray(lengths))
-                    chunk_ids = np.asarray(next_ids)[:, None]
-            except BaseException as e:  # noqa: BLE001 — fail all waiters
-                for req in list(self._active):
-                    self._active.remove(req)
-                    self._free.append(req.slot)
-                    if not req.future.done():
-                        req.future.set_exception(e)
-                    if req.stream_queue is not None:
-                        req.stream_queue.put(("error", e))
-                continue
-            for req in list(self._active):
-                for j in range(chunk_ids.shape[1]):
-                    tok = int(chunk_ids[req.slot, j])
-                    req.length += 1
-                    req.generated.append(tok)
-                    if req.stream_queue is not None:
-                        req.stream_queue.put(("token", tok))
-                    if self._maybe_finish(req, tok):
-                        break  # EOS mid-chunk: overshoot discarded
+def _bucket(n: int, buckets) -> int:
+    """Back-compat shim for the pre-subsystem helper."""
+    return bucket_for(n, list(buckets))
 
 
 def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,

@@ -80,9 +80,13 @@ async function refresh() {
     if (api.jobs && api.jobs.length)
       html += table('Jobs', api.jobs,
         ['submission_id', 'status', 'entrypoint', 'message']);
-    html += '<h2>Object store</h2><pre>' +
-      JSON.stringify(api.objects, null, 1) + '</pre>';
+    html += '<h2>Object store</h2><pre id="objstore"></pre>';
     document.getElementById('tables').innerHTML = html;
+    // The object-store summary goes in via textContent, never innerHTML:
+    // its strings (spill paths, debug labels) can carry user-controlled
+    // markup that must not execute in the operator's browser.
+    document.getElementById('objstore').textContent =
+      JSON.stringify(api.objects, null, 1);
     document.getElementById('meta').textContent =
       new Date().toLocaleTimeString() + ' — ' + api.nodes.length +
       ' nodes, ' + api.actors.length + ' actors';

@@ -268,8 +268,13 @@ def _retryable(node: StepNode, err: BaseException) -> bool:
     cause = getattr(err, "cause", None)
     if cause is not None:
         return isinstance(cause, types)
-    name = getattr(err, "exc_type_name", "")
-    return any(t.__name__ == name for t in types)
+    # Cause failed to unpickle: match by NAME over the original exception's
+    # full ancestry (capture_exception records the MRO names), so e.g.
+    # ConnectionResetError still retries under retry_exceptions=
+    # (ConnectionError,). Older records carry only exc_type_name.
+    names = set(getattr(err, "exc_type_mro", None)
+                or [getattr(err, "exc_type_name", "")])
+    return any(t.__name__ in names for t in types)
 
 
 class _GraphRun:

@@ -11,7 +11,8 @@ import pytest
 
 from ray_tpu.models import llama, mixtral
 from ray_tpu.parallel import pipeline, spmd
-from ray_tpu.parallel.mesh import MeshSpec, make_mesh, param_shardings
+from ray_tpu.parallel.mesh import (MeshSpec, make_mesh, mesh_context,
+                                   param_shardings)
 
 
 @pytest.fixture(scope="module")
@@ -34,7 +35,7 @@ def test_pipeline_matches_dense_forward(pp2_mesh):
 
     pcfg = pipeline.PipelineConfig(stages=2, microbatches=4)
     staged = pipeline.stage_params(params, 2)
-    with jax.sharding.set_mesh(pp2_mesh):
+    with mesh_context(pp2_mesh):
         pipe_loss, _ = jax.jit(
             lambda p, t: pipeline.pipeline_loss_fn(p, t, cfg, pcfg,
                                                    mesh=pp2_mesh))(
@@ -47,7 +48,7 @@ def test_pipeline_train_step_decreases_loss(pp2_mesh):
     cfg = llama.tiny_config(n_layers=4)
     pcfg = pipeline.PipelineConfig(stages=2, microbatches=4)
     tx = spmd.default_optimizer(lr=5e-3, warmup=0, decay_steps=100)
-    with jax.sharding.set_mesh(pp2_mesh):
+    with mesh_context(pp2_mesh):
         params = pipeline.stage_params(
             llama.init_params(cfg, jax.random.PRNGKey(0)), 2)
         shardings = param_shardings(
@@ -125,7 +126,7 @@ def test_mixtral_train_step_ep_mesh():
     mesh = make_mesh(MeshSpec(ep=4, fsdp=2), jax.devices("cpu")[:8])
     cfg = mixtral.tiny_moe_config()
     tx = optax.adam(3e-3)
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         shardings = param_shardings(mesh, mixtral.param_logical_axes(cfg))
         params = jax.device_put(
             mixtral.init_params(cfg, jax.random.PRNGKey(0)), shardings)

@@ -1,0 +1,351 @@
+"""serve/engine subsystem tests: device-resident decode correctness,
+prefix-cache accounting, admission policy, and host-sync cadence.
+
+Everything here runs engine-local (no cluster fixture): the decode loop,
+scheduler, and KV manager are exactly the code the serve deployment
+wraps, and CPU/interpret mode runs the identical jitted programs.
+"""
+
+import concurrent.futures as cf
+import threading
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from ray_tpu.models import llama
+
+    cfg = llama.tiny_config(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def make_engine(tiny_model, **kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_buckets", [8, 16])
+    return LLMEngine(cfg, params, **kw)
+
+
+def reference_greedy(tiny_model, prompt, n):
+    """Step-by-step full-forward greedy decode (no KV cache): the ground
+    truth the chunked device loop must reproduce."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg, params = tiny_model
+    ids = list(prompt)
+    for _ in range(n):
+        logits = llama.forward(params, jnp.asarray([ids]), cfg)
+        ids.append(int(jnp.argmax(logits[0, -1])))
+    return ids[len(prompt):]
+
+
+# ------------------------------------------------------------------ decode
+
+
+def test_chunk_loop_matches_single_step(tiny_model):
+    """The K-step device scan (chunk=4) and the degenerate per-token loop
+    (chunk=1) must emit identical tokens — and both must match the
+    cache-free full-forward greedy reference."""
+    prompt = [1, 2, 3, 4, 5]
+    want = reference_greedy(tiny_model, prompt, 9)
+    for chunk in (1, 4):
+        eng = make_engine(tiny_model, decode_chunk=chunk)
+        try:
+            out = eng.generate(prompt, max_new_tokens=9)
+        finally:
+            eng.close()
+        assert out["token_ids"] == want, f"chunk={chunk}"
+
+
+def test_chunk_boundary_not_multiple(tiny_model):
+    """Budgets that are not chunk multiples stop exactly on budget: the
+    on-device `remaining` carry must not round up to the chunk."""
+    eng = make_engine(tiny_model, decode_chunk=4)
+    try:
+        out = eng.generate([9, 8, 7], max_new_tokens=6)
+    finally:
+        eng.close()
+    assert out["num_generated"] == 6
+    assert out["token_ids"] == reference_greedy(tiny_model, [9, 8, 7], 6)
+
+
+def test_eos_mid_chunk_overshoot_discard(tiny_model):
+    """A request whose EOS lands mid-chunk ends AT the EOS: the frozen
+    overshoot tokens the device kept scanning are discarded, never
+    delivered (stream and blocking agree)."""
+    prompt = [3, 1, 4, 1, 5]
+    eng = make_engine(tiny_model, decode_chunk=4)
+    try:
+        free_run = eng.generate(prompt, max_new_tokens=12)["token_ids"]
+        # Pick an EOS that first appears mid-chunk: generated index k
+        # with k % 4 not in (0, 3) (token 0 comes from prefill; chunks
+        # cover indices 1-4, 5-8, 9-12).
+        k = next(i for i, t in enumerate(free_run)
+                 if free_run.index(t) == i and i % 4 in (1, 2) and i > 0)
+        eos = free_run[k]
+        out = eng.generate(prompt, max_new_tokens=12, eos_id=eos)
+        assert out["token_ids"] == free_run[:k + 1]
+        assert out["token_ids"][-1] == eos
+        streamed = list(eng.generate_stream(prompt, max_new_tokens=12,
+                                            eos_id=eos))
+        assert streamed == free_run[:k + 1]
+    finally:
+        eng.close()
+
+
+def test_host_sync_cadence(tiny_model):
+    """Acceptance: decode-path device fetches happen at most once per K
+    generated tokens. Token 0 comes from prefill; the remaining n-1
+    arrive in ceil((n-1)/K) chunk fetches — counted, not inferred."""
+    eng = make_engine(tiny_model, decode_chunk=8)
+    try:
+        before = eng.metrics.host_syncs
+        out = eng.generate([1, 2, 3], max_new_tokens=17)
+        syncs = eng.metrics.host_syncs - before
+    finally:
+        eng.close()
+    assert out["num_generated"] == 17
+    assert syncs == 2  # ceil(16 / 8) — one fetch per device chunk
+    # The old engine paid one fetch per token; the subsystem's contract:
+    assert syncs <= -(-16 // 8)
+
+
+# ------------------------------------------------------------ prefix cache
+
+
+def test_prefix_cache_hit_skips_reprefill(tiny_model):
+    """Acceptance: a repeated prompt prefix is served from the freed
+    slot's resident KV — cached_prefix_len > 0 — and the generation is
+    bit-identical to the cold run."""
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    eng = make_engine(tiny_model, max_batch=1, decode_chunk=4,
+                      prefix_block=4)
+    try:
+        cold = eng.generate(prompt, max_new_tokens=8)
+        assert cold["cached_prefix_len"] == 0
+        assert eng.kv.misses == 1 and eng.kv.hits == 0
+        warm = eng.generate(prompt, max_new_tokens=8)
+        # 9-token prompt, block 4 -> 8 resident rows reused.
+        assert warm["cached_prefix_len"] == 8
+        assert warm["token_ids"] == cold["token_ids"]
+        assert eng.kv.hits == 1
+        assert eng.metrics.prefill_tokens == 9 + 1  # cold 9, warm suffix 1
+        stats = eng.stats()
+        assert stats["prefix_hit_rate"] == 0.5
+        assert stats["prefix_tokens_reused"] == 8
+    finally:
+        eng.close()
+
+
+def test_prefix_cache_survives_concurrent_decode(tiny_model):
+    """A freed slot's resident prefix KV must survive OTHER slots'
+    decode chunks: the scan steps every slot (static shapes), and the
+    inactive slots' parked writes must not clobber resident rows."""
+    shared = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    eng = make_engine(tiny_model, max_batch=2, decode_chunk=4,
+                      prefix_block=4)
+    try:
+        cold = eng.generate(shared, max_new_tokens=6)
+        assert cold["cached_prefix_len"] == 0
+        # Keep slot 2 decoding (many chunk dispatches) while slot 1 —
+        # holding the shared prefix — sits freed; every chunk used to
+        # overwrite row 0 of the freed slot.
+        with cf.ThreadPoolExecutor(2) as pool:
+            long_run = pool.submit(eng.generate, [11, 12, 13], 24)
+            while not eng.scheduler.active:  # admitted and decoding
+                pass
+            warm = eng.generate(shared, max_new_tokens=6)
+            long_run.result(timeout=300)
+        assert warm["cached_prefix_len"] == 8
+        assert warm["token_ids"] == cold["token_ids"]
+    finally:
+        eng.close()
+
+
+def test_prefix_reuse_shrinks_to_fit_bucket(tiny_model):
+    """Reuse depths whose bucket-padded suffix prefill would write past
+    max_len are shrunk block-by-block (never silently clamped on
+    device): 24 resident + bucket 16 at max_len 32 must drop reuse to
+    16 rows, and the generation still matches the cold run."""
+    eng = make_engine(tiny_model, max_batch=1, max_len=32,
+                      prompt_buckets=[16], decode_chunk=2, prefix_block=4)
+    prompt = list(range(2, 26))  # 24 tokens
+    try:
+        cold = eng.generate(prompt, max_new_tokens=4)
+        warm = eng.generate(prompt, max_new_tokens=4)
+        # Full-depth reuse would be 23 (len-1 clamp) -> suffix 1 ->
+        # bucket 16: 23+16 exceeds max_len 32. Shrinking by block_size=4
+        # steps: 23 -> 19 -> 15; 15+bucket_for(9)=16 fits (31 <= 32).
+        assert warm["cached_prefix_len"] == 15
+        assert warm["token_ids"] == cold["token_ids"]
+    finally:
+        eng.close()
+
+
+def test_kv_manager_hit_miss_accounting():
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+
+    kv = KVCacheManager(num_slots=2, max_len=32, block_size=4)
+    prompt = list(range(10, 19))  # 9 tokens -> 2 complete blocks
+    slot, cached = kv.acquire(prompt)
+    assert cached == 0 and kv.misses == 1
+    kv.release(slot, resident_tokens=prompt)
+    s2, cached = kv.acquire(prompt)
+    assert s2 == slot and cached == 8 and kv.hits == 1
+    # Prefix reuse is clamped: at least one token must prefill.
+    kv.release(s2, resident_tokens=prompt)
+    s3, cached = kv.acquire(prompt[:8])
+    assert s3 == slot and cached == 7  # min(8, len-1)
+    kv.release(s3, resident_tokens=prompt[:8])
+    # A diverging prompt must not hit (block contents are verified).
+    other = [1] + prompt[1:]
+    _, cached = kv.acquire(other)
+    assert cached == 0 and kv.misses == 2
+    assert kv.stats()["prefix_hit_rate"] == pytest.approx(2 / 4)
+    assert kv.tokens_reused == 8 + 7
+
+
+def test_kv_manager_miss_evicts_lru_not_hot_prefix():
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+
+    kv = KVCacheManager(num_slots=2, max_len=32, block_size=4)
+    hot = list(range(100, 108))
+    s0, _ = kv.acquire(hot)
+    kv.release(s0, resident_tokens=hot)          # slot s0 holds `hot`
+    s1, _ = kv.acquire(list(range(50, 58)))
+    kv.release(s1, resident_tokens=[])           # s1: nothing resident
+    # Re-touch the hot prefix (hit) so s0 is the MOST recently freed.
+    s_hit, cached = kv.acquire(hot)
+    assert s_hit == s0 and cached == 7
+    kv.release(s_hit, resident_tokens=hot)
+    # A miss must evict the least-recently-freed slot — s1, not the hot
+    # slot (hot prefixes survive longest).
+    s_new, cached = kv.acquire(list(range(200, 208)))
+    assert s_new == s1 and cached == 0
+    s_hot, cached = kv.acquire(hot)              # hot prefix survived
+    assert s_hot == s0 and cached == 7
+
+
+def test_kv_manager_slot_exhaustion_returns_none():
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+
+    kv = KVCacheManager(num_slots=1, max_len=16, block_size=4)
+    assert kv.acquire([1, 2, 3]) is not None
+    assert kv.acquire([4, 5, 6]) is None
+    assert kv.free_slots() == 0
+
+
+# --------------------------------------------------------------- admission
+
+
+def test_scheduler_admission_under_slot_exhaustion():
+    """Model-free admission policy: FIFO, stops at slot exhaustion,
+    resumes when a finished request recycles its slot."""
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+    from ray_tpu.serve.engine.scheduler import EngineRequest, Scheduler
+
+    kv = KVCacheManager(num_slots=2, max_len=32, block_size=4)
+    sched = Scheduler(kv, max_len=32, prompt_buckets=[8, 16])
+    reqs = [EngineRequest(prompt_ids=[i, i + 1, i + 2], max_new_tokens=4)
+            for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    admitted = list(sched.admissions())
+    assert [a.request for a in admitted] == reqs[:2]  # FIFO, 2 slots
+    assert all(a.bucket == 8 for a in admitted)
+    assert sched.queue_depth() == 1
+    for a in admitted:
+        sched.activate(a.request)
+    assert list(sched.admissions()) == []             # exhausted: waits
+    reqs[0].generated = [7, 7, 7, 7]
+    sched.finish(reqs[0])                             # slot recycled
+    admitted2 = list(sched.admissions())
+    assert [a.request for a in admitted2] == [reqs[2]]
+    assert sched.queue_depth() == 0
+
+
+def test_engine_slot_exhaustion_queues_and_completes(tiny_model):
+    """More concurrent callers than slots: later arrivals wait for a
+    recycled slot between device chunks and still complete correctly."""
+    eng = make_engine(tiny_model, max_batch=1, decode_chunk=2)
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    try:
+        with cf.ThreadPoolExecutor(3) as pool:
+            futs = [pool.submit(eng.generate, p, 5) for p in prompts]
+            outs = [f.result(timeout=300) for f in futs]
+        assert eng.scheduler.peak_active == 1  # never oversubscribed
+    finally:
+        eng.close()
+    for p, o in zip(prompts, outs):
+        assert o["token_ids"] == reference_greedy(tiny_model, p, 5), p
+
+
+def test_bucket_for_and_request_validation(tiny_model):
+    from ray_tpu.serve.engine.scheduler import bucket_for
+
+    assert bucket_for(3, [8, 16]) == 8
+    assert bucket_for(8, [8, 16]) == 8
+    assert bucket_for(9, [8, 16]) == 16
+    with pytest.raises(ValueError):
+        bucket_for(17, [8, 16])
+    eng = make_engine(tiny_model)
+    try:
+        with pytest.raises(ValueError):
+            eng.generate([], max_new_tokens=4)
+        with pytest.raises(ValueError):
+            eng.generate([1, 2, 999999], max_new_tokens=4)  # vocab range
+        with pytest.raises(ValueError):
+            eng.generate([1] * 60, max_new_tokens=10)  # exceeds max_len
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------- streaming
+
+
+def test_streaming_consumer_ordering(tiny_model):
+    """Two concurrent streams over one engine: each consumer sees ITS
+    tokens, in decode order, matching the blocking path exactly."""
+    eng = make_engine(tiny_model, max_batch=2, decode_chunk=4)
+    prompts = [[1, 2, 3], [4, 5, 6]]
+    got = {}
+
+    def consume(i):
+        got[i] = list(eng.generate_stream(prompts[i], max_new_tokens=7))
+
+    try:
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, p in enumerate(prompts):
+            assert got[i] == reference_greedy(tiny_model, p, 7), p
+    finally:
+        eng.close()
+
+
+def test_engine_stats_surface(tiny_model):
+    """stats() carries the serving counters the bench rows read."""
+    eng = make_engine(tiny_model, decode_chunk=4)
+    try:
+        eng.generate([1, 2, 3], max_new_tokens=5)
+        s = eng.stats()
+    finally:
+        eng.close()
+    for key in ("requests", "tokens_generated", "decode_host_syncs",
+                "prefix_hit_rate", "ttft_ms_p50", "tpot_ms_p50",
+                "free_slots", "kv_used_blocks"):
+        assert key in s, key
+    assert s["requests"] == 1
+    assert s["tokens_generated"] == 5

@@ -33,7 +33,7 @@ def _tiny_llama_loop(config):
     import ray_tpu.train as train
     from ray_tpu.models import llama
     from ray_tpu.parallel import spmd
-    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh, mesh_context
 
     ctx = train.get_context()
     assert ctx.get_world_size() == config["world_size"]
@@ -41,7 +41,7 @@ def _tiny_llama_loop(config):
     cfg = llama.tiny_config()
     mesh = make_mesh(MeshSpec(), jax.devices("cpu")[:1])
     tx = spmd.default_optimizer(lr=1e-2)
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         state = spmd.sharded_init(cfg, mesh, jax.random.PRNGKey(0), tx)
         start_step = 0
         ckpt = train.get_checkpoint()
