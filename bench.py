@@ -27,6 +27,11 @@ Rows:
   (controller/router/replica, tiny engine) in a CPU child process; the
   reference publishes no serve numbers (it delegates to vLLM), so these
   are absolute, tracked round-over-round.
+- locality_scheduling — locality-aware scheduling suite (``--locality``
+  runs it standalone) on a 4-node in-process CPU cluster:
+  ``locality_hit_rate`` and ``object_bytes_pulled_per_task`` for the
+  default scheduler vs a forced-random-placement baseline of the same
+  workload.
 
 Structure: measurements run in CHILD subprocesses; the parent supervises
 with retry + backoff. A TPU backend init failure is cached for the life
@@ -61,6 +66,7 @@ BACKOFFS_S = (10, 30, 60)  # between attempts
 CHILD_TIMEOUT_S = 2100     # first TPU compiles (4 programs) can take minutes
 SERVE_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
+LOCALITY_TIMEOUT_S = 420   # per locality child (boots a 4-node cluster)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -96,13 +102,13 @@ def _bench_train(cfg, batch, seq, warmup, iters, devices, tx=None):
     import jax
 
     from ray_tpu.parallel import spmd
-    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh, mesh_context
 
     n = len(devices)
     mesh = make_mesh(MeshSpec(fsdp=n), devices) if n > 1 else \
         make_mesh(MeshSpec(), devices[:1])
     tx = tx or spmd.default_optimizer(lr=1e-4)
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         state = spmd.sharded_init(cfg, mesh, jax.random.PRNGKey(0), tx)
         step = spmd.make_train_step(cfg, mesh, tx)
         rng = np.random.default_rng(0)
@@ -385,6 +391,142 @@ def serve_child_main() -> None:
 
 
 # --------------------------------------------------------------------------
+# locality suite (--locality): locality-aware scheduling vs forced-random
+# --------------------------------------------------------------------------
+
+def locality_child_main() -> None:
+    """One locality-workload measurement on a 4-node in-process cluster:
+    blocks are produced pinned round-robin across the nodes, then one
+    consumer task per block reads its block. With locality scheduling on
+    (RTPU_SCHEDULER_LOCALITY_ENABLED=1, the default) consumers land on
+    their block's holder node and pull nothing; the ``--random`` child
+    (flag off + SPREAD placement) is the forced-random-placement
+    baseline whose consumers pull their input over the simulated DCN.
+    Prints one JSON row."""
+    _pin_platform()
+    mode = "random" if "--random" in sys.argv else "locality"
+    import ray_tpu as rt
+    from ray_tpu.core.runtime_context import require_runtime
+    from ray_tpu.util import metrics as _m
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    rt.init(num_cpus=2)
+    runtime = require_runtime()
+    extra = [runtime.add_node(num_cpus=2) for _ in range(3)]
+    node_ids = [runtime._nodes[0].node_id] + [n.node_id for n in extra]
+
+    n_blocks = 24
+    block_bytes = 4 << 20
+
+    @rt.remote
+    def produce(i: int, nbytes: int):
+        import numpy as _np
+
+        return _np.full(nbytes, i % 251, dtype=_np.uint8)
+
+    @rt.remote
+    def consume(arr) -> int:
+        time.sleep(0.1)  # stand-in compute: keeps one task per lease
+        return int(arr[0]) + len(arr)
+
+    blocks = [
+        produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_ids[i % len(node_ids)])
+        ).remote(i, block_bytes)
+        for i in range(n_blocks)]
+    ready, _ = rt.wait(blocks, num_returns=n_blocks, timeout=180)
+    assert len(ready) == n_blocks, "block production timed out"
+
+    def pull_totals() -> int:
+        pulled = 0
+        for n in runtime.nodes():
+            try:
+                st = runtime._pool.get(n["address"]).call(
+                    "pull_stats", timeout=5)
+                pulled += int(st.get("bytes_pulled", 0))
+            except Exception:
+                pass
+        return pulled
+
+    opts = {"scheduling_strategy": "SPREAD"} if mode == "random" else {}
+    h0 = _m.SCHEDULER_LOCALITY_HITS.get()
+    m0 = _m.SCHEDULER_LOCALITY_MISSES.get()
+    p0 = pull_totals()
+    t0 = time.perf_counter()
+    futs = [consume.options(**opts).remote(ref) for ref in blocks]
+    out = rt.get(futs, timeout=300)
+    wall_s = time.perf_counter() - t0
+    assert len(out) == n_blocks
+    pulled = pull_totals() - p0
+    hits = _m.SCHEDULER_LOCALITY_HITS.get() - h0
+    misses = _m.SCHEDULER_LOCALITY_MISSES.get() - m0
+    row = {
+        "metric": "locality_scheduling", "mode": mode,
+        "locality_hit_rate": round(hits / max(1, hits + misses), 3),
+        "object_bytes_pulled_per_task": round(pulled / n_blocks, 1),
+        "bytes_pulled_total": pulled,
+        "locality_hits": hits, "locality_misses": misses,
+        "n_tasks": n_blocks, "block_bytes": block_bytes,
+        "nodes": len(node_ids), "wall_s": round(wall_s, 2)}
+    print(json.dumps(row), flush=True)
+    rt.shutdown()
+
+
+def _locality_suite_rows() -> list:
+    """Run both locality children; returns their rows (error rows on
+    failure — the suite must never take down the whole bench)."""
+    rows = []
+    for mode in ("locality", "random"):
+        args = ["--locality-child"] + (["--random"] if mode == "random"
+                                       else [])
+        env = {"JAX_PLATFORMS": "cpu",
+               "RTPU_SCHEDULER_LOCALITY_ENABLED":
+                   "1" if mode == "locality" else "0"}
+        try:
+            proc = _run(args, LOCALITY_TIMEOUT_S, env_extra=env)
+        except subprocess.TimeoutExpired:
+            rows.append({"metric": "locality_scheduling", "mode": mode,
+                         "error": f"timeout {LOCALITY_TIMEOUT_S}s"})
+            continue
+        lines = _json_lines(proc.stdout)
+        if proc.returncode == 0 and lines:
+            rows.append(lines[-1])
+        else:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            rows.append({"metric": "locality_scheduling", "mode": mode,
+                         "error": "rc=%d: %s" % (proc.returncode,
+                                                 " | ".join(tail))})
+    return rows
+
+
+def locality_main() -> int:
+    """Standalone ``--locality``: both modes + one merged tail line."""
+    rows = _locality_suite_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_locality_rows(rows)))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+def _merge_locality_rows(rows: list) -> dict:
+    by = {r.get("mode"): r for r in rows}
+    loc, rnd = by.get("locality", {}), by.get("random", {})
+    merged = {"metric": "locality_scheduling"}
+    if "error" in loc:
+        merged["error"] = loc["error"]
+    else:
+        merged["locality_hit_rate"] = loc.get("locality_hit_rate")
+        merged["object_bytes_pulled_per_task"] = \
+            loc.get("object_bytes_pulled_per_task")
+    if "error" not in rnd:
+        merged["object_bytes_pulled_per_task_random"] = \
+            rnd.get("object_bytes_pulled_per_task")
+    return merged
+
+
+# --------------------------------------------------------------------------
 # parent supervisor
 # --------------------------------------------------------------------------
 
@@ -542,6 +684,17 @@ def main() -> int:
     if serve_row is not None:
         print(json.dumps(serve_row), flush=True)
 
+    # Phase 4: locality-scheduling suite on CPU (multi-node in-process
+    # cluster; chip-independent). Tracked round-over-round from this PR.
+    loc_rows: list = []
+    try:
+        loc_rows = _locality_suite_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        loc_rows = [{"metric": "locality_scheduling",
+                     "error": repr(e)[:200]}]
+    for r in loc_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -570,6 +723,14 @@ def main() -> int:
             merged[k] = serve_row.get(k)
     elif serve_row:
         merged["serve_error"] = serve_row["error"]
+    loc_merged = _merge_locality_rows(loc_rows)
+    if "error" not in loc_merged:
+        for k in ("locality_hit_rate", "object_bytes_pulled_per_task",
+                  "object_bytes_pulled_per_task_random"):
+            if loc_merged.get(k) is not None:
+                merged[k] = loc_merged[k]
+    else:
+        merged["locality_error"] = loc_merged["error"]
     print(json.dumps(merged))
     return 0
 
@@ -581,6 +742,10 @@ if __name__ == "__main__":
         sys.exit(serve_child_main())
     if "--engine" in sys.argv:
         sys.exit(engine_child_main())
+    if "--locality-child" in sys.argv:
+        sys.exit(locality_child_main())
+    if "--locality" in sys.argv:
+        sys.exit(locality_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

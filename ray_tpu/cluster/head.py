@@ -92,6 +92,13 @@ class HeadServer:
         self._named: Dict[Tuple[str, str], bytes] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         self._object_dir: Dict[bytes, Set[str]] = {}
+        # Sealed sizes alongside the holder sets: the scheduler scores
+        # candidate nodes by locally-resident input BYTES, not object
+        # counts (reference: the GCS object directory the raylet's
+        # locality-aware lease policy reads).
+        self._object_sizes: Dict[bytes, int] = {}
+        self._locality_hits = 0
+        self._locality_misses = 0
         self._pgs: Dict[bytes, Dict[str, Any]] = {}
         self._subscribers: Dict[str, List[Any]] = {}  # channel -> [conn]
         self._job_counter = 1
@@ -268,6 +275,14 @@ class HeadServer:
         """Graceful removal (autoscaler downscale)."""
         with self._lock:
             n = self._nodes.pop(node_id, None)
+            # Its object copies leave with it: scrub directory entries
+            # (same cleanup as node death) so pullers don't dial a
+            # drained node and the locality scorer doesn't credit it.
+            for oid, nodes in list(self._object_dir.items()):
+                nodes.discard(node_id)
+                if not nodes:
+                    del self._object_dir[oid]
+                    self._object_sizes.pop(oid, None)
         if n is not None:
             self._publish("NODE", {"event": "removed", "node_id": node_id})
         return True
@@ -315,6 +330,7 @@ class HeadServer:
                 nodes.discard(node_id)
                 if not nodes:
                     del self._object_dir[oid]
+                    self._object_sizes.pop(oid, None)
         for a in victims:
             self._actor_died(a, f"node {node_id} died", try_restart=True)
 
@@ -379,13 +395,22 @@ class HeadServer:
     def rpc_pick_node(self, conn, resources: Dict[str, float],
                       strategy: Optional[Dict[str, Any]] = None,
                       exclude: Optional[List[str]] = None,
-                      demand_key: Optional[Any] = None):
+                      demand_key: Optional[Any] = None,
+                      input_objects: Optional[List[bytes]] = None):
         """Returns (node_id, address, store_name) or None (infeasible now).
 
         ``demand_key`` identifies the REQUESTING ENTITY (actor id, sched
         key) for the unmet-demand ring: N distinct requesters of one shape
         must register as N demands, while one requester retrying must
-        register as one (see rpc_get_demand)."""
+        register as one (see rpc_get_demand).
+
+        ``input_objects`` is the locality hint: ids of the task's input
+        objects. Feasible nodes are scored by locally-resident input
+        bytes (object directory x sealed sizes) and the best holder wins
+        — unless its utilization already crossed
+        `scheduler_locality_spill_threshold`, in which case the hybrid
+        pack/spread ranking decides (spillback: locality must never
+        starve a task behind a loaded holder)."""
         exclude_set = set(exclude or ())
         strategy = strategy or {}
         kind = strategy.get("kind")
@@ -480,7 +505,75 @@ class HeadServer:
             self._unmet_demand.append(
                 (time.monotonic(), dict(resources), demand_key))
         n = ranked[0]
+        if input_objects:
+            # In the saturated fallback the lease QUEUES at the picked
+            # node anyway — queueing at the HOLDER is exactly what
+            # locality wants (the utilization spill-check is meaningless
+            # there: the view reads ~full everywhere; the lease queue
+            # timeout + exclude/retry is the spillback instead).
+            n = self._apply_locality(ranked, input_objects, resources,
+                                     exclude_set, relax_spill=saturated)
         return n.node_id, n.address, n.store_name
+
+    def _apply_locality(self, ranked: List[NodeInfo],
+                        input_objects: List[bytes],
+                        resources: Dict[str, float],
+                        exclude: Set[str],
+                        relax_spill: bool = False) -> NodeInfo:
+        """Re-rank candidate nodes by locally-resident input bytes; ties
+        (including the zero-bytes case) keep the hybrid ordering.
+
+        Candidates are ALL alive nodes whose TOTAL capacity fits the
+        demand, not just `ranked`: the pack branch ranks only
+        under-threshold nodes, and a holder that is momentarily FULL is
+        still the right pick — the lease request QUEUES there for
+        `scheduler_locality_wait_ms` and only then spills back (waiting
+        out one task beats migrating the input bytes)."""
+        candidates = list(ranked)
+        seen = {n.node_id for n in candidates}
+        with self._lock:
+            for n in self._nodes.values():
+                if (n.node_id not in seen and n.alive
+                        and n.node_id not in exclude
+                        and all(n.total.get(k, 0) >= v
+                                for k, v in resources.items() if v > 0)):
+                    candidates.append(n)
+        if len(candidates) < 2:
+            return ranked[0]
+        with self._lock:
+            local_bytes: Dict[str, int] = {}
+            for oid in input_objects:
+                holders = self._object_dir.get(oid)
+                if not holders:
+                    continue
+                size = self._object_sizes.get(oid, 1)
+                for nid in holders:
+                    local_bytes[nid] = local_bytes.get(nid, 0) + size
+        if not local_bytes:
+            return ranked[0]
+        order = {n.node_id: i for i, n in enumerate(candidates)}
+        best = max(candidates, key=lambda n: (local_bytes.get(n.node_id, 0),
+                                              -order[n.node_id]))
+        if local_bytes.get(best.node_id, 0) <= 0:
+            return ranked[0]
+        # Lazy: the feasibility probe is only needed for the spill check
+        # (most hinted picks return before here; the head is single-
+        # threaded for scheduling — don't scan nodes twice per pick).
+        if (best is not ranked[0] and not relax_spill
+                and any(n.node_id == best.node_id
+                        for n in self._feasible_nodes(resources, exclude))
+                and self._util(best)
+                >= cfg.scheduler_locality_spill_threshold):
+            # Spillback: the holder has capacity RIGHT NOW yet is loaded
+            # past the threshold; keep the hybrid choice. A view-full
+            # holder is NOT spilled here — its lease request queues
+            # briefly at the node and spills via decline+exclude instead.
+            with self._lock:
+                self._locality_misses += 1
+            return ranked[0]
+        with self._lock:
+            self._locality_hits += 1
+        return best
 
     # ------------------------------------------------------------- actors
 
@@ -726,9 +819,12 @@ class HeadServer:
 
     # ------------------------------------------------------------- objects
 
-    def rpc_object_added(self, conn, oid: bytes, node_id: str):
+    def rpc_object_added(self, conn, oid: bytes, node_id: str,
+                         size: Optional[int] = None):
         with self._lock:
             self._object_dir.setdefault(oid, set()).add(node_id)
+            if size:
+                self._object_sizes[oid] = int(size)
         return True
 
     def rpc_object_removed(self, conn, oid: bytes, node_id: str):
@@ -738,14 +834,41 @@ class HeadServer:
                 locs.discard(node_id)
                 if not locs:
                     del self._object_dir[oid]
+                    self._object_sizes.pop(oid, None)
         return True
 
-    def rpc_object_locations(self, conn, oid: bytes):
+    def rpc_object_locations(self, conn, oid: bytes,
+                             requester_node_id: Optional[str] = None):
+        """Holder list for an object, NEAREST-FIRST relative to the
+        requester: holders sharing the requester's "zone" label sort
+        ahead of cross-zone ones (the simulated-DCN distance signal), so
+        a puller's first fetch attempt goes to the cheapest copy."""
         with self._lock:
-            node_ids = list(self._object_dir.get(oid, ()))
-            return [(nid, self._nodes[nid].address)
-                    for nid in node_ids
-                    if nid in self._nodes and self._nodes[nid].alive]
+            # Filter BEFORE sorting: a drained/unknown node id lingering
+            # in the directory must not crash the lookup.
+            node_ids = [nid for nid in self._object_dir.get(oid, ())
+                        if nid in self._nodes and self._nodes[nid].alive]
+            req = self._nodes.get(requester_node_id) \
+                if requester_node_id else None
+            req_zone = req.labels.get("zone") if req is not None else None
+
+            def dist(nid: str) -> Tuple:
+                n = self._nodes[nid]
+                same_zone = (req_zone is not None
+                             and n.labels.get("zone") == req_zone)
+                return (0 if same_zone else 1, nid)
+
+            node_ids.sort(key=dist)
+            return [(nid, self._nodes[nid].address) for nid in node_ids]
+
+    def rpc_scheduler_stats(self, conn):
+        """Locality accounting for the head's pick decisions (the owner
+        dispatch keeps its own counters; this one covers spillbacks)."""
+        with self._lock:
+            return {"locality_hits": self._locality_hits,
+                    "locality_misses": self._locality_misses,
+                    "objects_tracked": len(self._object_dir),
+                    "object_bytes_tracked": sum(self._object_sizes.values())}
 
     # ------------------------------------------------------------- KV
 

@@ -36,8 +36,30 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.shm_store import ShmStore
 from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
+from ray_tpu.util import metrics as _metrics
 
 
+
+
+_PIDFD_OK: Optional[bool] = None
+
+
+def _pidfd_supported() -> bool:
+    """Zygote forks are tracked via pidfds (Linux 5.3+). On older
+    kernels pidfd_open returns ENOSYS, which _ForkedProc would read as
+    "already exited" — every fork instantly presumed dead while actually
+    alive: phantom death sweeps, rejected registrations, and leases
+    leaking their resources. Probe once; without pidfd the zygote path
+    is disabled and workers cold-spawn."""
+    global _PIDFD_OK
+    if _PIDFD_OK is None:
+        try:
+            fd = os.pidfd_open(os.getpid())
+            os.close(fd)
+            _PIDFD_OK = True
+        except (AttributeError, OSError):
+            _PIDFD_OK = False
+    return _PIDFD_OK
 
 
 class _ForkedProc:
@@ -168,6 +190,14 @@ class NodeManager:
         self._tpu_waiters = collections.deque()
         self._tpu_spawning = 0
         self._lease_grant_order = collections.deque()
+        # Pull manager (reference: object_manager/pull_manager.h): dedups
+        # concurrent pulls of one object onto a single in-flight transfer
+        # and fans chunked pulls of large objects out across holders.
+        self._pulls: Dict[bytes, threading.Event] = {}
+        self._pull_lock = threading.Lock()
+        self.pull_stats: Dict[str, int] = {
+            "bytes_pulled": 0, "pulls_started": 0, "pulls_completed": 0,
+            "pulls_coalesced": 0, "multi_source_pulls": 0}
         self._workers: Dict[str, WorkerProc] = {}
         # Idle pools keyed by runtime-env fingerprint ('' = default env):
         # two runtime envs must never share a worker process (reference:
@@ -184,6 +214,10 @@ class NodeManager:
         self._server = RpcServer(self, host).start()
         self.address = self._server.address
         self._stop = threading.Event()
+        # Wakes the heartbeat loop the moment availability changes so the
+        # head's resource view (and its locality/pack decisions) tracks
+        # reality at RPC latency, not heartbeat-period latency.
+        self._hb_wake = threading.Event()
         # Per-node Prometheus endpoint (reference: the per-node metrics
         # agent exporting core metrics): GET /metrics on this port serves
         # the process registry + live node gauges; the port is advertised
@@ -215,7 +249,13 @@ class NodeManager:
         # template; ~0.4 s interpreter+import CPU -> ~10 ms per worker).
         self._zygote: Optional[subprocess.Popen] = None
         self._zygote_log = None  # the zygote's stderr log handle
+        # Lock split: _zygote_lock guards HANDLE lifecycle only (start /
+        # discard / close — held for microseconds); _zygote_io_lock
+        # serializes the fork round-trip's pipe I/O. stop() and concurrent
+        # spawns need only the former, so a zygote stuck mid-fork (up to
+        # zygote_spawn_timeout_s) cannot wedge them.
         self._zygote_lock = threading.Lock()
+        self._zygote_io_lock = threading.Lock()
         threading.Thread(target=self._spawner_loop, daemon=True,
                          name=f"node-spawner-{node_id[:8]}").start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
@@ -237,6 +277,7 @@ class NodeManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._hb_wake.set()  # release a heartbeat loop parked in wait()
         if self._metrics_exporter is not None:
             self._metrics_exporter.stop()
             self._metrics_exporter = None
@@ -270,9 +311,27 @@ class NodeManager:
 
     def _heartbeat_loop(self) -> None:
         period = cfg.health_check_period_ms / 1000.0
+        # Event-driven resource sync: availability CHANGES (lease grant/
+        # return, bundle reserve/release, blocked workers) wake this loop
+        # immediately instead of waiting out the period, so the head's
+        # scheduling view is ~RPC-latency stale rather than up to a full
+        # beat — a stale-full view sent locality picks to the wrong node
+        # for a second after every burst. Rate-limited to period/10.
+        min_gap = period / 10.0
+        last_beat = 0.0
         last_sent: Dict[str, float] = {}
         version = 0
-        while not self._stop.wait(period):
+        while True:
+            self._hb_wake.wait(period)
+            self._hb_wake.clear()
+            if self._stop.is_set():
+                return
+            gap = time.monotonic() - last_beat
+            if gap < min_gap:
+                time.sleep(min_gap - gap)
+            if self._stop.is_set():
+                return
+            last_beat = time.monotonic()
             try:
                 with self._lock:
                     avail = dict(self.available)
@@ -467,6 +526,11 @@ class NodeManager:
              for k, v in total.items()]
             + [({**nid, "resource": k, "kind": "available"}, v)
                for k, v in avail.items()])
+        with self._pull_lock:
+            pulls = dict(self.pull_stats)
+        lines += gauge_lines(
+            "rtpu_node_pull", "pull-manager counters",
+            [({**nid, "kind": k}, v) for k, v in pulls.items()])
         return lines
 
     def _spawn_worker(self, tpu: bool = False, runtime_env=None) -> None:
@@ -550,6 +614,7 @@ class NodeManager:
         # (interpreter+imports paid once per host, not per worker).
         if (not tpu and not runtime_env and cfg.worker_zygote_enabled
                 and sys.platform.startswith("linux")
+                and _pidfd_supported()
                 and py == sys.executable):
             forked = self._zygote_spawn(worker_id, env)
             if forked is not None:
@@ -603,64 +668,90 @@ class NodeManager:
 
     def _zygote_spawn(self, worker_id: str, env: dict):
         """Fork one worker off the zygote; returns a _ForkedProc, or None
-        to fall back to a cold Popen (zygote dead/unresponsive)."""
+        to fall back to a cold Popen (zygote dead/unresponsive).
+
+        The blocking fork round-trip (a pipe read of up to
+        `zygote_spawn_timeout_s`) runs under ``_zygote_io_lock`` only;
+        ``_zygote_lock`` is held just for handle start/write/discard.
+        ``stop()`` can therefore always take ``_zygote_lock`` and kill a
+        stuck zygote immediately — the pending read wakes on EOF — where
+        it previously wedged up to 60s behind one unresponsive fork."""
         import json as _json
         import selectors as _selectors
 
-        with self._zygote_lock:
+        with self._zygote_io_lock:
+            with self._zygote_lock:
+                if self._stop.is_set():
+                    return None
+                try:
+                    if (self._zygote is None
+                            or self._zygote.poll() is not None):
+                        if self._zygote_log is not None:
+                            try:
+                                self._zygote_log.close()
+                            except Exception:
+                                pass
+                        zlog = self._zygote_log = open(os.path.join(
+                            cfg.log_dir, f"zygote-{self.node_id[:8]}.log"),
+                            "ab", buffering=0)
+                        self._zygote = subprocess.Popen(
+                            [sys.executable, "-m",
+                             "ray_tpu.cluster.worker_main", "--zygote",
+                             "--node-addr", self.address,
+                             "--head-addr", self.head_addr,
+                             "--node-id", self.node_id,
+                             "--store-name", self.store_name],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            stderr=zlog, env=env)
+                    z = self._zygote
+                    z.stdin.write(
+                        (_json.dumps({"worker_id": worker_id}) + "\n")
+                        .encode())
+                    z.stdin.flush()
+                except Exception:
+                    self._discard_zygote_locked()
+                    return None
+            # Blocking read OUTSIDE _zygote_lock: a concurrent stop() may
+            # close/kill the zygote under us — the select/read then fails
+            # fast and lands in the except below.
             try:
-                if self._zygote is None or self._zygote.poll() is not None:
-                    if self._zygote_log is not None:
-                        try:
-                            self._zygote_log.close()
-                        except Exception:
-                            pass
-                    zlog = self._zygote_log = open(os.path.join(
-                        cfg.log_dir, f"zygote-{self.node_id[:8]}.log"),
-                        "ab", buffering=0)
-                    self._zygote = subprocess.Popen(
-                        [sys.executable, "-m",
-                         "ray_tpu.cluster.worker_main", "--zygote",
-                         "--node-addr", self.address,
-                         "--head-addr", self.head_addr,
-                         "--node-id", self.node_id,
-                         "--store-name", self.store_name],
-                        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                        stderr=zlog, env=env)
-                z = self._zygote
-                z.stdin.write(
-                    (_json.dumps({"worker_id": worker_id}) + "\n").encode())
-                z.stdin.flush()
                 sel = _selectors.DefaultSelector()
                 sel.register(z.stdout, _selectors.EVENT_READ)
-                # First fork waits out the zygote's own import warmup.
-                if not sel.select(timeout=cfg.zygote_spawn_timeout_s):
-                    raise TimeoutError("zygote unresponsive")
+                try:
+                    # First fork waits out the zygote's own import warmup.
+                    if not sel.select(timeout=cfg.zygote_spawn_timeout_s):
+                        raise TimeoutError("zygote unresponsive")
+                finally:
+                    sel.close()
                 line = z.stdout.readline()
-                sel.close()
                 if not line:
                     raise RuntimeError("zygote EOF")
                 resp = _json.loads(line)
                 return _ForkedProc(int(resp["pid"]))
             except Exception:
-                # Only a DEAD zygote is discarded with a kill. A live one
-                # that merely missed the deadline (CPU-starved host) is
-                # ABANDONED instead: its forked workers hold PDEATHSIG
-                # against it, so killing it would take down every healthy
-                # worker on the node; orphaned it keeps its children alive
-                # and dies with the node manager. Either way this side's
-                # pipe fds and the zlog handle are closed — the zygote
-                # lingers on stdin EOF (zygote_main) precisely so the
-                # close cannot cascade into its children.
-                z = self._zygote
-                self._zygote = None
-                if z is not None and z.poll() is not None:
-                    try:
-                        z.kill()  # reap the corpse's pipes
-                    except Exception:
-                        pass
-                self._close_zygote_handles(z)
+                with self._zygote_lock:
+                    if self._zygote is z:
+                        self._discard_zygote_locked()
                 return None
+
+    def _discard_zygote_locked(self) -> None:
+        """Drop the current zygote handle (caller holds ``_zygote_lock``).
+        Only a DEAD zygote is discarded with a kill. A live one that
+        merely missed the deadline (CPU-starved host) is ABANDONED
+        instead: its forked workers hold PDEATHSIG against it, so killing
+        it would take down every healthy worker on the node; orphaned it
+        keeps its children alive and dies with the node manager. Either
+        way this side's pipe fds and the zlog handle are closed — the
+        zygote lingers on stdin EOF (zygote_main) precisely so the close
+        cannot cascade into its children."""
+        z = self._zygote
+        self._zygote = None
+        if z is not None and z.poll() is not None:
+            try:
+                z.kill()  # reap the corpse's pipes
+            except Exception:
+                pass
+        self._close_zygote_handles(z)
 
     def rpc_register_worker(self, conn, worker_id: str, address: str):
         """A freshly-spawned worker joins the idle pool (leases claim workers
@@ -792,6 +883,7 @@ class NodeManager:
                    for k, v in resources.items() if v > 0):
                 for k, v in resources.items():
                     pool[k] = pool.get(k, 0) - v
+                self._hb_wake.set()  # push the new view to the head now
                 return key
         return None
 
@@ -805,6 +897,7 @@ class NodeManager:
         for k, v in lease.resources.items():
             pool[k] = pool.get(k, 0) + v
         self._avail_cond.notify_all()
+        self._hb_wake.set()  # push the new view to the head now
 
     @blocking_rpc
     def rpc_request_lease(self, conn, resources: Dict[str, float],
@@ -812,11 +905,15 @@ class NodeManager:
                           pg: Optional[Tuple[bytes, int]] = None,
                           req_id: Optional[str] = None,
                           lessee: Optional[str] = None,
-                          runtime_env: Optional[Dict[str, Any]] = None):
+                          runtime_env: Optional[Dict[str, Any]] = None,
+                          queue_block_ms: Optional[int] = None):
         """Returns (worker_addr, lease_id) or None if infeasible (spillback).
         `req_id` makes retries idempotent: the memo is CLAIMED before the
         (slow) worker pop, so a retry arriving mid-flight waits for the
-        original outcome instead of double-acquiring resources."""
+        original outcome instead of double-acquiring resources.
+        `queue_block_ms` overrides how long the request queues for
+        resources before declining (locality-hinted requests wait a
+        shorter, configured window at a full holder)."""
         entry = None
         am_owner = True
         if req_id is not None:
@@ -838,7 +935,7 @@ class NodeManager:
         grant = None
         try:
             grant = self._do_request_lease(resources, pg, lessee,
-                                           runtime_env)
+                                           runtime_env, queue_block_ms)
             if grant is not None and conn.peer_info.get("gone"):
                 # Requester died while queued: reclaim immediately.
                 self.rpc_return_lease(conn, grant[1])
@@ -852,8 +949,11 @@ class NodeManager:
     def _do_request_lease(self, resources: Dict[str, float],
                           pg: Optional[Tuple[bytes, int]],
                           lessee: Optional[str] = None,
-                          runtime_env: Optional[Dict[str, Any]] = None):
-        deadline = time.monotonic() + cfg.lease_queue_block_ms / 1000.0
+                          runtime_env: Optional[Dict[str, Any]] = None,
+                          queue_block_ms: Optional[int] = None):
+        block_ms = (queue_block_ms if queue_block_ms is not None
+                    else cfg.lease_queue_block_ms)
+        deadline = time.monotonic() + block_ms / 1000.0
         with self._lock:
             while True:
                 resolved = self._try_acquire(resources, pg)
@@ -1002,6 +1102,7 @@ class NodeManager:
             self._bundles[(pg_id, idx)] = dict(bundle)
             self._bundle_avail[(pg_id, idx)] = dict(bundle)
             self._avail_cond.notify_all()
+            self._hb_wake.set()
         return True
 
     def rpc_release_bundle(self, conn, pg_id: bytes, idx: int):
@@ -1012,6 +1113,7 @@ class NodeManager:
                 for k, v in bundle.items():
                     self.available[k] = self.available.get(k, 0) + v
                 self._avail_cond.notify_all()
+                self._hb_wake.set()
         return True
 
     # ------------------------------------------------------------ objects
@@ -1034,43 +1136,94 @@ class NodeManager:
 
     @blocking_rpc
     def rpc_pull_object(self, conn, oid_bytes: bytes, timeout_ms: int):
-        """Pull an object from whichever node has it into the local store.
-        Returns True when the object is locally available."""
+        """Pull an object into the local store via the pull manager
+        (reference: object_manager/pull_manager.h). Concurrent pulls of
+        one object COALESCE onto a single in-flight transfer (followers
+        wait on the leader's completion event instead of opening their
+        own streams); the transfer fetches from the nearest holder and
+        fans chunks of large objects out across several holders in
+        parallel. Returns True when the object is locally available."""
         from ray_tpu.core.ids import ObjectID
 
         oid = ObjectID(oid_bytes)
-        if self.store.contains(oid):
-            return True
         deadline = time.monotonic() + timeout_ms / 1000.0
-        while time.monotonic() < deadline:
-            try:
-                locs = self._head.call("object_locations", oid_bytes,
-                                   timeout=cfg.rpc_control_timeout_s)
-            except Exception:
-                locs = []
-            for node_id, addr in locs:
-                if node_id == self.node_id:
-                    continue
-                if self._pull_from(oid, addr, deadline):
-                    return True
+        # Stats count once per LOGICAL pull, not per 50ms retry lap.
+        counted_coalesce = False
+        counted_started = False
+        while True:
             if self.store.contains(oid):
                 return True
+            with self._pull_lock:
+                ev = self._pulls.get(oid_bytes)
+                leader = ev is None
+                if leader:
+                    ev = self._pulls[oid_bytes] = threading.Event()
+                    if not counted_started:
+                        counted_started = True
+                        self.pull_stats["pulls_started"] += 1
+                elif not counted_coalesce:
+                    counted_coalesce = True
+                    self.pull_stats["pulls_coalesced"] += 1
+                    _metrics.PULLS_COALESCED.inc()
+            if leader:
+                ok = False
+                try:
+                    ok = self._pull_once(oid, deadline)
+                finally:
+                    with self._pull_lock:
+                        self._pulls.pop(oid_bytes, None)
+                        if ok:
+                            self.pull_stats["pulls_completed"] += 1
+                    ev.set()
+                if ok or self.store.contains(oid):
+                    return True
+            else:
+                ev.wait(max(0.0, deadline - time.monotonic()))
+                if self.store.contains(oid):
+                    return True
+            # Transfer round failed (no holder yet / holder died): retry
+            # until the caller's deadline; a follower may take over as
+            # leader on its next lap.
+            if time.monotonic() >= deadline:
+                return self.store.contains(oid)
             time.sleep(cfg.spill_restore_poll_s)
-        return self.store.contains(oid)
 
-    def _pull_from(self, oid, addr: str, deadline: float) -> bool:
+    def _pull_once(self, oid, deadline: float) -> bool:
+        """One directory lookup + transfer attempt. The head orders the
+        holder list nearest-first for this node (same-zone label ahead of
+        cross-zone), so the primary stream dials the cheapest copy."""
+        try:
+            locs = self._head.call("object_locations", oid.binary(),
+                                   self.node_id,
+                                   timeout=cfg.rpc_control_timeout_s)
+        except Exception:
+            locs = []
+        addrs = [addr for node_id, addr in locs if node_id != self.node_id]
+        if not addrs:
+            return False
+        return self._pull_from_holders(oid, addrs, deadline)
+
+    def _pull_from_holders(self, oid, addrs: List[str],
+                           deadline: float) -> bool:
         from ray_tpu.core.shm_store import ShmObjectExistsError
 
         chunk = cfg.object_transfer_chunk_bytes
-        try:
-            # Inside the try: connecting to a DEAD holder (post node death,
-            # pre directory cleanup) must read as "pull failed", not crash
-            # the pull RPC.
-            client = self._pool.get(addr)
-            first = client.call("fetch_object", oid.binary(), 0, chunk, 0,
-                                timeout=max(1.0, deadline - time.monotonic()))
-        except Exception:
-            return False
+        first = None
+        src = None
+        # Inside the try: connecting to a DEAD holder (post node death,
+        # pre directory cleanup) must read as "pull failed", not crash
+        # the pull RPC — fall through to the next-nearest holder.
+        for addr in addrs:
+            try:
+                client = self._pool.get(addr)
+                first = client.call(
+                    "fetch_object", oid.binary(), 0, chunk, 0,
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except Exception:
+                continue
+            if first is not None:
+                src = client
+                break
         if first is None:
             return False
         total, data = first
@@ -1078,26 +1231,117 @@ class NodeManager:
             mv = self.store.create_buffer(oid, total)
         except ShmObjectExistsError:
             return True
+        multi_source = False
         try:
             mv[:len(data)] = data
-            off = len(data)
-            while off < total:
-                nxt = client.call("fetch_object", oid.binary(), off, chunk, 0,
-                                  timeout=max(1.0, deadline - time.monotonic()))
-                if nxt is None:
-                    raise IOError("object vanished mid-pull")
-                _, data = nxt
-                mv[off:off + len(data)] = data
-                off += len(data)
+            offsets = list(range(len(data), total, chunk))
+            multi_source = (len(addrs) > 1 and len(offsets) > 1
+                            and total >= cfg.pull_fanout_min_bytes)
+            if multi_source:
+                if not self._fanout_fetch(oid, mv, offsets, chunk, addrs,
+                                          deadline):
+                    raise IOError("multi-source pull failed")
+            else:
+                for off in offsets:
+                    nxt = src.call(
+                        "fetch_object", oid.binary(), off, chunk, 0,
+                        timeout=max(1.0, deadline - time.monotonic()))
+                    if nxt is None:
+                        raise IOError("object vanished mid-pull")
+                    _, data = nxt
+                    mv[off:off + len(data)] = data
         except BaseException:
             self.store.abort(oid)
             return False
         self.store.seal(oid)
+        with self._pull_lock:
+            self.pull_stats["bytes_pulled"] += total
+            if multi_source:
+                self.pull_stats["multi_source_pulls"] += 1
+        _metrics.OBJECT_BYTES_PULLED.inc(total)
+        if multi_source:
+            _metrics.PULLS_MULTI_SOURCE.inc()
         try:
-            self._head.notify("object_added", oid.binary(), self.node_id)
+            self._head.notify("object_added", oid.binary(), self.node_id,
+                              total)
         except Exception:
             pass
         return True
+
+    def _fanout_fetch(self, oid, mv, offsets: List[int], chunk: int,
+                      addrs: List[str], deadline: float) -> bool:
+        """Parallel range fetch: stripe the remaining chunks across up to
+        `pull_fanout_max_holders` holders, one fetch thread per holder
+        (reference: the object manager requests chunks from multiple
+        copies concurrently). Chunks a failed holder owned are retried
+        sequentially from any surviving holder; only an offset no holder
+        can serve fails the pull."""
+        n = min(len(addrs), max(1, cfg.pull_fanout_max_holders))
+        failed: List[int] = []
+        failed_lock = threading.Lock()
+
+        def fetch_stripe(k: int) -> None:
+            stripe = offsets[k::n]
+            try:
+                client = self._pool.get(addrs[k])
+            except Exception:
+                with failed_lock:
+                    failed.extend(stripe)
+                return
+            for j, off in enumerate(stripe):
+                if time.monotonic() >= deadline:
+                    with failed_lock:
+                        failed.extend(stripe[j:])
+                    return
+                try:
+                    nxt = client.call(
+                        "fetch_object", oid.binary(), off, chunk, 0,
+                        timeout=max(1.0, deadline - time.monotonic()))
+                except Exception:
+                    nxt = None
+                if nxt is None:
+                    with failed_lock:
+                        failed.append(off)
+                    continue
+                _, data = nxt
+                mv[off:off + len(data)] = data
+
+        threads = [threading.Thread(target=fetch_stripe, args=(k,),
+                                    daemon=True,
+                                    name=f"pull-fanout-{k}")
+                   for k in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for off in failed:
+            got = False
+            for addr in addrs:
+                if time.monotonic() >= deadline:
+                    return False  # honor the caller's pull timeout
+                try:
+                    nxt = self._pool.get(addr).call(
+                        "fetch_object", oid.binary(), off, chunk, 0,
+                        timeout=max(1.0, deadline - time.monotonic()))
+                except Exception:
+                    nxt = None
+                if nxt is not None:
+                    _, data = nxt
+                    mv[off:off + len(data)] = data
+                    got = True
+                    break
+            if not got:
+                return False
+        return True
+
+    def _pull_from(self, oid, addr: str, deadline: float) -> bool:
+        """Single-holder pull (the push-transfer receive half)."""
+        return self._pull_from_holders(oid, [addr], deadline)
+
+    def rpc_pull_stats(self, conn):
+        """Pull-manager counters (bench/observability surface)."""
+        with self._pull_lock:
+            return dict(self.pull_stats)
 
     @blocking_rpc
     def rpc_pull_direct(self, conn, oid_bytes: bytes, source_addr: str,

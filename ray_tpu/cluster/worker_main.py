@@ -349,7 +349,9 @@ class WorkerRuntime(ClusterCore):
                     rec = (oid.binary(), "value", bytes(flat))
                 else:
                     self._put_plasma(oid, header, buffers)
-                    rec = (oid.binary(), "in_store", None)
+                    # (node_id, size): the owner's locality cache feeds on
+                    # where each sealed result lives.
+                    rec = (oid.binary(), "in_store", (self.node_id, total))
                 self._enqueue_done(owner, ("stream",
                                            (task_id_bytes, index, rec)))
                 index += 1
@@ -424,7 +426,9 @@ class WorkerRuntime(ClusterCore):
                     results.append((oid.binary(), "value", bytes(flat)))
                 else:
                     self._put_plasma(oid, header, buffers)
-                    results.append((oid.binary(), "in_store", None))
+                    # Locality breadcrumb for the owner's dispatch.
+                    results.append((oid.binary(), "in_store",
+                                    (self.node_id, total)))
         # Batched + acked + retried via the flusher: a chaos-dropped
         # completion must not leave the owner waiting forever, and one
         # frame per completion was a single-core throughput ceiling.

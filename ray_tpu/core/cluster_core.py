@@ -73,13 +73,17 @@ class _SubmitTemplate:
 
 
 class _Lease:
-    __slots__ = ("worker_addr", "lease_id", "node_addr", "inflight",
-                 "release_at", "broken")
+    __slots__ = ("worker_addr", "lease_id", "node_addr", "node_id",
+                 "inflight", "release_at", "broken")
 
-    def __init__(self, worker_addr: str, lease_id: str, node_addr: str):
+    def __init__(self, worker_addr: str, lease_id: str, node_addr: str,
+                 node_id: Optional[str] = None):
         self.worker_addr = worker_addr
         self.lease_id = lease_id
         self.node_addr = node_addr
+        # Which node granted this lease: the dispatch-side locality match
+        # pairs queued tasks with leases on their inputs' holder node.
+        self.node_id = node_id
         self.inflight = 0
         # A lease is born with a linger deadline: a grant that lands AFTER
         # the queue drained (slow worker spawn raced the burst) must still be
@@ -92,7 +96,8 @@ class _Lease:
 class _InflightTask:
     __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
                  "sched_key", "resources", "strategy", "name", "sys_retries",
-                 "runtime_env", "streaming")
+                 "runtime_env", "streaming", "arg_ids", "enqueued_at",
+                 "pref_node")
 
     def __init__(self, spec_blob, return_ids, worker_addr, retries_left,
                  sched_key, resources, strategy, name, runtime_env=None,
@@ -108,6 +113,16 @@ class _InflightTask:
         self.sys_retries = None  # lazily set from config on first failure
         self.runtime_env = runtime_env  # validated dict or None
         self.streaming = streaming
+        # ObjectIDs passed as args: the locality signal — lease requests
+        # ship them as the pick_node hint, and dispatch pairs the task
+        # with a lease on the node holding most of their bytes.
+        self.arg_ids: List[ObjectID] = []
+        self.enqueued_at = 0.0  # stamped by _enqueue_task (defer aging)
+        # Memoized _preferred_node result (False = not yet resolved):
+        # the dispatch match consults it per lease per round, and the
+        # answer only depends on arg_ids + the slow-changing locality
+        # cache. Re-resolved while unknown (locations may arrive late).
+        self.pref_node: Any = False
 
 
 class _StreamState:
@@ -244,7 +259,9 @@ class ClusterCore:
         self.node_addr = node_addr
 
         self.memory_store = MemoryStore()
-        self.refcount = ReferenceCounter(on_release=self._release_object)
+        self.refcount = ReferenceCounter(
+            on_release=self._release_object,
+            on_borrow_release=self._release_borrow)
         self.store = ShmStore.open(store_name)
         self._driver_task_id = TaskID.for_driver(job_id)
         self._nil_actor = ActorID.nil_for_job(job_id)
@@ -258,6 +275,15 @@ class ClusterCore:
 
         self._key_queues: Dict[tuple, _KeyQueue] = {}
         self._lease_lock = threading.Lock()
+        # Owner-side object locality cache: oid bytes -> (node_id, size).
+        # Populated for free from task completions ("in_store" results
+        # carry the sealing node) and local plasma puts; consulted by the
+        # dispatch-side locality match and shipped as pick_node hints
+        # (reference: the owner's LocalityData feeding the lease policy).
+        import collections as _coll
+
+        self._obj_locality: "_coll.OrderedDict" = _coll.OrderedDict()
+        self._obj_loc_lock = threading.Lock()
         self._inflight: Dict[bytes, _InflightTask] = {}  # task_id -> info
         self._inflight_lock = threading.Lock()
         # task_id -> ObjectIDs passed as args: each holds a submitted-task
@@ -308,12 +334,22 @@ class ClusterCore:
         self._push_ack_event = threading.Event()
         self._borrow_buf: Dict[str, list] = {}
         self._borrow_buf_lock = threading.Lock()
+        #: oid bytes -> owner addr for refs this process BORROWS; consulted
+        #: when the borrowed ref goes out of scope so the owner can be
+        #: told to drop us from its borrower set (the release half of the
+        #: borrow protocol).
+        self._borrowed_owners: Dict[bytes, str] = {}
         #: owner_addr -> (retry-not-before deadline, consecutive failures);
         #: keeps a dead owner from being retried inline on every ref
         #: deserialization (flushes go through the periodic sweep instead).
         self._borrow_flush_backoff: Dict[str, tuple] = {}
-        self._borrows_sent: set = set()
-        self._borrows_sent_order = _collections.deque()
+        # key -> generation: a re-borrow after release bumps the gen, so
+        # the FIFO trim below only forgets an entry if it is still the
+        # CURRENT one (a stale trim must not delete a live re-borrow's
+        # tracking and silently skip its owner-side release).
+        self._borrows_sent: Dict[bytes, int] = {}
+        self._borrows_sent_order = _collections.deque()  # (key, gen)
+        self._borrow_gen = itertools.count(1)
         # Function table (reference: _private/function_manager.py exports a
         # function ONCE to the GCS function table; tasks carry only its
         # digest). Pickling the function per submit was the tasks_async
@@ -398,11 +434,15 @@ class ClusterCore:
                 if key in self._borrows_sent:
                     return  # owner already knows; re-gets of the same
                             # ref-bearing object must not re-notify
-                self._borrows_sent.add(key)
-                self._borrows_sent_order.append(key)
+                gen = next(self._borrow_gen)
+                self._borrows_sent[key] = gen
+                self._borrows_sent_order.append((key, gen))
+                self._borrowed_owners[key] = owner_addr
                 while len(self._borrows_sent_order) > 200_000:
-                    self._borrows_sent.discard(
-                        self._borrows_sent_order.popleft())
+                    old, old_gen = self._borrows_sent_order.popleft()
+                    if self._borrows_sent.get(old) == old_gen:
+                        self._borrows_sent.pop(old, None)
+                        self._borrowed_owners.pop(old, None)
                 self._borrow_buf.setdefault(owner_addr, []).append(key)
                 if (len(self._borrow_buf[owner_addr])
                         >= cfg.borrow_flush_batch_size
@@ -410,6 +450,50 @@ class ClusterCore:
                     flush = self._borrow_buf.pop(owner_addr)
             if flush is not None:
                 self._flush_borrows(owner_addr, flush)
+
+    def _release_borrow(self, oid: ObjectID) -> None:
+        """A borrowed ref went out of scope locally: tell the owner to
+        drop this process from the object's borrower set (the release
+        half of the borrow protocol — without it the owner pins every
+        borrowed object until this process exits). Best-effort: a lost
+        removal pins until then, never frees early."""
+        key = oid.binary()
+        with self._borrow_buf_lock:
+            # Re-borrow race: a concurrent deserialization may have
+            # re-acquired this oid AND dedup-skipped re-registration
+            # (our maps were still populated). In that case the existing
+            # registration is exactly right — keep it and send nothing,
+            # or the owner would drop us while a live ref exists here.
+            if self.refcount.is_in_scope(oid):
+                return
+            owner = self._borrowed_owners.pop(key, None)
+            # Forget the dedup entry: a future re-borrow of the same
+            # object must RE-register (the owner just dropped us).
+            self._borrows_sent.pop(key, None)
+            if owner is not None:
+                buf = self._borrow_buf.get(owner)
+                if buf is not None and key in buf:
+                    # The registration never left this process: cancel it
+                    # locally; the owner was never told.
+                    buf.remove(key)
+                    return
+        if owner is None or self._shutdown_flag:
+            return
+        # Respect the per-owner backoff the registration path maintains:
+        # releases to a DEAD owner must not pay an inline TCP connect
+        # attempt per ref from refcount hot paths. While backed off the
+        # removal is skipped (same best-effort contract: pins until this
+        # process exits, never frees early).
+        if self._in_borrow_backoff(owner):
+            return
+        try:
+            self._pool.get(owner).notify("remove_borrower", key,
+                                         self.owner_addr)
+        except Exception:
+            _prev, fails = self._borrow_flush_backoff.get(owner, (0, 0))
+            fails = min(fails + 1, 10)
+            self._borrow_flush_backoff[owner] = (
+                time.monotonic() + min(60.0, 2.0 ** fails), fails)
 
     def _in_borrow_backoff(self, owner_addr: str) -> bool:
         ent = self._borrow_flush_backoff.get(owner_addr)
@@ -438,9 +522,13 @@ class ClusterCore:
                 if len(buf) > cap:
                     # Dropped keys must leave _borrows_sent too, else a
                     # later deserialization of the same ref would be
-                    # dedup-skipped and the borrow never registered.
+                    # dedup-skipped and the borrow never registered —
+                    # and _borrowed_owners, else the dropped (never
+                    # delivered) registration leaks its owner mapping
+                    # and later sends a spurious removal.
                     for k in buf[:-cap]:
-                        self._borrows_sent.discard(k)
+                        self._borrows_sent.pop(k, None)
+                        self._borrowed_owners.pop(k, None)
                     del buf[:-cap]
 
     def _flush_all_borrows(self) -> None:
@@ -476,6 +564,45 @@ class ClusterCore:
         # otherwise idle (ObjectRef.__del__ can only enqueue).
         self.refcount.flush_deferred()
 
+    # ------------------------------------------------------ object locality
+
+    def _note_object_location(self, oid_bytes: bytes, node_id: Optional[str],
+                              size) -> None:
+        if not node_id:
+            return
+        with self._obj_loc_lock:
+            self._obj_locality[oid_bytes] = (node_id, int(size or 0))
+            self._obj_locality.move_to_end(oid_bytes)
+            while len(self._obj_locality) > cfg.object_locality_cache_max:
+                self._obj_locality.popitem(last=False)
+
+    def _preferred_node(self, info: "_InflightTask") -> Optional[str]:
+        """The node holding the plurality of this task's input bytes per
+        the local locality cache; None when no input location is known.
+        Memoized on the task once resolved (a None answer is retried —
+        completions may land locations after the first dispatch look)."""
+        arg_ids = info.arg_ids
+        if not arg_ids:
+            return None
+        if info.pref_node is not False:
+            return info.pref_node
+        best_node = None
+        best_bytes = 0
+        per_node: Dict[str, int] = {}
+        with self._obj_loc_lock:
+            for oid in arg_ids:
+                ent = self._obj_locality.get(oid.binary())
+                if ent is None:
+                    continue
+                node_id, size = ent
+                b = per_node.get(node_id, 0) + (size or 1)
+                per_node[node_id] = b
+                if b > best_bytes:
+                    best_node, best_bytes = node_id, b
+        if best_node is not None:
+            info.pref_node = best_node
+        return best_node
+
     def _release_object(self, oid: ObjectID) -> None:
         memory_only = self.memory_store.delete([oid])
         if memory_only:
@@ -483,6 +610,8 @@ class ClusterCore:
             # the C delete + spill-unlink syscalls (per-task-return hot
             # path; the shm attempt was ~1/4 of release cost).
             return
+        with self._obj_loc_lock:
+            self._obj_locality.pop(oid.binary(), None)
         if self.store.delete(oid):
             try:
                 self.head.notify("object_removed", oid.binary(), self.node_id)
@@ -504,6 +633,7 @@ class ClusterCore:
         else:
             self._put_plasma(oid, header, buffers)
             self.memory_store.put(oid, PlasmaStub(oid))
+            self._note_object_location(oid.binary(), self.node_id, total)
         from ray_tpu.util import metrics
 
         metrics.OBJECTS_PUT.inc()
@@ -540,7 +670,8 @@ class ClusterCore:
             raise
         self.store.seal(oid)
         try:
-            self.head.notify("object_added", oid.binary(), self.node_id)
+            self.head.notify("object_added", oid.binary(), self.node_id,
+                             total)
         except Exception:
             pass
 
@@ -599,7 +730,17 @@ class ClusterCore:
         # array transitively keeps the pin alive, so LRU eviction can never
         # reuse the arena block under live user data. The pin drops when the
         # last view is garbage-collected (PinnedBuffer.__buffer__).
-        return SERIALIZER.decode(memoryview(buf))
+        try:
+            view = memoryview(buf)
+        except TypeError:
+            # Python < 3.12 has no PEP 688 __buffer__ hook, so PinnedBuffer
+            # cannot export: decode from a COPY and release the pin now.
+            # Correctness over zero-copy — without an exporter tie, LRU
+            # eviction could reuse the arena under live views.
+            data = bytes(buf.buffer)
+            buf.release()
+            return SERIALIZER.decode(memoryview(data))
+        return SERIALIZER.decode(view)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -864,6 +1005,7 @@ class ClusterCore:
                              rec.sched_key, rec.resources, rec.strategy,
                              rec.name + "[recovery]",
                              getattr(rec, "runtime_env", None))
+        info.arg_ids = list(rec.arg_ids)
         # Re-point the lineage mapping at the new spec so a SECOND loss
         # recovers from the resubmitted task, and re-protect the args.
         from ray_tpu.core.lineage import LineageRecord
@@ -997,6 +1139,11 @@ class ClusterCore:
             elif kind == "error":
                 puts.append((oid, payload, True))
             else:
+                # "in_store" payloads carry (node_id, size) of the sealed
+                # copy: free locality data for downstream scheduling.
+                if isinstance(payload, (tuple, list)) and len(payload) == 2:
+                    self._note_object_location(oid_bytes, payload[0],
+                                               payload[1])
                 puts.append((oid, PlasmaStub(oid), False))
         if info is not None:
             self._lease_task_finished(
@@ -1238,6 +1385,7 @@ class ClusterCore:
                              tmpl.runtime_env, streaming=tmpl.streaming)
         _metrics.TASKS_SUBMITTED.inc()
         arg_ids = self._register_submitted_args(task_id_bytes, args, kwargs)
+        info.arg_ids = arg_ids
         if tmpl.streaming:
             # No lineage for streams (v1): partial replay would duplicate
             # already-consumed items; a lost stream fails instead.
@@ -1357,6 +1505,8 @@ class ClusterCore:
         elif kind == "error":
             puts.append((oid, payload, True))
         else:
+            if isinstance(payload, (tuple, list)) and len(payload) == 2:
+                self._note_object_location(oid_bytes, payload[0], payload[1])
             puts.append((oid, PlasmaStub(oid), False))
         # The consumer wakes only AFTER put_batch lands (the ref must be
         # gettable the moment __next__ returns): defer via `notifies`.
@@ -1404,6 +1554,7 @@ class ClusterCore:
 
     def _enqueue_task(self, task_id_bytes: bytes, info: _InflightTask) -> None:
         key = info.sched_key
+        info.enqueued_at = time.monotonic()
         with self._lease_lock:
             kq = self._key_queues.get(key)
             if kq is None:
@@ -1447,16 +1598,44 @@ class ClusterCore:
                 short = (kq.avg_task_s is not None
                          and kq.avg_task_s < cfg.pipeline_short_task_s)
                 cap = depth if short else 1
-                while kq.queue:
-                    best = None
+                locality_on = cfg.scheduler_locality_enabled
+                # Live-lease census per node: the locality match defers a
+                # task whose home node has a live lease here (bounded —
+                # see _match_queued_task) instead of migrating its input.
+                live_count: Dict[str, int] = {}
+                if locality_on:
                     for l in kq.leases:
-                        if not l.broken and l.inflight < cap and (
-                                best is None or l.inflight < best.inflight):
-                            best = l
-                    if best is None:
-                        break
-                    best.inflight += 1
-                    batch.append((kq.queue.popleft(), best))
+                        if not l.broken and l.node_id:
+                            live_count[l.node_id] = \
+                                live_count.get(l.node_id, 0) + 1
+                made_progress = True
+                while kq.queue and made_progress:
+                    made_progress = False
+                    free = sorted(
+                        (l for l in kq.leases
+                         if not l.broken and l.inflight < cap),
+                        key=lambda l: l.inflight)
+                    for lease in free:
+                        if not kq.queue or lease.inflight >= cap:
+                            continue
+                        match = self._match_queued_task(
+                            kq, lease, live_count, locality_on, cap)
+                        if match is None:
+                            continue
+                        idx, pref = match
+                        if idx:
+                            kq.queue.rotate(-idx)
+                            entry = kq.queue.popleft()
+                            kq.queue.rotate(idx)
+                        else:
+                            entry = kq.queue.popleft()
+                        if locality_on and pref is not None:
+                            (_metrics.SCHEDULER_LOCALITY_HITS
+                             if pref == lease.node_id
+                             else _metrics.SCHEDULER_LOCALITY_MISSES).inc()
+                        lease.inflight += 1
+                        batch.append((entry, lease))
+                        made_progress = True
                 queue_len = len(kq.queue)
                 sample = kq.queue[0][1] if kq.queue else None
             if batch:
@@ -1494,6 +1673,56 @@ class ClusterCore:
             else:
                 idle_deadline = None
 
+    def _match_queued_task(self, kq: "_KeyQueue", lease: _Lease,
+                           live_count: Dict[str, int], locality_on: bool,
+                           cap: int) -> Optional[Tuple[int, Optional[str]]]:
+        """(index into kq.queue, that task's preferred node) of the task
+        to hand this lease, or None to leave the lease idle this round
+        (it lingers briefly, then returns to its node). Preference
+        order, scanned over a bounded window:
+
+        1. a task whose inputs live on the lease's node (locality hit);
+        2. a task with no known input locations;
+        3. a task whose preferred node has no live lease under this key —
+           it has to run SOMEWHERE, and a miss now beats waiting for a
+           lease that may never come.
+
+        A task whose preferred node DOES have live leases here is
+        DEFERRED — its home lease frees within one task, or leaves
+        kq.leases entirely, which lifts the deferral next round — but
+        only up to 4 x (live leases x pipeline cap) tasks per node, so a
+        skewed workload (every input on one hot node) still fans out
+        instead of serializing behind one worker. Caller holds
+        _lease_lock."""
+        if not kq.queue:
+            return None
+        if not locality_on:
+            return 0, None  # FIFO; hit/miss accounting is off anyway
+        fallback = None
+        deferred: Dict[str, int] = {}
+        stale_cutoff = time.monotonic() - cfg.scheduler_locality_defer_max_s
+        for i, (_tid, info) in enumerate(kq.queue):
+            if i >= 64:
+                break
+            pref = self._preferred_node(info)
+            if pref is not None and pref == lease.node_id:
+                return i, pref
+            if (pref is None or pref not in live_count
+                    or info.enqueued_at < stale_cutoff):
+                # No locality data, no live home lease, or deferred past
+                # the age cap (home lease wedged on one long task): run
+                # anywhere rather than wait longer.
+                if fallback is None:
+                    fallback = (i, pref)
+                continue
+            d = deferred.get(pref, 0)
+            if d >= 4 * cap * live_count[pref]:
+                if fallback is None:
+                    fallback = (i, pref)
+            else:
+                deferred[pref] = d + 1
+        return fallback
+
     def _maybe_request_leases(self, kq: "_KeyQueue", sample: _InflightTask,
                               queue_len: int) -> None:
         """Spawn background lease requesters if the queue outruns capacity."""
@@ -1519,9 +1748,16 @@ class ClusterCore:
             if sample.strategy is None and kq.lease_fail_deadline is None:
                 kq.lease_fail_deadline = (
                     time.monotonic() + cfg.lease_timeout_ms / 1000.0 * 6)
-        for _ in range(want):
+            # DISTINCT samples: the i-th new request hints the i-th queued
+            # task's inputs, so granted leases land where the backlog's
+            # data actually lives — `want` copies of the head task's hint
+            # would pile every lease onto one holder node.
+            qlist = list(kq.queue)
+            samples = [qlist[i][1] if i < len(qlist) else sample
+                       for i in range(want)]
+        for s in samples:
             threading.Thread(target=self._lease_requester,
-                             args=(kq, sample), daemon=True).start()
+                             args=(kq, s), daemon=True).start()
 
     def _lease_requester(self, kq: "_KeyQueue",
                          sample: _InflightTask) -> None:
@@ -1529,9 +1765,14 @@ class ClusterCore:
 
         env_err = None
         lease = None
+        hint = None
+        if (cfg.scheduler_locality_enabled and sample.arg_ids
+                and sample.strategy is None):
+            hint = [o.binary() for o in
+                    sample.arg_ids[:cfg.scheduler_locality_max_hint_objects]]
         try:
             lease = self._request_new_lease(sample.resources, sample.strategy,
-                                            sample.runtime_env)
+                                            sample.runtime_env, hint)
         except RuntimeEnvSetupError as e:
             env_err = e
         finally:
@@ -1548,6 +1789,14 @@ class ClusterCore:
                     # The kq was reaped while this grant was in flight:
                     # nobody will ever dispatch on (or return) this lease —
                     # hand the worker straight back to its node.
+                    orphaned = True
+                elif not kq.queue and any(not l.broken for l in kq.leases):
+                    # SURPLUS straggler: the backlog drained onto existing
+                    # leases while this grant was queued at its node.
+                    # Return it NOW instead of letting it linger — a chain
+                    # of trailing grants each holding the node's resources
+                    # for a linger period starves other submitters' (and
+                    # other keys') locality-hinted requests at that node.
                     orphaned = True
                 else:
                     orphaned = False
@@ -1701,10 +1950,14 @@ class ClusterCore:
 
     def _request_new_lease(self, resources: Dict[str, float],
                            strategy,
-                           runtime_env=None) -> Optional[_Lease]:
+                           runtime_env=None,
+                           locality_hint: Optional[List[bytes]] = None,
+                           ) -> Optional[_Lease]:
         """One head pick + node lease round trip; None if infeasible now.
         Both RPCs are retry-safe: pick_node is read-only, request_lease is
-        idempotent via the per-attempt req_id (the node caches the grant)."""
+        idempotent via the per-attempt req_id (the node caches the grant).
+        ``locality_hint`` ships the requesting task's input-object ids so
+        the head can score candidates by locally-resident bytes."""
         exclude: List[str] = []
         # Demand identity for the head's unmet-demand ring: this
         # submitter + shape. Retries of one starved key stay one demand;
@@ -1715,7 +1968,7 @@ class ClusterCore:
             try:
                 picked = self.head.retrying_call(
                     "pick_node", resources, strategy, exclude, demand_key,
-                    timeout=10)
+                    locality_hint, timeout=10)
             except (ConnectionLost, TimeoutError):
                 return None
             if picked is None:
@@ -1723,10 +1976,24 @@ class ClusterCore:
             node_id, node_addr, _ = picked
             pg = pg_key_from_strategy(strategy)
             req_id = uuid.uuid4().hex
+            # The short locality wait applies ONLY when the picked node
+            # actually holds input bytes (a locality gamble): queue
+            # briefly, declined -> exclude -> repick is the spillback. A
+            # plain hybrid pick keeps the full default queue window —
+            # shortening it for every data task would cost the whole
+            # cluster 3x its queue patience under saturation.
+            block_ms = None
+            if locality_hint:
+                with self._obj_loc_lock:
+                    holders = {self._obj_locality[k][0]
+                               for k in locality_hint
+                               if k in self._obj_locality}
+                if node_id in holders:
+                    block_ms = cfg.scheduler_locality_wait_ms
             try:
                 granted = self._pool.get(node_addr).retrying_call(
                     "request_lease", resources, True, pg, req_id,
-                    self.owner_addr, runtime_env,
+                    self.owner_addr, runtime_env, block_ms,
                     timeout=cfg.lease_timeout_ms / 1000.0 + 5)
             except (ConnectionLost, TimeoutError):
                 exclude.append(node_id)
@@ -1741,7 +2008,7 @@ class ClusterCore:
 
                 raise RuntimeEnvSetupError(granted["env_error"])
             worker_addr, lease_id = granted
-            return _Lease(worker_addr, lease_id, node_addr)
+            return _Lease(worker_addr, lease_id, node_addr, node_id)
         return None
 
     def _on_worker_conn_lost(self, client: RpcClient) -> None:

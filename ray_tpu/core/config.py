@@ -126,6 +126,28 @@ _d("object_store_prefault", bool, False,
 
 # --- scheduling ---
 _d("lease_timeout_ms", int, 10_000, "worker lease validity")
+_d("scheduler_locality_enabled", bool, True,
+   "score candidate nodes by locally-resident input bytes when picking a "
+   "node for a task (reference: the raylet's locality-aware lease policy); "
+   "disable to fall back to pure pack-then-spread")
+_d("scheduler_locality_spill_threshold", float, 0.8,
+   "holder-node utilization above which locality yields to the hybrid "
+   "policy — the spillback guard: a loaded holder must not starve tasks "
+   "that could run elsewhere")
+_d("scheduler_locality_max_hint_objects", int, 16,
+   "max input-object ids shipped with a pick_node lease request as the "
+   "locality hint (largest inputs dominate; a long tail adds only bytes)")
+_d("scheduler_locality_wait_ms", int, 1000,
+   "how long a locality-hinted lease request queues at a momentarily-full "
+   "holder node before declining (the requester then excludes it and "
+   "spills back) — waiting briefly beats migrating the input bytes")
+_d("scheduler_locality_defer_max_s", float, 3.0,
+   "max age a queued task is deferred waiting for a lease on its inputs' "
+   "holder node; past it the task dispatches to any free lease (a holder "
+   "wedged on one long task must not indefinitely delay its queue)")
+_d("object_locality_cache_max", int, 65_536,
+   "owner-side oid -> (node, size) locality cache entries (populated from "
+   "task completions and local puts; consulted at dispatch)")
 _d("lease_queue_block_ms", int, 3_000,
    "how long a saturated node queues a lease request before declining "
    "(spillback); reference: tasks queue at the raylet")
@@ -341,3 +363,9 @@ _d("object_store_slots", int, 1 << 16,
    "shm store object-table slots (max resident objects per node)")
 _d("spill_restore_poll_s", float, 0.05,
    "pull-manager pause between spilled-object restore attempts")
+_d("pull_fanout_max_holders", int, 4,
+   "max holder nodes a chunked pull fans out across in parallel "
+   "(reference: object_manager Pull spreads chunk requests over copies)")
+_d("pull_fanout_min_bytes", int, 8 * 1024**2,
+   "objects at least this large pull chunks from multiple holders in "
+   "parallel; smaller ones single-stream from the nearest holder")

@@ -35,12 +35,18 @@ class _Ref:
 
 
 class ReferenceCounter:
-    def __init__(self, on_release: Optional[Callable[[ObjectID], None]] = None):
+    def __init__(self, on_release: Optional[Callable[[ObjectID], None]] = None,
+                 on_borrow_release: Optional[Callable[[ObjectID],
+                                                      None]] = None):
         import collections
 
         self._lock = threading.Lock()
         self._refs: Dict[ObjectID, _Ref] = {}
         self._on_release = on_release
+        # Fires when a BORROWED (non-owned) ref goes out of scope in this
+        # process: the borrower's half of the WaitForRefRemoved protocol —
+        # without it the owner pins every borrowed object forever.
+        self._on_borrow_release = on_borrow_release
         self.enabled = True
         # ObjectRef.__del__ may run INSIDE a locked section of this very
         # counter (any allocation under the lock can trigger GC, which
@@ -189,5 +195,8 @@ class ReferenceCounter:
         del self._refs[oid]
         if ref.owned and self._on_release:
             cb = self._on_release
+            return lambda: cb(oid)
+        if not ref.owned and self._on_borrow_release is not None:
+            cb = self._on_borrow_release
             return lambda: cb(oid)
         return None

@@ -32,8 +32,14 @@ def _load_lib():
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        so = os.path.join(here, "_cpp", "libshm_store.so")
+        # RTPU_SHM_STORE_SO points at an out-of-tree build of the store
+        # library (e.g. one rebuilt for this machine's glibc) without
+        # touching the checked-in binary; inherited by every spawned
+        # head/node/worker process.
+        so = os.environ.get("RTPU_SHM_STORE_SO") or ""
+        if not so:
+            here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            so = os.path.join(here, "_cpp", "libshm_store.so")
         if not os.path.exists(so):
             from ray_tpu._cpp.build import build
 

@@ -19,7 +19,27 @@ from ray_tpu.core.resources import ResourceSet
 
 @dataclass(frozen=True)
 class DefaultSchedulingStrategy:
-    """Hybrid pack-then-spread with data locality."""
+    """Hybrid pack-then-spread with data locality.
+
+    The real policy, end to end (reference: raylet hybrid_scheduling_
+    policy.cc + the owner's locality-aware lease policy):
+
+    1. The head filters ALIVE nodes whose availability fits the demand,
+       packs onto the most-utilized feasible node until utilization
+       crosses `scheduler_spread_threshold`, then prefers the
+       least-utilized one. A transiently-saturated cluster falls back to
+       ranking by TOTAL capacity so the lease request queues at a node.
+    2. Locality: lease requests carry the requesting task's input-object
+       ids; the head re-scores feasible nodes by locally-resident input
+       bytes (object directory x sealed sizes) and the best holder wins
+       — unless its utilization crossed
+       `scheduler_locality_spill_threshold`, in which case step 1's
+       choice stands (spillback: locality never starves a task).
+    3. Owner-side dispatch pairs queued tasks with already-held leases on
+       their inputs' holder node (`scheduler_locality_hits/misses`
+       counters), falling back to the least-loaded lease so a free
+       worker is never left idle while work exists.
+    """
 
 
 @dataclass(frozen=True)

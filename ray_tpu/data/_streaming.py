@@ -245,7 +245,14 @@ class TaskPoolMapOperator(Operator):
     transforms with upstream reads and downstream consumption. Holds a
     CHAIN of fused stages: the optimizer merges adjacent map operators so
     one task applies the whole chain per block (reference:
-    logical/rules/operator_fusion.py)."""
+    logical/rules/operator_fusion.py).
+
+    Data locality rides for free: the input block ref is a task ARG, so
+    the submitter's lease requests carry it as the pick_node locality
+    hint and each transform schedules onto the node already holding its
+    block (core/task_spec.py DefaultSchedulingStrategy) — shuffle/map
+    stages stop shipping bytes the cluster already has, with no Data-API
+    change."""
 
     def __init__(self, fn: Callable, *, batch_size: Optional[int] = None,
                  fn_kwargs: Optional[Dict[str, Any]] = None,

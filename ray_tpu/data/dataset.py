@@ -9,6 +9,13 @@ TPU-first: `iter_batches(device_put=...)` keeps `device_prefetch_depth`
 batches resident on device ahead of the consumer (the flag the reference
 era left to torch DataLoader pinned-memory workers), so the train step's
 host->HBM copy overlaps compute.
+
+Block refs flow through map/shuffle tasks as plain args, which makes
+every stage locality-aware automatically: the scheduler scores candidate
+nodes by locally-resident input bytes and places each transform next to
+its block (see core/task_spec.py and the "Scheduling & data locality"
+README section), so pipelines pull bytes over the (simulated DCN)
+network only when a stage genuinely migrates data.
 """
 
 from __future__ import annotations

@@ -81,12 +81,15 @@ async function refresh() {
       html += table('Jobs', api.jobs,
         ['submission_id', 'status', 'entrypoint', 'message']);
     html += '<h2>Object store</h2><pre id="objstore"></pre>';
+    html += '<h2>Scheduling &amp; locality</h2><pre id="sched"></pre>';
     document.getElementById('tables').innerHTML = html;
     // The object-store summary goes in via textContent, never innerHTML:
     // its strings (spill paths, debug labels) can carry user-controlled
     // markup that must not execute in the operator's browser.
     document.getElementById('objstore').textContent =
       JSON.stringify(api.objects, null, 1);
+    document.getElementById('sched').textContent =
+      JSON.stringify(api.scheduler, null, 1);
     document.getElementById('meta').textContent =
       new Date().toLocaleTimeString() + ' — ' + api.nodes.length +
       ' nodes, ' + api.actors.length + ' actors';
@@ -156,10 +159,44 @@ def _api_payload() -> Dict[str, Any]:
             "get_demand", 30.0, timeout=5).get("unmet", [])
     except Exception:
         pass
+    # Locality scheduling + pull-manager counters: head-side pick stats,
+    # this driver's dispatch hit/miss, and per-node pull totals.
+    scheduler: Dict[str, Any] = {}
+    try:
+        from ray_tpu.core.runtime_context import require_runtime
+        from ray_tpu.util import metrics as _m
+
+        rt = require_runtime()
+        scheduler = dict(rt.head.retrying_call(
+            "scheduler_stats", timeout=5) or {})
+        scheduler["dispatch_locality_hits"] = \
+            _m.SCHEDULER_LOCALITY_HITS.get()
+        scheduler["dispatch_locality_misses"] = \
+            _m.SCHEDULER_LOCALITY_MISSES.get()
+        # Bounded poll: sequential per-node RPCs must not stretch the
+        # refresh on big clusters or park 2s per dead node — cap the fan
+        # and keep the per-node deadline tight (full-fleet pull counters
+        # live on each node's Prometheus endpoint for real scraping).
+        pulls: Dict[str, int] = {}
+        nodes = [n for n in state.list_nodes() if n.get("alive", True)]
+        for n in nodes[:16]:
+            try:
+                st = rt._pool.get(n["address"]).call("pull_stats",
+                                                     timeout=0.5)
+            except Exception:
+                continue
+            for k, v in (st or {}).items():
+                pulls[k] = pulls.get(k, 0) + v
+        scheduler["pull_manager"] = pulls
+        if len(nodes) > 16:
+            scheduler["pull_manager_nodes_sampled"] = 16
+    except Exception:
+        pass
     return {"nodes": state.list_nodes(), "actors": state.list_actors(),
             "tasks": state.list_tasks()[-100:],
             "objects": state.summarize_objects(),
-            "jobs": jobs, "pending_demand": demand}
+            "jobs": jobs, "pending_demand": demand,
+            "scheduler": scheduler}
 
 
 def _timeline_payload() -> list:

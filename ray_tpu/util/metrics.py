@@ -150,3 +150,22 @@ TASK_EXEC_SECONDS = Histogram("rtpu_task_exec_seconds",
 OBJECTS_PUT = Counter("rtpu_objects_put_total", "ray_tpu.put calls")
 PUT_BYTES = Counter("rtpu_put_bytes_total", "bytes written via put")
 ACTOR_CALLS = Counter("rtpu_actor_calls_total", "actor method submissions")
+# Locality-aware scheduling (owner-side dispatch accounting): a task with
+# known input locations counts a hit when it lands on the node holding the
+# plurality of its input bytes, a miss otherwise.
+SCHEDULER_LOCALITY_HITS = Counter(
+    "rtpu_scheduler_locality_hits_total",
+    "tasks dispatched to the node holding most of their input bytes")
+SCHEDULER_LOCALITY_MISSES = Counter(
+    "rtpu_scheduler_locality_misses_total",
+    "tasks with known input locations dispatched to a non-holder node")
+# Object plane (node-side pull manager).
+OBJECT_BYTES_PULLED = Counter(
+    "rtpu_object_bytes_pulled_total",
+    "bytes fetched from remote nodes by this node's pull manager")
+PULLS_COALESCED = Counter(
+    "rtpu_pulls_coalesced_total",
+    "duplicate concurrent pulls coalesced onto one in-flight transfer")
+PULLS_MULTI_SOURCE = Counter(
+    "rtpu_pulls_multi_source_total",
+    "pulls whose chunks fanned out across multiple holder nodes")

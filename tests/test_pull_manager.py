@@ -1,0 +1,130 @@
+"""Pull-manager unit tests over an in-process head + node managers.
+
+Parity model: src/ray/object_manager/pull_manager.h behaviors — duplicate
+concurrent pulls coalesce onto one in-flight transfer, large pulls fan
+chunks out across multiple holders, and the directory orders holders
+nearest-first (zone label) for the requester.
+"""
+
+import os
+import threading
+import time
+import uuid
+
+import pytest
+
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.cluster.head import HeadServer
+from ray_tpu.cluster.node_manager import NodeManager
+
+
+def _mk_node(head, zone: str) -> NodeManager:
+    return NodeManager(head.address, uuid.uuid4().hex,
+                       {"CPU": 1.0}, {"zone": zone}, 64 << 20)
+
+
+@pytest.fixture
+def mini_cluster():
+    head = HeadServer()
+    nodes = [_mk_node(head, z) for z in ("east", "west", "west")]
+    yield head, nodes
+    for n in nodes:
+        n.shutdown()
+    head.shutdown()
+
+
+def _seal(head, nm: NodeManager, oid: ObjectID, data: bytes) -> None:
+    mv = nm.store.create_buffer(oid, len(data))
+    mv[:] = data
+    nm.store.seal(oid)
+    head.rpc_object_added(None, oid.binary(), nm.node_id, len(data))
+
+
+def test_concurrent_pulls_coalesce_and_take_over(mini_cluster):
+    """A second pull of an in-flight object waits on the first transfer
+    (no duplicate stream); if the leader fails, a follower takes over."""
+    head, (a, _b, c) = mini_cluster
+    oid = ObjectID.from_random()
+    data = os.urandom(1 << 20)
+    _seal(head, a, oid, data)
+
+    # Simulate an in-flight leader on c, then issue a concurrent pull:
+    # it must COALESCE (wait) instead of opening a second transfer.
+    ev = threading.Event()
+    with c._pull_lock:
+        c._pulls[oid.binary()] = ev
+    results = []
+    t = threading.Thread(target=lambda: results.append(
+        c.rpc_pull_object(None, oid.binary(), 20000)), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while (c.pull_stats["pulls_coalesced"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert c.pull_stats["pulls_coalesced"] >= 1
+    assert not c.store.contains(oid)  # still parked behind the "leader"
+    # Leader "dies" without delivering: followers wake, one takes over.
+    with c._pull_lock:
+        c._pulls.pop(oid.binary(), None)
+    ev.set()
+    t.join(30)
+    assert results == [True]
+    assert c.store.contains(oid)
+    # Exactly ONE transfer moved the bytes.
+    assert c.pull_stats["bytes_pulled"] == len(data)
+
+    buf = c.store.get(oid, timeout_ms=1000)
+    assert bytes(buf.buffer) == data
+    buf.release()
+
+
+def test_multi_source_pull_fans_out_across_holders(mini_cluster):
+    """A large object with several holders pulls chunks from multiple
+    sources in parallel and reassembles correctly."""
+    head, (a, b, c) = mini_cluster
+    oid = ObjectID.from_random()
+    data = os.urandom(6 << 20)
+    _seal(head, a, oid, data)
+    _seal(head, b, oid, data)
+    old_chunk = cfg.object_transfer_chunk_bytes
+    old_min = cfg.pull_fanout_min_bytes
+    cfg.set("object_transfer_chunk_bytes", 1 << 20)
+    cfg.set("pull_fanout_min_bytes", 2 << 20)
+    try:
+        assert c.rpc_pull_object(None, oid.binary(), 30000) is True
+    finally:
+        cfg.set("object_transfer_chunk_bytes", old_chunk)
+        cfg.set("pull_fanout_min_bytes", old_min)
+    assert c.pull_stats["multi_source_pulls"] == 1
+    assert c.pull_stats["bytes_pulled"] == len(data)
+    buf = c.store.get(oid, timeout_ms=1000)
+    assert bytes(buf.buffer) == data
+    buf.release()
+
+
+def test_object_locations_orders_nearest_first(mini_cluster):
+    """Holder list is sorted nearest-first for the requester: same-zone
+    holders ahead of cross-zone ones."""
+    head, (a, b, c) = mini_cluster  # zones: east, west, west
+    oid = ObjectID.from_random()
+    data = b"x" * 1024
+    _seal(head, a, oid, data)
+    _seal(head, b, oid, data)
+    locs = head.rpc_object_locations(None, oid.binary(),
+                                     requester_node_id=c.node_id)
+    assert [nid for nid, _ in locs][0] == b.node_id  # west first for c
+    locs_a = head.rpc_object_locations(None, oid.binary(),
+                                       requester_node_id=a.node_id)
+    assert [nid for nid, _ in locs_a][0] == a.node_id  # east first for a
+
+
+def test_object_removed_drops_size_accounting(mini_cluster):
+    head, (a, _b, _c) = mini_cluster
+    oid = ObjectID.from_random()
+    _seal(head, a, oid, b"y" * 2048)
+    stats = head.rpc_scheduler_stats(None)
+    assert stats["object_bytes_tracked"] >= 2048
+    head.rpc_object_removed(None, oid.binary(), a.node_id)
+    stats = head.rpc_scheduler_stats(None)
+    assert oid.binary() not in head._object_sizes
