@@ -23,6 +23,12 @@ Rows:
   decode tok/s, engine-side TTFT/TPOT p50, and prefix-cache hit rate
   under a shared-prefix workload; rows are labelled ``config:
   "tiny-cpu"`` when not measured on hardware.
+- llm_engine_spec / llm_engine_spec_off — speculative decoding
+  (prompt-lookup drafting + multi-token verify) on a repetitive
+  workload, measured against the identical engine with speculation
+  disabled: tok/s both ways, ``llm_spec_accept_rate``, and the
+  ``spec_speedup`` ratio (greedy outputs are token-identical, so both
+  rows count the same tokens).
 - serve_llm_* — req/s + p50/p99 TTFT through the FULL serve stack
   (controller/router/replica, tiny engine) in a CPU child process; the
   reference publishes no serve numbers (it delegates to vLLM), so these
@@ -314,13 +320,107 @@ def _bench_engine(on_tpu: bool) -> dict:
     return row
 
 
+def _bench_engine_spec(on_tpu: bool) -> list:
+    """Speculative-decoding suite: a repetitive/code-like workload —
+    where prompt-lookup drafting bites — measured back-to-back with
+    speculation ON and OFF on otherwise identical engines, so the
+    speedup is a measured ratio from one process, not an assertion.
+
+    The repetitive prompt drives the generation into the repetition
+    loops real serving sees in code edits / templated output; greedy
+    outputs are token-identical between the two runs (the engine's
+    equivalence invariant), so both rows count the same tokens."""
+    import threading
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    if on_tpu:
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=512,
+                                  use_decode_kernel=True)
+        max_batch, new_tokens, seconds = 8, 160, 8.0
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        max_batch, new_tokens, seconds = 4, 200, 4.0
+    # A constant-token prompt is the distilled repetitive workload: the
+    # generation locks into repetition loops the drafter tracks.
+    prompt = [16] * 24
+    spec_kw = dict(spec_draft_len=12, spec_chunk=2, spec_ngram_max=8)
+
+    def run(spec: bool) -> dict:
+        engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
+                           prompt_buckets=[32], decode_chunk=8,
+                           name=f"bench-spec-{'on' if spec else 'off'}",
+                           **(spec_kw if spec else {}))
+        for _ in range(2):  # compile prefill+decode(+verify), warm ctrl
+            engine.generate(prompt, max_new_tokens=120)
+        stop_at = time.perf_counter() + seconds
+        counts = [0] * max_batch
+        errors: list = []
+
+        def client(i):
+            try:
+                while time.perf_counter() < stop_at:
+                    out = engine.generate(prompt,
+                                          max_new_tokens=new_tokens,
+                                          timeout=300)
+                    counts[i] += len(out["token_ids"])
+            except Exception as e:  # noqa: BLE001 — recorded below
+                errors.append(repr(e)[:200])
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(max_batch)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        stats = engine.stats()
+        engine.close()
+        if errors and not sum(counts):
+            raise RuntimeError(f"all spec-bench clients failed: "
+                               f"{errors[0]}")
+        out = {"tokens_per_s": round(sum(counts) / elapsed, 1),
+               "stats": stats, "errors": errors}
+        return out
+
+    on = run(spec=True)
+    off = run(spec=False)
+    common = {"workload": "repetitive", "prompt_len": len(prompt),
+              "max_batch": max_batch, "decode_chunk": 8,
+              "config": "llama3-1b" if on_tpu else "tiny-cpu"}
+    row_on = {"metric": "llm_engine_spec",
+              "llm_decode_tokens_per_s": on["tokens_per_s"],
+              "llm_spec_accept_rate": on["stats"]["spec_accept_rate"],
+              "spec_drafted": on["stats"]["spec_drafted"],
+              "spec_accepted": on["stats"]["spec_accepted"],
+              "decode_utilization": on["stats"]["decode_utilization"],
+              "spec_speedup": round(
+                  on["tokens_per_s"] / off["tokens_per_s"], 2)
+              if off["tokens_per_s"] else None,
+              **spec_kw, **common}
+    row_off = {"metric": "llm_engine_spec_off",
+               "llm_decode_tokens_per_s": off["tokens_per_s"],
+               "decode_utilization": off["stats"]["decode_utilization"],
+               **common}
+    for row, r in ((row_on, on), (row_off, off)):
+        if r["errors"]:
+            row["client_errors"] = len(r["errors"])
+            row["client_error_sample"] = r["errors"][0]
+    return [row_on, row_off]
+
+
 def engine_child_main() -> None:
-    """Standalone engine suite (``bench.py --engine``): one JSON row."""
+    """Standalone engine suite (``bench.py --engine``): engine row plus
+    the speculative-decoding on/off pair, one JSON row each."""
     _pin_platform()
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
     print(json.dumps(_bench_engine(on_tpu)), flush=True)
+    for row in _bench_engine_spec(on_tpu):
+        print(json.dumps(row), flush=True)
 
 
 def child_main() -> None:
@@ -380,6 +480,14 @@ def child_main() -> None:
     except Exception as e:  # noqa: BLE001
         row_eng = {"metric": "llm_engine", "error": repr(e)[:300]}
     print(json.dumps(row_eng), flush=True)
+
+    # --- rows 5+6: speculative decoding on/off (repetitive workload) ----
+    try:
+        spec_rows = _bench_engine_spec(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        spec_rows = [{"metric": "llm_engine_spec", "error": repr(e)[:300]}]
+    for r in spec_rows:
+        print(json.dumps(r), flush=True)
 
 
 def serve_child_main() -> None:
@@ -717,6 +825,14 @@ def main() -> int:
         if not merged.get("llm_decode_tokens_per_s"):
             merged["llm_decode_tokens_per_s"] = \
                 eng.get("llm_decode_tokens_per_s")
+    spec = by_metric.get("llm_engine_spec", {})
+    if "error" not in spec:
+        merged["llm_spec_accept_rate"] = spec.get("llm_spec_accept_rate")
+        merged["llm_spec_speedup"] = spec.get("spec_speedup")
+        merged["llm_decode_tokens_per_s_spec"] = \
+            spec.get("llm_decode_tokens_per_s")
+    elif spec:
+        merged["spec_error"] = spec["error"]
     if serve_row and "error" not in serve_row:
         for k in ("serve_llm_requests_per_s", "serve_llm_tokens_per_s",
                   "serve_llm_p50_ttft_ms", "serve_llm_p99_ttft_ms"):

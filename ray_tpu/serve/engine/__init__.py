@@ -4,13 +4,20 @@ The serving engine as a subsystem (vs the round-5 single-file
 serve/llm.py), four cooperating modules under one orchestrator:
 
 - ``decode_loop``  — jitted K-step decode scan that keeps EOS/budget
-  termination ON DEVICE; one host sync per K tokens.
+  termination ON DEVICE; one host sync per K tokens. With speculation
+  enabled it also compiles the multi-token verify program (one forward
+  per [B, draft+1] candidate window, on-device accept masks).
+- ``drafter``      — model-free prompt-lookup draft proposer (longest
+  suffix n-gram over prompt + generated) and the per-request adaptive
+  draft-length controller.
 - ``kv_manager``   — slot allocation, block-granular occupancy, and
-  hash-based prefix caching over freed slots' resident KV.
+  hash-based prefix caching over freed slots' resident KV; speculative
+  grow/rollback keeps rejected draft rows out of the prefix index.
 - ``scheduler``    — model-free continuous-batching admission (FIFO,
   bucketed prefill, slot recycling, per-request token accounting).
-- ``metrics``      — TTFT/TPOT/queue-depth/prefix-hit-rate through the
-  util/metrics registry + the engine ``stats()`` snapshot.
+- ``metrics``      — TTFT/TPOT/queue-depth/prefix-hit-rate plus
+  drafted/accepted speculation counters through the util/metrics
+  registry + the engine ``stats()`` snapshot.
 - ``core``         — ``InferenceEngine``, the engine-thread glue.
 
 See README.md in this package for the architecture notes;
@@ -19,6 +26,7 @@ See README.md in this package for the architecture notes;
 
 from ray_tpu.serve.engine.core import InferenceEngine
 from ray_tpu.serve.engine.decode_loop import DecodeLoop
+from ray_tpu.serve.engine.drafter import PromptLookupDrafter, SpecControl
 from ray_tpu.serve.engine.kv_manager import KVCacheManager
 from ray_tpu.serve.engine.metrics import EngineMetrics
 from ray_tpu.serve.engine.scheduler import (Admission, EngineRequest,
@@ -26,5 +34,6 @@ from ray_tpu.serve.engine.scheduler import (Admission, EngineRequest,
 
 __all__ = [
     "Admission", "DecodeLoop", "EngineMetrics", "EngineRequest",
-    "InferenceEngine", "KVCacheManager", "Scheduler", "bucket_for",
+    "InferenceEngine", "KVCacheManager", "PromptLookupDrafter",
+    "Scheduler", "SpecControl", "bucket_for",
 ]

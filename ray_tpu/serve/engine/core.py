@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ray_tpu.serve.engine.decode_loop import DecodeLoop
+from ray_tpu.serve.engine.drafter import PromptLookupDrafter, SpecControl
 from ray_tpu.serve.engine.kv_manager import KVCacheManager
 from ray_tpu.serve.engine.metrics import EngineMetrics
 from ray_tpu.serve.engine.scheduler import EngineRequest, Scheduler
@@ -36,6 +37,22 @@ class InferenceEngine:
     ``decode_chunk`` now defaults to 8 (K decode steps per host sync —
     per-token fetches through a remote-TPU tunnel cost ~75 ms each) and
     ``prefix_block`` sets the prefix-cache block granularity.
+
+    Speculative decoding (``spec_draft_len`` > 0): each decode tick the
+    host proposes up to ``spec_chunk * spec_draft_len`` continuation
+    tokens per request by prompt lookup (drafter.py), the device
+    verifies them in multi-token windows (decode_loop.verify_chunk) and
+    the host commits exactly the accepted prefix — greedy output is
+    token-identical to spec-off, only the number of forward passes per
+    token changes. Program choice is per TICK and roster-wide: a tick
+    with no drafts anywhere dispatches the unchanged plain chunk, while
+    one drafting request routes the whole roster through the verify
+    program (draft-free neighbors then advance ``spec_chunk`` tokens
+    per dispatch instead of ``decode_chunk`` — co-batching interference
+    comparable to sharing the roster with any long request).
+    ``spec_draft_len=0`` (the default) builds none of this: no verify
+    program, no cache padding, byte-identical engine behavior to the
+    pre-speculation subsystem.
     """
 
     def __init__(self, cfg=None, params=None, *, max_batch: int = 4,
@@ -43,6 +60,10 @@ class InferenceEngine:
                  prompt_buckets: Optional[List[int]] = None,
                  decode_chunk: int = 8,
                  prefix_block: int = 16,
+                 spec_draft_len: int = 0,
+                 spec_ngram_max: int = 3,
+                 spec_adaptive: bool = True,
+                 spec_chunk: int = 0,
                  seed: int = 0,
                  name: Optional[str] = None):
         import jax
@@ -58,14 +79,26 @@ class InferenceEngine:
         self.max_len = min(max_len, self.cfg.max_seq_len)
         self.decode_chunk = max(1, int(decode_chunk))
         self.buckets = prompt_buckets or [32, 64, 128]
-        self.cache = llama.init_kv_cache(self.cfg, max_batch, self.max_len)
+        self.spec_draft_len = max(0, int(spec_draft_len))
+        self.spec_adaptive = bool(spec_adaptive)
+        self.drafter = (PromptLookupDrafter(ngram_max=spec_ngram_max)
+                        if self.spec_draft_len else None)
+
+        self.loop = DecodeLoop(self.cfg, max_len=self.max_len,
+                               chunk=self.decode_chunk,
+                               spec_window=self.spec_draft_len + 1,
+                               spec_chunk=spec_chunk)
+        # Verify windows span spec_draft_len+1 rows; the scratch strip
+        # past max_len absorbs parked/overrun writes so they can never
+        # clamp back onto resident rows (decode_loop docstring). Row
+        # accounting everywhere else still uses the logical max_len.
+        self.cache = llama.init_kv_cache(
+            self.cfg, max_batch, self.max_len + self.loop.scratch_rows)
 
         self.kv = KVCacheManager(max_batch, self.max_len,
                                  block_size=prefix_block)
         self.scheduler = Scheduler(self.kv, max_len=self.max_len,
                                    prompt_buckets=self.buckets)
-        self.loop = DecodeLoop(self.cfg, max_len=self.max_len,
-                               chunk=self.decode_chunk)
         self.metrics = EngineMetrics(name)
 
         self._queue: "queue.Queue[EngineRequest]" = queue.Queue()
@@ -117,6 +150,15 @@ class InferenceEngine:
             raise ValueError("prompt_ids must be ints in [0, vocab_size)")
         if len(req.prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
+        if self.spec_draft_len:
+            # Draft-buffer capacity at full acceptance: every window
+            # advances draft_len+1 positions (_draft_for_roster packs
+            # rows at that stride), the last window needs no bonus.
+            cap = (self.loop.spec_chunk * (self.spec_draft_len + 1)) - 1
+            req.spec = SpecControl(
+                allowance=self.spec_draft_len,
+                max_allowance=cap if self.spec_adaptive
+                else self.spec_draft_len)
         return req
 
     def stats(self) -> Dict[str, Any]:
@@ -193,16 +235,15 @@ class InferenceEngine:
                 req.stream_queue.put(("done", None))
         return done
 
-    def _decode_tick(self) -> None:
-        """One device chunk for the whole roster + ONE host fetch."""
-        jnp = self._jax.numpy
-        active = self.scheduler.active
+    def _roster_arrays(self, active):
+        """Per-slot device inputs for a chunk dispatch (plain or spec)."""
         tokens = np.zeros((self.max_batch, 1), np.int32)
         # The scan's static shape steps EVERY slot, so inactive slots
         # still write one KV row per step. Park those writes on the LAST
         # row: resident prefixes never extend past max_len-2 (a request
         # needs >= 1 suffix + 1 generated token), so the last row is
-        # never prefix-cache-reused — row 0 of a freed slot is.
+        # never prefix-cache-reused — row 0 of a freed slot is. (The
+        # verify program ignores this and parks in the scratch strip.)
         lengths = np.full((self.max_batch,), self.max_len - 1, np.int32)
         remaining = np.zeros((self.max_batch,), np.int32)
         eos_ids = np.full((self.max_batch,), -1, np.int32)
@@ -214,6 +255,36 @@ class InferenceEngine:
             if req.eos_id is not None:
                 eos_ids[req.slot] = req.eos_id
             done[req.slot] = False
+        return tokens, lengths, remaining, eos_ids, done
+
+    def _fail_roster(self, e: BaseException) -> None:
+        for req in self.scheduler.fail_active():
+            if not req.future.done():
+                req.future.set_exception(e)
+            if req.stream_queue is not None:
+                req.stream_queue.put(("error", e))
+
+    def _decode_tick(self) -> None:
+        """One device chunk for the whole roster + ONE host fetch.
+
+        With speculation enabled, ticks where prompt lookup proposed at
+        least one draft dispatch the multi-token verify program; ticks
+        with nothing to verify fall through to the plain chunk — so a
+        workload on which lookup never bites costs nothing over
+        speculation-off.
+        """
+        if self.drafter is not None:
+            drafts = self._draft_for_roster()
+            if drafts:
+                self._spec_tick(drafts)
+                return
+        self._plain_tick()
+
+    def _plain_tick(self) -> None:
+        jnp = self._jax.numpy
+        active = self.scheduler.active
+        tokens, lengths, remaining, eos_ids, done = \
+            self._roster_arrays(active)
         t0 = time.perf_counter()
         try:
             toks_d, n_valid_d, _len_d, _done_d, self.cache = \
@@ -223,15 +294,16 @@ class InferenceEngine:
                     jnp.asarray(eos_ids), jnp.asarray(done))
             chunk_ids, n_valid = self._fetch((toks_d, n_valid_d))
         except BaseException as e:  # noqa: BLE001 — fail all waiters
-            for req in self.scheduler.fail_active():
-                if not req.future.done():
-                    req.future.set_exception(e)
-                if req.stream_queue is not None:
-                    req.stream_queue.put(("error", e))
+            self._fail_roster(e)
             return
         elapsed = time.perf_counter() - t0
         chunk_ids = np.asarray(chunk_ids)  # [B, K]
         n_valid = np.asarray(n_valid)      # [B]
+        # Device utilization denominator: every slot live at dispatch is
+        # scanned for the full chunk (static shapes) whether or not it
+        # freezes mid-chunk — delivered/live_steps < 1.0 shows the
+        # frozen-overshoot waste instead of the old always-1.0 readout.
+        live_steps = len(active) * self.loop.chunk
         delivered = 0
         for req in list(active):
             n = int(n_valid[req.slot])
@@ -245,7 +317,141 @@ class InferenceEngine:
                     req.stream_queue.put(("token", tok))
                 if self._maybe_finish(req, tok):
                     break  # device froze the slot here; rest are repeats
-        self.metrics.record_chunk(delivered, delivered, elapsed)
+        self.metrics.record_chunk(delivered, live_steps, elapsed)
+
+    # -------------------------------------------------------- speculation
+
+    def _draft_for_roster(self) -> Dict[int, List[int]]:
+        """Prompt-lookup proposals for this tick, keyed by slot.
+        Empty dict = nothing to verify (dispatch the plain program)."""
+        # A fully accepted window advances W = K+1 positions (K drafts
+        # + the model's bonus token), so a continuation long enough to
+        # keep all spec_chunk windows fed spans C*W - 1 positions (the
+        # final window needs no bonus prediction).
+        cap = self.loop.spec_chunk * (self.spec_draft_len + 1) - 1
+        out: Dict[int, List[int]] = {}
+        for req in self.scheduler.active:
+            # Drafting past the request's own stopping point is pure
+            # waste: at most remaining-1 drafts can be emitted (the last
+            # budgeted token is always the model's own), and the row cap
+            # freezes the slot at max_len-1 rows.
+            need = min(req.spec.budget(), cap, req.remaining() - 1,
+                       self.max_len - req.length - 2)
+            if need <= 0:
+                continue
+            cont = self.drafter.draft(req.prompt_ids + req.generated,
+                                      need)
+            if cont:
+                out[req.slot] = cont
+            else:
+                req.spec.miss()
+        return out
+
+    def _spec_tick(self, drafts: Dict[int, List[int]]) -> None:
+        """One speculative verify chunk: K-token draft windows verified
+        on device, accepted prefixes committed, rejected rows rolled
+        back — still ONE host fetch."""
+        jnp = self._jax.numpy
+        active = self.scheduler.active
+        C, K = self.loop.spec_chunk, self.spec_draft_len
+        W = K + 1
+        tokens, lengths, remaining, eos_ids, done = \
+            self._roster_arrays(active)
+        draft_buf = np.zeros((self.max_batch, C, K), np.int32)
+        ndraft = np.zeros((self.max_batch,), np.int32)
+        for slot, cont in drafts.items():
+            # Window rows are packed at stride W = K+1, not K: the only
+            # path to row i is i FULLY accepted windows, and each full
+            # window advances K+1 positions (K drafts + the model's
+            # bonus token). The continuation's prediction for a bonus
+            # position is skipped — the bonus comes from the model's
+            # own argmax, so drafting it would desynchronize every
+            # later row by one position per window (systematic row-1+
+            # rejection on any repetition with period > 1).
+            packed = 0
+            for i in range(C):
+                row = cont[i * (K + 1):i * (K + 1) + K]
+                if not row:
+                    break
+                draft_buf[slot, i, :len(row)] = row
+                packed += len(row)
+            ndraft[slot] = packed
+        for req in active:
+            self.kv.begin_speculation(
+                req.slot, min(C * W, self.max_len - req.length))
+        t0 = time.perf_counter()
+        try:
+            emits_d, counts_d, _len_d, _done_d, self.cache = \
+                self.loop.verify_chunk(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(draft_buf), jnp.asarray(ndraft),
+                    jnp.asarray(lengths), jnp.asarray(remaining),
+                    jnp.asarray(eos_ids), jnp.asarray(done))
+            emits, counts = self._fetch((emits_d, counts_d))
+        except BaseException as e:  # noqa: BLE001 — fail all waiters
+            self._fail_roster(e)
+            return
+        elapsed = time.perf_counter() - t0
+        emits = np.asarray(emits)    # [B, C, W]
+        counts = np.asarray(counts)  # [B, C]
+        live_steps = len(active) * C * W  # token-positions scanned
+        delivered = 0
+        accepted_total = 0
+        for req in list(active):
+            s = req.slot
+            n = int(counts[s].sum())
+            # Commit the verified rows, roll back the reservation for
+            # the rejected remainder BEFORE delivery: _maybe_finish may
+            # release the slot, and a released slot must carry no
+            # in-flight reservation into the free pool.
+            self.kv.commit_speculation(s, n)
+            delivered += n
+            accepted_total += int(np.maximum(counts[s] - 1, 0).sum())
+            finished = False
+            for i in range(C):
+                for j in range(int(counts[s, i])):
+                    tok = int(emits[s, i, j])
+                    req.length += 1
+                    req.generated.append(tok)
+                    if req.stream_queue is not None:
+                        req.stream_queue.put(("token", tok))
+                    if self._maybe_finish(req, tok):
+                        finished = True
+                        break
+                if finished:
+                    break
+            if (self.spec_adaptive and not finished
+                    and s in drafts):
+                consumed, acc = self._spec_outcome(
+                    counts[s], int(ndraft[s]), K, W)
+                if consumed:
+                    req.spec.observe(consumed, acc)
+        self.metrics.record_chunk(delivered, live_steps, elapsed)
+        self.metrics.record_spec(int(ndraft.sum()), accepted_total)
+
+    @staticmethod
+    def _spec_outcome(counts_row, drafted: int, K: int, W: int):
+        """(verified, accepted) draft tokens for one non-finished slot's
+        chunk — the adaptive controller's signal. Only drafts the device
+        actually checked count as verified: a request that finished
+        mid-chunk never reaches here (its unchecked tail is neither
+        accepted nor rejected), and windows after a divergence run
+        draft-free, consuming nothing."""
+        consumed = accepted = 0
+        nd_rem = drafted
+        for m in (int(x) for x in counts_row):
+            if m == 0:
+                break
+            k_i = min(nd_rem, K)
+            if m == W:  # full window: all K drafts accepted
+                consumed += k_i
+                accepted += k_i
+                nd_rem -= k_i
+            else:
+                consumed += k_i
+                accepted += m - 1
+                nd_rem = 0
+        return consumed, accepted
 
     def _engine_loop(self) -> None:
         while not self._shutdown:

@@ -24,6 +24,19 @@ Single-token attention inside the step dispatches through
 ``models/llama.forward_with_cache`` to the Pallas decode-attention
 kernel (ops/decode_attention.py) on TPU; off-TPU the same code runs the
 masked-attention reference path, so CPU tests cover the identical loop.
+
+Speculative verification (``spec_window`` > 1) adds a SECOND chunk
+program, ``verify_chunk``: each scan iteration forwards a ``[B, W]``
+candidate window (last committed token + W-1 host-drafted tokens) in
+one batched call, computes the greedy accept mask ON DEVICE (longest
+prefix where draft == argmax), applies the same EOS/budget/row-cap
+stops per WINDOW POSITION, and emits between 1 and W tokens per live
+slot per iteration — still one host sync per chunk. The engine's KV
+cache must be allocated with ``scratch_rows`` extra rows past
+``max_len``: rejected-draft and parked writes land in that scratch
+strip instead of clamping backwards onto valid rows (XLA clamps
+out-of-range dynamic_update_slice starts, which would otherwise let a
+W-row window overwrite resident prefix KV).
 """
 
 from __future__ import annotations
@@ -34,17 +47,40 @@ class DecodeLoop:
 
     Exactly one decode program is compiled per engine (the chunk scan;
     ``chunk=1`` is the degenerate per-token case), plus one prefill
-    program per prompt bucket.
+    program per prompt bucket. With ``spec_window`` > 1 the speculative
+    verify program is compiled alongside (the plain program remains —
+    ticks with zero drafted tokens dispatch it unchanged).
     """
 
-    def __init__(self, cfg, *, max_len: int, chunk: int = 8):
+    def __init__(self, cfg, *, max_len: int, chunk: int = 8,
+                 spec_window: int = 1, spec_chunk: int = 0):
         import jax
 
         self.cfg = cfg
         self.max_len = max_len
         self.chunk = max(1, int(chunk))
+        self.spec_window = max(1, int(spec_window))
+        # Verify iterations per dispatch. The default keeps the token
+        # POSITIONS scanned per dispatch comparable to the plain chunk
+        # (chunk // window): each verify iteration forwards a whole
+        # window, so running `chunk` of them would multiply per-dispatch
+        # compute by W — and every mid-chunk divergence would strand the
+        # remaining iterations draft-free. Fewer, wider dispatches also
+        # put the host back in the loop sooner with FRESH drafts. Raise
+        # it explicitly when the host sync dominates (remote-TPU tunnel).
+        self.spec_chunk = (max(1, int(spec_chunk)) if spec_chunk
+                           else max(1, self.chunk // self.spec_window))
         self._jax = jax
         self._build()
+        if self.spec_window > 1:
+            self._build_verify()
+
+    @property
+    def scratch_rows(self) -> int:
+        """Extra KV rows past ``max_len`` the engine must allocate so
+        verify windows never clamp onto valid rows (0 when the verify
+        program is not built)."""
+        return self.spec_window if self.spec_window > 1 else 0
 
     # ------------------------------------------------------------ compile
 
@@ -126,6 +162,111 @@ class DecodeLoop:
         # Exposed for the equivalence tests: the same single step the
         # chunk scans over, jitted standalone.
         self.decode_step = jax.jit(step)
+
+    def _build_verify(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        cfg = self.cfg
+        max_len = self.max_len
+        W = self.spec_window      # window = 1 committed token + K drafts
+        K = W - 1
+
+        def verify_step(params, cache, tokens, lengths):
+            """One W-token forward per slot: tokens [B, W], lengths [B]
+            (per-slot write offset). Returns greedy targets [B, W] —
+            targets[b, j] is the model's next token after the context
+            plus tokens[b, :j+1]."""
+
+            def one(cache_row, tok, idx):
+                row = {k: v[:, None] for k, v in cache_row.items()}
+                logits, new_row = llama.forward_with_cache(
+                    params, tok[None], row, idx, cfg)
+                return logits[0], {k: v[:, 0]
+                                   for k, v in new_row.items()}
+
+            logits, new_cache = jax.vmap(
+                one, in_axes=({"k": 1, "v": 1}, 0, 0),
+                out_axes=(0, {"k": 1, "v": 1}))(cache, tokens, lengths)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+        def verify_chunk(params, cache, tokens, drafts, ndraft, lengths,
+                         remaining, eos_ids, done):
+            """``spec_chunk`` speculative verify iterations in ONE program.
+
+            tokens [B,1] (each slot's last committed token), drafts
+            [B, spec_chunk, K] (host prompt-lookup proposals; iteration
+            i consumes row i), ndraft [B] (valid drafted tokens per
+            slot, consumed front-to-back), lengths/remaining/eos_ids/
+            done as in ``decode_chunk``.
+
+            Returns (emits [B, spec_chunk, W], counts [B, spec_chunk],
+            new_lengths [B], done [B], cache): iteration i of slot b
+            emitted ``emits[b, i, :counts[b, i]]`` — the accepted draft
+            prefix plus the model's bonus/correction token, cut at the
+            first EOS/budget/row-cap stop. Greedy-equivalence: emitted
+            tokens are exactly what ``decode_chunk`` would emit, in
+            order, for any draft content.
+            """
+            jj = jnp.arange(W)
+
+            def body(carry, window_drafts):  # window_drafts [B, K]
+                cache, tok, ln, rem, nd, dn = carry
+                w = jnp.concatenate([tok, window_drafts], axis=1)
+                # Done slots park their W-row window write entirely in
+                # the scratch strip [max_len, max_len + W).
+                idx = jnp.where(dn, max_len, ln)
+                t, cache = verify_step(params, cache, w, idx)  # [B, W]
+                nd_eff = jnp.clip(nd, 0, K)
+                match = ((jnp.arange(K)[None, :] < nd_eff[:, None])
+                         & (window_drafts == t[:, :K]))
+                # acc = longest accepted draft prefix, in [0, K].
+                acc = jnp.cumprod(match.astype(jnp.int32),
+                                  axis=1).sum(axis=1)
+                # Per-position stop conditions on the CANDIDATE emission
+                # t_j — identical to decode_chunk's post-update checks:
+                # after emitting position j, length is ln+j+1 and the
+                # budget is rem-j-1.
+                ln_j = ln[:, None] + jj[None, :] + 1
+                rem_j = rem[:, None] - jj[None, :] - 1
+                stop = ((t == eos_ids[:, None]) | (rem_j <= 0)
+                        | (ln_j + 1 >= max_len))
+                # Position j emits iff every earlier position emitted
+                # without stopping and j is within the accepted prefix
+                # (+1 for the bonus token).
+                elig = jj[None, :] <= acc[:, None]
+                prev_ok = jnp.concatenate(
+                    [jnp.ones((t.shape[0], 1), bool), ~stop[:, :-1]],
+                    axis=1)
+                alive = ((~dn)[:, None]
+                         & (jnp.cumprod((elig & prev_ok).astype(jnp.int32),
+                                        axis=1) > 0))
+                m = alive.sum(axis=1).astype(jnp.int32)       # [B]
+                stopped = jnp.any(alive & stop, axis=1)
+                new_dn = dn | stopped
+                last = jnp.take_along_axis(
+                    t, jnp.maximum(m - 1, 0)[:, None], axis=1)
+                new_tok = jnp.where((m > 0)[:, None], last, tok)
+                ln = ln + m
+                rem = rem - m
+                # Drafts survive into the next window only after a FULL
+                # window emission (all K drafts accepted, no stop): a
+                # partial accept means the drafted continuation diverged
+                # from the generation, so the rest of the buffer is dead.
+                nd = jnp.where(~new_dn & (m == W), nd - K, 0)
+                return (cache, new_tok, ln, rem, nd, new_dn), (t, m)
+
+            (cache, _t, lengths, remaining, _nd, done), (toks, counts) = \
+                jax.lax.scan(body, (cache, tokens, lengths, remaining,
+                                    ndraft, done),
+                             jnp.swapaxes(drafts, 0, 1),
+                             length=self.spec_chunk)
+            return (jnp.transpose(toks, (1, 0, 2)), counts.T, lengths,
+                    done, cache)
+
+        self.verify_chunk = jax.jit(verify_chunk)
 
     # ------------------------------------------------------------ helpers
 

@@ -38,6 +38,10 @@ class SlotInfo:
     chain: Tuple[int, ...] = ()      # block-chain hashes over ``resident``
     in_use: bool = False
     length: int = 0                  # rows occupied by the CURRENT request
+    spec_rows: int = 0               # rows RESERVED for in-flight draft
+    #                                  tokens (not yet verified; rolled
+    #                                  back to the accepted count when
+    #                                  the verify chunk returns)
 
 
 class KVCacheManager:
@@ -81,8 +85,10 @@ class KVCacheManager:
         return len(self._free)
 
     def used_blocks(self) -> int:
-        """Block-granular occupancy of the in-use slots."""
-        return sum(_ceil_div(s.length, self.block_size)
+        """Block-granular occupancy of the in-use slots (in-flight
+        speculative reservations count: those rows hold draft KV until
+        the verify chunk commits or rolls them back)."""
+        return sum(_ceil_div(s.length + s.spec_rows, self.block_size)
                    for s in self._slots if s.in_use)
 
     def total_blocks(self) -> int:
@@ -161,6 +167,37 @@ class KVCacheManager:
         """Account ``n`` more rows written to an in-use slot (decode)."""
         self._slots[slot].length += n
 
+    # ------------------------------------------------------- speculation
+
+    def begin_speculation(self, slot: int, rows: int) -> None:
+        """Reserve up to ``rows`` rows past ``length`` for a dispatched
+        verify chunk's draft windows. The reservation keeps
+        ``used_blocks()`` honest while the chunk is in flight — draft KV
+        really occupies those rows — but the tokens are NOT resident:
+        they never enter the hash-chain prefix index, so a rejected
+        draft can never serve a prefix-cache hit."""
+        info = self._slots[slot]
+        if not info.in_use:
+            raise ValueError(f"slot {slot} is not in use")
+        if info.spec_rows:
+            raise ValueError(f"slot {slot} already has an in-flight "
+                             "speculation")
+        info.spec_rows = max(0, rows)
+
+    def commit_speculation(self, slot: int, accepted_rows: int) -> None:
+        """Resolve a reservation: ``accepted_rows`` rows were verified
+        (they hold tokens greedy decode would have produced) and become
+        part of ``length``; the rest are rolled back — their contents
+        are rejected drafts, overwritten by the next window or discarded
+        with the slot, and never accounted nor indexed."""
+        info = self._slots[slot]
+        if accepted_rows > info.spec_rows:
+            raise ValueError(
+                f"slot {slot}: accepted {accepted_rows} rows exceeds the "
+                f"{info.spec_rows}-row reservation")
+        info.length += accepted_rows
+        info.spec_rows = 0
+
     def release(self, slot: int,
                 resident_tokens: Optional[Sequence[int]] = None) -> None:
         """Return a slot to the free pool. ``resident_tokens`` are the
@@ -173,6 +210,8 @@ class KVCacheManager:
             return
         info.in_use = False
         info.length = 0
+        info.spec_rows = 0  # a pending reservation dies with the slot
+        #                     (device-failure path releases mid-flight)
         info.resident = tuple(resident_tokens or ())
         info.chain = tuple(self._chain(info.resident))
         for h in info.chain:

@@ -51,6 +51,18 @@ PREFIX_REUSED_TOTAL = _m.Counter(
 HOST_SYNCS_TOTAL = _m.Counter(
     "rtpu_llm_decode_host_syncs_total",
     "device->host fetches issued by the decode loop (one per chunk)")
+SPEC_DRAFTED_TOTAL = _m.Counter(
+    "rtpu_llm_spec_drafted_total",
+    "draft tokens proposed by prompt-lookup speculation")
+SPEC_ACCEPTED_TOTAL = _m.Counter(
+    "rtpu_llm_spec_accepted_total",
+    "draft tokens accepted by the device verify step")
+SPEC_ACCEPT_RATE = _m.Gauge(
+    "rtpu_llm_spec_accept_rate",
+    "accepted/drafted ratio since engine start")
+SPEC_CHUNKS_TOTAL = _m.Counter(
+    "rtpu_llm_spec_chunks_total",
+    "decode chunks dispatched through the speculative verify program")
 
 
 class EngineMetrics:
@@ -66,6 +78,9 @@ class EngineMetrics:
         self.prefill_tokens = 0
         self.host_syncs = 0        # decode-loop device fetches
         self.decode_steps = 0      # live slot-steps advanced on device
+        self.spec_drafted = 0      # draft tokens proposed
+        self.spec_accepted = 0     # draft tokens verified + accepted
+        self.spec_chunks = 0       # chunks through the verify program
         self._ttfts = collections.deque(maxlen=256)   # seconds
         self._tpots = collections.deque(maxlen=1024)  # seconds/token
 
@@ -100,6 +115,22 @@ class EngineMetrics:
             TOKENS_TOTAL.inc(tokens, labels=self._labels)
             TPOT_SECONDS.observe(elapsed_s / tokens, labels=self._labels)
 
+    def record_spec(self, drafted: int, accepted: int) -> None:
+        """One speculative verify chunk: ``drafted`` tokens proposed
+        across the roster, ``accepted`` of them verified correct."""
+        with self._lock:
+            self.spec_chunks += 1
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            rate = (self.spec_accepted / self.spec_drafted
+                    if self.spec_drafted else 0.0)
+        SPEC_CHUNKS_TOTAL.inc(labels=self._labels)
+        if drafted:
+            SPEC_DRAFTED_TOTAL.inc(drafted, labels=self._labels)
+        if accepted:
+            SPEC_ACCEPTED_TOTAL.inc(accepted, labels=self._labels)
+        SPEC_ACCEPT_RATE.set(rate, labels=self._labels)
+
     def record_depths(self, queue_depth: int, active: int,
                       prefix_hit_rate: float) -> None:
         QUEUE_DEPTH.set(queue_depth, labels=self._labels)
@@ -122,6 +153,20 @@ class EngineMetrics:
                 "prefill_tokens": self.prefill_tokens,
                 "decode_host_syncs": self.host_syncs,
                 "decode_steps": self.decode_steps,
+                # decode tokens delivered per device token-position
+                # scanned (first tokens come from prefill, so they're
+                # excluded): < 1.0 when slots freeze mid-chunk or
+                # drafted window positions get rejected; 1.0 = every
+                # scanned position produced a delivered token.
+                "decode_utilization": round(
+                    (self.tokens_generated - self.requests)
+                    / self.decode_steps, 4) if self.decode_steps else 0.0,
+                "spec_chunks": self.spec_chunks,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
+                "spec_accept_rate": round(
+                    self.spec_accepted / self.spec_drafted, 4)
+                    if self.spec_drafted else 0.0,
                 "ttft_ms_p50": round(self._p50(self._ttfts) * 1e3, 3),
                 "tpot_ms_p50": round(self._p50(self._tpots) * 1e3, 3),
             }

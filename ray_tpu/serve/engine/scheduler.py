@@ -47,6 +47,10 @@ class EngineRequest:
     cached_len: int = 0    # prompt prefix served from the prefix cache
     arrival_t: float = 0.0
     first_token_t: float = 0.0
+    # Speculative-decoding state (None when the engine runs spec-off):
+    # the adaptive draft allowance + lifetime drafted/accepted counters
+    # (drafter.SpecControl), attached by the engine at request creation.
+    spec: Optional[Any] = None
 
     def remaining(self) -> int:
         """Token budget left (per-request accounting)."""

@@ -8,7 +8,10 @@ in this file; it is now a real subsystem — ``ray_tpu/serve/engine/``
 this module keeps the stable public surface:
 
 - ``LLMEngine``            — the engine (continuous batching, static
-  shapes, device-resident K-step decode, prefix caching).
+  shapes, device-resident K-step decode, prefix caching, and — with
+  ``spec_draft_len`` > 0 — prompt-lookup speculative decoding with
+  on-device multi-token verification; greedy output is token-identical
+  either way, see serve/engine/README.md).
 - ``GenerationRequest``    — the request record (engine.scheduler's
   ``EngineRequest``).
 - ``build_llm_deployment`` — a ready-to-run ``@serve.deployment``.
@@ -41,7 +44,11 @@ def _bucket(n: int, buckets) -> int:
 
 def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
                          use_tpu: bool = False, engine_kwargs=None):
-    """A ready-to-run @serve.deployment wrapping LLMEngine."""
+    """A ready-to-run @serve.deployment wrapping LLMEngine.
+
+    ``engine_kwargs`` flow straight into the ``LLMEngine`` constructor —
+    including the speculative-decoding knobs (``spec_draft_len``,
+    ``spec_ngram_max``, ``spec_adaptive``)."""
     from ray_tpu.serve import api as serve_api
 
     engine_kwargs = engine_kwargs or {}

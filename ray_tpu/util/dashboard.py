@@ -18,6 +18,7 @@ or `python -m ray_tpu.util.dashboard --address HOST:PORT [--port 8265]`.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from typing import Any, Dict, Optional
 
@@ -82,6 +83,7 @@ async function refresh() {
         ['submission_id', 'status', 'entrypoint', 'message']);
     html += '<h2>Object store</h2><pre id="objstore"></pre>';
     html += '<h2>Scheduling &amp; locality</h2><pre id="sched"></pre>';
+    html += '<h2>LLM engines</h2><pre id="llm"></pre>';
     document.getElementById('tables').innerHTML = html;
     // The object-store summary goes in via textContent, never innerHTML:
     // its strings (spill paths, debug labels) can carry user-controlled
@@ -90,6 +92,9 @@ async function refresh() {
       JSON.stringify(api.objects, null, 1);
     document.getElementById('sched').textContent =
       JSON.stringify(api.scheduler, null, 1);
+    // Engine names come from user code: textContent, same as above.
+    document.getElementById('llm').textContent =
+      JSON.stringify(api.llm_engines, null, 1);
     document.getElementById('meta').textContent =
       new Date().toLocaleTimeString() + ' — ' + api.nodes.length +
       ' nodes, ' + api.actors.length + ' actors';
@@ -137,6 +142,84 @@ refresh();
 setInterval(refresh, 5000);
 </script>
 </body></html>"""
+
+
+# Per-engine serving health shown in the "LLM engines" panel: the
+# throughput/queue gauges plus the speculative-decoding counters
+# (drafted/accepted/accept-rate) from serve/engine/metrics.py.
+_LLM_PANEL_METRICS = (
+    "rtpu_llm_queue_depth", "rtpu_llm_active_slots",
+    "rtpu_llm_prefix_hit_rate", "rtpu_llm_requests_total",
+    "rtpu_llm_tokens_generated_total", "rtpu_llm_decode_host_syncs_total",
+    "rtpu_llm_spec_drafted_total", "rtpu_llm_spec_accepted_total",
+    "rtpu_llm_spec_accept_rate", "rtpu_llm_spec_chunks_total",
+)
+
+
+def _llm_engines_payload() -> Dict[str, Dict[str, float]]:
+    """Engine-labelled rtpu_llm_* values grouped per engine.
+
+    Two sources, cluster first: the prometheus snapshots every reporting
+    process publishes to the head KV (serve replicas hosting an engine
+    live in worker processes — their counters arrive only this way),
+    overlaid with this process's own registry (fresher for any engine
+    embedded in the dashboard's driver)."""
+    from ray_tpu.util import metrics as _m
+
+    out: Dict[str, Dict[str, float]] = {}
+    wanted = set(_LLM_PANEL_METRICS)
+
+    def fold(name: str, labels: Dict[str, str], value: float) -> None:
+        engine = labels.get("engine", "<unlabelled>")
+        out.setdefault(engine, {})[name[len("rtpu_llm_"):]] = \
+            round(value, 4)
+
+    try:
+        from ray_tpu.util import state
+
+        for text in state.cluster_metrics().values():
+            for name, labels, value in _parse_prometheus(text):
+                if name in wanted:
+                    fold(name, labels, value)
+    except Exception:
+        pass  # no cluster (engine-only drivers): local registry below
+    for name in _LLM_PANEL_METRICS:
+        metric = _m.get_metric(name)
+        if metric is None:
+            continue
+        for labels, value in metric.items():
+            fold(name, labels, value)
+    return out
+
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE = {r"\\": "\\", r"\"": '"', r"\n": "\n"}
+
+
+def _parse_prometheus(text: str):
+    """Minimal prometheus-text reader: yields (name, labels, value) for
+    plain sample lines (comments/histogram buckets skipped upstream by
+    the name filter). Label values are matched as quoted strings with
+    escapes — engine/actor names are arbitrary user text, and a naive
+    comma split would mis-attribute metrics for a name containing
+    ',' or '"' (util/metrics escapes them on render)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, val = line.rsplit(" ", 1)
+            if "{" in head:
+                name = head.split("{", 1)[0]
+                labels = {
+                    k: re.sub(r'\\[\\"n]',
+                              lambda m: _UNESCAPE[m.group(0)], v)
+                    for k, v in _LABEL_RE.findall(head)}
+            else:
+                name, labels = head, {}
+            yield name, labels, float(val)
+        except ValueError:
+            continue
 
 
 def _api_payload() -> Dict[str, Any]:
@@ -192,11 +275,16 @@ def _api_payload() -> Dict[str, Any]:
             scheduler["pull_manager_nodes_sampled"] = 16
     except Exception:
         pass
+    llm: Dict[str, Any] = {}
+    try:
+        llm = _llm_engines_payload()
+    except Exception:
+        pass
     return {"nodes": state.list_nodes(), "actors": state.list_actors(),
             "tasks": state.list_tasks()[-100:],
             "objects": state.summarize_objects(),
             "jobs": jobs, "pending_demand": demand,
-            "scheduler": scheduler}
+            "scheduler": scheduler, "llm_engines": llm}
 
 
 def _timeline_payload() -> list:

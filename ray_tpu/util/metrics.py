@@ -38,10 +38,20 @@ class Metric:
         with _REGISTRY_LOCK:
             _REGISTRY[name] = self
 
+    @staticmethod
+    def _escape_label(v: str) -> str:
+        # Prometheus text exposition: backslash, quote, and newline in
+        # label values must be escaped — label values are arbitrary
+        # user strings (actor names, engine names) and an unescaped
+        # quote/comma corrupts every consumer's parse of the line.
+        return (str(v).replace("\\", r"\\").replace('"', r'\"')
+                .replace("\n", r"\n"))
+
     def _fmt_labels(self, key: Tuple) -> str:
         if not key:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        inner = ",".join(f'{k}="{self._escape_label(v)}"'
+                         for k, v in key)
         return "{" + inner + "}"
 
     def render(self) -> List[str]:
@@ -52,6 +62,12 @@ class Metric:
         for key, v in items:
             lines.append(f"{self.name}{self._fmt_labels(key)} {v}")
         return lines
+
+    def items(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) pair recorded on this metric — the
+        public per-label snapshot (readers must not touch _values)."""
+        with self._lock:
+            return [(dict(key), v) for key, v in self._values.items()]
 
 
 class Counter(Metric):

@@ -57,6 +57,13 @@ for _stale in glob.glob("/dev/shm/rtpu_store_*"):
         pass
 
 
+def pytest_configure(config):
+    # Tier-1 CI runs `-m 'not slow'` (ROADMAP): long sweeps opt out of
+    # the 870s budget with this marker and run in the full suite only.
+    config.addinivalue_line(
+        "markers", "slow: long-running sweep excluded from tier-1")
+
+
 @pytest.fixture
 def local_init():
     import ray_tpu
