@@ -38,6 +38,15 @@ Rows:
   ``locality_hit_rate`` and ``object_bytes_pulled_per_task`` for the
   default scheduler vs a forced-random-placement baseline of the same
   workload.
+- dataplane — multi-writer object-plane suite (``--dataplane`` runs it
+  standalone): K-process concurrent large puts through one sharded shm
+  store (``single_put_gbps``, ``multi_put_gbps``, ``put_scaling_ratio``
+  = multi/single — concurrent writers must not fall below one), node-to-
+  node pull bandwidth over the scatter-gather transfer path
+  (``pull_gbps``), and n-callers x n-actors calls with array args
+  (``actor_args_nn_per_s``). Needs a loadable native store lib
+  (RTPU_SHM_STORE_SO on containers whose glibc rejects the checked-in
+  .so).
 
 Structure: measurements run in CHILD subprocesses; the parent supervises
 with retry + backoff. A TPU backend init failure is cached for the life
@@ -73,6 +82,7 @@ CHILD_TIMEOUT_S = 2100     # first TPU compiles (4 programs) can take minutes
 SERVE_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 LOCALITY_TIMEOUT_S = 420   # per locality child (boots a 4-node cluster)
+DATAPLANE_TIMEOUT_S = 420  # dataplane child (store bench + 2-node cluster)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -635,6 +645,203 @@ def _merge_locality_rows(rows: list) -> dict:
 
 
 # --------------------------------------------------------------------------
+# dataplane suite (--dataplane): multi-writer store + pull + actor args
+# --------------------------------------------------------------------------
+
+_DP_STORE = "/rtpu_bench_dp"
+_DP_OBJ = 8 << 20
+_DP_SECONDS = 3.0
+
+
+def _dp_writer(idx: int, barrier, q) -> None:
+    """One put+delete writer process over the shared bench store (spawned
+    via multiprocessing; must be module-level for pickling)."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.shm_store import ShmStore
+
+    store = ShmStore.open(_DP_STORE)
+    payload = bytearray(_DP_OBJ)
+
+    def oid(i):
+        return ObjectID(bytes([idx]) + i.to_bytes(8, "little") + b"\0" * 19)
+
+    for i in range(2):  # warm the affine block (first-touch faults)
+        store.put_bytes(oid(1000000 + i), payload)
+        store.delete(oid(1000000 + i))
+    barrier.wait(timeout=60)
+    n = 0
+    t0 = time.perf_counter()
+    stop = t0 + _DP_SECONDS
+    while time.perf_counter() < stop:
+        store.put_bytes(oid(n), payload)
+        store.delete(oid(n))
+        n += 1
+    q.put((n, time.perf_counter() - t0))
+
+
+def _dp_put_gbps(k: int) -> float:
+    """Aggregate put bandwidth of k concurrent writer PROCESSES (each in
+    its own interpreter and page tables — the real multi-client shape)."""
+    import multiprocessing as mp
+
+    from ray_tpu.core.shm_store import ShmStore
+
+    store = ShmStore.create(_DP_STORE, 768 << 20, prefault=False)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        barrier = ctx.Barrier(k)
+        procs = [ctx.Process(target=_dp_writer, args=(i, barrier, q))
+                 for i in range(k)]
+        for p in procs:
+            p.start()
+        res = [q.get(timeout=120) for _ in range(k)]
+        for p in procs:
+            p.join(timeout=30)
+        return sum(n * _DP_OBJ / dt for n, dt in res) / 1e9
+    finally:
+        store.close()
+
+
+def dataplane_child_main() -> None:
+    """Store put scaling, then a 2-node cluster for pull bandwidth and
+    n x n actor calls with array args. One JSON row per metric."""
+    _pin_platform()
+    rows = []
+
+    single = _dp_put_gbps(1)
+    multi = _dp_put_gbps(4)
+    ratio = round(multi / single, 3) if single else None
+    rows.append({"metric": "single_put_gbps", "value": round(single, 2),
+                 "unit": "GB/s", "object_mib": _DP_OBJ >> 20, "writers": 1})
+    rows.append({"metric": "multi_put_gbps", "value": round(multi, 2),
+                 "unit": "GB/s", "object_mib": _DP_OBJ >> 20, "writers": 4})
+    rows.append({"metric": "put_scaling_ratio", "value": ratio,
+                 "unit": "multi/single"})
+    for r in rows:
+        print(json.dumps(r), flush=True)
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.core.runtime_context import require_runtime
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    rt.init(num_cpus=2)
+    try:
+        runtime = require_runtime()
+        extra = runtime.add_node(num_cpus=2)
+
+        # --- pull bandwidth: object sealed on the extra node, pulled by
+        # the driver's node manager over the scatter-gather chunk path.
+        @rt.remote
+        def produce(nbytes: int):
+            import numpy as _np
+
+            return _np.full(nbytes, 7, dtype=_np.uint8)
+
+        pull_mib = 64
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=extra.node_id)).remote(pull_mib << 20)
+        rt.wait([ref], timeout=120)
+        home_addr = runtime.nodes()[0]["address"]
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+        t0 = time.perf_counter()
+        ok = runtime._pool.get(home_addr).call(
+            "pull_object", ref.id().binary(), 60_000, timeout=90)
+        dt = time.perf_counter() - t0
+        rows.append({
+            "metric": "pull_gbps",
+            "value": round((pull_mib << 20) / dt / 1e9, 2) if ok else 0.0,
+            "unit": "GB/s", "object_mib": pull_mib,
+            "chunk_bytes": int(_cfg.object_transfer_chunk_bytes)})
+        print(json.dumps(rows[-1]), flush=True)
+
+        # --- n x n actor calls with a numpy array argument (the
+        # actor_calls_with_arg_async_n_n shape).
+        import threading
+
+        @rt.remote
+        class Sink:
+            def take(self, arr):
+                return arr.nbytes
+
+        n_actors = 4
+        actors = [Sink.remote() for _ in range(n_actors)]
+        rt.get([a.take.remote(np.zeros(8, np.uint8)) for a in actors],
+               timeout=120)  # boot + compile path
+        arg = np.zeros(32 << 10, np.uint8)
+        counts = [0] * n_actors
+        stop_at = time.perf_counter() + 3.0
+
+        def caller(i):
+            a = actors[i]
+            while time.perf_counter() < stop_at:
+                futs = [a.take.remote(arg) for _ in range(32)]
+                rt.get(futs, timeout=60)
+                counts[i] += len(futs)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=caller, args=(i,))
+                   for i in range(n_actors)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        rows.append({"metric": "actor_args_nn_per_s",
+                     "value": round(sum(counts) / elapsed, 1),
+                     "unit": "calls/s", "actors": n_actors,
+                     "arg_bytes": int(arg.nbytes)})
+        print(json.dumps(rows[-1]), flush=True)
+    finally:
+        rt.shutdown()
+
+
+def _dataplane_rows() -> list:
+    """Run the dataplane child; returns its rows (or one error row)."""
+    try:
+        proc = _run(["--dataplane-child"], DATAPLANE_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "dataplane",
+                 "error": f"timeout {DATAPLANE_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "dataplane",
+                "error": "rc=%d: %s" % (proc.returncode, " | ".join(tail))})
+    return out
+
+
+def dataplane_main() -> int:
+    """Standalone ``--dataplane``: rows + one merged tail line."""
+    rows = _dataplane_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_dataplane_rows(rows)))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+def _merge_dataplane_rows(rows: list) -> dict:
+    by = {r.get("metric"): r for r in rows}
+    merged = {"metric": "dataplane"}
+    for k in ("single_put_gbps", "multi_put_gbps", "put_scaling_ratio",
+              "pull_gbps", "actor_args_nn_per_s"):
+        if k in by and "error" not in by[k]:
+            merged[k] = by[k].get("value")
+    errs = [r["error"] for r in rows if "error" in r]
+    if errs:
+        merged["error"] = errs[0]
+    return merged
+
+
+# --------------------------------------------------------------------------
 # parent supervisor
 # --------------------------------------------------------------------------
 
@@ -803,6 +1010,16 @@ def main() -> int:
     for r in loc_rows:
         print(json.dumps(r), flush=True)
 
+    # Phase 5: dataplane suite on CPU (multi-writer store + pull + actor
+    # args). Tracked round-over-round from this PR.
+    dp_rows: list = []
+    try:
+        dp_rows = _dataplane_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        dp_rows = [{"metric": "dataplane", "error": repr(e)[:200]}]
+    for r in dp_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -847,6 +1064,13 @@ def main() -> int:
                 merged[k] = loc_merged[k]
     else:
         merged["locality_error"] = loc_merged["error"]
+    dp_merged = _merge_dataplane_rows(dp_rows)
+    for k in ("single_put_gbps", "multi_put_gbps", "put_scaling_ratio",
+              "pull_gbps", "actor_args_nn_per_s"):
+        if dp_merged.get(k) is not None:
+            merged[k] = dp_merged[k]
+    if "error" in dp_merged:
+        merged["dataplane_error"] = dp_merged["error"]
     print(json.dumps(merged))
     return 0
 
@@ -862,6 +1086,10 @@ if __name__ == "__main__":
         sys.exit(locality_child_main())
     if "--locality" in sys.argv:
         sys.exit(locality_main())
+    if "--dataplane-child" in sys.argv:
+        sys.exit(dataplane_child_main())
+    if "--dataplane" in sys.argv:
+        sys.exit(dataplane_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

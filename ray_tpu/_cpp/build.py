@@ -2,6 +2,15 @@
 
 Run directly (`python ray_tpu/_cpp/build.py`) or let
 `ray_tpu.core.shm_store.ensure_built()` invoke it lazily on first use.
+
+NOTE: shm_store.cc layout v2 (sharded arena) changed the mapped segment
+format AND the library ABI (rtpu_store_create gained n_shards,
+rtpu_obj_create gained pref_shard). Any previously built .so — including
+one an RTPU_SHM_STORE_SO override points at — must be rebuilt from the
+current source; the Python client checks rtpu_lib_layout_version() at
+load and refuses stale builds with a clear error. On containers whose
+glibc rejects the checked-in binary, build OUT of tree and point
+RTPU_SHM_STORE_SO at the result (see .claude/skills/verify/SKILL.md).
 """
 
 from __future__ import annotations

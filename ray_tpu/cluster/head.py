@@ -515,6 +515,13 @@ class HeadServer:
                                      exclude_set, relax_spill=saturated)
         return n.node_id, n.address, n.store_name
 
+    def rpc_pick_nodes(self, conn, requests):
+        """Batched pick_node: one frame places a whole dispatch round's
+        lease requests (per-request frames + dispatch overhead at the head
+        were a multi-submitter bottleneck). Each request is the pick_node
+        argument tuple; the reply is the per-request pick list."""
+        return [self.rpc_pick_node(conn, *req) for req in requests]
+
     def _apply_locality(self, ranked: List[NodeInfo],
                         input_objects: List[bytes],
                         resources: Dict[str, float],
@@ -835,6 +842,27 @@ class HeadServer:
                 if not locs:
                     del self._object_dir[oid]
                     self._object_sizes.pop(oid, None)
+        return True
+
+    def rpc_object_batch(self, conn, node_id: str, entries):
+        """Batched directory updates from one owner/node: entries are
+        ("add", oid, size) / ("rm", oid, None) in submission order — one
+        frame + one lock acquisition per put burst instead of per object
+        (the per-put notify serialized multi-writer put throughput at the
+        head's dispatch path)."""
+        with self._lock:
+            for kind, oid, size in entries:
+                if kind == "add":
+                    self._object_dir.setdefault(oid, set()).add(node_id)
+                    if size:
+                        self._object_sizes[oid] = int(size)
+                else:
+                    locs = self._object_dir.get(oid)
+                    if locs:
+                        locs.discard(node_id)
+                        if not locs:
+                            del self._object_dir[oid]
+                            self._object_sizes.pop(oid, None)
         return True
 
     def rpc_object_locations(self, conn, oid: bytes,

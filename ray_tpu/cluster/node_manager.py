@@ -1121,18 +1121,23 @@ class NodeManager:
     @blocking_rpc
     def rpc_fetch_object(self, conn, oid_bytes: bytes, offset: int,
                          chunk: int, timeout_ms: int):
-        """Serve a chunk of a local sealed object to a remote node."""
+        """Serve a chunk of a local sealed object to a remote node.
+
+        Zero-copy: the reply carries a pinned VIEW of the source shm block
+        (PickleBuffer rides the scatter frame straight into sendmsg — the
+        old ``bytes(...)`` staged a full host copy of every served chunk);
+        the BufferLease drops the pin once the frame is on the wire."""
+        import pickle
+
         from ray_tpu.core.ids import ObjectID
+        from ray_tpu.cluster.protocol import BufferLease
 
         buf = self.store.get(ObjectID(oid_bytes), timeout_ms=timeout_ms)
         if buf is None:
             return None
-        try:
-            total = len(buf.buffer)
-            data = bytes(buf.buffer[offset:offset + chunk])
-            return total, data
-        finally:
-            buf.release()
+        total = len(buf.buffer)
+        view = buf.buffer[offset:offset + chunk]
+        return BufferLease((total, pickle.PickleBuffer(view)), buf.release)
 
     @blocking_rpc
     def rpc_pull_object(self, conn, oid_bytes: bytes, timeout_ms: int):
@@ -1243,13 +1248,20 @@ class NodeManager:
                     raise IOError("multi-source pull failed")
             else:
                 for off in offsets:
-                    nxt = src.call(
+                    # Chunk length is known, so the socket bytes land
+                    # DIRECTLY in this object's shm view (call_into sink)
+                    # — the staging-buffer copy only happens if the reply
+                    # came back in the legacy frame form.
+                    want = min(chunk, total - off)
+                    nxt, landed = src.call_into(
                         "fetch_object", oid.binary(), off, chunk, 0,
+                        sink=mv[off:off + want],
                         timeout=max(1.0, deadline - time.monotonic()))
                     if nxt is None:
                         raise IOError("object vanished mid-pull")
-                    _, data = nxt
-                    mv[off:off + len(data)] = data
+                    if not landed:
+                        _, data = nxt
+                        mv[off:off + len(data)] = data
         except BaseException:
             self.store.abort(oid)
             return False
@@ -1288,23 +1300,27 @@ class NodeManager:
                 with failed_lock:
                     failed.extend(stripe)
                 return
+            total = len(mv)
             for j, off in enumerate(stripe):
                 if time.monotonic() >= deadline:
                     with failed_lock:
                         failed.extend(stripe[j:])
                     return
                 try:
-                    nxt = client.call(
+                    nxt, landed = client.call_into(
                         "fetch_object", oid.binary(), off, chunk, 0,
+                        sink=mv[off:off + min(chunk, total - off)],
                         timeout=max(1.0, deadline - time.monotonic()))
                 except Exception:
                     nxt = None
+                    landed = False
                 if nxt is None:
                     with failed_lock:
                         failed.append(off)
                     continue
-                _, data = nxt
-                mv[off:off + len(data)] = data
+                if not landed:
+                    _, data = nxt
+                    mv[off:off + len(data)] = data
 
         threads = [threading.Thread(target=fetch_stripe, args=(k,),
                                     daemon=True,
@@ -1314,20 +1330,24 @@ class NodeManager:
             t.start()
         for t in threads:
             t.join()
+        total = len(mv)
         for off in failed:
             got = False
             for addr in addrs:
                 if time.monotonic() >= deadline:
                     return False  # honor the caller's pull timeout
                 try:
-                    nxt = self._pool.get(addr).call(
+                    nxt, landed = self._pool.get(addr).call_into(
                         "fetch_object", oid.binary(), off, chunk, 0,
+                        sink=mv[off:off + min(chunk, total - off)],
                         timeout=max(1.0, deadline - time.monotonic()))
                 except Exception:
                     nxt = None
+                    landed = False
                 if nxt is not None:
-                    _, data = nxt
-                    mv[off:off + len(data)] = data
+                    if not landed:
+                        _, data = nxt
+                        mv[off:off + len(data)] = data
                     got = True
                     break
             if not got:

@@ -7,10 +7,25 @@ per peer — control-plane fan-in is O(workers/node), not O(tasks)), and a
 thread-safe client with request pipelining (many in-flight calls multiplexed
 over one socket, matched by request id).
 
-Frame: u32 len | payload. Payload = Serializer-encoded tuple
+Payloads are tuples:
     (req_id, method, args)        request  (req_id > 0)
     (0, method, args)             one-way notify
     (-req_id, ok: bool, result)   response
+
+Two frame forms on the wire:
+
+  legacy   u32 len | flat Serializer encoding        (small messages)
+  scatter  u32 (0x80000000 | header_len) | u32 nbufs | i64 rid |
+           u64 buf_len[nbufs] | header | buffers...
+
+The scatter form carries the payload's pickle-5 out-of-band buffers as raw
+trailing segments: the sender feeds them straight to ``sendmsg`` (payloads
+holding large numpy arrays / shm views are never flattened host-side), and
+the receiver lands each one in a freshly ``recv_into``-ed buffer — or, for
+a response whose caller registered a sink (``RpcClient.call_into``),
+DIRECTLY into the caller-supplied memoryview (e.g. a shm ``create_buffer``
+view), so a pulled object chunk crosses the host at most once. The ``rid``
+rides outside the pickle so the reader can route buffers before decoding.
 
 Chaos injection (`rpc_chaos_failure_prob` flag) drops requests/responses to
 exercise retry paths, mirroring RAY_testing_rpc_failure.
@@ -50,37 +65,176 @@ class RemoteError(RpcError):
         self.cause = cause
 
 
-def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
-    # sendmsg gathers header+payload in one syscall without concatenating
-    # (the concat was one full copy per frame on the hot path).
+_SCATTER_BIT = 0x80000000
+_SCATTER_META = struct.Struct("<Iq")  # nbufs, rid
+# At most this many out-of-band segments per frame (IOV sanity; payloads
+# with more buffers flatten to the legacy form).
+_SCATTER_MAX_BUFS = 256
+
+
+class BufferLease:
+    """Wraps an RPC handler's result whose out-of-band buffers BORROW
+    memory (e.g. pinned shm views): the payload is sent scatter-gather
+    straight from the borrowed views — no ``bytes()`` staging copy — and
+    ``release`` runs once the frame is on the socket (or dropped)."""
+
+    __slots__ = ("value", "_release")
+
+    def __init__(self, value: Any, release: Callable):
+        self.value = value
+        self._release = release
+
+    def release(self) -> None:
+        rel, self._release = self._release, None
+        if rel is not None:
+            try:
+                rel()
+            except Exception:
+                pass
+
+
+def _shutdown_socket(sock: socket.socket) -> None:
+    """shutdown + close: unlike a bare close(), shutdown() reliably wakes
+    any thread blocked in recv on the socket (close only frees the fd)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _as_byte_view(b) -> memoryview:
+    mv = b if isinstance(b, memoryview) else memoryview(b)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    return mv
+
+
+def _payload_parts(payload: Any) -> list:
+    """Serialize a payload into wire parts. One part (legacy frame) for
+    small / buffer-free payloads; otherwise a scatter frame whose large
+    buffers are passed through as separate segments for ``sendmsg`` —
+    large objects are never flattened host-side."""
+    header, buffers = SERIALIZER.serialize(payload)
+    if buffers and len(buffers) <= _SCATTER_MAX_BUFS:
+        oob = sum(b.nbytes for b in buffers)
+        if oob >= cfg.rpc_scatter_min_bytes:
+            rid = payload[0] if type(payload) is tuple and payload and \
+                isinstance(payload[0], int) else 0
+            prefix = (struct.pack("<I", _SCATTER_BIT | len(header))
+                      + _SCATTER_META.pack(len(buffers), rid)
+                      + struct.pack("<%dQ" % len(buffers),
+                                    *(b.nbytes for b in buffers)))
+            return [memoryview(prefix), memoryview(header)] + [
+                _as_byte_view(b) for b in buffers]
+    total = SERIALIZER.encode_total_size(header, buffers)
+    if total >= _SCATTER_BIT:
+        # The length prefix's top bit is the scatter flag: a >=2 GiB flat
+        # frame would be misparsed as a scatter header on the receiver and
+        # desynchronize the connection. In-band payloads this large are
+        # pathological (big values go through the object store) — fail
+        # loudly at the sender instead.
+        raise ValueError(
+            f"RPC frame of {total} bytes exceeds the 2 GiB flat-frame "
+            "limit; pass large data via the object store or as pickle-5 "
+            "out-of-band buffers")
+    out = bytearray(4 + total)
+    _LEN.pack_into(out, 0, total)
+    SERIALIZER.encode_into(memoryview(out)[4:], header, buffers)
+    return [memoryview(out)]
+
+
+def _sendmsg_all(sock: socket.socket, views: list) -> None:
+    """Gather-send every view (handles partial sends and IOV limits)."""
+    while views:
+        sent = sock.sendmsg(views[:_SCATTER_MAX_BUFS + 8])
+        i = 0
+        while i < len(views) and sent >= len(views[i]):
+            sent -= len(views[i])
+            i += 1
+        views = views[i:]
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
+def _send_payload(sock: socket.socket, payload: Any,
+                  lock: threading.Lock) -> None:
+    parts = _payload_parts(payload)
     with lock:
-        n = 4 + len(payload)
-        sent = sock.sendmsg((_LEN.pack(len(payload)), payload))
-        if sent != n:
-            # Partial send (large payload): fall back to sendall for the rest.
-            rest = (_LEN.pack(len(payload)) + payload)[sent:]
-            sock.sendall(rest)
+        _sendmsg_all(sock, parts)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n:
+def _send_frame(sock: socket.socket, payload: bytes,
+                lock: threading.Lock) -> None:
+    """Send a pre-encoded flat payload as a legacy frame."""
+    with lock:
+        _sendmsg_all(sock, [memoryview(_LEN.pack(len(payload))),
+                            _as_byte_view(payload)])
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> bool:
+    """Fill ``view`` from the socket via recv_into — a single preallocated
+    (or shm-destined) destination, no chunk list + join copy."""
+    pos, n = 0, len(view)
+    while pos < n:
         try:
-            b = sock.recv(min(n, cfg.rpc_recv_chunk_bytes))
+            r = sock.recv_into(view[pos:],
+                               min(n - pos, cfg.rpc_recv_chunk_bytes))
         except OSError:
-            return None
-        if not b:
-            return None
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+            return False
+        if not r:
+            return False
+        pos += r
+    return True
 
 
-def _recv_frame(sock: socket.socket) -> Optional[bytes]:
-    hdr = _recv_exact(sock, 4)
-    if hdr is None:
+def _recv_msg(sock: socket.socket, sink_for: Optional[Callable] = None
+              ) -> Optional[Tuple[Any, bool]]:
+    """Receive + decode one frame. Returns (payload, sink_used) or None on
+    EOF/error. ``sink_for(rid, lens)`` may return caller-owned writable
+    views to land a scatter frame's buffers in (zero staging copy)."""
+    hdr = bytearray(4)
+    if not _recv_exact_into(sock, memoryview(hdr)):
         return None
-    return _recv_exact(sock, _LEN.unpack(hdr)[0])
+    (n,) = _LEN.unpack(hdr)
+    if not n & _SCATTER_BIT:
+        buf = memoryview(bytearray(n))
+        if not _recv_exact_into(sock, buf):
+            return None
+        try:
+            return SERIALIZER.decode(buf), False
+        except Exception:
+            return None
+    hlen = n & ~_SCATTER_BIT
+    meta = bytearray(_SCATTER_META.size)
+    if not _recv_exact_into(sock, memoryview(meta)):
+        return None
+    nbufs, rid = _SCATTER_META.unpack(meta)
+    if nbufs > _SCATTER_MAX_BUFS:
+        return None  # corrupt frame
+    lens_raw = bytearray(8 * nbufs)
+    if not _recv_exact_into(sock, memoryview(lens_raw)):
+        return None
+    lens = struct.unpack("<%dQ" % nbufs, lens_raw)
+    header = bytearray(hlen)
+    if not _recv_exact_into(sock, memoryview(header)):
+        return None
+    sinks = sink_for(rid, lens) if sink_for is not None else None
+    buffers = []
+    for i, blen in enumerate(lens):
+        dest = sinks[i] if sinks is not None else memoryview(
+            bytearray(blen))
+        if not _recv_exact_into(sock, dest):
+            return None
+        buffers.append(dest)
+    try:
+        return SERIALIZER.deserialize(bytes(header), buffers), \
+            sinks is not None
+    except Exception:
+        return None
 
 
 def _chaos_drop() -> bool:
@@ -96,26 +250,67 @@ def _stats_on() -> bool:
     return bool(cfg.event_stats_enabled)
 
 
-_event_stats: dict = {}
-_event_stats_lock = threading.Lock()
+# Lock-free per-thread accumulation, folded on read: the old single global
+# lock serialized every RPC dispatch across every peer connection — the
+# stats meant to OBSERVE the multi-peer dispatch path were throttling it.
+# Each dispatch thread appends to its own dict (GIL-atomic); the rare
+# reader folds all thread dicts. Per-field tearing across a concurrent
+# update is possible and acceptable for monitoring counters.
+_event_stats_local = threading.local()
+_event_stats_all: list = []  # [per-thread {method: [count, errors, total_s, max_s]}]
+_event_stats_retired: dict = {}  # folded dicts of finished recorder threads
+_event_stats_lock = threading.Lock()  # guards registration + fold only
+
+
+def _fold_into(out: dict, d: dict) -> None:
+    for m, s in list(d.items()):
+        agg = out.get(m)
+        if agg is None:
+            agg = out[m] = [0, 0, 0.0, 0.0]
+        agg[0] += s[0]
+        agg[1] += s[1]
+        agg[2] += s[2]
+        agg[3] = max(agg[3], s[3])
 
 
 def _record_event_stat(method: str, seconds: float, ok: bool) -> None:
-    with _event_stats_lock:
-        s = _event_stats.get(method)
-        if s is None:
-            s = _event_stats[method] = {"count": 0, "errors": 0,
-                                        "total_s": 0.0, "max_s": 0.0}
-        s["count"] += 1
-        if not ok:
-            s["errors"] += 1
-        s["total_s"] += seconds
-        s["max_s"] = max(s["max_s"], seconds)
+    d = getattr(_event_stats_local, "d", None)
+    if d is None:
+        d = _event_stats_local.d = {}
+        with _event_stats_lock:
+            _event_stats_all.append((threading.current_thread(), d))
+            if len(_event_stats_all) > 512:
+                # Short-lived dispatch threads (one per blocking RPC) must
+                # not grow the registry without bound: fold DEAD threads'
+                # dicts into the cumulative retired aggregate. Live ones
+                # stay (their dicts still receive updates).
+                live = []
+                for t, od in _event_stats_all:
+                    if t.is_alive():
+                        live.append((t, od))
+                    else:
+                        _fold_into(_event_stats_retired, od)
+                _event_stats_all[:] = live
+    s = d.get(method)
+    if s is None:
+        s = d[method] = [0, 0, 0.0, 0.0]
+    s[0] += 1
+    if not ok:
+        s[1] += 1
+    s[2] += seconds
+    if seconds > s[3]:
+        s[3] = seconds
 
 
 def get_event_stats() -> dict:
     with _event_stats_lock:
-        return {m: dict(s) for m, s in _event_stats.items()}
+        snapshot = [d for _t, d in _event_stats_all]
+        folded: dict = {m: list(s) for m, s in _event_stats_retired.items()}
+    for d in snapshot:
+        _fold_into(folded, d)
+    return {m: {"count": s[0], "errors": s[1], "total_s": s[2],
+                "max_s": s[3]}
+            for m, s in folded.items()}
 
 
 # --------------------------------------------------------------------------
@@ -144,10 +339,10 @@ class RpcServer:
                 try:
                     outer._on_connect(conn)
                     while True:
-                        frame = _recv_frame(self.request)
-                        if frame is None:
+                        msg = _recv_msg(self.request)
+                        if msg is None:
                             return
-                        outer._dispatch(conn, frame)
+                        outer._dispatch(conn, msg[0])
                 finally:
                     outer._on_disconnect(conn)
 
@@ -187,14 +382,15 @@ class RpcServer:
             except Exception:
                 pass
 
-    def _dispatch(self, conn: "PeerConnection", frame: bytes) -> None:
-        req_id, method, args = SERIALIZER.decode(frame)
+    def _dispatch(self, conn: "PeerConnection", payload) -> None:
+        req_id, method, args = payload
         if _chaos_drop():
             return  # request lost
         fn = getattr(self.handler_obj, "rpc_" + method, None)
 
         def run():
             t0 = time.monotonic() if _stats_on() else 0.0
+            lease = None
             try:
                 if fn is None:
                     raise RpcError(f"no such rpc method: {method}")
@@ -202,13 +398,19 @@ class RpcServer:
                 ok = True
             except BaseException as e:  # noqa: BLE001
                 result, ok = e, False
+            if isinstance(result, BufferLease):
+                lease, result = result, result.value
             if _stats_on():
                 _record_event_stat(method, time.monotonic() - t0, ok)
-            if req_id > 0 and not _chaos_drop():
-                try:
-                    conn.send_raw(SERIALIZER.encode((-req_id, ok, result)))
-                except Exception:
-                    pass
+            try:
+                if req_id > 0 and not _chaos_drop():
+                    try:
+                        conn.send_payload((-req_id, ok, result))
+                    except Exception:
+                        pass
+            finally:
+                if lease is not None:
+                    lease.release()
 
         # Fast handlers run inline; blocking ones (marked) get a thread so
         # one slow call can't head-of-line-block the peer's other requests.
@@ -234,12 +436,15 @@ class PeerConnection:
         self.send_lock = threading.Lock()
         self.peer_info: Dict[str, Any] = {}  # set by register handlers
 
+    def send_payload(self, payload) -> None:
+        _send_payload(self.sock, payload, self.send_lock)
+
     def send_raw(self, payload: bytes) -> None:
         _send_frame(self.sock, payload, self.send_lock)
 
     def notify(self, method: str, *args) -> None:
         """Server->client push (client must run a ClientListener)."""
-        self.send_raw(SERIALIZER.encode((0, method, args)))
+        self.send_payload((0, method, args))
 
 
 # --------------------------------------------------------------------------
@@ -270,6 +475,9 @@ class RpcClient:
         self._req_counter = itertools.count(1)
         self._pending: Dict[int, "_Waiter"] = {}
         self._pending_lock = threading.Lock()
+        #: req_id -> writable memoryview: the reader lands a scatter
+        #: response's single buffer directly here (see call_into).
+        self._sinks: Dict[int, memoryview] = {}
         self._on_push = on_push
         self._closed = False
         self._alive = True
@@ -279,6 +487,19 @@ class RpcClient:
         threading.Thread(target=self._read_loop, args=(sock,), daemon=True,
                          name=f"rpc-client-{self.address}").start()
 
+    def _take_sink(self, rid: int, lens) -> Optional[list]:
+        """Reader-side sink routing: a response whose caller registered a
+        destination view (call_into) and whose single buffer matches it
+        exactly lands straight in that view."""
+        if rid >= 0:
+            return None
+        with self._pending_lock:
+            mv = self._sinks.get(-rid)
+            if mv is None or len(lens) != 1 or lens[0] != len(mv):
+                return None
+            del self._sinks[-rid]
+            return [mv]
+
     def _read_loop(self, sock: socket.socket) -> None:
         """Reader bound to one socket generation. A reconnect() superseded
         reader exits silently: it must neither steal frames from the new
@@ -286,10 +507,10 @@ class RpcClient:
         while not self._closed:
             if sock is not self._sock:
                 return  # superseded by reconnect(); new reader owns state
-            frame = _recv_frame(sock)
-            if frame is None:
+            msg = _recv_msg(sock, self._take_sink)
+            if msg is None:
                 break
-            rid, a, b = SERIALIZER.decode(frame)
+            (rid, a, b), sink_used = msg
             if rid == 0:
                 if self._on_push is not None:
                     try:
@@ -300,6 +521,7 @@ class RpcClient:
             with self._pending_lock:
                 waiter = self._pending.pop(-rid, None)
             if waiter is not None:
+                waiter.sink_used = sink_used
                 waiter.set(a, b)
         # Connection died: fail waiters — but only if we are still the
         # CURRENT reader (reconnect() already failed/migrated the old ones).
@@ -308,6 +530,7 @@ class RpcClient:
                 return
             self._alive = False
             pending, self._pending = self._pending, {}
+            self._sinks.clear()
         for w in pending.values():
             w.fail(ConnectionLost(self.address))
         if self._on_close is not None and not self._closed:
@@ -316,7 +539,8 @@ class RpcClient:
             except Exception:
                 pass
 
-    def call_async(self, method: str, *args) -> "_Waiter":
+    def call_async(self, method: str, *args,
+                   _sink: Optional[memoryview] = None) -> "_Waiter":
         """Fire a request and return its waiter without blocking: callers
         pipeline many requests then collect acks (the dispatcher's push path
         needs in-flight depth without one thread per push)."""
@@ -328,12 +552,14 @@ class RpcClient:
             if self._closed:
                 raise ConnectionLost(self.address)
             self._pending[rid] = waiter
+            if _sink is not None:
+                self._sinks[rid] = _sink
         try:
-            _send_frame(self._sock, SERIALIZER.encode((rid, method, args)),
-                        self._send_lock)
+            _send_payload(self._sock, (rid, method, args), self._send_lock)
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(rid, None)
+                self._sinks.pop(rid, None)
             raise ConnectionLost(f"{self.address}: {e}") from e
         return waiter
 
@@ -347,9 +573,44 @@ class RpcClient:
                 self._pending.pop(waiter._rid, None)
             raise
 
+    def call_into(self, method: str, *args, sink: memoryview,
+                  timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """call(), but a scatter response whose single out-of-band buffer
+        is exactly ``len(sink)`` bytes is received DIRECTLY into ``sink``
+        (e.g. a shm create_buffer view) — no staging copy. Returns
+        (result, landed): when ``landed`` is True the result's buffer IS a
+        view of ``sink`` and the bytes are already in place."""
+        waiter = self.call_async(method, *args, _sink=sink)
+        try:
+            result = waiter.wait(timeout)
+        except TimeoutError:
+            with self._pending_lock:
+                untouched = self._sinks.pop(waiter._rid, None) is not None
+                if untouched or waiter._event.is_set():
+                    # Reader never took the sink (or already finished):
+                    # safe to hand the memory back to the caller.
+                    self._pending.pop(waiter._rid, None)
+                    raise
+            # The reader popped the sink and is landing the late response
+            # INTO the caller's view right now. Returning would let it
+            # keep writing after the caller frees/reuses that memory
+            # (e.g. a shm block aborted and reallocated) — wait for the
+            # frame to finish; a wedged peer is cut off by shutting the
+            # socket down, which errors the reader's recv out of the sink.
+            if not waiter._event.wait(30.0):
+                _shutdown_socket(self._sock)
+                waiter._event.wait(30.0)
+            with self._pending_lock:
+                self._pending.pop(waiter._rid, None)
+            raise
+        finally:
+            # Non-scatter / mismatched replies leave the sink registered.
+            with self._pending_lock:
+                self._sinks.pop(waiter._rid, None)
+        return result, waiter.sink_used
+
     def notify(self, method: str, *args) -> None:
-        _send_frame(self._sock, SERIALIZER.encode((0, method, args)),
-                    self._send_lock)
+        _send_payload(self._sock, (0, method, args), self._send_lock)
 
     def retrying_call(self, method: str, *args,
                       timeout: Optional[float] = None) -> Any:
@@ -384,24 +645,25 @@ class RpcClient:
             self._alive = True
             # Requests in flight on the old socket will never be answered.
             pending, self._pending = self._pending, {}
+            self._sinks.clear()
+        # Tear the old socket down BEFORE failing waiters: the superseded
+        # reader may be mid-recv_into a call_into sink (caller-owned shm),
+        # and a failed waiter lets its caller free/reuse that memory.
+        # shutdown() — not just close() — is what actually wakes a thread
+        # blocked in recv on another fd reference.
+        _shutdown_socket(old)
         for w in pending.values():
             w.fail(ConnectionLost(f"{self.address}: reconnected"))
-        try:
-            old.close()
-        except OSError:
-            pass
         self._start_reader(new_sock)
 
     def close(self) -> None:
         self._closed = True
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        _shutdown_socket(self._sock)
 
 
 class _Waiter:
-    __slots__ = ("_event", "_ok", "_result", "_exc", "_rid", "_client")
+    __slots__ = ("_event", "_ok", "_result", "_exc", "_rid", "_client",
+                 "sink_used")
 
     def __init__(self):
         self._event = threading.Event()
@@ -410,6 +672,7 @@ class _Waiter:
         self._exc = None
         self._rid = 0
         self._client = None
+        self.sink_used = False  # response buffer landed in a call_into sink
 
     def set(self, ok: bool, result: Any) -> None:
         self._ok, self._result = ok, result
@@ -447,10 +710,16 @@ class ClientPool:
                 # handed out again: replace it with a fresh connection.
                 c = RpcClient(address, on_push=on_push, on_close=on_close)
                 self._clients[address] = c
-            elif on_close is not None and c._on_close is None:
-                # Upgrade: a later caller may care about conn-loss events on
-                # a connection first opened by a caller that didn't.
-                c._on_close = on_close
+            else:
+                # Upgrade: a later caller may care about conn-loss or push
+                # frames on a connection first opened by a caller that
+                # didn't. Without the on_push half, a cached client created
+                # push-less silently DROPPED every later caller's server
+                # pushes for the life of the connection.
+                if on_close is not None and c._on_close is None:
+                    c._on_close = on_close
+                if on_push is not None and c._on_push is None:
+                    c._on_push = on_push
             return c
 
     def invalidate(self, address: str) -> None:

@@ -368,6 +368,18 @@ class ClusterCore:
         # Dedicated cache lock: _fn_exports_lock spans a head kv_put RPC in
         # _export_function; cache mutation must never wait on network I/O.
         self._fn_cache_lock = threading.Lock()
+        # Object-directory notify outbox: per-put/per-release head frames
+        # coalesce into one object_batch frame per flush window — N
+        # concurrent writers were paying N head frames (+ head dispatch +
+        # lock) per object, which serialized multi-client put throughput.
+        self._obj_notify_outbox: "_collections.deque" = _collections.deque()
+        self._obj_notify_event = threading.Event()
+        # Single-flusher guard: shutdown's last-gasp flush racing the
+        # daemon's would split an ordered add/rm pair across two frames
+        # whose send order is unconstrained.
+        self._obj_notify_flush_lock = threading.Lock()
+        threading.Thread(target=self._obj_notify_loop, daemon=True,
+                         name="obj-notify").start()
         threading.Thread(target=self._push_ack_loop, daemon=True,
                          name="push-acks").start()
         self._lease_reaper = threading.Thread(
@@ -564,6 +576,51 @@ class ClusterCore:
         # otherwise idle (ObjectRef.__del__ can only enqueue).
         self.refcount.flush_deferred()
 
+    # ---------------------------------------------- object notify batching
+
+    def _queue_object_notify(self, kind: str, oid_bytes: bytes,
+                             size=None) -> None:
+        """Queue an object_added/object_removed for the batched flush.
+        Order within the outbox is preserved, so an add followed by a
+        remove of the same object lands in the right order at the head."""
+        self._obj_notify_outbox.append((kind, oid_bytes, size))
+        self._obj_notify_event.set()
+
+    def _obj_notify_loop(self) -> None:
+        window = cfg.object_notify_flush_ms / 1000.0
+        while not self._shutdown_flag:
+            self._obj_notify_event.wait(0.5)
+            # Clear BEFORE the emptiness check: an append that raced the
+            # previous flush re-set the event with an already-drained
+            # outbox, and clearing only on the non-empty path would turn
+            # this loop into a busy spin. An append after this clear
+            # re-sets the event, so nothing is lost.
+            self._obj_notify_event.clear()
+            if not self._obj_notify_outbox:
+                continue
+            if window > 0:
+                time.sleep(window)  # coalesce the burst behind one frame
+            self._flush_object_notifies()
+
+    def _flush_object_notifies(self) -> None:
+        # One flusher at a time: drain AND send under the lock so two
+        # racing flushes can't send an oid's add and rm out of order.
+        with self._obj_notify_flush_lock:
+            outbox = self._obj_notify_outbox
+            while outbox:
+                batch = []
+                while outbox and len(batch) < 4096:
+                    try:
+                        batch.append(outbox.popleft())
+                    except IndexError:
+                        break
+                if not batch:
+                    return
+                try:
+                    self.head.notify("object_batch", self.node_id, batch)
+                except Exception:
+                    return  # best-effort, like the old per-object notifies
+
     # ------------------------------------------------------ object locality
 
     def _note_object_location(self, oid_bytes: bytes, node_id: Optional[str],
@@ -613,10 +670,7 @@ class ClusterCore:
         with self._obj_loc_lock:
             self._obj_locality.pop(oid.binary(), None)
         if self.store.delete(oid):
-            try:
-                self.head.notify("object_removed", oid.binary(), self.node_id)
-            except Exception:
-                pass
+            self._queue_object_notify("rm", oid.binary())
 
     # ------------------------------------------------------------------ put/get
 
@@ -643,6 +697,7 @@ class ClusterCore:
     def _put_plasma(self, oid: ObjectID, header: bytes, buffers) -> None:
         total = SERIALIZER.encode_total_size(header, buffers)
         deadline = time.monotonic() + cfg.put_create_retry_deadline_s
+        takeover_at = time.monotonic() + 5.0
         while True:
             try:
                 mv = self.store.create_buffer(oid, total)
@@ -660,7 +715,16 @@ class ClusterCore:
                     buf.release()
                     return  # sealed by the other writer — done
                 if not self.store.contains(oid):
-                    continue  # aborted: retry the create ourselves
+                    if time.monotonic() > takeover_at:
+                        # Unsealed for seconds: if the slot is a PENDING
+                        # placeholder, its creator died mid-create (a
+                        # live create's pending window is milliseconds)
+                        # and nothing else can ever clear it. Reclaim
+                        # touches only pending slots — a live writer
+                        # mid-write keeps its buffer and we keep waiting.
+                        self.store.reclaim_pending(oid)
+                        takeover_at = time.monotonic() + 5.0
+                    continue  # aborted/reclaimed: retry the create
                 if time.monotonic() > deadline:
                     raise
         try:
@@ -669,11 +733,7 @@ class ClusterCore:
             self.store.abort(oid)
             raise
         self.store.seal(oid)
-        try:
-            self.head.notify("object_added", oid.binary(), self.node_id,
-                             total)
-        except Exception:
-            pass
+        self._queue_object_notify("add", oid.binary(), total)
 
     def _read_plasma(self, oid: ObjectID, timeout: Optional[float],
                      owner: Optional[str] = None) -> Any:
@@ -1755,24 +1815,58 @@ class ClusterCore:
             qlist = list(kq.queue)
             samples = [qlist[i][1] if i < len(qlist) else sample
                        for i in range(want)]
-        for s in samples:
+        if len(samples) == 1:
             threading.Thread(target=self._lease_requester,
-                             args=(kq, s), daemon=True).start()
+                             args=(kq, samples[0]), daemon=True).start()
+        elif samples:
+            # One batched pick_nodes frame covers the whole round; the
+            # per-node lease requests still fan out on their own threads.
+            threading.Thread(target=self._batch_lease_requests,
+                             args=(kq, samples), daemon=True).start()
 
-    def _lease_requester(self, kq: "_KeyQueue",
-                         sample: _InflightTask) -> None:
+    def _locality_hint_for(self, sample: _InflightTask):
+        if (cfg.scheduler_locality_enabled and sample.arg_ids
+                and sample.strategy is None):
+            return [o.binary() for o in
+                    sample.arg_ids[:cfg.scheduler_locality_max_hint_objects]]
+        return None
+
+    def _batch_lease_requests(self, kq: "_KeyQueue",
+                              samples: List[_InflightTask]) -> None:
+        """Resolve a round of head picks in ONE pick_nodes frame, then run
+        the standard per-sample lease requester with the pick pre-filled.
+        A failed batch call degrades to per-sample picks (first_pick=None).
+        Each requester decrements kq.pending_lease_requests exactly as in
+        the unbatched path."""
+        demand_key = None
+        picks: List[Any] = [None] * len(samples)
+        try:
+            reqs = []
+            for s in samples:
+                demand_key = (self.worker_id.hex(),
+                              tuple(sorted(s.resources.items())))
+                reqs.append((s.resources, s.strategy, [], demand_key,
+                             self._locality_hint_for(s)))
+            got = self.head.retrying_call("pick_nodes", reqs, timeout=10)
+            if isinstance(got, list) and len(got) == len(samples):
+                picks = got
+        except Exception:
+            pass  # per-sample requesters fall back to their own picks
+        for s, pick in zip(samples, picks):
+            threading.Thread(target=self._lease_requester,
+                             args=(kq, s, pick), daemon=True).start()
+
+    def _lease_requester(self, kq: "_KeyQueue", sample: _InflightTask,
+                         first_pick=None) -> None:
         from ray_tpu.exceptions import RuntimeEnvSetupError
 
         env_err = None
         lease = None
-        hint = None
-        if (cfg.scheduler_locality_enabled and sample.arg_ids
-                and sample.strategy is None):
-            hint = [o.binary() for o in
-                    sample.arg_ids[:cfg.scheduler_locality_max_hint_objects]]
+        hint = self._locality_hint_for(sample)
         try:
             lease = self._request_new_lease(sample.resources, sample.strategy,
-                                            sample.runtime_env, hint)
+                                            sample.runtime_env, hint,
+                                            first_pick=first_pick)
         except RuntimeEnvSetupError as e:
             env_err = e
         finally:
@@ -1952,25 +2046,31 @@ class ClusterCore:
                            strategy,
                            runtime_env=None,
                            locality_hint: Optional[List[bytes]] = None,
+                           first_pick=None,
                            ) -> Optional[_Lease]:
         """One head pick + node lease round trip; None if infeasible now.
         Both RPCs are retry-safe: pick_node is read-only, request_lease is
         idempotent via the per-attempt req_id (the node caches the grant).
         ``locality_hint`` ships the requesting task's input-object ids so
-        the head can score candidates by locally-resident bytes."""
+        the head can score candidates by locally-resident bytes.
+        ``first_pick`` (from a batched pick_nodes) skips the first
+        pick_node round trip; spillback hops re-pick individually."""
         exclude: List[str] = []
         # Demand identity for the head's unmet-demand ring: this
         # submitter + shape. Retries of one starved key stay one demand;
         # distinct submitters register separately.
         demand_key = (self.worker_id.hex(),
                       tuple(sorted(resources.items())))
-        for _ in range(4):  # a few spillback hops per attempt
-            try:
-                picked = self.head.retrying_call(
-                    "pick_node", resources, strategy, exclude, demand_key,
-                    locality_hint, timeout=10)
-            except (ConnectionLost, TimeoutError):
-                return None
+        for hop in range(4):  # a few spillback hops per attempt
+            if hop == 0 and first_pick is not None:
+                picked = first_pick
+            else:
+                try:
+                    picked = self.head.retrying_call(
+                        "pick_node", resources, strategy, exclude,
+                        demand_key, locality_hint, timeout=10)
+                except (ConnectionLost, TimeoutError):
+                    return None
             if picked is None:
                 return None
             node_id, node_addr, _ = picked
@@ -2573,6 +2673,12 @@ class ClusterCore:
         if self._shutdown_flag:
             return
         self._shutdown_flag = True
+        try:
+            # Last-gasp directory sync: queued adds/removes still flush so
+            # the head's view doesn't miss this owner's final objects.
+            self._flush_object_notifies()
+        except Exception:
+            pass
         self._server.stop()
         self._pool.close_all()
         for c in (self.head, self.node):

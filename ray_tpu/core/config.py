@@ -145,6 +145,10 @@ _d("scheduler_locality_defer_max_s", float, 3.0,
    "max age a queued task is deferred waiting for a lease on its inputs' "
    "holder node; past it the task dispatches to any free lease (a holder "
    "wedged on one long task must not indefinitely delay its queue)")
+_d("object_notify_flush_ms", int, 5,
+   "flush window for batched object_added/object_removed notifies to the "
+   "head: puts coalesce a burst's directory updates into one object_batch "
+   "frame (0 flushes immediately, still batched per sweep)")
 _d("object_locality_cache_max", int, 65_536,
    "owner-side oid -> (node, size) locality cache entries (populated from "
    "task completions and local puts; consulted at dispatch)")
@@ -314,6 +318,10 @@ _d("rpc_state_timeout_s", float, 10.0,
    "bookkeeping, location publishes)")
 _d("rpc_recv_chunk_bytes", int, 1 << 20,
    "max bytes per socket recv() in the frame reader")
+_d("rpc_scatter_min_bytes", int, 64 * 1024,
+   "payloads whose pickle-5 out-of-band buffers total at least this ride "
+   "the scatter frame form: buffers go straight to sendmsg (never "
+   "flattened host-side) and land via recv_into on the receiver")
 _d("rpc_listen_backlog", int, 128, "server socket accept backlog")
 _d("pubsub_retry_delay_s", float, 0.5,
    "subscriber reconnect backoff after a dropped long-poll")
@@ -361,6 +369,12 @@ _d("push_ack_idle_poll_s", float, 0.01,
 # --- store breadth ---
 _d("object_store_slots", int, 1 << 16,
    "shm store object-table slots (max resident objects per node)")
+_d("object_store_shards", int, 8,
+   "shm store arena shards: each has its own process-shared mutex, slot "
+   "stripe and free list, so concurrent writers stop serializing on one "
+   "lock. Ceiling — tiny stores shrink it so every sub-arena stays "
+   "usefully large. NOTE: a single object cannot exceed one sub-arena "
+   "(~capacity/shards); lower this for giant-object workloads")
 _d("spill_restore_poll_s", float, 0.05,
    "pull-manager pause between spilled-object restore attempts")
 _d("pull_fanout_max_holders", int, 4,

@@ -3,9 +3,12 @@
 This is the per-node object plane. Parity target: the reference's plasma
 client (reference: src/ray/object_manager/plasma/client.h — Create/Seal/Get/
 Release/Delete over a unix-socket protocol), re-designed: here every process
-maps the same POSIX shm segment and calls straight into the store library
-under a process-shared robust mutex — no store server, no socket round trip,
-zero-copy reads via memoryview into the mapping.
+maps the same POSIX shm segment and calls straight into the store library —
+no store server, no socket round trip, zero-copy reads via memoryview into
+the mapping. The segment is SHARDED (layout v2): per-shard process-shared
+robust mutexes, slot stripes, and sub-arena free lists, with process-affine
+allocation so concurrent writers neither serialize on one lock nor ping-pong
+pages between each other's page tables (see shm_store.cc).
 
 The creator process calls `ShmStore.create(...)`; workers `ShmStore.open(...)`
 with the same name. Both sides then use identical put/get APIs.
@@ -25,6 +28,30 @@ from ray_tpu.core.ids import ObjectID
 _LIB = None
 _LIB_LOCK = threading.Lock()
 
+#: Expected shm segment layout version. MUST match kLayoutVersion in
+#: shm_store.cc: the v2 layout shards the arena (per-shard mutexes, slot
+#: stripes, sub-arena free lists), so a library built from older source
+#: would corrupt a v2 segment — attach fails fast instead.
+_LAYOUT_VERSION = 2
+
+
+def _check_layout_version(lib, so: str) -> None:
+    """Refuse a store library whose compiled-in layout disagrees with this
+    client. A stale prebuilt .so (or an RTPU_SHM_STORE_SO override pointing
+    at an old build) must fail LOUDLY at load, not corrupt the arena."""
+    try:
+        lib.rtpu_lib_layout_version.restype = ctypes.c_uint64
+        got = int(lib.rtpu_lib_layout_version())
+    except AttributeError:
+        got = 1  # pre-versioning builds exported no version symbol
+    if got != _LAYOUT_VERSION:
+        override = os.environ.get("RTPU_SHM_STORE_SO")
+        hint = (f" (RTPU_SHM_STORE_SO points at {override!r} — rebuild "
+                "that file or unset the override)" if override else "")
+        raise OSError(
+            f"stale shm store library {so!r}: layout version {got}, "
+            f"this client needs {_LAYOUT_VERSION}. Rebuild with "
+            f"`python ray_tpu/_cpp/build.py`{hint}.")
 
 
 def _load_lib():
@@ -46,26 +73,29 @@ def _load_lib():
             build(verbose=False)
         try:
             lib = ctypes.CDLL(so)
+            _check_layout_version(lib, so)
         except OSError as e:
             # The shipped .so was built against a different libc (e.g.
-            # `GLIBC_2.33 not found`). Rebuilding from the checked-in
-            # source fixes it, but only on explicit request: an implicit
-            # rebuild here would race (every node process dlopens this
-            # path — concurrent g++ runs into one .so corrupt it).
+            # `GLIBC_2.33 not found`) or from pre-layout-bump source.
+            # Rebuilding from the checked-in source fixes it, but only on
+            # explicit request: an implicit rebuild here would race (every
+            # node process dlopens this path — concurrent g++ runs into
+            # one .so corrupt it).
             if os.environ.get("RTPU_REBUILD_NATIVE") != "1":
                 raise OSError(
-                    f"{e}\nThe prebuilt libshm_store.so does not load on "
-                    "this machine; rerun with RTPU_REBUILD_NATIVE=1 (or "
-                    "run `python ray_tpu/_cpp/build.py`) to rebuild it "
+                    f"{e}\nThe prebuilt libshm_store.so does not match "
+                    "this machine/source; rerun with RTPU_REBUILD_NATIVE=1 "
+                    "(or run `python ray_tpu/_cpp/build.py`) to rebuild it "
                     "from source.") from e
             from ray_tpu._cpp.build import build
 
             build(verbose=False, force=True)
             lib = ctypes.CDLL(so)
+            _check_layout_version(lib, so)
         lib.rtpu_store_create.restype = ctypes.c_void_p
         lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                          ctypes.c_uint64, ctypes.c_int,
-                                          ctypes.c_int]
+                                          ctypes.c_uint64, ctypes.c_uint64,
+                                          ctypes.c_int, ctypes.c_int]
         lib.rtpu_store_open.restype = ctypes.c_void_p
         lib.rtpu_store_open.argtypes = [ctypes.c_char_p]
         lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
@@ -74,7 +104,7 @@ def _load_lib():
         lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
         lib.rtpu_obj_create.restype = ctypes.c_uint64
         lib.rtpu_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_uint64,
+                                        ctypes.c_uint64, ctypes.c_int64,
                                         ctypes.POINTER(ctypes.c_int)]
         lib.rtpu_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_obj_get.restype = ctypes.c_int
@@ -87,6 +117,9 @@ def _load_lib():
         lib.rtpu_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_obj_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_obj_reclaim_pending.restype = ctypes.c_int
+        lib.rtpu_obj_reclaim_pending.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p]
         lib.rtpu_store_stats.argtypes = [ctypes.c_void_p] + [
             ctypes.POINTER(ctypes.c_uint64)] * 4
         lib.rtpu_store_prefault.argtypes = [ctypes.c_void_p]
@@ -98,6 +131,16 @@ def _load_lib():
         lib.rtpu_store_spill_victims.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.rtpu_store_layout_version.restype = ctypes.c_uint64
+        lib.rtpu_store_layout_version.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_n_shards.restype = ctypes.c_uint64
+        lib.rtpu_store_n_shards.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_spill_note.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+        lib.rtpu_store_spill_count.restype = ctypes.c_int64
+        lib.rtpu_store_spill_count.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_max_object_bytes.restype = ctypes.c_uint64
+        lib.rtpu_store_max_object_bytes.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return lib
 
@@ -154,6 +197,26 @@ class ShmStore:
         self._h = handle
         self.name = name
         self._owner = owner
+        # Belt-and-braces attach guard: the C open/create already rejects
+        # mismatched segments via the versioned magic, but a corrupted or
+        # hand-rolled mapping must still fail fast here.
+        seg_ver = int(self._lib.rtpu_store_layout_version(self._h))
+        if seg_ver != _LAYOUT_VERSION:
+            raise OSError(
+                f"shm store {name!r} has layout version {seg_ver}, this "
+                f"client needs {_LAYOUT_VERSION}; the creating process ran "
+                "a different build — rebuild everything with "
+                "`python ray_tpu/_cpp/build.py` and restart the cluster.")
+        self.n_shards = int(self._lib.rtpu_store_n_shards(self._h))
+        # Allocation affinity: this process prefers one sub-arena, so the
+        # blocks it cycles through stay mapped in ITS page tables (soft
+        # page faults are per-process and brutally slow on sandboxed
+        # kernels — concurrent writers swapping blocks was the
+        # multi-writer put collapse). Lookup correctness is unaffected:
+        # an object's slot location is always key-hashed.
+        self._pref_shard = os.getpid() % self.n_shards
+        self.max_object_bytes = int(
+            self._lib.rtpu_store_max_object_bytes(self._h))
         # Object views are built per-get from this base pointer; offsets from
         # the store are segment-relative.
         self._base_ptr = self._lib.rtpu_store_base(self._h)
@@ -179,15 +242,19 @@ class ShmStore:
 
     @classmethod
     def create(cls, name: str, capacity: int, n_slots: int = 0,
-               unlink_existing: bool = True,
+               n_shards: int = 0, unlink_existing: bool = True,
                prefault: bool = True) -> "ShmStore":
         lib = _load_lib()
-        if not n_slots:
-            from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+        from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
 
+        if not n_slots:
             n_slots = _cfg.object_store_slots
+        if not n_shards:
+            n_shards = _cfg.object_store_shards
+        # The C side shrinks the shard count for tiny segments so every
+        # sub-arena can still hold a real object; n_shards is a ceiling.
         h = lib.rtpu_store_create(name.encode(), capacity, n_slots,
-                                  1 if unlink_existing else 0, 0)
+                                  n_shards, 1 if unlink_existing else 0, 0)
         if not h:
             raise OSError(f"failed to create shm store {name!r}")
         store = cls(h, name, owner=True)
@@ -205,7 +272,11 @@ class ShmStore:
         lib = _load_lib()
         h = lib.rtpu_store_open(name.encode())
         if not h:
-            raise OSError(f"failed to open shm store {name!r}")
+            raise OSError(
+                f"failed to open shm store {name!r} (missing, or created "
+                f"by a build with a different layout version — expected "
+                f"v{_LAYOUT_VERSION}; rebuild with "
+                "`python ray_tpu/_cpp/build.py`)")
         return cls(h, name, owner=False)
 
     def close(self) -> None:
@@ -265,13 +336,22 @@ class ShmStore:
             try:
                 with open(tmp, "wb") as f:
                     f.write(buf.buffer)
+                # Shared live-file counter: delete() on every process
+                # mapping this store skips its unlink syscall while this
+                # reads 0 (the overwhelmingly common case). Incremented
+                # BEFORE the rename so a concurrent delete() can never
+                # observe the file without the counter — skipping an
+                # unlink there would let a stale file resurrect a deleted
+                # object. Over-counting (rename lost a race) only costs
+                # extra unlink attempts, never correctness.
+                self._lib.rtpu_store_spill_note(self._h, 1)
                 try:
                     os.replace(tmp, path)  # atomic: whole files only
                 except FileNotFoundError:
                     # A concurrent spill (or a shutdown rmtree) won the
                     # race; the object is either safely on disk already or
                     # the store is going away.
-                    pass
+                    self._lib.rtpu_store_spill_note(self._h, -1)
             finally:
                 buf.release()
             self.spill_delete_only(oid)  # keep the file we just wrote
@@ -279,10 +359,17 @@ class ShmStore:
             spilled = True
         return spilled
 
+    def _spill_files_live(self) -> bool:
+        """True when any process mapping this store may have spill files on
+        disk. One mapped-memory read — gates the per-op unlink/stat/open
+        syscalls (~400us each on overlayfs) off the spill-less hot path."""
+        return (self._spill_enabled
+                and self._lib.rtpu_store_spill_count(self._h) > 0)
+
     def _maybe_restore(self, oid: ObjectID) -> bool:
         """Bring a spilled object back into the arena. True if present
         afterwards (restored here or concurrently by another process)."""
-        if not self._spill_enabled:
+        if not self._spill_files_live():
             return False
         path = self._spill_path(self._key(oid))
         try:
@@ -307,37 +394,58 @@ class ShmStore:
         return True
 
     def _create_raw(self, key: bytes, total: int, what: str) -> int:
-        """rtpu_obj_create + spill-on-pressure retry loop."""
+        """rtpu_obj_create with a spill-on-pressure rescue OFF the hot
+        path: the common case is exactly one C call under one shard mutex
+        (concurrent creates from separate processes proceed in parallel).
+        Only a full store enters the spill/retry loop below — and the
+        gc.collect rescue (zero-copy views stuck in GC cycles keeping
+        arena pins alive) runs at most once per call, never per lap."""
+        if total > self.max_object_bytes:
+            raise ShmStoreFullError(
+                f"object of {total} bytes exceeds the largest sub-arena "
+                f"({self.max_object_bytes} bytes across {self.n_shards} "
+                "shards); raise object_store_memory_bytes or lower "
+                "object_store_shards")
         err = ctypes.c_int(0)
-        attempts = 0
-        while True:
+        off = self._lib.rtpu_obj_create(self._h, key, total,
+                                        self._pref_shard, ctypes.byref(err))
+        if off:
+            return off
+        if err.value == 1:
+            raise ShmObjectExistsError(key.hex())
+
+        def full():
+            return ShmStoreFullError(
+                f"store full ({what}: {total} bytes requested; "
+                f"err={err.value}, spilling="
+                f"{'on' if self._spill_enabled else 'off'})")
+
+        if not self._spill_enabled:
+            raise full()
+        gc_done = False
+        for attempt in range(24):
+            spilled = self.spill_for(total)
             off = self._lib.rtpu_obj_create(self._h, key, total,
+                                            self._pref_shard,
                                             ctypes.byref(err))
             if off:
                 return off
             if err.value == 1:
                 raise ShmObjectExistsError(key.hex())
-            if not self._spill_enabled or attempts >= 20 \
-                    or not self.spill_for(total):
-                # Dropped zero-copy views can sit in GC cycles (exception
-                # tracebacks referencing frames referencing buffers),
-                # keeping arena pins alive past their last use. One
-                # collect often frees enough to proceed — only then fail.
-                if self._spill_enabled and attempts < 22:
+            if not spilled:
+                if not gc_done:
                     import gc
 
                     gc.collect()
-                    if self.spill_for(total):
-                        attempts += 1
-                        continue
-                    time.sleep(0.05)
-                    attempts += 1
+                    gc_done = True
                     continue
-                raise ShmStoreFullError(
-                    f"store full ({what}: {total} bytes requested; "
-                    f"err={err.value}, spilling="
-                    f"{'on' if self._spill_enabled else 'off'})")
-            attempts += 1
+                if attempt >= 4:
+                    raise full()
+                # Nothing spillable and GC already ran: concurrent pins
+                # are the only thing that can still free room — wait them
+                # out briefly, then give up.
+                time.sleep(0.02 * (attempt + 1))
+        raise full()
 
     # -- object API --------------------------------------------------------
 
@@ -349,12 +457,15 @@ class ShmStore:
         key = self._key(oid)
         off = self._create_raw(key, total, "put_bytes")
         try:
+            from ray_tpu.core.serialization import stream_copy
+
             mv = self._view(off, total)
             pos = 0
             for p in parts:
                 n = len(p)
-                mv[pos:pos + n] = p if isinstance(
-                    p, (bytes, bytearray, memoryview)) else bytes(p)
+                if not isinstance(p, (bytes, bytearray, memoryview)):
+                    p = bytes(p)
+                stream_copy(mv[pos:pos + n], p)
                 pos += n
         except BaseException:
             self._lib.rtpu_obj_abort(self._h, key)
@@ -407,7 +518,7 @@ class ShmStore:
     def _release_raw(self, key: bytes, spill_pin: bool = False) -> None:
         if self._h:
             rc = self._lib.rtpu_obj_release(self._h, key)
-            if rc == 2 and self._spill_enabled and not spill_pin:
+            if rc == 2 and not spill_pin and self._spill_files_live():
                 # Last pin of a DOOMED object (deleted while we held it):
                 # any spill file we or others wrote must not resurrect it.
                 # SPILL pins are exempt: two concurrent spills of the same
@@ -420,20 +531,32 @@ class ShmStore:
                 # file, never data.
                 try:
                     os.unlink(self._spill_path(key))
+                    self._lib.rtpu_store_spill_note(self._h, -1)
                 except OSError:
                     pass
 
     def delete(self, oid: ObjectID) -> bool:
         """Remove the in-memory copy AND any spill file (a freed object must
-        not resurrect on a later read)."""
+        not resurrect on a later read). The unlink syscall is skipped while
+        the shared spill-file counter reads 0 — the common (spill-less)
+        case pays exactly one C call."""
         ok = self._lib.rtpu_obj_delete(self._h, self._key(oid)) == 0
-        if self._spill_enabled:
+        if self._spill_files_live():
             try:
                 os.unlink(self._spill_path(self._key(oid)))
+                self._lib.rtpu_store_spill_note(self._h, -1)
                 ok = True
             except OSError:
                 pass
         return ok
+
+    def reclaim_pending(self, oid: ObjectID) -> bool:
+        """Reclaim a create whose owner died between inserting its
+        placeholder slot and filling it (the slot would otherwise wedge
+        the key forever). Only touches PENDING placeholders — a live
+        writer's allocated-but-unsealed object is never affected."""
+        return self._lib.rtpu_obj_reclaim_pending(
+            self._h, self._key(oid)) == 0
 
     def spill_delete_only(self, oid: ObjectID) -> bool:
         """delete() semantics as used by spill_for: drop ONLY the arena
@@ -443,7 +566,7 @@ class ShmStore:
     def contains(self, oid: ObjectID) -> bool:
         if bool(self._lib.rtpu_obj_contains(self._h, self._key(oid))):
             return True
-        return (self._spill_enabled
+        return (self._spill_files_live()
                 and os.path.exists(self._spill_path(self._key(oid))))
 
     def stats(self) -> Tuple[int, int, int, int]:

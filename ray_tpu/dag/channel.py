@@ -173,11 +173,14 @@ class CrossNodeChannel:
     def _delete_unregistered(self, store, oid: ObjectID) -> None:
         """Delete + drop the head's directory entry: pushed copies were
         registered object_added on arrival, and a raw store delete would
-        leak one directory row per message forever."""
+        leak one directory row per message forever. The removal rides the
+        runtime's BATCHED notify outbox — a direct head.notify here could
+        overtake a same-process put's still-queued object_added and leave
+        the head holding a permanently stale add."""
         store.delete(oid)
         rt = self._runtime()
         try:
-            rt.head.notify("object_removed", oid.binary(), rt.node_id)
+            rt._queue_object_notify("rm", oid.binary())
         except Exception:
             pass
 
