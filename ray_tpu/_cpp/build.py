@@ -15,6 +15,7 @@ RTPU_SHM_STORE_SO at the result (see .claude/skills/verify/SKILL.md).
 
 from __future__ import annotations
 
+import argparse
 import os
 import subprocess
 import sys
@@ -25,24 +26,75 @@ TARGETS = [
     ("shm_store.cc", "libshm_store.so", ["-lpthread", "-lrt"]),
 ]
 
+#: --sanitize flag -> extra g++ flags. Sanitized builds are for hunting
+#: races/overflows in shm_store.cc under the dataplane tests; they are
+#: slower and must NEVER overwrite the checked-in .so — they build
+#: out-of-tree and are loaded via RTPU_SHM_STORE_SO.
+SANITIZERS = {
+    "address": ["-fsanitize=address", "-fno-omit-frame-pointer"],
+    "thread": ["-fsanitize=thread", "-fno-omit-frame-pointer"],
+}
 
-def build(verbose: bool = True, force: bool = False) -> list[str]:
+
+def build(verbose: bool = True, force: bool = False,
+          sanitize: str | None = None,
+          out_dir: str | None = None) -> list[str]:
+    extra: list[str] = []
+    if sanitize is not None:
+        extra = SANITIZERS[sanitize]
+        if out_dir is None:
+            # Default the sanitized artifact out of tree: an in-tree
+            # sanitized .so would both dirty the checked-in binary and
+            # drag libasan/libtsan into every normal cluster boot.
+            out_dir = os.path.join("/tmp", f"rtpu_native_{sanitize}")
+        force = True  # flags changed: mtime shortcut would lie
+    dest = out_dir or HERE
+    os.makedirs(dest, exist_ok=True)
     built = []
     for src, out, libs in TARGETS:
         src_p = os.path.join(HERE, src)
-        out_p = os.path.join(HERE, out)
+        out_p = os.path.join(dest, out)
         if (not force and os.path.exists(out_p)
                 and os.path.getmtime(out_p) >= os.path.getmtime(src_p)):
             built.append(out_p)
             continue
-        cmd = ["g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
-               "-o", out_p, src_p] + libs
+        cmd = (["g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC"]
+               + extra + ["-o", out_p, src_p] + libs)
         if verbose:
             print("+", " ".join(cmd), file=sys.stderr)
         subprocess.run(cmd, check=True)
         built.append(out_p)
+    if sanitize is not None and verbose:
+        # dlopen-ing a sanitized .so into a plain python process aborts
+        # ("runtime does not come first in initial library list") unless
+        # the sanitizer runtime is preloaded.
+        rt_lib = {"address": "libasan.so", "thread": "libtsan.so"}[sanitize]
+        preload = subprocess.run(
+            ["g++", f"-print-file-name={rt_lib}"],
+            capture_output=True, text=True).stdout.strip()
+        print(f"sanitized ({sanitize}) build is out-of-tree; run the "
+              f"cluster against it with:\n"
+              f"  export RTPU_SHM_STORE_SO={built[0]}\n"
+              f"  export LD_PRELOAD={preload or rt_lib}",
+              file=sys.stderr)
     return built
 
 
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sanitize", choices=sorted(SANITIZERS),
+                   help="build with AddressSanitizer/ThreadSanitizer "
+                        "(out-of-tree; load via RTPU_SHM_STORE_SO)")
+    p.add_argument("--out-dir",
+                   help="directory for the built .so (default: in-tree, "
+                        "or /tmp/rtpu_native_<sanitizer> when "
+                        "--sanitize is given)")
+    p.add_argument("--force", action="store_true",
+                   help="rebuild even if the output is newer than the "
+                        "source")
+    args = p.parse_args()
+    build(force=args.force, sanitize=args.sanitize, out_dir=args.out_dir)
+
+
 if __name__ == "__main__":
-    build()
+    main()

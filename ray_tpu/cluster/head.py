@@ -32,6 +32,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.task_spec import pg_key_from_strategy
 from ray_tpu.cluster.persistence import HeadStore
 from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
+from ray_tpu.devtools.lock_debug import make_rlock
 
 class _TransientReservationFailure(Exception):
     """A node rejected a bundle after local re-check; retry placement."""
@@ -86,7 +87,7 @@ class HeadServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("head._lock")
         self._nodes: Dict[str, NodeInfo] = {}
         self._actors: Dict[bytes, ActorInfo] = {}
         self._named: Dict[Tuple[str, str], bytes] = {}
@@ -180,6 +181,9 @@ class HeadServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # _stop wakes the health loop's wait(): join so no sweep runs
+        # against a server/store that is being torn down below.
+        self._health_thread.join(timeout=2.0)
         self._server.stop()
         self._pool.close_all()
         if self._store is not None:

@@ -24,6 +24,7 @@ isolation (reference python/ray/_private/accelerators/tpu.py:154).
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import sys
@@ -36,7 +37,10 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.shm_store import ShmStore
 from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
+from ray_tpu.devtools.lock_debug import make_lock, make_rlock
 from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 
 
 
@@ -164,7 +168,7 @@ class NodeManager:
         self.store_name = f"/rtpu_store_{node_id[:12]}"
         self.store = ShmStore.create(self.store_name, object_store_bytes,
                                      prefault=cfg.object_store_prefault)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("node_manager._lock")
         self._idle_cv = threading.Condition(self._lock)
         # Signalled whenever resources are credited back (lease return,
         # blocked worker, bundle release): queued lease requests re-check
@@ -194,7 +198,7 @@ class NodeManager:
         # concurrent pulls of one object onto a single in-flight transfer
         # and fans chunked pulls of large objects out across holders.
         self._pulls: Dict[bytes, threading.Event] = {}
-        self._pull_lock = threading.Lock()
+        self._pull_lock = make_lock("node_manager._pull_lock")
         self.pull_stats: Dict[str, int] = {
             "bytes_pulled": 0, "pulls_started": 0, "pulls_completed": 0,
             "pulls_coalesced": 0, "multi_source_pulls": 0}
@@ -234,7 +238,10 @@ class NodeManager:
                 labels["metrics-port"] = str(self._metrics_exporter.port)
                 self.labels = labels
             except Exception:
-                pass
+                # Observability is optional, its absence is not: a node
+                # silently missing from scrapes looks like a dead node.
+                logger.warning("metrics exporter failed to start; node "
+                               "metrics disabled", exc_info=True)
         self._head = RpcClient(head_addr)
         self._head.retrying_call("register_node", node_id, self.address,
                                  resources, labels, self.store_name,
@@ -254,8 +261,8 @@ class NodeManager:
         # serializes the fork round-trip's pipe I/O. stop() and concurrent
         # spawns need only the former, so a zygote stuck mid-fork (up to
         # zygote_spawn_timeout_s) cannot wedge them.
-        self._zygote_lock = threading.Lock()
-        self._zygote_io_lock = threading.Lock()
+        self._zygote_lock = make_lock("node_manager._zygote_lock")
+        self._zygote_io_lock = make_lock("node_manager._zygote_io_lock")
         threading.Thread(target=self._spawner_loop, daemon=True,
                          name=f"node-spawner-{node_id[:8]}").start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
@@ -286,8 +293,9 @@ class NodeManager:
         for w in workers:
             try:
                 w.proc.terminate()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("terminate of worker %s failed: %r",
+                             w.worker_id[:8], e)
         for w in workers:
             try:
                 w.proc.wait(timeout=cfg.worker_graceful_shutdown_s)
@@ -305,8 +313,8 @@ class NodeManager:
         self._pool.close_all()
         try:
             self._head.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("head client close failed: %r", e)
         self.store.close()
 
     def _heartbeat_loop(self) -> None:
@@ -371,11 +379,19 @@ class NodeManager:
                         self.total, self.labels, self.store_name,
                         timeout=cfg.rpc_state_timeout_s)
                     last_sent = {}  # fresh NodeInfo: full snapshot next
-            except Exception:
+            except Exception as e:
+                if self._stop.is_set():
+                    return  # shutdown raced the beat: conn loss expected
+                logger.debug("heartbeat to head failed (%r); "
+                             "reconnecting", e)
                 try:
                     self._head.reconnect()
-                except Exception:
-                    pass
+                except Exception as e2:
+                    # Broad on purpose: ANY reconnect error (incl. a
+                    # RuntimeError from thread exhaustion) must leave
+                    # this loop alive to retry next beat — a dead
+                    # heartbeat thread reads as a dead node.
+                    logger.debug("head reconnect failed: %r", e2)
             self._check_worker_deaths()
 
     def _check_worker_deaths(self) -> None:
@@ -432,8 +448,13 @@ class NodeManager:
                 # Acked: a lost death report would stall actor-restart FSMs.
                 self._head.retrying_call("worker_dead_at", w.address,
                                          timeout=5)
-            except Exception:
-                pass
+            except Exception as e:
+                if self._stop.is_set():
+                    return  # whole node going down: head may be gone too
+                # An undelivered death report stalls actor-restart FSMs
+                # until the head's own liveness sweep notices — loud.
+                logger.warning("worker death report for %s not "
+                               "delivered: %r", w.address, e)
 
         # Off the heartbeat thread: retries must not delay liveness pings.
         threading.Thread(target=report, daemon=True).start()
@@ -467,16 +488,19 @@ class NodeManager:
             for w in reap:
                 try:
                     w.proc.terminate()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("reap terminate of %s failed: %r",
+                                 w.worker_id[:8], e)
 
     # ------------------------------------------------------------ workers
 
     def _spawner_loop(self) -> None:
+        import queue as _queue
+
         while not self._stop.is_set():
             try:
                 tpu, runtime_env = self._spawn_requests.get(timeout=1.0)
-            except Exception:
+            except _queue.Empty:
                 continue
             try:
                 self._spawn_worker_inner(tpu=bool(tpu),
@@ -499,6 +523,14 @@ class NodeManager:
         lines = []
         try:
             used, capacity, n_objects, n_evictions = self.store.stats()
+        except Exception:
+            # Loud but non-fatal: a raise would hit the exporter's
+            # per-collector swallow and silently drop the worker/lease
+            # gauges below along with the store's.
+            if not self._stop.is_set():
+                logger.warning("store stats unavailable for metrics "
+                               "scrape", exc_info=True)
+        else:
             lines += gauge_lines(
                 "rtpu_node_store_bytes", "object store occupancy",
                 [({**nid, "kind": "used"}, used),
@@ -506,8 +538,6 @@ class NodeManager:
             lines += gauge_lines(
                 "rtpu_node_store_objects", "objects resident in the store",
                 [(nid, n_objects)])
-        except Exception:
-            pass
         with self._lock:
             n_workers = len(self._workers)
             n_idle = sum(len(v) for v in self._idle.values())
@@ -694,7 +724,11 @@ class NodeManager:
                         zlog = self._zygote_log = open(os.path.join(
                             cfg.log_dir, f"zygote-{self.node_id[:8]}.log"),
                             "ab", buffering=0)
-                        self._zygote = subprocess.Popen(
+                        # Zygote (re)start runs under the handle lock BY
+                        # DESIGN: it happens once per zygote lifetime and
+                        # a concurrent spawn must see either no zygote or
+                        # a complete one.
+                        self._zygote = subprocess.Popen(  # rtpu-lint: disable=blocking-under-lock
                             [sys.executable, "-m",
                              "ray_tpu.cluster.worker_main", "--zygote",
                              "--node-addr", self.address,
@@ -1010,8 +1044,9 @@ class NodeManager:
             elif not pool_worker and not w.is_actor_host:
                 try:
                     w.proc.terminate()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("broken-lease terminate of %s failed: "
+                                 "%r", w.worker_id[:8], e)
         return True
 
     def _lease_for_worker_addr(self, addr: str) -> Optional[Lease]:
@@ -1201,7 +1236,9 @@ class NodeManager:
             locs = self._head.call("object_locations", oid.binary(),
                                    self.node_id,
                                    timeout=cfg.rpc_control_timeout_s)
-        except Exception:
+        except Exception as e:
+            logger.debug("object_locations lookup for %s failed: %r",
+                         oid.hex()[:12], e)
             locs = []
         addrs = [addr for node_id, addr in locs if node_id != self.node_id]
         if not addrs:
@@ -1224,7 +1261,9 @@ class NodeManager:
                 first = client.call(
                     "fetch_object", oid.binary(), 0, chunk, 0,
                     timeout=max(1.0, deadline - time.monotonic()))
-            except Exception:
+            except Exception as e:
+                logger.debug("fetch_object from holder %s failed: %r; "
+                             "trying next holder", addr, e)
                 continue
             if first is not None:
                 src = client
@@ -1393,7 +1432,9 @@ class NodeManager:
             return bool(self._pool.get(target_addr).call(
                 "pull_direct", oid_bytes, self.address, timeout_ms,
                 timeout=timeout_ms / 1000.0 + 5))
-        except Exception:
+        except Exception as e:
+            logger.debug("push of %s to %s failed: %r",
+                         ObjectID(oid_bytes).hex()[:12], target_addr, e)
             return False
 
     def rpc_has_object(self, conn, oid_bytes: bytes):

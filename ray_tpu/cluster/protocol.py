@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.serialization import SERIALIZER
+from ray_tpu.devtools.lock_debug import make_lock
 
 _LEN = struct.Struct("<I")
 
@@ -368,8 +369,11 @@ class RpcServer:
         try:
             self._server.shutdown()
             self._server.server_close()
-        except Exception:
+        except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort teardown
             pass
+        # serve_forever returns after shutdown(): join so teardown is
+        # ordered (no acceptor thread outliving its server object).
+        self._thread.join(timeout=2.0)
 
     def _on_connect(self, conn: "PeerConnection") -> None:
         pass
@@ -433,7 +437,7 @@ class PeerConnection:
     def __init__(self, sock: socket.socket, server: RpcServer):
         self.sock = sock
         self.server = server
-        self.send_lock = threading.Lock()
+        self.send_lock = make_lock("protocol.send_lock")
         self.peer_info: Dict[str, Any] = {}  # set by register handlers
 
     def send_payload(self, payload) -> None:
@@ -471,10 +475,10 @@ class RpcClient:
             timeout=connect_timeout or cfg.rpc_connect_timeout_s)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = make_lock("protocol._send_lock")
         self._req_counter = itertools.count(1)
         self._pending: Dict[int, "_Waiter"] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("protocol._pending_lock")
         #: req_id -> writable memoryview: the reader lands a scatter
         #: response's single buffer directly here (see call_into).
         self._sinks: Dict[int, memoryview] = {}
@@ -699,7 +703,7 @@ class ClientPool:
 
     def __init__(self):
         self._clients: Dict[str, RpcClient] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("protocol.client_pool._lock")
 
     def get(self, address: str, on_push: Optional[Callable] = None,
             on_close: Optional[Callable] = None) -> RpcClient:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import collections
 import inspect
+import logging
 import os
 import sys
 import threading
@@ -35,7 +36,10 @@ from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.serialization import SERIALIZER, capture_exception
 from ray_tpu.cluster.protocol import ClientPool
+from ray_tpu.devtools.lock_debug import make_lock
 from ray_tpu.exceptions import ActorDiedError, RayTpuError, TaskError
+
+logger = logging.getLogger(__name__)
 
 
 class _OrderState:
@@ -65,7 +69,7 @@ class _HostedActor:
         # applies (at-least-once pushes), ordering guarantees don't.
         self.out_of_order = out_of_order
         self.is_async = is_async
-        self.lock = threading.Lock()
+        self.lock = make_lock("worker_main.actor.lock")
         self.pool = ThreadPoolExecutor(
             max_workers=max_concurrency,
             thread_name_prefix=f"actor-{actor_id.hex()[:8]}")
@@ -87,7 +91,7 @@ class _HostedActor:
                 self._method_groups[mname] = g
         self.loop = None
         self.order: Dict[str, _OrderState] = {}  # owner_addr -> state
-        self.order_lock = threading.Lock()
+        self.order_lock = make_lock("worker_main.actor.order_lock")
         self.dead = False
 
     def pool_for(self, method_name: str) -> ThreadPoolExecutor:
@@ -123,17 +127,17 @@ class WorkerRuntime(ClusterCore):
         self._task_slot = threading.Semaphore(1)
         self._slot_state = threading.local()
         self._hosted: Dict[ActorID, _HostedActor] = {}
-        self._hosted_lock = threading.Lock()
+        self._hosted_lock = make_lock("worker_main._hosted_lock")
         self._owner_pool = ClientPool()
         # Dedup for retried pushes (the submitter retries an unacked push;
         # at-least-once delivery + this set = exactly-once execution here).
         self._seen_tasks: set = set()
         self._seen_order = collections.deque()
-        self._seen_lock = threading.Lock()
+        self._seen_lock = make_lock("worker_main._seen_lock")
         # Per-owner completion flushers: one dead/unreachable owner must not
         # head-of-line block completion delivery to every other owner.
         self._done_flushers: Dict[str, tuple] = {}
-        self._done_lock = threading.Lock()
+        self._done_lock = make_lock("worker_main._done_lock")
         # Cooperative cancellation: ids cancelled before execution start
         # are skipped (running user code is never preempted — reference
         # semantics for non-force cancel). FIFO-bounded like _seen_tasks.
@@ -359,7 +363,9 @@ class WorkerRuntime(ClusterCore):
                     try:
                         consumed = self._owner_pool.get(owner).call(
                             "stream_consumed", task_id_bytes, timeout=10)
-                    except Exception:
+                    except Exception as e:
+                        logger.debug("stream_consumed poll to %s failed:"
+                                     " %r; stop gating", owner, e)
                         consumed = index  # owner unreachable: stop gating
                         break
                     if consumed < 0:  # stream abandoned owner-side
@@ -377,8 +383,9 @@ class WorkerRuntime(ClusterCore):
             if cancelled and hasattr(gen, "close"):
                 try:
                     gen.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("generator close after cancel raised: "
+                                 "%r", e)
         except BaseException as e:  # noqa: BLE001 -> terminal record
             err = capture_exception(e)
         self._enqueue_done(owner, ("stream_end",
@@ -484,10 +491,11 @@ class WorkerRuntime(ClusterCore):
             try:
                 self._owner_pool.get(owner).retrying_call(
                     "batch_done", entries, timeout=10)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, OSError) as e:
                 # Owner gone: results are orphaned; large ones stay in
                 # the store until the owner's death GC reclaims them.
-                pass
+                logger.debug("owner %s unreachable, %d completions "
+                             "orphaned: %r", owner, len(entries), e)
             except Exception as e:
                 # A handler-side error at a LIVE owner is a completion
                 # LOSS — it must be visible, never silent.

@@ -40,6 +40,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.serialization import SERIALIZER, capture_exception
 from ray_tpu.core.shm_store import ShmObjectExistsError, ShmStore
 from ray_tpu.core.task_spec import PlacementGroupSpec, pg_key_from_strategy
+from ray_tpu.devtools.lock_debug import make_lock
 from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
                                       RpcServer, blocking_rpc)
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
@@ -232,7 +233,7 @@ class _ActorConn:
         self.outbound = collections.deque()  # (seq, task_id_bytes, blob, rids)
         self.unacked = collections.deque()   # [seq, tid, blob, waiter, tries, deadline]
         self.pending: Dict[int, tuple] = {}  # seq -> (tid, blob, return_ids)
-        self.lock = threading.Lock()
+        self.lock = make_lock("cluster_core.actor_conn.lock")
         self.sender_running = False
         self.dead = False
         self.death_reason = ""
@@ -274,7 +275,7 @@ class ClusterCore:
         self.owner_addr = self._server.address
 
         self._key_queues: Dict[tuple, _KeyQueue] = {}
-        self._lease_lock = threading.Lock()
+        self._lease_lock = make_lock("cluster_core._lease_lock")
         # Owner-side object locality cache: oid bytes -> (node_id, size).
         # Populated for free from task completions ("in_store" results
         # carry the sealing node) and local plasma puts; consulted by the
@@ -283,9 +284,9 @@ class ClusterCore:
         import collections as _coll
 
         self._obj_locality: "_coll.OrderedDict" = _coll.OrderedDict()
-        self._obj_loc_lock = threading.Lock()
+        self._obj_loc_lock = make_lock("cluster_core._obj_loc_lock")
         self._inflight: Dict[bytes, _InflightTask] = {}  # task_id -> info
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("cluster_core._inflight_lock")
         # task_id -> ObjectIDs passed as args: each holds a submitted-task
         # ref until the task reaches a TERMINAL state (done or failed), so
         # the caller dropping its local ObjectRef right after `.remote(ref)`
@@ -294,7 +295,7 @@ class ClusterCore:
         self._submitted_args: Dict[bytes, List[ObjectID]] = {}
         # task_id -> _StreamState for in-flight streaming generators.
         self._streams: Dict[bytes, _StreamState] = {}
-        self._streams_lock = threading.Lock()
+        self._streams_lock = make_lock("cluster_core._streams_lock")
         # (expiry, oid) transfer pins for owned refs serialized outbound;
         # swept by the push-ack loop.
         import collections as _collections
@@ -309,12 +310,12 @@ class ClusterCore:
 
         self.lineage = LineageStore(cfg.max_lineage_bytes)
         self._recovering: Dict[bytes, float] = {}  # task_id -> last attempt
-        self._recover_lock = threading.Lock()
+        self._recover_lock = make_lock("cluster_core._recover_lock")
         # Observability: recent completions ring (util.state.list_tasks).
         self._recent_tasks: "_collections.deque" = _collections.deque(
             maxlen=cfg.recent_tasks_ring)
         self._actors: Dict[ActorID, _ActorConn] = {}
-        self._actors_lock = threading.Lock()
+        self._actors_lock = make_lock("cluster_core._actors_lock")
         self._actor_classes: Dict[ActorID, Any] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupSpec] = {}
         # Cancelled task ids: consulted at (re)dispatch so a cancel issued
@@ -333,7 +334,7 @@ class ClusterCore:
         self._push_acks = collections.deque()
         self._push_ack_event = threading.Event()
         self._borrow_buf: Dict[str, list] = {}
-        self._borrow_buf_lock = threading.Lock()
+        self._borrow_buf_lock = make_lock("cluster_core._borrow_buf_lock")
         #: oid bytes -> owner addr for refs this process BORROWS; consulted
         #: when the borrowed ref goes out of scope so the owner can be
         #: told to drop us from its borrower set (the release half of the
@@ -358,7 +359,7 @@ class ClusterCore:
 
         self._fn_exports: "weakref.WeakKeyDictionary" = (
             weakref.WeakKeyDictionary())
-        self._fn_exports_lock = threading.Lock()
+        self._fn_exports_lock = make_lock("cluster_core._fn_exports_lock")
         # digest -> fn, LRU-bounded: unique-lambda loops must not grow it
         # without bound; an evicted digest re-fetches from the head KV.
         import collections
@@ -367,7 +368,7 @@ class ClusterCore:
         self._fn_cache_max = 4096
         # Dedicated cache lock: _fn_exports_lock spans a head kv_put RPC in
         # _export_function; cache mutation must never wait on network I/O.
-        self._fn_cache_lock = threading.Lock()
+        self._fn_cache_lock = make_lock("cluster_core._fn_cache_lock")
         # Object-directory notify outbox: per-put/per-release head frames
         # coalesce into one object_batch frame per flush window — N
         # concurrent writers were paying N head frames (+ head dispatch +
@@ -377,7 +378,7 @@ class ClusterCore:
         # Single-flusher guard: shutdown's last-gasp flush racing the
         # daemon's would split an ordered add/rm pair across two frames
         # whose send order is unconstrained.
-        self._obj_notify_flush_lock = threading.Lock()
+        self._obj_notify_flush_lock = make_lock("cluster_core._obj_notify_flush_lock")
         threading.Thread(target=self._obj_notify_loop, daemon=True,
                          name="obj-notify").start()
         threading.Thread(target=self._push_ack_loop, daemon=True,
@@ -1314,7 +1315,11 @@ class ClusterCore:
         digest = hashlib.sha1(blob).digest()
         with self._fn_exports_lock:
             if digest not in self._fn_cache:
-                self.head.retrying_call("kv_put", "__fn__", digest, blob,
+                # Export lock spans the kv_put BY DESIGN: it single-
+                # flights concurrent exports of one function (dedup) and
+                # is never taken on the dispatch/cache hot path (that is
+                # what _fn_cache_lock is for).
+                self.head.retrying_call("kv_put", "__fn__", digest, blob,  # rtpu-lint: disable=blocking-under-lock
                                         False, timeout=10)
                 self._fn_cache_put(digest, func)
         try:
@@ -2681,6 +2686,8 @@ class ClusterCore:
             pass
         self._server.stop()
         self._pool.close_all()
+        # _shutdown_flag is set above: the reaper's next 50ms lap exits.
+        self._lease_reaper.join(timeout=2.0)
         for c in (self.head, self.node):
             try:
                 c.close()

@@ -38,7 +38,9 @@ class PlasmaStub:
 
 class MemoryStore:
     def __init__(self):
-        self._lock = threading.Lock()
+        from ray_tpu.devtools.lock_debug import make_lock
+
+        self._lock = make_lock("memory_store._lock")
         self._cv = threading.Condition(self._lock)
         self._objects: Dict[ObjectID, _Record] = {}
         self._callbacks: Dict[ObjectID, List[Callable[[_Record], None]]] = {}

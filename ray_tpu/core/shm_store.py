@@ -25,8 +25,10 @@ from typing import Optional, Tuple
 
 from ray_tpu.core.ids import ObjectID
 
+from ray_tpu.devtools.lock_debug import make_lock as _make_lock
+
 _LIB = None
-_LIB_LOCK = threading.Lock()
+_LIB_LOCK = _make_lock("shm_store._LIB_LOCK")
 
 #: Expected shm segment layout version. MUST match kLayoutVersion in
 #: shm_store.cc: the v2 layout shards the arena (per-shard mutexes, slot

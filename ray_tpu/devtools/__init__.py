@@ -1,0 +1,19 @@
+"""rtpu devtools: project-specific static analysis + runtime checkers.
+
+Every PR so far has shipped post-review fixes for the same bug families
+(lock-ordering hazards, blocking I/O while holding a state lock, sockets
+closed without shutdown under readers writing into shm, dashboard
+innerHTML XSS, jax<0.5-incompatible API calls, swallowed exceptions).
+This package codifies those invariants as tooling instead of reviewer
+memory — the same move as the reference's lint-enforced C++ status/ID
+conventions and TSan wiring:
+
+- ``python -m ray_tpu.devtools.lint``: AST-based, stdlib-only linter
+  enforcing the declared invariants (see ``invariants.py``) against a
+  checked-in baseline (``lint_baseline.json``) — legacy violations are
+  tracked-not-fatal, NEW violations fail the run.
+- ``lock_debug``: ``RTPU_DEBUG_LOCKS=1`` swaps the cluster core's lock
+  creation for an ordering witness that records the per-thread lock
+  acquisition graph, detects order cycles online, and reports
+  excessive hold times via util/metrics.
+"""

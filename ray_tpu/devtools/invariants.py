@@ -1,0 +1,169 @@
+"""Codified concurrency + compatibility invariants for rtpu-lint.
+
+Each table below is an invariant mined from a post-review finding in an
+earlier PR; the linter (``lint.py``) enforces them, the README's
+"Concurrency invariants & lint" section documents them for humans. Keep
+the two in sync: a new invariant lands here FIRST, then in prose.
+
+Module keys are dotted module names (``ray_tpu.cluster.node_manager``).
+Lock names are the attribute/variable names as they appear in source
+(``_zygote_lock`` matches ``self._zygote_lock`` and a bare
+``_zygote_lock``).
+"""
+
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------- locks
+
+#: What counts as "a lock" when the linter sees ``with <expr>:`` or
+#: ``<expr>.acquire()``. Condition variables count too: entering one
+#: acquires its underlying lock.
+LOCK_NAME_RE = re.compile(r"(lock|mutex|_cv|_cond|cond)$", re.IGNORECASE)
+
+#: Declared acquisition order per module: within one chain, a lock may
+#: only be acquired while holding locks that appear EARLIER in the
+#: chain. Acquiring chain[i] while holding chain[j] (j > i) is a
+#: lock-order violation. (PR 2: the zygote lock split — the fork
+#: round-trip's pipe I/O runs under ``_zygote_io_lock`` with
+#: ``_zygote_lock`` taken briefly inside it for handle lifecycle;
+#: nesting them the other way re-creates the stop()-wedged-behind-a-
+#: 60s-fork hang the split fixed.)
+LOCK_ORDER: dict[str, list[list[str]]] = {
+    "ray_tpu.cluster.node_manager": [
+        ["_zygote_io_lock", "_zygote_lock"],
+    ],
+}
+# (protocol's send-vs-pending rule lives in NEVER_NESTED below — an
+# ordering chain needs two members to enforce anything.)
+
+#: Lock groups that must NEVER be held together (any nesting, either
+#: order). The Python-side analog of shm layout v2's "no op ever holds
+#: two shard locks" rule (PR 4).
+NEVER_NESTED: dict[str, list[set[str]]] = {
+    "ray_tpu.cluster.worker_main": [
+        {"_seen_lock", "_done_lock", "_hosted_lock", "order_lock"},
+    ],
+    "ray_tpu.cluster.protocol": [
+        {"_send_lock", "_pending_lock"},
+        {"send_lock", "_pending_lock"},
+    ],
+    "ray_tpu.core.cluster_core": [
+        # Owner-side bookkeeping locks are leaves: holding two at once
+        # is how the single-flusher/outbox races of PR 4 started.
+        {"_obj_loc_lock", "_inflight_lock", "_lease_lock",
+         "_obj_notify_flush_lock"},
+    ],
+    "ray_tpu.cluster.node_manager": [
+        {"_lock", "_pull_lock"},
+    ],
+}
+
+#: Locks that exist to SERIALIZE blocking I/O — the blocking-under-lock
+#: rule does not apply to them (holding them during recv/sendmsg is the
+#: point). Everything else holding a lock across the calls in
+#: BLOCKING_METHODS/BLOCKING_FUNCS is a finding.
+IO_LOCKS: dict[str, set[str]] = {
+    "ray_tpu.cluster.protocol": {"send_lock", "_send_lock"},
+    "ray_tpu.cluster.node_manager": {"_zygote_io_lock"},
+}
+
+#: Method names whose call under a (non-IO) lock blocks on the network,
+#: a pipe, or a subprocess. ``.wait``/``.join`` are deliberately absent:
+#: Condition.wait releases its lock and Thread.join under a lock is a
+#: separate (ordering) problem.
+BLOCKING_METHODS = {
+    "recv", "recv_into", "recvmsg", "recvmsg_into", "recvfrom",
+    "sendmsg", "sendall", "accept", "connect", "readline", "select",
+    "retrying_call",
+}
+
+#: Dotted function names that block (subprocess round-trips, fork pipe
+#: I/O). Matched against the full dotted call target.
+BLOCKING_FUNCS = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.fork", "os.forkpty",
+}
+
+#: ``time.sleep(x)`` with a constant ``x`` strictly greater than this
+#: (seconds) inside a ``with <lock>`` body is a finding.
+SLEEP_UNDER_LOCK_MAX_S = 0.05
+
+# ------------------------------------------------------------- sockets
+
+#: Modules whose sockets feed ``recv_into`` sinks (caller-owned shm
+#: views): a bare ``close()`` leaves a blocked reader alive and writing
+#: into freed/reallocated memory — ``shutdown()`` is what wakes it
+#: (PR 4 review rounds 1+2). Any ``<x>.close()`` where ``x`` looks like
+#: a socket and has no earlier ``shutdown``/``_shutdown_socket`` in the
+#: same function is flagged in these modules.
+SOCKET_SHUTDOWN_MODULES = {
+    "ray_tpu.cluster.protocol",
+    "ray_tpu.cluster.node_manager",
+    "ray_tpu.cluster.head",
+    "ray_tpu.cluster.worker_main",
+}
+
+#: Variable-name heuristic for "this is a socket".
+SOCKET_NAME_RE = re.compile(r"sock", re.IGNORECASE)
+
+# ---------------------------------------------------------- banned APIs
+
+#: jax<0.5 compatibility (this container ships jax<0.5): these calls /
+#: imports silently break it. Use the compat shims instead.
+#: dotted-call-suffix -> replacement hint.
+BANNED_CALLS = {
+    "jax.sharding.set_mesh":
+        "use ray_tpu.parallel.mesh.mesh_context() (jax<0.5 has no "
+        "set_mesh)",
+    "sharding.set_mesh":
+        "use ray_tpu.parallel.mesh.mesh_context() (jax<0.5 has no "
+        "set_mesh)",
+}
+
+#: Module paths whose import is banned (jax<0.5 moved/renamed them).
+#: import-path -> (replacement hint, exempt modules). The exempt module
+#: IS the compat shim — it may import the real thing inside a guarded
+#: fallback.
+BANNED_IMPORTS = {
+    "jax.experimental.shard_map": (
+        "import shard_map via the ray_tpu.ops.ring_attention compat "
+        "shim (the jax.experimental path is jax<0.5-only and moves in "
+        "0.5+)",
+        {"ray_tpu.ops.ring_attention"},
+    ),
+    "jax.shard_map": (
+        "import shard_map via the ray_tpu.ops.ring_attention compat "
+        "shim (top-level jax.shard_map does not exist before jax 0.5)",
+        {"ray_tpu.ops.ring_attention"},
+    ),
+}
+
+#: Modules that embed browser JS in Python strings: every occurrence of
+#: these substrings in a string constant is flagged (the dashboard XSS
+#: was fixed twice — PR 1 and PR 3 — before it became a rule).
+#: substring -> hint.
+DASHBOARD_MODULES = {"ray_tpu.util.dashboard"}
+BANNED_JS_SUBSTRINGS = {
+    "innerHTML":
+        "prefer textContent; innerHTML is allowed only for fully "
+        "esc()-disciplined markup (tracked in the baseline)",
+    "document.write": "document.write executes markup; build nodes or "
+                      "use textContent",
+}
+
+# --------------------------------------------------------- bare excepts
+
+#: Logging-ish call names that make a broad except "handled".
+LOGGING_CALL_NAMES = {
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log", "print_exc", "print_exception", "print",
+    "capture_exception", "zlog",
+}
+
+#: Comment tokens that suppress a finding on their line.
+SUPPRESS_TOKEN = "rtpu-lint: disable="
+#: Existing `# noqa: BLE001` annotations mark audited broad excepts.
+NOQA_BROAD_EXCEPT = "noqa: BLE001"

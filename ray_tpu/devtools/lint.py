@@ -1,0 +1,585 @@
+"""rtpu-lint: AST-based invariant enforcement for this repo.
+
+Stdlib-only. Run as ``python -m ray_tpu.devtools.lint`` (from the repo
+root or anywhere — the default scan roots resolve relative to the
+installed package). Rules live in ``invariants.py``; each finding
+carries a rule id:
+
+  lock-order            nested acquisition violating a declared chain,
+                        or two locks from a never-nested group held
+                        together
+  blocking-under-lock   socket recv*/sendmsg, subprocess, pipe reads,
+                        or a long time.sleep inside a ``with <lock>``
+                        body (I/O-serialization locks exempt)
+  close-without-shutdown  socket .close() with no earlier shutdown in
+                        the same function (recv_into-sink modules only)
+  banned-api            jax<0.5-breaking calls/imports; dashboard
+                        innerHTML/document.write in JS strings
+  swallowed-exception   broad except that neither raises, logs, nor
+                        uses the bound exception
+  daemon-no-join        a daemon Thread stored on self but never
+                        joined by any method of the class
+
+Baseline workflow: legacy findings live in ``lint_baseline.json``
+(fingerprint -> count). A run fails (exit 1) only when a fingerprint's
+current count exceeds its baselined count — new violations fail, old
+ones are tracked. Update after an intentional change with
+``--write-baseline``. Suppress a single line with
+``# rtpu-lint: disable=<rule-id>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.devtools import invariants as inv
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "lint_baseline.json")
+
+RULES = (
+    "lock-order", "blocking-under-lock", "close-without-shutdown",
+    "banned-api", "swallowed-exception", "daemon-no-join",
+)
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "scope", "message")
+
+    def __init__(self, rule: str, path: str, line: int, scope: str,
+                 message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.scope = scope
+        self.message = message
+
+    def fingerprint(self) -> str:
+        # Line numbers drift with every edit: the fingerprint hashes the
+        # rule + file + enclosing scope + message so baselined findings
+        # survive unrelated churn. Duplicates within one scope share a
+        # fingerprint and are baselined by COUNT.
+        raw = "|".join((self.rule, self.path, self.scope, self.message))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                f"  (in {self.scope or '<module>'})")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """The lock's short name if ``expr`` looks like a lock (self._lock,
+    module_lock, conn.send_lock ...)."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    if inv.LOCK_NAME_RE.search(name):
+        return name
+    return None
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, module: str, path: str, source: str):
+        self.module = module
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+        self._scope: List[str] = []
+        self._held: List[str] = []  # with-lock stack (short names)
+        self._order = inv.LOCK_ORDER.get(module, ())
+        self._never = inv.NEVER_NESTED.get(module, ())
+        self._io_locks = inv.IO_LOCKS.get(module, set())
+        self._is_dashboard = module in inv.DASHBOARD_MODULES
+        self._check_sockets = module in inv.SOCKET_SHUTDOWN_MODULES
+        self._js_counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ utils
+
+    def _suppressed(self, line: int, rule: str) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        text = self.lines[line - 1]
+        tok = inv.SUPPRESS_TOKEN
+        if tok in text:
+            parts = text.split(tok, 1)[1].split()
+            if parts and rule in parts[0].split(","):
+                return True
+        if rule == "swallowed-exception" and inv.NOQA_BROAD_EXCEPT in text:
+            return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(line, rule):
+            return
+        self.findings.append(Finding(rule, self.path, line,
+                                     ".".join(self._scope), message))
+
+    # ------------------------------------------------------------ scope
+
+    def visit_FunctionDef(self, node):
+        self._scope.append(node.name)
+        if self._check_sockets:
+            self._check_close_without_shutdown(node)
+        # A nested def's body runs LATER, on whatever thread calls it —
+        # not under the with-locks lexically enclosing the def. Clear
+        # the held stack for its body so closures defined inside a lock
+        # block aren't falsely flagged (and restore for the remainder
+        # of the enclosing block).
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+        self._scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self._check_daemon_threads(node)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    # -------------------------------------------------- socket shutdown
+
+    def _check_close_without_shutdown(self, fn) -> None:
+        """Within one function: ``x.close()`` on a socket-looking name
+        with no earlier ``x.shutdown(...)`` / ``_shutdown_socket(x)``.
+        A bare close() frees the fd without waking a thread blocked in
+        recv on it — which then keeps writing into freed shm."""
+        events = []  # (lineno, col, kind, varname)
+        # Walk THIS function only: nested defs get their own visit (a
+        # shared walk would double-report every close() inside them).
+        todo = list(ast.iter_child_nodes(fn))
+        nodes = []
+        while todo:
+            sub = todo.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            nodes.append(sub)
+            todo.extend(ast.iter_child_nodes(sub))
+        for sub in nodes:
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute):
+                var = _dotted(sub.func.value)
+                if var is None or not inv.SOCKET_NAME_RE.search(var):
+                    continue
+                if sub.func.attr == "shutdown":
+                    events.append((sub.lineno, sub.col_offset, "shut",
+                                   var))
+                elif sub.func.attr == "close":
+                    events.append((sub.lineno, sub.col_offset, "close",
+                                   var))
+            elif isinstance(sub.func, ast.Name) and \
+                    "shutdown" in sub.func.id and sub.args:
+                var = _dotted(sub.args[0])
+                if var is not None:
+                    events.append((sub.lineno, sub.col_offset, "shut",
+                                   var))
+        shut = set()
+        for lineno, _col, kind, var in sorted(events):
+            if kind == "shut":
+                shut.add(var)
+            elif var not in shut:
+                if not self._suppressed(lineno, "close-without-shutdown"):
+                    self.findings.append(Finding(
+                        "close-without-shutdown", self.path, lineno,
+                        ".".join(self._scope),
+                        f"{var}.close() without a prior shutdown() in "
+                        f"'{fn.name}' — a reader blocked in recv stays "
+                        "alive writing into freed buffers"))
+
+    # -------------------------------------------------------- lock rules
+
+    def _check_lock_pair(self, node: ast.AST, new: str) -> None:
+        for held in self._held:
+            if held == new:
+                continue
+            for chain in self._order:
+                if new in chain and held in chain and \
+                        chain.index(new) < chain.index(held):
+                    self._emit(
+                        "lock-order", node,
+                        f"acquires '{new}' while holding '{held}' — "
+                        f"declared order is {' -> '.join(chain)}")
+            for group in self._never:
+                if new in group and held in group:
+                    self._emit(
+                        "lock-order", node,
+                        f"acquires '{new}' while holding '{held}' — "
+                        "these locks are declared never-nested")
+
+    def visit_With(self, node):
+        count = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            name = _lock_name(item.context_expr)
+            if name is not None:
+                self._check_lock_pair(item.context_expr, name)
+                self._held.append(name)
+                count += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if count:
+            del self._held[-count:]
+
+    visit_AsyncWith = visit_With
+
+    def _held_non_io(self) -> List[str]:
+        return [h for h in self._held if h not in self._io_locks]
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        # .acquire() on another lock while inside a with-lock body.
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            name = _lock_name(node.func.value)
+            if name is not None and self._held:
+                self._check_lock_pair(node, name)
+        # Blocking calls under a (non-IO) lock.
+        held = self._held_non_io()
+        if held:
+            blocked = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in inv.BLOCKING_METHODS:
+                blocked = f".{node.func.attr}()"
+            elif dotted in inv.BLOCKING_FUNCS:
+                blocked = f"{dotted}()"
+            elif dotted == "time.sleep" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, (int, float)) and \
+                        arg.value > inv.SLEEP_UNDER_LOCK_MAX_S:
+                    blocked = f"time.sleep({arg.value})"
+            if blocked is not None:
+                self._emit(
+                    "blocking-under-lock", node,
+                    f"{blocked} inside `with {held[-1]}` — blocking "
+                    "I/O must not run while holding a state lock")
+        # Banned jax calls.
+        if dotted is not None:
+            for suffix, hint in inv.BANNED_CALLS.items():
+                if dotted == suffix or dotted.endswith("." + suffix):
+                    self._emit("banned-api", node,
+                               f"call to {dotted}: {hint}")
+                    break
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------- imports
+
+    def _banned_import(self, node: ast.AST, path: str) -> None:
+        entry = inv.BANNED_IMPORTS.get(path)
+        if entry is None:
+            return
+        hint, exempt = entry
+        if self.module in exempt:
+            return
+        self._emit("banned-api", node, f"import of {path}: {hint}")
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            self._banned_import(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        self._banned_import(node, mod)
+        for alias in node.names:
+            self._banned_import(node, f"{mod}.{alias.name}")
+        self.generic_visit(node)
+
+    # ------------------------------------------------------- JS strings
+
+    def visit_Constant(self, node):
+        if self._is_dashboard and isinstance(node.value, str):
+            for sub, hint in inv.BANNED_JS_SUBSTRINGS.items():
+                start = 0
+                while True:
+                    idx = node.value.find(sub, start)
+                    if idx < 0:
+                        break
+                    line = node.lineno + node.value.count("\n", 0, idx)
+                    # Fingerprint by per-file occurrence INDEX, not char
+                    # offset: edits elsewhere in the JS must not churn
+                    # the baseline.
+                    n = self._js_counts.get(sub, 0)
+                    self._js_counts[sub] = n + 1
+                    if not self._suppressed(line, "banned-api"):
+                        self.findings.append(Finding(
+                            "banned-api", self.path, line,
+                            ".".join(self._scope) + f"+{sub}#{n}",
+                            f"'{sub}' in dashboard JS: {hint}"))
+                    start = idx + len(sub)
+        self.generic_visit(node)
+
+    # ------------------------------------------------------ bare excepts
+
+    def visit_ExceptHandler(self, node):
+        if self._broad(node.type) and not self._handled(node):
+            self._emit(
+                "swallowed-exception", node,
+                "broad except neither raises, logs, nor uses the "
+                "exception — log at debug minimum or narrow the type")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _broad(type_node) -> bool:
+        if type_node is None:
+            return True
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [t for t in type_node.elts]
+        else:
+            names = [type_node]
+        for t in names:
+            n = t.id if isinstance(t, ast.Name) else (
+                t.attr if isinstance(t, ast.Attribute) else "")
+            if n in ("Exception", "BaseException"):
+                return True
+        return False
+
+    @staticmethod
+    def _handled(handler: ast.ExceptHandler) -> bool:
+        bound = handler.name
+        for sub in ast.walk(ast.Module(body=handler.body,
+                                       type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                return True
+            if bound and isinstance(sub, ast.Name) and sub.id == bound:
+                return True  # exception object is inspected/reported
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                n = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if n in inv.LOGGING_CALL_NAMES:
+                    return True
+        return False
+
+    # ------------------------------------------------- daemon-thread join
+
+    def _check_daemon_threads(self, cls: ast.ClassDef) -> None:
+        daemons: List[Tuple[str, ast.AST]] = []
+        joined: set = set()
+        for sub in ast.walk(cls):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and isinstance(sub.value, ast.Call)):
+                    fn = _dotted(sub.value.func) or ""
+                    if fn.endswith("Thread"):
+                        for kw in sub.value.keywords:
+                            if (kw.arg == "daemon"
+                                    and isinstance(kw.value, ast.Constant)
+                                    and kw.value.value is True):
+                                daemons.append((tgt.attr, sub))
+            if (isinstance(sub, ast.Attribute) and sub.attr == "join"
+                    and isinstance(sub.value, ast.Attribute)
+                    and isinstance(sub.value.value, ast.Name)
+                    and sub.value.value.id == "self"):
+                joined.add(sub.value.attr)
+        for attr, node in daemons:
+            if attr not in joined:
+                self._emit(
+                    "daemon-no-join", node,
+                    f"daemon thread self.{attr} is never joined by any "
+                    "method of this class — join it on close/shutdown "
+                    "so teardown is ordered")
+
+
+# --------------------------------------------------------------- driver
+
+
+def lint_source(source: str, module: str, path: str) -> List[Finding]:
+    """Lint one module's source; ``module`` selects the invariant
+    tables that apply (tests inject fixture snippets this way)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("banned-api", path, e.lineno or 1, "",
+                        f"syntax error: {e.msg}")]
+    linter = _FileLinter(module, path, source)
+    linter.visit(tree)
+    return linter.findings
+
+
+def _module_for(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def default_roots() -> Tuple[str, List[str]]:
+    """(repo_root, scan paths): the installed ray_tpu package plus the
+    repo-root driver scripts when present."""
+    pkg = os.path.dirname(_HERE)          # .../ray_tpu
+    repo = os.path.dirname(pkg)           # the dir holding the package
+    paths = [pkg]
+    for extra in ("bench.py", "__graft_entry__.py"):
+        p = os.path.join(repo, extra)
+        if os.path.exists(p):
+            paths.append(p)
+    return repo, paths
+
+
+def iter_py_files(paths: List[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def lint_paths(paths: List[str], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(path, root)
+        findings.extend(
+            Finding(f.rule, rel, f.line, f.scope, f.message)
+            for f in lint_source(source, _module_for(path, root), rel))
+    return findings
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return data.get("findings", {})
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    table: Dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        entry = table.setdefault(fp, {
+            "count": 0, "rule": f.rule, "path": f.path,
+            "message": f.message})
+        entry["count"] += 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1,
+                   "note": "legacy findings tracked-not-fatal; "
+                           "regenerate with python -m "
+                           "ray_tpu.devtools.lint --write-baseline",
+                   "findings": dict(sorted(table.items()))},
+                  fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def new_findings(findings: List[Finding],
+                 baseline: Dict[str, dict]) -> List[Finding]:
+    """Findings whose per-fingerprint count exceeds the baseline's."""
+    budget = {fp: e.get("count", 0) for fp, e in baseline.items()}
+    out = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the ray_tpu "
+                        "package + repo-root driver scripts)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: the packaged one)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from this run's findings")
+    p.add_argument("--all", action="store_true",
+                   help="print baselined findings too, not just new")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-rule finding counts")
+    args = p.parse_args(argv)
+
+    root, roots = default_roots()
+    paths = args.paths or roots
+    findings = lint_paths(paths, root)
+
+    if args.stats:
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        for rule in RULES:
+            print(f"{rule:24s} {counts.get(rule, 0)}")
+
+    if args.write_baseline:
+        if args.paths and (os.path.abspath(args.baseline)
+                           == os.path.abspath(DEFAULT_BASELINE)):
+            # A partial scan must never truncate the repo baseline: the
+            # next full run would report every other legacy finding as
+            # new and fail tier-1.
+            print("refusing --write-baseline of the packaged baseline "
+                  "from an explicit path list (it would drop every "
+                  "finding outside those paths); rerun with no paths, "
+                  "or pass --baseline <other-file>", file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, findings)
+        print(f"baseline written: {len(findings)} findings -> "
+              f"{args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    fresh = new_findings(findings, baseline)
+    if args.all:
+        for f in findings:
+            mark = "NEW " if f in fresh else "base"
+            print(f"[{mark}] {f}")
+    else:
+        for f in fresh:
+            print(f"NEW: {f}")
+    n_base = len(findings) - len(fresh)
+    print(f"rtpu-lint: {len(findings)} findings "
+          f"({n_base} baselined, {len(fresh)} new)")
+    if fresh:
+        print("new findings fail the lint — fix them, suppress with "
+              "'# rtpu-lint: disable=<rule>', or (for an accepted "
+              "legacy-style debt) --write-baseline")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

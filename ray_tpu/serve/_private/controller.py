@@ -23,11 +23,13 @@ class ServeController:
         import ray_tpu  # inside the actor process
 
         self._ray = ray_tpu
-        self._lock = threading.RLock()
+        from ray_tpu.devtools.lock_debug import make_lock, make_rlock
+
+        self._lock = make_rlock("serve.controller._lock")
         # Serializes whole reconcile passes: deploy() and the background
         # loop reconciling the same deployment concurrently would both
         # observe the deficit and double-create replicas.
-        self._reconcile_mutex = threading.Lock()
+        self._reconcile_mutex = make_lock("serve.controller._reconcile_mutex")
         # name -> {config..., replicas: [ActorHandle], version}
         self._deployments: Dict[str, Dict[str, Any]] = {}
         # Replica-SET versions + condvar: routers long-poll
@@ -42,7 +44,7 @@ class ServeController:
         # Serializes _ensure_proxies (user RPC vs reconcile loop): two
         # concurrent passes would each spawn a proxy for the same node and
         # the overwritten handle would leak its actor forever.
-        self._proxy_mutex = threading.Lock()
+        self._proxy_mutex = make_lock("serve.controller._proxy_mutex")
         self._shutdown = False
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()

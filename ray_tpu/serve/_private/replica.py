@@ -31,7 +31,9 @@ class ReplicaActor:
         self._callable = cls(*init_args, **init_kwargs)
         self._ongoing = 0
         self._total = 0
-        self._lock = threading.Lock()
+        from ray_tpu.devtools.lock_debug import make_lock
+
+        self._lock = make_lock("serve.replica._lock")
         self._started = time.time()
         # Live response streams: stream_id -> [queue, cancelled_event,
         # last_poll_monotonic] (a drain thread pulls the user generator so

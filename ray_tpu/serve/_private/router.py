@@ -12,10 +12,13 @@ not on a refresh timer.
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class Router:
@@ -27,7 +30,9 @@ class Router:
             refresh_interval_s = cfg.serve_router_refresh_s
         self._controller = controller
         self._deployment = deployment
-        self._lock = threading.Lock()
+        from ray_tpu.devtools.lock_debug import make_lock
+
+        self._lock = make_lock("serve.router._lock")
         self._replicas: List[Any] = []
         self._version = -1
         self._inflight: Dict[Any, int] = {}
@@ -63,8 +68,9 @@ class Router:
             self._poller_started = True
         try:
             self._seed()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("router seed for %s failed (poller will "
+                         "retry): %r", self._deployment, e)
         threading.Thread(target=self._poll_loop, daemon=True,
                          name=f"serve-longpoll-{self._deployment}").start()
 
@@ -105,8 +111,8 @@ class Router:
 
                         self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
                         self._seed()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.debug("controller re-resolve failed: %r", e)
 
     def stop(self) -> None:
         self._stopped = True

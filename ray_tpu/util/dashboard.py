@@ -22,6 +22,11 @@ import re
 import threading
 from typing import Any, Dict, Optional
 
+# rtpu-lint scans this module's strings for innerHTML/document.write
+# (banned-api rule): the XSS here was fixed twice before it became a
+# rule. The esc()-disciplined sites below are tracked in
+# devtools/lint_baseline.json; any NEW occurrence fails the lint — use
+# textContent for anything carrying user strings.
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
 <style>

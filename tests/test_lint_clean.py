@@ -1,0 +1,27 @@
+"""Tier-1 guard: the repo lints clean against its checked-in baseline.
+
+A NEW violation of any codified invariant (lock order, blocking-under-
+lock, close-without-shutdown, banned jax<0.5 / dashboard APIs,
+swallowed exceptions, unjoined daemon threads) fails this test — the
+same check `python -m ray_tpu.devtools.lint` runs standalone. After an
+intentional change, regenerate with
+``python -m ray_tpu.devtools.lint --write-baseline``.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools import lint
+
+
+def _fresh():
+    root, paths = lint.default_roots()
+    findings = lint.lint_paths(paths, root)
+    baseline = lint.load_baseline(lint.DEFAULT_BASELINE)
+    return lint.new_findings(findings, baseline)
+
+
+def test_repo_lints_clean_against_baseline():
+    fresh = _fresh()
+    assert not fresh, (
+        "new rtpu-lint findings (fix, suppress inline, or "
+        "--write-baseline):\n" + "\n".join(str(f) for f in fresh))
