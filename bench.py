@@ -29,6 +29,15 @@ Rows:
   disabled: tok/s both ways, ``llm_spec_accept_rate``, and the
   ``spec_speedup`` ratio (greedy outputs are token-identical, so both
   rows count the same tokens).
+- ops_microbench / decode_matmul_gbps — per-kernel rows (``--ops`` runs
+  them standalone): fused-vs-unfused step time for the model-path glue
+  (RMSNorm / rope / SwiGLU, ops/fused.py) and the decode-shaped matmul's
+  weight-streaming GB/s at the working dtype vs weight-only int8
+  (``baseline_dtype`` names the precision) — so a kernel
+  regression is visible in BENCH_r0N without a full train run.
+- llm_decode_tokens_per_s_int8 — the decode bench re-run with
+  ``quantize="int8"`` (weight-only int8, models/quant.py) on an
+  otherwise identical engine; carries ``speedup_vs_f32``.
 - serve_llm_* — req/s + p50/p99 TTFT through the FULL serve stack
   (controller/router/replica, tiny engine) in a CPU child process; the
   reference publishes no serve numbers (it delegates to vLLM), so these
@@ -145,7 +154,8 @@ def _bench_8b_proxy(on_tpu: bool, devices, kind: str) -> dict:
     from ray_tpu.models import llama
 
     if on_tpu:
-        base = dataclasses.replace(llama.LLAMA3_8B, max_seq_len=2048)
+        base = dataclasses.replace(llama.LLAMA3_8B, max_seq_len=2048,
+                                   fused_ops=True)
         batch, seq, warmup, iters = 4, 2048, 2, 6
         depth_pairs = [(2, 6), (2, 4)]  # fallback shrinks HBM footprint
     else:
@@ -193,8 +203,11 @@ def _bench_8b_proxy(on_tpu: bool, devices, kind: str) -> dict:
             "error": f"all depth pairs failed: {last_err!r:.300}"}
 
 
-def _bench_decode(on_tpu: bool) -> dict:
-    """Steady-state decode throughput of the native LLM engine."""
+def _bench_decode(on_tpu: bool, quantize: str = None) -> dict:
+    """Steady-state decode throughput of the native LLM engine
+    (``quantize="int8"`` measures the weight-only-quantized engine on
+    the identical workload — the decode path is weight-bandwidth bound,
+    so halving the weight bytes is the headline lever)."""
     import threading
 
     import numpy as np
@@ -212,7 +225,8 @@ def _bench_decode(on_tpu: bool) -> dict:
     # decode_chunk=8: one host sync per 8 tokens — through the remote-TPU
     # tunnel per-token sync alone caps throughput at ~13 steps/s.
     engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
-                       prompt_buckets=[32], decode_chunk=8)
+                       prompt_buckets=[32], decode_chunk=8,
+                       quantize=quantize)
     rng = np.random.default_rng(0)
 
     hi = min(1000, cfg.vocab_size - 1)
@@ -246,10 +260,14 @@ def _bench_decode(on_tpu: bool) -> dict:
     if client_errors and not sum(counts):
         raise RuntimeError(f"all decode clients failed: {client_errors[0]}")
     tps = sum(counts) / elapsed
-    row = {"metric": "llm_decode_tokens_per_s", "value": round(tps, 1),
+    metric = ("llm_decode_tokens_per_s_int8" if quantize == "int8"
+              else "llm_decode_tokens_per_s")
+    row = {"metric": metric, "value": round(tps, 1),
            "unit": "tokens/s",
            "config": "llama3-1b" if on_tpu else "tiny-cpu",
            "max_batch": max_batch}
+    if quantize:
+        row["quantize"] = quantize
     if client_errors:
         # Dead clients deflate throughput: a plausible-but-wrong number
         # must carry the evidence (module invariant).
@@ -433,6 +451,150 @@ def engine_child_main() -> None:
         print(json.dumps(row), flush=True)
 
 
+# --------------------------------------------------------------------------
+# ops microbench suite (--ops): per-kernel fused-vs-unfused + int8 matmul
+# --------------------------------------------------------------------------
+
+def _timed_chain(fn, state, iters: int, warmup: int = 3):
+    """Seconds per call for a shape-preserving jitted fn, chained
+    state -> state so XLA cannot hoist the work; one host fetch per
+    timed region (the only reliable barrier through the TPU tunnel)."""
+    import jax
+
+    for _ in range(warmup):
+        state = fn(state)
+    float(jax.tree.leaves(state)[0].ravel()[0])  # drain warmup work
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    leaves = jax.tree.leaves(jax.tree.map(lambda a: a.ravel()[0], state))
+    float(leaves[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def _bench_ops(on_tpu: bool) -> list:
+    """Per-kernel microbenches: fused vs unfused step time for the
+    model-path glue, and the decode matmul's weight GB/s at the
+    working dtype vs
+    weight-only int8. Small and self-contained so a kernel regression
+    shows up in every BENCH round."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import ops
+
+    if on_tpu:
+        b, s, d, f, h, kh, hd = 8, 2048, 2048, 8192, 32, 8, 64
+        fm, iters, dt = 8192, 30, jnp.bfloat16
+    else:
+        b, s, d, f, h, kh, hd = 2, 128, 64, 128, 4, 2, 16
+        fm, iters, dt = 512, 10, jnp.float32
+    config = "llama1b-shapes" if on_tpu else "tiny-cpu"
+    key = jax.random.PRNGKey(0)
+    rows = []
+
+    def row(op, fused_fn, plain_fn, state, shape):
+        t_plain = _timed_chain(jax.jit(plain_fn), state, iters)
+        t_fused = _timed_chain(jax.jit(fused_fn), state, iters)
+        rows.append({
+            "metric": "ops_microbench", "op": op,
+            "fused_us": round(t_fused * 1e6, 1),
+            "unfused_us": round(t_plain * 1e6, 1),
+            "speedup": round(t_plain / t_fused, 3) if t_fused else None,
+            "shape": shape, "config": config})
+
+    # Fused-vs-unfused is only a measurement where the fused path IS a
+    # kernel: off-TPU the dispatchers fall back to the very references
+    # the "unfused" lambdas call, so the ratio would be two timings of
+    # the same function — round-over-round noise dressed as a signal.
+    if on_tpu:
+        x = jax.random.normal(key, (b, s, d), dt)
+        scale = jax.random.normal(jax.random.fold_in(key, 1), (d,),
+                                  jnp.float32) * 0.1
+        row("rms_norm",
+            lambda x: ops.fused_rms_norm(x, scale),
+            lambda x: ops.rms_norm(x, scale),
+            x, [b, s, d])
+
+        q = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, hd),
+                              dt)
+        k = jax.random.normal(jax.random.fold_in(key, 3), (b, s, kh, hd),
+                              dt)
+        pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+        row("rope_qk",
+            lambda qk: ops.fused_qk_rope(qk[0], qk[1], pos),
+            lambda qk: (ops.apply_rope(qk[0], pos),
+                        ops.apply_rope(qk[1], pos)),
+            (q, k), [b, s, h, hd])
+
+        gate = jax.random.normal(jax.random.fold_in(key, 4), (b, s, f),
+                                 dt)
+        up = jax.random.normal(jax.random.fold_in(key, 5), (b, s, f), dt)
+        row("swiglu",
+            lambda g: ops.fused_swiglu(g, up),
+            lambda g: (jax.nn.silu(g) * up).astype(g.dtype),
+            gate, [b, s, f])
+
+    # Decode-shaped matmul: tiny activation against a big square weight
+    # — pure weight streaming, the thing int8 halves. GB/s counts the
+    # WEIGHT bytes actually read per step. The weights ride the chained
+    # STATE (jit arguments), never a closure: a closed-over int8 weight
+    # gets constant-folded to full width at trace time and the "int8"
+    # timing silently streams full-precision bytes (verified in HLO).
+    w = jax.random.normal(jax.random.fold_in(key, 6), (fm, fm), dt)
+    wq = jnp.clip(jnp.round(w.astype(jnp.float32) * 127), -127,
+                  127).astype(jnp.int8)
+    wscale = jnp.full((fm,), 1.0 / 127, jnp.float32)
+    xa = jax.random.normal(jax.random.fold_in(key, 7), (8, fm), dt)
+    t_base = _timed_chain(
+        jax.jit(lambda s: ((s[0] @ s[1]).astype(dt), s[1])),
+        (xa, w), iters)
+    t_int8 = _timed_chain(
+        jax.jit(lambda s: (((s[0] @ s[1].astype(s[0].dtype))
+                            * s[2]).astype(dt), s[1], s[2])),
+        (xa, wq, wscale), iters)
+    rows.append({
+        "metric": "decode_matmul_gbps",
+        # "baseline" = the model's working dtype (bf16 on TPU, f32 on
+        # CPU) — named by the dtype field, not mislabelled f32.
+        "baseline_gbps": round(fm * fm * w.dtype.itemsize / t_base / 1e9,
+                               2),
+        "int8_gbps": round(fm * fm * 1 / t_int8 / 1e9, 2),
+        "baseline_dtype": jnp.dtype(dt).name,
+        "speedup": round(t_base / t_int8, 3) if t_int8 else None,
+        "weight_shape": [fm, fm], "batch": 8, "config": config})
+    return rows
+
+
+def ops_main() -> int:
+    """Standalone ``--ops``: per-kernel rows + one merged tail line."""
+    _pin_platform()
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rows = _bench_ops(on_tpu)
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_ops_rows(rows)))
+    return 0
+
+
+def _merge_ops_rows(rows: list) -> dict:
+    merged = {"metric": "ops"}
+    for r in rows:
+        if r.get("metric") == "ops_microbench" and "error" not in r:
+            merged[f"ops_fused_{r['op']}_speedup"] = r.get("speedup")
+        elif r.get("metric") == "decode_matmul_gbps" and "error" not in r:
+            merged["decode_matmul_baseline_gbps"] = r.get("baseline_gbps")
+            merged["decode_matmul_baseline_dtype"] = \
+                r.get("baseline_dtype")
+            merged["decode_matmul_int8_gbps"] = r.get("int8_gbps")
+            merged["decode_matmul_int8_speedup"] = r.get("speedup")
+        elif "error" in r:
+            merged.setdefault("error", r["error"])
+    return merged
+
+
 def child_main() -> None:
     _pin_platform()
     import jax
@@ -444,8 +606,13 @@ def child_main() -> None:
     kind = devices[0].device_kind
 
     # --- row 1: Llama-1B full-model MFU (round-over-round continuity) ---
+    # fused_ops=True: Pallas-fused RMSNorm/rope/SwiGLU on TPU
+    # (ops/fused.py; off-TPU the flag falls back to the references, so
+    # the CPU row is unaffected). Equivalence vs the unfused path is
+    # tier-1-tested (tests/test_fused_ops.py).
     if on_tpu:
-        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=2048)
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=2048,
+                                  fused_ops=True)
         batch, seq, warmup, iters = 8, 2048, 2, 10
     else:
         cfg = llama.tiny_config(max_seq_len=256)
@@ -463,6 +630,7 @@ def child_main() -> None:
         "device": kind,
         "n_chips": len(devices),
         "config": "llama3-1b" if on_tpu else "tiny-cpu",
+        "fused_ops": bool(cfg.fused_ops),
         "batch": batch, "seq": seq,
     }
     print(json.dumps(row_1b), flush=True)
@@ -484,6 +652,17 @@ def child_main() -> None:
                    "unit": "tokens/s", "error": repr(e)[:300]}
     print(json.dumps(row_dec), flush=True)
 
+    # --- row 3b: same decode workload, weight-only int8 engine ----------
+    try:
+        row_q = _bench_decode(on_tpu, quantize="int8")
+        if row_dec.get("value") and row_q.get("value"):
+            row_q["speedup_vs_f32"] = round(
+                row_q["value"] / row_dec["value"], 3)
+    except Exception as e:  # noqa: BLE001
+        row_q = {"metric": "llm_decode_tokens_per_s_int8", "value": 0.0,
+                 "unit": "tokens/s", "error": repr(e)[:300]}
+    print(json.dumps(row_q), flush=True)
+
     # --- row 4: engine suite (decode + TTFT + prefix-cache) -------------
     try:
         row_eng = _bench_engine(on_tpu)
@@ -497,6 +676,14 @@ def child_main() -> None:
     except Exception as e:  # noqa: BLE001
         spec_rows = [{"metric": "llm_engine_spec", "error": repr(e)[:300]}]
     for r in spec_rows:
+        print(json.dumps(r), flush=True)
+
+    # --- rows 7+: per-kernel ops microbench (fused glue + int8 matmul) --
+    try:
+        ops_rows = _bench_ops(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        ops_rows = [{"metric": "ops_microbench", "error": repr(e)[:300]}]
+    for r in ops_rows:
         print(json.dumps(r), flush=True)
 
 
@@ -1033,6 +1220,16 @@ def main() -> int:
     merged["train_mfu_llama1b"] = r1b.get("value")
     dec = by_metric.get("llm_decode_tokens_per_s", {})
     merged["llm_decode_tokens_per_s"] = dec.get("value")
+    decq = by_metric.get("llm_decode_tokens_per_s_int8", {})
+    if "error" not in decq and decq.get("value"):
+        merged["llm_decode_tokens_per_s_int8"] = decq.get("value")
+        merged["llm_decode_int8_speedup"] = decq.get("speedup_vs_f32")
+    ops_merged = _merge_ops_rows(
+        [r for r in rows if r.get("metric") in ("ops_microbench",
+                                                "decode_matmul_gbps")])
+    for k, v in ops_merged.items():
+        if k not in ("metric", "error") and v is not None:
+            merged[k] = v
     eng = by_metric.get("llm_engine", {})
     if "error" not in eng:
         for k in ("ttft_ms", "prefix_hit_rate"):
@@ -1082,6 +1279,8 @@ if __name__ == "__main__":
         sys.exit(serve_child_main())
     if "--engine" in sys.argv:
         sys.exit(engine_child_main())
+    if "--ops" in sys.argv:
+        sys.exit(ops_main())
     if "--locality-child" in sys.argv:
         sys.exit(locality_child_main())
     if "--locality" in sys.argv:

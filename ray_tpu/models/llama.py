@@ -28,10 +28,18 @@ from jax import lax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ray_tpu.ops.attention import causal_attention, full_causal_attention
-from ray_tpu.ops.norms import rms_norm
-from ray_tpu.ops.ring_attention import ring_attention
-from ray_tpu.ops.rotary import apply_rope
+from ray_tpu.ops import (
+    apply_rope,
+    causal_attention,
+    full_causal_attention,
+    fused_qk_rope,
+    fused_rms_norm,
+    fused_rms_norm_residual,
+    fused_swiglu,
+    ring_attention,
+    rms_norm,
+)
+from ray_tpu.models.quant import QuantTensor
 from ray_tpu.parallel.mesh import constrain
 
 Params = Dict[str, Any]
@@ -57,6 +65,14 @@ class LlamaConfig:
     # kernel glue under the Pallas interpreter off-TPU (test coverage for
     # the dispatch itself).
     use_decode_kernel: Any = True
+    # Fused Pallas kernels for the per-layer glue (ops/fused.py):
+    # RMSNorm(+residual), rotary folded over the QK projection outputs,
+    # and SwiGLU each become one VMEM pass instead of several XLA HBM
+    # round trips. True = fused kernels on TPU, jnp references elsewhere
+    # (same custom-VJP wrapper either way, so the train path fuses too);
+    # "interpret" = run the kernels under the Pallas interpreter off-TPU
+    # (equivalence-test escape hatch); False = the plain unfused ops.
+    fused_ops: Any = False
     # jax.checkpoint policy name: "nothing" = full per-layer remat (lowest
     # HBM — backward recomputes the block from its input), "dots" = save
     # non-batch matmul outputs (faster bwd, +O(layers*S*d_ff) HBM).
@@ -173,6 +189,36 @@ def _remat_policy(cfg: LlamaConfig):
 
 
 
+def _wdot(eqn: str, x, w):
+    """Weight-side einsum accepting dense arrays OR ``QuantTensor``
+    (weight-only int8, ``models/quant.py``): the int8 weights widen to
+    the activation dtype INSIDE the dot (XLA streams them from HBM at
+    one byte/element) and the per-output-channel fp32 scale right-
+    broadcasts against the output — every weight einsum in this model
+    routes through here so quantized pytrees work engine-wide."""
+    if isinstance(w, QuantTensor):
+        y = jnp.einsum(eqn, x, w.q.astype(x.dtype))
+        return (y.astype(jnp.float32) * w.scale).astype(x.dtype)
+    return jnp.einsum(eqn, x, w)
+
+
+def _head_matmul(x, params, cfg: LlamaConfig):
+    """Final LM-head projection (tied embeddings are never quantized)."""
+    if cfg.tie_embeddings:
+        return jnp.einsum("bsd,dv->bsv", x, params["embed"].T)
+    return _wdot("bsd,dv->bsv", x, params["lm_head"])
+
+
+def _norm(x, scale, cfg: LlamaConfig):
+    """RMSNorm with the ``cfg.fused_ops`` dispatch — the SINGLE decode
+    point for the flag (train/decode paths must not re-derive it and
+    drift)."""
+    if cfg.fused_ops:
+        return fused_rms_norm(x, scale, cfg.norm_eps,
+                              interpret=cfg.fused_ops == "interpret")
+    return rms_norm(x, scale, cfg.norm_eps)
+
+
 def _attention_dispatch(q, k, v, q_pos, kv_pos, cfg, mesh: Optional[Mesh],
                         standard_positions: bool = False):
     """``standard_positions`` is a STATIC flag set by the caller when positions
@@ -189,14 +235,20 @@ def _attention_dispatch(q, k, v, q_pos, kv_pos, cfg, mesh: Optional[Mesh],
 def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
            cache_kv=None, cache_index=None, standard_positions: bool = False):
     """One transformer block. Returns (x, new_kv | None)."""
-    h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    fused = bool(cfg.fused_ops)
+    interp = cfg.fused_ops == "interpret"
+    h = _norm(x, layer["ln_attn"], cfg)
+    q = _wdot("bsd,dhk->bshk", h, layer["wq"])
+    k = _wdot("bsd,dhk->bshk", h, layer["wk"])
+    v = _wdot("bsd,dhk->bshk", h, layer["wv"])
     q = constrain(q, ("batch", "seq", "heads", None))
     k = constrain(k, ("batch", "seq", "kv_heads", None))
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    if fused:
+        q, k = fused_qk_rope(q, k, positions, cfg.rope_theta,
+                             interpret=interp)
+    else:
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
 
     new_kv = None
     if cache_kv is not None:
@@ -213,7 +265,7 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
             # Pallas kernel streams the native-layout cache directly
             # (ops/decode_attention.py). "interpret" runs the same glue
             # under the Pallas interpreter off-TPU (test escape hatch).
-            from ray_tpu.ops.decode_attention import decode_attention
+            from ray_tpu.ops import decode_attention
 
             lengths = jnp.broadcast_to(cache_index + 1, (x.shape[0],))
             s_cache = ck.shape[2]
@@ -233,14 +285,22 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
         attn = _attention_dispatch(q, k, v, positions, positions, cfg, mesh,
                                    standard_positions=standard_positions)
     attn = constrain(attn, ("batch", "seq", "heads", None))
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"]).astype(x.dtype)
+    attn_out = _wdot("bshk,hkd->bsd", attn, layer["wo"]).astype(x.dtype)
+    if fused:
+        # Residual add folded into the next norm: one pass emits both
+        # the normed MLP input and the updated residual stream.
+        h, x = fused_rms_norm_residual(attn_out, x, layer["ln_mlp"],
+                                       cfg.norm_eps, interpret=interp)
+    else:
+        x = x + attn_out
+        h = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
     x = constrain(x, ("batch", "seq", None))
-
-    h = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-    ff = constrain(jax.nn.silu(gate) * up, ("batch", "seq", "mlp"))
-    x = x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"]).astype(x.dtype)
+    gate = _wdot("bsd,df->bsf", h, layer["w_gate"])
+    up = _wdot("bsd,df->bsf", h, layer["w_up"])
+    ff = fused_swiglu(gate, up, interpret=interp) if fused \
+        else jax.nn.silu(gate) * up
+    ff = constrain(ff, ("batch", "seq", "mlp"))
+    x = x + _wdot("bsf,fd->bsd", ff, layer["w_down"]).astype(x.dtype)
     return constrain(x, ("batch", "seq", None)), new_kv
 
 
@@ -249,8 +309,7 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
             positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Full-sequence forward: tokens [B,S] -> logits [B,S,V]."""
     x = forward_hidden(params, tokens, cfg, mesh=mesh, positions=positions)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    logits = _head_matmul(x, params, cfg)
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
@@ -282,7 +341,7 @@ def forward_hidden(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg))
     x, _ = lax.scan(body, x, params["blocks"])
-    return rms_norm(x, params["ln_out"], cfg.norm_eps)
+    return _norm(x, params["ln_out"], cfg)
 
 
 def loss_fn(params: Params, tokens: jnp.ndarray, cfg: LlamaConfig,
@@ -321,7 +380,7 @@ def loss_from_hidden(params: Params, x: jnp.ndarray, tokens: jnp.ndarray,
 
     def chunk_nll(args):
         xc, tc = args  # [B,C,D], [B,C]
-        logits = jnp.einsum("bcd,dv->bcv", xc, head).astype(jnp.float32)
+        logits = _wdot("bcd,dv->bcv", xc, head).astype(jnp.float32)
         logits = constrain(logits, ("batch", "seq", "vocab"))
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
@@ -380,7 +439,6 @@ def forward_with_cache(params: Params, tokens: jnp.ndarray,
         return y, new_kv
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["ln_out"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    x = _norm(x, params["ln_out"], cfg)
+    logits = _head_matmul(x, params, cfg)
     return logits, {"k": new_k, "v": new_v}

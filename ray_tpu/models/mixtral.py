@@ -22,8 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ray_tpu.models import llama
-from ray_tpu.ops.norms import rms_norm
-from ray_tpu.ops.rotary import apply_rope
+from ray_tpu.ops import apply_rope, rms_norm
 from ray_tpu.parallel.mesh import constrain
 
 Params = Dict[str, Any]
@@ -209,7 +208,7 @@ def _moe_block(x, layer, positions, cfg: MixtralConfig,
     vv = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
     q = apply_rope(q, positions, cfg.rope_theta)
     kk = apply_rope(kk, positions, cfg.rope_theta)
-    from ray_tpu.ops.attention import full_causal_attention
+    from ray_tpu.ops import full_causal_attention
 
     attn = full_causal_attention(q, kk, vv)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"]).astype(x.dtype)

@@ -1,0 +1,122 @@
+"""Weight-only int8 quantization for the serving/decode path.
+
+Decode is memory-bandwidth bound: every generated token streams every
+matmul weight from HBM once, so halving the weight bytes nearly halves
+the per-token step time (and it COMPOUNDS with speculative decoding —
+the multi-token verify window amortizes the same weight read over more
+tokens). This module quantizes the matmul weights of a Llama-class
+param pytree to int8 with per-output-channel fp32 scales:
+
+    q     = round(w / scale)  clipped to [-127, 127], int8
+    scale = max|w| over the CONTRACTED (input) dims / 127
+
+and the matmul becomes an int8 weight gather + rescale of the OUTPUT:
+
+    y = einsum(x, q.astype(x.dtype)) * scale        # scale broadcasts
+                                                    # over output dims
+
+The int8->bf16/f32 convert fuses into the dot (XLA keeps the weights
+int8 in HBM and widens in registers), values in [-127, 127] are exact
+in bf16, and the scale is applied per output channel in fp32 — so
+activations and accumulation keep full precision; only the weights are
+compressed. Embedding table and norm scales stay unquantized (the
+gather is cheap and the norms are tiny).
+
+``models/llama.py`` consumes ``QuantTensor`` leaves transparently in
+every weight einsum (``_wdot``), so ``forward_with_cache`` — and with
+it the engine's prefill/decode/verify programs — accepts a quantized
+pytree unchanged. The engine exposes this as ``LLMEngine(
+quantize="int8")``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantTensor(NamedTuple):
+    """A weight-only quantized matmul operand (a pytree node — NamedTuple
+    leaves flow through ``lax.scan`` / ``tree_map`` untouched).
+
+    ``q``: int8, the original weight shape. ``scale``: fp32, the
+    NON-contracted (output) dims' shape — it right-broadcasts against
+    the matmul output, never against ``q``.
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+#: Contracted (input) axes per PER-LAYER weight, excluding the stacked
+#: ``layers`` axis 0 handled by the caller: these are the dims each
+#: einsum in ``llama._block`` sums over.
+_BLOCK_CONTRACT: Dict[str, Tuple[int, ...]] = {
+    "wq": (0,),        # [d, h, hd] @ bsd -> contract d
+    "wk": (0,),
+    "wv": (0,),
+    "wo": (0, 1),      # [h, hd, d] @ bshk -> contract h, hd
+    "w_gate": (0,),    # [d, f]
+    "w_up": (0,),
+    "w_down": (0,),    # [f, d]
+}
+
+
+def _quantize_leaf(w: jnp.ndarray, contract: Tuple[int, ...]) -> QuantTensor:
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=contract)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / jnp.expand_dims(scale, contract)),
+                 -127, 127).astype(jnp.int8)
+    return QuantTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QuantTensor, contract: Tuple[int, ...],
+               dtype=jnp.float32) -> jnp.ndarray:
+    """Reconstruct the (lossy) full-precision weight — test/debug aid."""
+    return (qt.q.astype(jnp.float32)
+            * jnp.expand_dims(qt.scale, contract)).astype(dtype)
+
+
+def quantize_params(params: Dict[str, Any], dtype: str = "int8",
+                    ) -> Dict[str, Any]:
+    """Quantize a Llama param pytree's matmul weights to ``dtype``.
+
+    Returns a NEW tree: ``blocks`` matmul weights and ``lm_head`` become
+    ``QuantTensor`` leaves (stacked-layer axis preserved — the per-layer
+    scan slices ``q`` and ``scale`` together); ``embed``, ``ln_*`` stay
+    as-is. Only ``"int8"`` is implemented.
+    """
+    if dtype != "int8":
+        raise ValueError(f"unsupported quantize dtype {dtype!r}; "
+                         "only 'int8' is implemented")
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, contract in _BLOCK_CONTRACT.items():
+        # Leaves are stacked [layers, ...]: shift the per-layer contract
+        # axes past the layer dim so every layer gets its own scales.
+        stacked = tuple(a + 1 for a in contract)
+        blocks[name] = _quantize_leaf(blocks[name], stacked)
+    out["blocks"] = blocks
+    if "lm_head" in params:
+        out["lm_head"] = _quantize_leaf(params["lm_head"], (0,))
+    return out
+
+
+def quantized_weight_bytes(params: Dict[str, Any]) -> Tuple[int, int]:
+    """(weight bytes this tree holds, bytes the same tree would hold
+    with every weight at fp32) — surfaces in ``LLMEngine.stats()`` so
+    the bandwidth claim behind ``quantize="int8"`` is inspectable."""
+    actual = f32 = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda n: isinstance(n, QuantTensor)):
+        if isinstance(leaf, QuantTensor):
+            actual += (leaf.q.size * leaf.q.dtype.itemsize
+                       + leaf.scale.size * leaf.scale.dtype.itemsize)
+            f32 += leaf.q.size * 4
+        else:
+            actual += leaf.size * leaf.dtype.itemsize
+            f32 += leaf.size * 4
+    return actual, f32

@@ -26,7 +26,7 @@ from jax import lax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops import rms_norm
 from ray_tpu.parallel.mesh import constrain
 
 Params = Dict[str, Any]

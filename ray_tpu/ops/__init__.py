@@ -1,0 +1,71 @@
+"""Public kernel API for the model path.
+
+Every op pairs a portable jnp reference with a TPU-tuned fast path and a
+dispatcher that picks between them; callers import from THIS package,
+not the submodules. Dispatch conditions:
+
+=========================  ===============================  =========================================
+op                         TPU fast path                    dispatch condition
+=========================  ===============================  =========================================
+full_causal_attention      Pallas flash kernel (fwd+bwd)    ``use_fused_kernel``: standard arange
+                                                            positions, seq >= 256 and % 128 == 0,
+                                                            head_dim <= 128 or % 128 == 0; else
+                                                            blockwise scan (seq >= 1024) / dense
+causal_attention           (portable dense reference)       always available; position-based masks
+blockwise_attention        (portable online-softmax scan)   seq a multiple of ``block_k``
+decode_attention           Pallas single-query kernel       on TPU, or ``interpret=True`` off-TPU;
+                                                            jnp reference elsewhere
+ring_attention             shard_map ppermute ring          mesh ``sp`` axis > 1 (the ONLY module
+                                                            allowed to import shard_map — rtpu-lint
+                                                            banned-API rule)
+rms_norm                   (fp32 jnp reference)             always; the fused ops' exactness anchor
+apply_rope                 (fp32 jnp reference)             always
+fused_rms_norm             Pallas one-pass norm kernel      ``LlamaConfig.fused_ops``: kernel on TPU
+fused_rms_norm_residual    + residual-add fold              or under ``interpret``; reference impl
+fused_qk_rope              one kernel for q AND k           elsewhere (same custom VJP both ways,
+fused_swiglu               silu(gate)*up, no temp           so the train path may fuse too)
+=========================  ===============================  =========================================
+"""
+
+from ray_tpu.ops.attention import (
+    blockwise_attention,
+    causal_attention,
+    full_causal_attention,
+    online_softmax_update,
+    repeat_kv,
+    use_fused_kernel,
+)
+from ray_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+)
+from ray_tpu.ops.fused import (
+    fused_qk_rope,
+    fused_rms_norm,
+    fused_rms_norm_residual,
+    fused_swiglu,
+    swiglu_reference,
+)
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rotary import apply_rope, rope_frequencies
+
+__all__ = [
+    "apply_rope",
+    "blockwise_attention",
+    "causal_attention",
+    "decode_attention",
+    "decode_attention_reference",
+    "full_causal_attention",
+    "fused_qk_rope",
+    "fused_rms_norm",
+    "fused_rms_norm_residual",
+    "fused_swiglu",
+    "online_softmax_update",
+    "repeat_kv",
+    "ring_attention",
+    "rms_norm",
+    "rope_frequencies",
+    "swiglu_reference",
+    "use_fused_kernel",
+]

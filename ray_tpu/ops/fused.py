@@ -1,0 +1,331 @@
+"""Fused Pallas TPU kernels for the per-layer model-path glue.
+
+The transformer block's non-matmul work — RMSNorm, rotary embedding,
+SwiGLU — is memory-bound elementwise/reduction glue between matmuls.
+Left to XLA it becomes several HBM round trips per block (norm reads x,
+rope reads q and k separately and recomputes cos/sin twice, the silu
+and multiply each materialize a [B,S,F] temp). Each op here makes ONE
+pass over its operands in VMEM:
+
+- ``fused_rms_norm``          — fp32 normalize + scale in one pass.
+- ``fused_rms_norm_residual`` — residual add folded into the next norm:
+  returns ``(normed, summed)`` so the block's ``x = x + attn; h =
+  rms_norm(x)`` pair reads/writes ``x`` once.
+- ``fused_qk_rope``           — one kernel rotates BOTH the q and k
+  projection outputs, computing the cos/sin tables once per position
+  (the unfused path recomputes them per tensor).
+- ``fused_swiglu``            — ``silu(gate) * up`` in fp32 without a
+  materialized intermediate.
+
+Each op follows the ``ops/decode_attention.py`` idiom: a pure-jnp
+reference (the exact pre-fusion formulation), a Pallas kernel, and a
+dispatcher that runs the kernel on TPU (or under ``interpret=True`` on
+CPU — the test suite checks kernel-vs-reference equivalence that way)
+and the reference elsewhere. Every op carries a custom VJP (backward in
+plain jnp, checked against autodiff of the reference) so the TRAINING
+path can use the fused forward under ``jax.checkpoint``; models opt in
+via ``LlamaConfig.fused_ops``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ray_tpu.ops.norms import rms_norm as rms_norm_reference
+from ray_tpu.ops.rotary import apply_rope as apply_rope_reference
+
+_ROW_BLOCKS = (128, 64, 32, 16, 8, 4, 2, 1)
+_COL_BLOCKS = (1024, 512, 256, 128)
+
+
+def _row_block(n: int) -> int:
+    return next(c for c in _ROW_BLOCKS if n % c == 0)
+
+
+def _col_block(n: int) -> int:
+    for c in _COL_BLOCKS:
+        if n % c == 0:
+            return c
+    return n  # small/ragged feature dim: one block spans it
+
+
+def _use_kernel(interpret: bool) -> bool:
+    return interpret or jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------- RMSNorm
+
+def _rms_kernel(x_ref, s_ref, o_ref, *, eps: float):
+    xf = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    o_ref[...] = (y * (1.0 + s_ref[...].astype(jnp.float32))
+                  ).astype(o_ref.dtype)
+
+
+def _rms_res_kernel(x_ref, r_ref, s_ref, o_ref, sum_ref, *, eps: float):
+    # The residual add happens in the STORAGE dtype (matching the
+    # unfused ``x = x + attn`` it replaces), then the norm upcasts.
+    u = x_ref[...] + r_ref[...]
+    sum_ref[...] = u
+    uf = u.astype(jnp.float32)
+    var = jnp.mean(uf * uf, axis=-1, keepdims=True)
+    y = uf * lax.rsqrt(var + eps)
+    o_ref[...] = (y * (1.0 + s_ref[...].astype(jnp.float32))
+                  ).astype(o_ref.dtype)
+
+
+def _rms_impl(x, scale, eps, interpret, residual=None):
+    import jax.experimental.pallas as pl
+
+    shape = x.shape
+    d = shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    bn = _row_block(n)
+    s2 = scale.reshape(1, d)
+    row_spec = pl.BlockSpec((bn, d), lambda i: (i, 0))
+    scale_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    if residual is None:
+        out = pl.pallas_call(
+            functools.partial(_rms_kernel, eps=eps),
+            grid=(n // bn,),
+            in_specs=[row_spec, scale_spec],
+            out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+            interpret=interpret,
+        )(x2, s2)
+        return out.reshape(shape)
+    r2 = residual.reshape(-1, d)
+    out, summed = pl.pallas_call(
+        functools.partial(_rms_res_kernel, eps=eps),
+        grid=(n // bn,),
+        in_specs=[row_spec, row_spec, scale_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                   jax.ShapeDtypeStruct((n, d), residual.dtype)],
+        interpret=interpret,
+    )(x2, r2, s2)
+    return out.reshape(shape), summed.reshape(shape)
+
+
+def _rms_bwd_math(u, scale, gy, eps):
+    """Backward of y = rms_norm(u) * (1 + scale) w.r.t. (u, scale)."""
+    uf = u.astype(jnp.float32)
+    gf = gy.astype(jnp.float32)
+    r = lax.rsqrt(jnp.mean(uf * uf, axis=-1, keepdims=True) + eps)
+    n_ = uf * r
+    dn = gf * (1.0 + scale.astype(jnp.float32))
+    du = r * (dn - n_ * jnp.mean(dn * n_, axis=-1, keepdims=True))
+    ds = jnp.sum(gf * n_, axis=tuple(range(u.ndim - 1)))
+    return du, ds
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_p(x, scale, eps, interpret):
+    if not _use_kernel(interpret):
+        return rms_norm_reference(x, scale, eps)
+    return _rms_impl(x, scale, eps, interpret)
+
+
+def _rms_fwd(x, scale, eps, interpret):
+    return _rms_p(x, scale, eps, interpret), (x, scale)
+
+
+def _rms_bwd(eps, interpret, res, gy):
+    x, scale = res
+    du, ds = _rms_bwd_math(x, scale, gy, eps)
+    return du.astype(x.dtype), ds.astype(scale.dtype)
+
+
+_rms_p.defvjp(_rms_fwd, _rms_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _rms_res_p(x, residual, scale, eps, interpret):
+    if not _use_kernel(interpret):
+        u = x + residual
+        return rms_norm_reference(u, scale, eps), u
+    return _rms_impl(x, scale, eps, interpret, residual=residual)
+
+
+def _rms_res_fwd(x, residual, scale, eps, interpret):
+    y, u = _rms_res_p(x, residual, scale, eps, interpret)
+    return (y, u), (u, scale)
+
+
+def _rms_res_bwd(eps, interpret, res, gs):
+    u, scale = res
+    gy, gsum = gs
+    du, ds = _rms_bwd_math(u, scale, gy, eps)
+    du = du + gsum.astype(jnp.float32)
+    return (du.astype(u.dtype), du.astype(u.dtype), ds.astype(scale.dtype))
+
+
+_rms_res_p.defvjp(_rms_res_fwd, _rms_res_bwd)
+
+
+def fused_rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5,
+                   *, interpret: bool = False) -> jnp.ndarray:
+    """One-pass RMSNorm (fp32 compute): Pallas kernel on TPU / under
+    ``interpret``; the exact ``ops.norms.rms_norm`` reference elsewhere.
+    Differentiable (custom VJP) either way."""
+    return _rms_p(x, scale, float(eps), bool(interpret))
+
+
+def fused_rms_norm_residual(x: jnp.ndarray, residual: jnp.ndarray,
+                            scale: jnp.ndarray, eps: float = 1e-5,
+                            *, interpret: bool = False):
+    """Residual add folded into the norm: returns ``(normed, x +
+    residual)`` in one pass over the operands."""
+    return _rms_res_p(x, residual, scale, float(eps), bool(interpret))
+
+
+# ------------------------------------------------------------------ RoPE
+
+def _rope_kernel(pos_ref, q_ref, k_ref, oq_ref, ok_ref, *, theta: float):
+    # All intermediates stay >= 2D and no cross-lane reshapes happen
+    # (1D vectors and (1,N)->(N,1) relayouts are the classic Mosaic
+    # lowering failures); broadcasting inserts the unit axes instead.
+    d = q_ref.shape[-1]
+    half = d // 2
+    # Same formulation as ops.rotary.rope_frequencies: 1 / theta^(2i/d).
+    expo = lax.broadcasted_iota(jnp.float32, (1, 1, half), 2) * (2.0 / d)
+    inv = 1.0 / (theta ** expo)                            # [1, 1, half]
+    ang = pos_ref[...].astype(jnp.float32)[..., None] * inv  # [1,bs,half]
+    cos = jnp.cos(ang)[:, :, None, :]                    # [1,bs,1,half]
+    sin = jnp.sin(ang)[:, :, None, :]
+    for ref, out in ((q_ref, oq_ref), (k_ref, ok_ref)):
+        x = ref[...].astype(jnp.float32)                 # [1,bs,H,D]
+        x1, x2 = x[..., :half], x[..., half:]
+        out[...] = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+            axis=-1).astype(out.dtype)
+
+
+def _rope_impl(q, k, positions, theta, interpret):
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    kh = k.shape[2]
+    bs = _row_block(s)
+    qspec = pl.BlockSpec((1, bs, h, d), lambda bi, si: (bi, si, 0, 0))
+    kspec = pl.BlockSpec((1, bs, kh, d), lambda bi, si: (bi, si, 0, 0))
+    pspec = pl.BlockSpec((1, bs), lambda bi, si: (bi, si))
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, theta=theta),
+        grid=(b, s // bs),
+        in_specs=[pspec, qspec, kspec],
+        out_specs=[qspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(k.shape, k.dtype)],
+        interpret=interpret,
+    )(positions, q, k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _rope_qk_p(q, k, positions, theta, interpret):
+    if not _use_kernel(interpret):
+        return (apply_rope_reference(q, positions, theta),
+                apply_rope_reference(k, positions, theta))
+    return _rope_impl(q, k, positions, theta, interpret)
+
+
+def _rope_qk_fwd(q, k, positions, theta, interpret):
+    return _rope_qk_p(q, k, positions, theta, interpret), (positions,)
+
+
+def _rope_qk_bwd(theta, interpret, res, gs):
+    # Rotation is orthogonal: the VJP rotates the cotangents by -angle,
+    # i.e. the same kernel with negated positions.
+    (positions,) = res
+    gq, gk = gs
+    dq, dk = _rope_qk_p(gq, gk, -positions, theta, interpret)
+    dpos = np.zeros(positions.shape, jax.dtypes.float0)
+    return dq, dk, dpos
+
+
+_rope_qk_p.defvjp(_rope_qk_fwd, _rope_qk_bwd)
+
+
+def fused_qk_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
+                  theta: float = 500000.0, *, interpret: bool = False):
+    """Rotate the q AND k projection outputs in one kernel: q [B,S,H,D],
+    k [B,S,KH,D], positions [B,S] int. The cos/sin tables are computed
+    once per position (the unfused path recomputes them per tensor).
+    Returns ``(q_rot, k_rot)``; matches two ``ops.rotary.apply_rope``
+    calls."""
+    return _rope_qk_p(q, k, positions, float(theta), bool(interpret))
+
+
+# ---------------------------------------------------------------- SwiGLU
+
+def swiglu_reference(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    """The unfused formulation from the block: ``silu(gate) * up``
+    computed in fp32 (kernel and reference share the upcast)."""
+    out = jax.nn.silu(gate.astype(jnp.float32)) * up.astype(jnp.float32)
+    return out.astype(gate.dtype)
+
+
+def _swiglu_kernel(g_ref, u_ref, o_ref):
+    gf = g_ref[...].astype(jnp.float32)
+    uf = u_ref[...].astype(jnp.float32)
+    o_ref[...] = (gf * jax.nn.sigmoid(gf) * uf).astype(o_ref.dtype)
+
+
+def _swiglu_impl(gate, up, interpret):
+    import jax.experimental.pallas as pl
+
+    shape = gate.shape
+    f = shape[-1]
+    g2 = gate.reshape(-1, f)
+    n = g2.shape[0]
+    bn = _row_block(n)
+    bf = _col_block(f)
+    spec = pl.BlockSpec((bn, bf), lambda i, j: (i, j))
+    out = pl.pallas_call(
+        _swiglu_kernel,
+        grid=(n // bn, f // bf),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((n, f), gate.dtype),
+        interpret=interpret,
+    )(g2, up.reshape(-1, f))
+    return out.reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _swiglu_p(gate, up, interpret):
+    if not _use_kernel(interpret):
+        return swiglu_reference(gate, up)
+    return _swiglu_impl(gate, up, interpret)
+
+
+def _swiglu_fwd(gate, up, interpret):
+    return _swiglu_p(gate, up, interpret), (gate, up)
+
+
+def _swiglu_bwd(interpret, res, g):
+    gate, up = res
+    gf = gate.astype(jnp.float32)
+    uf = up.astype(jnp.float32)
+    cot = g.astype(jnp.float32)
+    sig = jax.nn.sigmoid(gf)
+    dgate = cot * uf * sig * (1.0 + gf * (1.0 - sig))
+    dup = cot * gf * sig
+    return dgate.astype(gate.dtype), dup.astype(up.dtype)
+
+
+_swiglu_p.defvjp(_swiglu_fwd, _swiglu_bwd)
+
+
+def fused_swiglu(gate: jnp.ndarray, up: jnp.ndarray,
+                 *, interpret: bool = False) -> jnp.ndarray:
+    """``silu(gate) * up`` in one pass (fp32 compute, no materialized
+    silu intermediate)."""
+    return _swiglu_p(gate, up, bool(interpret))

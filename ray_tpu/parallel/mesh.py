@@ -86,6 +86,42 @@ def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     return make_mesh(MeshSpec(), devs)
 
 
+def mesh_2d(n_devices: Optional[int] = None, *, tp: Optional[int] = None,
+            devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The canonical 2D **FSDP x tensor** training mesh.
+
+    This is the production shape for dense-model pretraining (the
+    scaling-book default): parameters ZeRO-3-shard over ``fsdp`` (outer
+    axis — bigger, less frequent all-gather/reduce-scatter, DCN-safe)
+    while each layer's matmuls split over ``tp`` (inner axis — chatty
+    collectives ride nearest-neighbor ICI, see `make_mesh`). ``tp``
+    defaults to the largest power of two <= min(8, n_devices) that
+    divides ``n_devices``; everything left fills ``fsdp``. All other
+    axes stay 1, so the mesh is logically 2D while remaining
+    program-compatible with the full six-axis Mesh.
+
+    The Llama train step needs no further wiring: `param_logical_axes`
+    names every weight dim, `DEFAULT_RULES` maps embed->fsdp and
+    heads/mlp/vocab->tp, and `spmd.sharded_init` materializes the
+    NamedShardings (verified by `spmd.assert_params_sharded`).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = list(devices)[:n_devices]
+        if len(devices) != n_devices:
+            raise ValueError(
+                f"mesh_2d: need {n_devices} devices, have {len(devices)}")
+    n = len(devices)
+    if tp is None:
+        tp = largest_pow2_leq(min(8, n))
+        while n % tp:
+            tp //= 2
+    if n % tp:
+        raise ValueError(f"mesh_2d: {n} devices not divisible by tp={tp}")
+    return make_mesh(MeshSpec(fsdp=n // tp, tp=tp), devices)
+
+
 # ---------------------------------------------------------------------------
 # Logical-axis → mesh-axis mapping (t5x-style logical annotations, minimal).
 # ---------------------------------------------------------------------------

@@ -147,7 +147,7 @@ def pipeline_forward_hidden(params: Params, tokens: jnp.ndarray,
     (rotor, outputs), _ = lax.scan(tick, (rotor, outputs),
                                    jnp.arange(n_ticks))
     hidden = outputs.reshape(b, s, d)
-    from ray_tpu.ops.norms import rms_norm
+    from ray_tpu.ops import rms_norm
 
     return rms_norm(hidden, params["ln_out"], cfg.norm_eps)
 

@@ -19,7 +19,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import llama
-from ray_tpu.parallel.mesh import param_shardings
+from ray_tpu.parallel.mesh import logical_spec, param_shardings
 
 
 class TrainState(NamedTuple):
@@ -90,6 +90,58 @@ def make_eval_step(cfg: llama.LlamaConfig, mesh: Mesh):
         loss, metrics = llama.loss_fn(params, tokens, cfg, mesh=mesh)
         return metrics
     return _with_mesh_context(mesh, jax.jit(eval_fn))
+
+
+def sharding_summary(params: Any, logical_tree: Any) -> Dict[str, str]:
+    """Flat ``{param path: "logical names -> PartitionSpec @ shard
+    shape"}`` map for dryrun/debug output — the human-readable view of
+    where every weight actually lives on the mesh."""
+    flat_p = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: hasattr(x, "sharding"))[0]
+    flat_l = jax.tree_util.tree_flatten_with_path(
+        logical_tree, is_leaf=lambda x: isinstance(x, tuple))[0]
+    if len(flat_p) != len(flat_l):
+        raise ValueError(
+            f"params tree has {len(flat_p)} leaves but logical tree has "
+            f"{len(flat_l)} — structures diverge (quantized trees and "
+            "extra keys are not summarizable)")
+    out: Dict[str, str] = {}
+    for (path, leaf), (_, names) in zip(flat_p, flat_l):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        shard_shape = getattr(
+            leaf.sharding, "shard_shape", lambda s: s)(leaf.shape)
+        out[key] = (f"{names} -> {logical_spec(names)} "
+                    f"@ {tuple(shard_shape)}")
+    return out
+
+
+def assert_params_sharded(params: Any, mesh: Mesh, logical_tree: Any,
+                          ) -> None:
+    """Verify every param leaf carries EXACTLY the NamedSharding its
+    logical axis names prescribe — the "is the 2D story real" check the
+    MULTICHIP dryrun and the CPU multi-device test both run. Raises
+    AssertionError naming the first offending leaf."""
+    expected = param_shardings(mesh, logical_tree)
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_e = jax.tree_util.tree_flatten_with_path(
+        expected, is_leaf=lambda x: isinstance(x, NamedSharding))[0]
+    # A silent zip truncation would let leaves after a structure
+    # divergence go unchecked — in the function whose job is checking.
+    assert len(flat_p) == len(flat_e), (
+        f"params tree has {len(flat_p)} leaves but the logical tree "
+        f"prescribes {len(flat_e)} — structures diverge")
+    for (path, leaf), (_, want) in zip(flat_p, flat_e):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        got = getattr(leaf, "sharding", None)
+        assert got is not None, f"{key}: leaf has no sharding"
+        ok = got.is_equivalent_to(want, leaf.ndim) \
+            if hasattr(got, "is_equivalent_to") else got == want
+        assert ok, f"{key}: sharding {got} != expected {want}"
+        # And the shards really are smaller than the array on >1-way axes.
+        shard = got.shard_shape(leaf.shape)
+        want_shard = want.shard_shape(leaf.shape)
+        assert tuple(shard) == tuple(want_shard), (
+            f"{key}: shard shape {shard} != expected {want_shard}")
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,

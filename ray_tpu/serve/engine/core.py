@@ -53,6 +53,15 @@ class InferenceEngine:
     ``spec_draft_len=0`` (the default) builds none of this: no verify
     program, no cache padding, byte-identical engine behavior to the
     pre-speculation subsystem.
+
+    ``quantize="int8"`` quantizes the matmul weights to weight-only
+    int8 at engine construction (per-output-channel fp32 scales,
+    ``models/quant.py``): decode and verify read HALF the weight bytes
+    per step — the same memory-bandwidth bound speculative decoding
+    attacks, so the two knobs compound. Greedy outputs may differ from
+    the f32 engine (quantization error), but spec-on vs spec-off WITHIN
+    a quantized engine keeps the token-identical invariant (both run
+    the same quantized weights).
     """
 
     def __init__(self, cfg=None, params=None, *, max_batch: int = 4,
@@ -64,6 +73,7 @@ class InferenceEngine:
                  spec_ngram_max: int = 3,
                  spec_adaptive: bool = True,
                  spec_chunk: int = 0,
+                 quantize: Optional[str] = None,
                  seed: int = 0,
                  name: Optional[str] = None):
         import jax
@@ -75,6 +85,17 @@ class InferenceEngine:
         self.params = (params if params is not None
                        else llama.init_params(self.cfg,
                                               jax.random.PRNGKey(seed)))
+        self.quantize = quantize
+        if quantize is not None:
+            # Weight-only int8 (models/quant.py): decode/verify stream
+            # half the weight bytes per step; every engine program
+            # (prefill, decode_chunk, verify_chunk) reads the same
+            # quantized pytree through forward_with_cache unchanged.
+            from ray_tpu.models.quant import (quantize_params,
+                                              quantized_weight_bytes)
+
+            self.params = quantize_params(self.params, dtype=quantize)
+            self._weight_bytes = quantized_weight_bytes(self.params)
         self.max_batch = max_batch
         self.max_len = min(max_len, self.cfg.max_seq_len)
         self.decode_chunk = max(1, int(decode_chunk))
@@ -164,8 +185,12 @@ class InferenceEngine:
     def stats(self) -> Dict[str, Any]:
         out = {"active": len(self.scheduler.active),
                "free_slots": self.kv.free_slots(),
+               "quantize": self.quantize,
                "waiting": (self._queue.qsize()
                            + self.scheduler.queue_depth())}
+        if self.quantize is not None:
+            out["weight_bytes"], out["weight_bytes_f32"] = \
+                self._weight_bytes
         out.update(self.kv.stats())
         out.update(self.metrics.snapshot())
         return out

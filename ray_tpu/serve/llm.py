@@ -8,10 +8,11 @@ in this file; it is now a real subsystem — ``ray_tpu/serve/engine/``
 this module keeps the stable public surface:
 
 - ``LLMEngine``            — the engine (continuous batching, static
-  shapes, device-resident K-step decode, prefix caching, and — with
+  shapes, device-resident K-step decode, prefix caching, with
   ``spec_draft_len`` > 0 — prompt-lookup speculative decoding with
   on-device multi-token verification; greedy output is token-identical
-  either way, see serve/engine/README.md).
+  either way — and with ``quantize="int8"`` — weight-only int8 decode
+  reading half the weight bytes per step; see serve/engine/README.md).
 - ``GenerationRequest``    — the request record (engine.scheduler's
   ``EngineRequest``).
 - ``build_llm_deployment`` — a ready-to-run ``@serve.deployment``.
@@ -48,7 +49,7 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
 
     ``engine_kwargs`` flow straight into the ``LLMEngine`` constructor —
     including the speculative-decoding knobs (``spec_draft_len``,
-    ``spec_ngram_max``, ``spec_adaptive``)."""
+    ``spec_ngram_max``, ``spec_adaptive``) and ``quantize="int8"``."""
     from ray_tpu.serve import api as serve_api
 
     engine_kwargs = engine_kwargs or {}

@@ -23,13 +23,64 @@ BLOCK = 4 << 20  # 4 MB: comfortably past the inline threshold
 
 @pytest.fixture(scope="module")
 def cluster3():
-    """Driver node + two extra nodes, 2 CPUs each."""
-    rt = ray_tpu.init(num_cpus=2, object_store_memory=256 << 20)
+    """Driver node + two extra nodes, 2 CPUs each.
+
+    The locality wait window is raised from its 1s default: on a loaded
+    2-core CI box, lease grants/heartbeats can stall past 1s from
+    AMBIENT load alone, which made the holder-placement asserts spill
+    ~1 run in 2. 4s absorbs scheduling jitter while staying far under
+    the spillback test's 10s bound (its hogs run 12s, so a genuine
+    saturation still spills well inside the assert window)."""
+    rt = ray_tpu.init(num_cpus=2, object_store_memory=256 << 20,
+                      _system_config={"scheduler_locality_wait_ms": 4000})
     extra = [rt.add_node(num_cpus=2, object_store_bytes=256 << 20)
              for _ in range(2)]
     node_ids = [rt._nodes[0].node_id] + [n.node_id for n in extra]
     yield rt, node_ids
     ray_tpu.shutdown()
+
+
+def _wait_holder_known(rt, ref, holder: str, timeout: float = 15.0) -> None:
+    """Deterministic scheduling barrier: ``wait(ref)`` returning means
+    the OWNER saw the result — the head's object directory learns the
+    holder via a batched async notify that can lag under load. Poll the
+    directory until the holder is registered, so a placement assert
+    afterwards tests the scheduler, not the notify race."""
+    deadline = time.monotonic() + timeout
+    last = []
+    while time.monotonic() < deadline:
+        try:
+            locs = rt.head.retrying_call("object_locations",
+                                         ref.id().binary(), None,
+                                         timeout=10)
+        except Exception:  # noqa: BLE001 — head briefly busy: retry
+            locs = []
+        last = [nid for nid, _addr in locs]
+        if holder in last:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"holder {holder} never appeared in the object directory "
+        f"(last view: {last})")
+
+
+def _placements_on(holder: str, ref, want: int, tries: int) -> int:
+    """Count how often a consumer of ``ref`` lands on ``holder`` across
+    up to ``tries`` runs, stopping at ``want`` successes. Load-tolerant
+    by design: transient CI load may legitimately spill ONE run (the
+    spillback guard EXISTS to allow that), so the asserts tolerate a
+    single miss — while keeping real statistical power against a broken
+    scheduler: uniform-random 3-node placement passes 5-of-6 with
+    p = Bin(6, 1/3) >= 5 ~= 1.8% and 4-of-5 with p ~= 4.5%."""
+    hits = 0
+    for _ in range(tries):
+        if ray_tpu.get(_where.remote(ref), timeout=60) == holder:
+            hits += 1
+            if hits >= want:
+                break
+        else:
+            time.sleep(0.3)  # let the transient load clear
+    return hits
 
 
 @ray_tpu.remote
@@ -54,13 +105,12 @@ def _produce_on(node_id: str, i: int = 0):
 
 def test_large_input_schedules_on_holder(cluster3):
     """A task whose (large) input lives on node X runs on node X —
-    repeatedly, not by luck."""
+    repeatedly, not by luck (5 holder placements within 6 tries)."""
     rt, node_ids = cluster3
     holder = node_ids[1]
     ref = _produce_on(holder)
-    for _ in range(3):
-        ran_on = ray_tpu.get(_where.remote(ref), timeout=60)
-        assert ran_on == holder
+    _wait_holder_known(rt, ref, holder)
+    assert _placements_on(holder, ref, want=5, tries=6) >= 5
     # And the owner-side accounting saw those as hits.
     assert _metrics.SCHEDULER_LOCALITY_HITS.get() >= 3
 
@@ -71,9 +121,7 @@ def test_head_tracks_object_holders_and_sizes(cluster3):
     rt, node_ids = cluster3
     holder = node_ids[2]
     ref = _produce_on(holder, i=7)
-    locs = rt.head.retrying_call("object_locations", ref.id().binary(),
-                                 None, timeout=10)
-    assert holder in [nid for nid, _addr in locs]
+    _wait_holder_known(rt, ref, holder)  # raises if never registered
     stats = rt.head.retrying_call("scheduler_stats", timeout=10)
     assert stats["objects_tracked"] >= 1
     assert stats["object_bytes_tracked"] >= BLOCK
@@ -100,7 +148,9 @@ def test_spillback_overrides_locality_under_load(cluster3):
     ran_on = ray_tpu.get(_where.remote(ref), timeout=60)
     elapsed = time.monotonic() - t0
     assert ran_on != holder, "task starved behind the loaded holder"
-    assert elapsed < 10.0, f"spillback took {elapsed:.1f}s"
+    # Must spill well before the 12s hogs finish (4s locality window +
+    # dispatch); waiting the load out would read >= 12s.
+    assert elapsed < 11.0, f"spillback took {elapsed:.1f}s"
     assert sum(ray_tpu.get(hogs, timeout=60)) == 2
 
 
@@ -109,5 +159,5 @@ def test_locality_survives_driver_put(cluster3):
     there (the put path feeds the locality cache too)."""
     rt, node_ids = cluster3
     ref = ray_tpu.put(np.ones(BLOCK, dtype=np.uint8))
-    ran_on = ray_tpu.get(_where.remote(ref), timeout=60)
-    assert ran_on == node_ids[0]
+    _wait_holder_known(rt, ref, node_ids[0])
+    assert _placements_on(node_ids[0], ref, want=4, tries=5) >= 4
