@@ -9,11 +9,23 @@ memory — the same move as the reference's lint-enforced C++ status/ID
 conventions and TSan wiring:
 
 - ``python -m ray_tpu.devtools.lint``: AST-based, stdlib-only linter
-  enforcing the declared invariants (see ``invariants.py``) against a
-  checked-in baseline (``lint_baseline.json``) — legacy violations are
-  tracked-not-fatal, NEW violations fail the run.
+  enforcing the declared invariants against a checked-in baseline
+  (``lint_baseline.json``, sectioned per rule family) — legacy
+  violations are tracked-not-fatal, NEW violations fail the run. Two
+  rule families: ``concurrency`` (tables in ``invariants.py``) and
+  ``jax`` (``jaxlint.py``: tracing-safety rules codified from the
+  model path's post-review bugs — closure constant-folding into jit,
+  donation-then-read, hot-path host syncs, unclamped
+  dynamic_update_slice, Mosaic kernel shape rules, per-mesh RNG
+  re-init).
 - ``lock_debug``: ``RTPU_DEBUG_LOCKS=1`` swaps the cluster core's lock
   creation for an ordering witness that records the per-thread lock
   acquisition graph, detects order cycles online, and reports
   excessive hold times via util/metrics.
+- ``jax_debug``: ``RTPU_DEBUG_JAX=1`` wraps the engine's and trainer's
+  jit entry points in a recompile witness (distinct-signature counts
+  vs declared program budgets), counts the engine's device->host
+  fetches per tag (one-sync-per-chunk is assertable), and wires
+  ``jax.transfer_guard`` around engine ticks
+  (``RTPU_DEBUG_JAX_TRANSFER_GUARD=disallow``). Zero overhead off.
 """

@@ -167,3 +167,98 @@ LOGGING_CALL_NAMES = {
 SUPPRESS_TOKEN = "rtpu-lint: disable="
 #: Existing `# noqa: BLE001` annotations mark audited broad excepts.
 NOQA_BROAD_EXCEPT = "noqa: BLE001"
+
+# ======================================================================
+# JAX/XLA tracing-safety invariants (rule family "jax", jaxlint.py).
+#
+# Each table encodes a bug found BY HAND in post-review: PR 6's int8
+# bench closed over a weight and jit constant-folded it to full width
+# (the int8 win was unmeasurable); its dryrun read a donated buffer
+# after the step; PR 3's verify window needed scratch rows because XLA
+# CLAMPS out-of-range dynamic_update_slice starts; and the engine's
+# one-host-sync-per-chunk discipline was asserted nowhere.
+# ======================================================================
+
+#: Call targets whose result is "an array" for the closure-capture rule:
+#: a local/module binding whose RHS contains one of these is array-like,
+#: and referencing it FREE inside a jitted function bakes it into the
+#: program as a constant (PR 6: `jax.jit(lambda s: s @ wq.astype(...))`
+#: constant-folded the int8 weight to full width — pass arrays as jit
+#: ARGUMENTS). Prefixes match the start of the dotted call target,
+#: suffixes its last component.
+ARRAY_FACTORY_PREFIXES = (
+    "jnp.", "np.", "numpy.", "jax.numpy.", "jax.random.", "lax.",
+    "jax.lax.",
+)
+ARRAY_FACTORY_CALLS = {
+    "jax.device_put", "jax.device_get",
+}
+ARRAY_FACTORY_SUFFIXES = {
+    "astype", "reshape", "init_params", "init_kv_cache",
+    "quantize_params",
+}
+
+#: Attribute-name heuristic for "self.<attr> is a weight/cache" when a
+#: jitted closure captures ``self`` (a class-level array referenced
+#: inside jit is the same constant-folding hazard as a local one).
+ARRAY_ATTR_RE = re.compile(
+    r"(param|weight|cache|table|embed|scale|buf)s?", re.IGNORECASE)
+
+#: Host-sync rule scope: module -> root functions of its device hot
+#: path. Every function reachable from a root through same-module calls
+#: is "hot": `.item()`, float()/int()/np.asarray on a value produced by
+#: a device program, `device_get`, and python if/while branching on a
+#: device value are findings there (the intended once-per-chunk syncs
+#: carry an inline allow-comment).
+JAX_HOT_PATH_ROOTS: dict[str, set[str]] = {
+    "ray_tpu.serve.engine.core": {"_decode_tick", "_admit",
+                                  "_engine_loop"},
+    "ray_tpu.serve.engine.decode_loop": {"__init__"},
+    "ray_tpu.parallel.spmd": {"make_train_step", "make_eval_step"},
+}
+
+#: Dotted-call suffixes whose RESULT lives on device (a jit program or
+#: a jnp op) — used by the hot-path rule to track which locals are
+#: device values; syncing one of them is a finding.
+DEVICE_PRODUCER_SUFFIXES = {
+    "decode_chunk", "verify_chunk", "prefill", "decode_step",
+}
+DEVICE_PRODUCER_PREFIXES = ("jnp.", "jax.numpy.")
+
+#: Dotted-call suffixes that move device values to HOST (their results
+#: are safe to float()/int()/branch on). ``_fetch`` is the engine's one
+#: counted sync point.
+HOST_FETCH_SUFFIXES = {"_fetch", "device_get", "block_until_ready"}
+
+#: Call names that synchronize device->host. Flagged in hot-path
+#: functions regardless of operand tracking (the single allowed site
+#: carries the inline allow-comment).
+HOST_SYNC_CALL_SUFFIXES = {"device_get", "item"}
+
+#: Clamp/bound call names: a dynamic_update_slice start expression
+#: containing one of these counts as "provably bounded". Anything else
+#: non-constant is a finding — XLA silently CLAMPS an out-of-range
+#: start, so an unbounded traced start can slide a window backwards
+#: over valid rows (the PR 3 scratch-row hazard).
+DUS_CLAMP_CALLS = {"clip", "minimum", "maximum", "where", "min", "max",
+                   "mod", "remainder"}
+
+#: Reductions that produce a sub-2D intermediate inside a Pallas TPU
+#: kernel body unless keepdims=True — plus 1D iota and cross-lane
+#: reshapes, the classic Mosaic lowering failures (use
+#: lax.broadcasted_iota and >=2D intermediates; PR 6 worked around
+#: each of these by hand before they became rules).
+PALLAS_REDUCTIONS = {"sum", "max", "min", "mean", "prod", "any", "all"}
+
+#: Modules whose sharded-equivalence paths must initialize RNG ONCE on
+#: host and ``device_put`` the result: with jax<0.5 non-partitionable
+#: threefry, jitted RNG VALUES depend on out_shardings, so a
+#: ``jax.random.PRNGKey`` re-init inside a mesh context makes
+#: "sharded == unsharded" comparisons vacuously flaky (PR 6 dryrun).
+RNG_SINGLE_INIT_MODULES = {"__graft_entry__", "bench"}
+
+#: With-context markers for "inside a mesh scope" (rng-reinit rule):
+#: matched case-insensitively as substrings of the unparsed context
+#: expression, so ``with mesh_context(m)``, ``with mesh:`` and
+#: ``with use_abstract_mesh(...)`` all count.
+MESH_CONTEXT_MARKERS = ("mesh",)

@@ -1,0 +1,235 @@
+"""Runtime JAX witness (``RTPU_DEBUG_JAX=1``) — the dynamic half of the
+jax-lint rule family, mirroring ``lock_debug.py``'s design: zero
+overhead when the flag is off, and when on it turns the model path's
+implicit performance contracts into observable, assertable facts:
+
+- **Recompile witness** (:func:`wrap_jit`): wraps a jitted callable,
+  counts DISTINCT call signatures (pytree structure + per-leaf
+  shape/dtype), and reports when a function exceeds its declared
+  program budget. Steady-state decode compiles ONE chunk program and
+  one prefill program per prompt bucket; a silent retrace per tick is
+  the single most expensive way to lose that (and invisible without
+  this — the step still returns correct numbers, just 100x slower).
+- **Host-sync counter** (:func:`note_host_sync`): the engine's counted
+  device->host fetch points call it, so tests can assert decode does
+  EXACTLY one sync per chunk — spec-on and spec-off (PAPER.md's
+  core-worker hot-path discipline applied to the decode loop).
+- **Transfer guard** (:func:`transfer_guard` / :func:`tick_guard`):
+  wires ``jax.transfer_guard`` as a context manager. Under
+  ``RTPU_DEBUG_JAX_TRANSFER_GUARD=disallow`` the engine runs every
+  tick inside the guard: all device traffic must be EXPLICIT
+  (``device_put``/``device_get``) — a stray ``np.asarray`` or a python
+  scalar leaking into a dispatch raises instead of silently syncing.
+
+With ``RTPU_DEBUG_JAX`` unset, :func:`wrap_jit` returns the function
+untouched and every hook is a dict-lookup no-op — the flag-off decode
+path is byte-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+
+def enabled() -> bool:
+    return os.environ.get("RTPU_DEBUG_JAX", "") == "1"
+
+
+def guard_level() -> str:
+    """Transfer-guard level for :func:`tick_guard` ("" = off). Valid
+    jax levels: "log", "disallow", "log_explicit", "disallow_explicit".
+    """
+    return os.environ.get("RTPU_DEBUG_JAX_TRANSFER_GUARD", "")
+
+
+class _Registry:
+    """Process-global witness state (host syncs + live jit wrappers)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.syncs: Dict[str, int] = {}
+        # Weak refs: a witness (and the jitted closure + trace cache +
+        # XLA executables it holds) must die with its engine/step — a
+        # strong registry would leak one program set per engine built
+        # over a long RTPU_DEBUG_JAX=1 session.
+        self.witnesses: List["weakref.ref[JitWitness]"] = []
+        self.over_budget: List[dict] = []
+
+    def note_sync(self, tag: str) -> None:
+        with self._mu:
+            self.syncs[tag] = self.syncs.get(tag, 0) + 1
+
+    def add_witness(self, w: "JitWitness") -> None:
+        with self._mu:
+            self.witnesses.append(weakref.ref(w))
+
+    def live_witnesses(self) -> List["JitWitness"]:
+        """Live witnesses; dead refs are pruned as a side effect.
+        Caller must hold ``_mu``."""
+        out: List[JitWitness] = []
+        keep = []
+        for ref in self.witnesses:
+            w = ref()
+            if w is not None:
+                out.append(w)
+                keep.append(ref)
+        self.witnesses[:] = keep
+        return out
+
+    def note_over_budget(self, report: dict) -> None:
+        with self._mu:
+            self.over_budget.append(report)
+        print(f"RTPU_DEBUG_JAX: {report['message']}", flush=True)
+
+    def reset(self) -> None:
+        with self._mu:
+            self.syncs.clear()
+            self.witnesses.clear()
+            self.over_budget.clear()
+
+
+_REGISTRY = _Registry()
+
+
+def _signature(args, kwargs) -> tuple:
+    """Trace-cache key of a call: pytree structure + per-leaf
+    (shape, dtype); non-array leaves key by type (their VALUES do not
+    retrace — their structure does)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append(type(leaf).__name__)
+    # PyTreeDef is hashable — keying on the object (not its str, which
+    # serializes the whole params tree per call) keeps the witness
+    # cheap enough to leave on during the bench's timed region.
+    return (treedef, tuple(sig))
+
+
+class JitWitness:
+    """A jitted callable under observation: every call records its
+    signature; crossing ``budget`` distinct signatures is reported once
+    (the steady-state program count is a declared invariant, not a
+    vibe). Transparent passthrough otherwise."""
+
+    def __init__(self, fn, name: str, budget: Optional[int] = None):
+        self._fn = fn
+        self.name = name
+        self.budget = budget
+        self.__name__ = getattr(fn, "__name__", name)
+        self._sigs: set = set()
+        self._reported = False
+        _REGISTRY.add_witness(self)
+
+    def __call__(self, *args, **kwargs):
+        try:
+            sig = _signature(args, kwargs)
+        except Exception:  # noqa: BLE001 — the witness must never break
+            sig = None     # the call it observes
+        if sig is not None and sig not in self._sigs:
+            self._sigs.add(sig)
+            if (self.budget is not None and not self._reported
+                    and len(self._sigs) > self.budget):
+                self._reported = True
+                _REGISTRY.note_over_budget({
+                    "name": self.name,
+                    "budget": self.budget,
+                    "programs": len(self._sigs),
+                    "message": (
+                        f"'{self.name}' compiled {len(self._sigs)} "
+                        f"distinct programs, budget is {self.budget} — "
+                        "an argument's shape/dtype/structure churns "
+                        "per call (steady state should hit the trace "
+                        "cache every time)"),
+                })
+        return self._fn(*args, **kwargs)
+
+    @property
+    def program_count(self) -> int:
+        return len(self._sigs)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+    def __repr__(self) -> str:
+        return (f"<JitWitness {self.name} programs={len(self._sigs)} "
+                f"budget={self.budget}>")
+
+
+def wrap_jit(fn, name: str, budget: Optional[int] = None):
+    """Witness-wrap a jitted callable under ``RTPU_DEBUG_JAX=1``;
+    return it UNTOUCHED otherwise (zero overhead off). ``budget`` is
+    the declared steady-state program count (None = count only)."""
+    if not enabled():
+        return fn
+    return JitWitness(fn, name, budget)
+
+
+def note_host_sync(tag: str) -> None:
+    """Count one device->host sync at a named point (no-op when the
+    witness is off)."""
+    if enabled():
+        _REGISTRY.note_sync(tag)
+
+
+def host_sync_counts() -> Dict[str, int]:
+    with _REGISTRY._mu:
+        return dict(_REGISTRY.syncs)
+
+
+def program_counts() -> Dict[str, int]:
+    """Aggregated distinct-program counts per LIVE wrapper name
+    (summed over instances — one engine = one instance per program;
+    a closed, collected engine's witnesses drop out)."""
+    with _REGISTRY._mu:
+        out: Dict[str, int] = {}
+        for w in _REGISTRY.live_witnesses():
+            out[w.name] = out.get(w.name, 0) + w.program_count
+        return out
+
+
+def over_budget_reports() -> List[dict]:
+    with _REGISTRY._mu:
+        return [dict(r) for r in _REGISTRY.over_budget]
+
+
+def reset() -> None:
+    """Clear the witness registry (tests isolate scenarios with this).
+    Already-wrapped callables keep counting into fresh state only via
+    new wrappers; drop engine/step objects alongside."""
+    _REGISTRY.reset()
+
+
+@contextlib.contextmanager
+def transfer_guard(level: str = "disallow"):
+    """``jax.transfer_guard(level)`` as a reusable context manager —
+    used by the witness tests and bench to prove a region's device
+    traffic is all explicit. No-op where jax lacks the API."""
+    import jax
+
+    tg = getattr(jax, "transfer_guard", None)
+    if tg is None:
+        yield
+        return
+    with tg(level):
+        yield
+
+
+def tick_guard():
+    """The engine wraps each tick in this: a transfer guard at
+    ``RTPU_DEBUG_JAX_TRANSFER_GUARD``'s level when the witness is on,
+    else a null context."""
+    level = guard_level()
+    if not enabled() or not level:
+        return contextlib.nullcontext()
+    return transfer_guard(level)

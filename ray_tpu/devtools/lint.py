@@ -20,12 +20,21 @@ carries a rule id:
   daemon-no-join        a daemon Thread stored on self but never
                         joined by any method of the class
 
-Baseline workflow: legacy findings live in ``lint_baseline.json``
+A second rule family, ``jax`` (``jaxlint.py``), runs from the same CLI:
+JAX/XLA tracing-safety rules (closure-captured-array-into-jit,
+donation-then-read, host-sync-in-hot-path,
+unclamped-dynamic-update-slice, pallas-shape-rules,
+rng-reinit-per-mesh). ``--family {all,concurrency,jax}`` selects which
+families run (default: all).
+
+Baseline workflow: legacy findings live in ``lint_baseline.json``,
+sectioned per rule family with a per-family schema version
 (fingerprint -> count). A run fails (exit 1) only when a fingerprint's
 current count exceeds its baselined count — new violations fail, old
 ones are tracked. Update after an intentional change with
-``--write-baseline``. Suppress a single line with
-``# rtpu-lint: disable=<rule-id>``.
+``--write-baseline`` (``--family X --write-baseline`` rewrites ONLY
+that family's section, never touching the other family's entries).
+Suppress a single line with ``# rtpu-lint: disable=<rule-id>``.
 """
 
 from __future__ import annotations
@@ -47,6 +56,22 @@ RULES = (
     "lock-order", "blocking-under-lock", "close-without-shutdown",
     "banned-api", "swallowed-exception", "daemon-no-join",
 )
+
+#: Rule families: "concurrency" = the tables above (the original
+#: rtpu-lint rule set), "jax" = the tracing-safety family in
+#: ``jaxlint.py``. Each family versions its fingerprinting scheme
+#: independently (FAMILY_SCHEMA) so a rule rewrite in one family never
+#: invalidates the other's baseline section.
+JAX_RULES = (
+    "closure-captured-array-into-jit", "donation-then-read",
+    "host-sync-in-hot-path", "unclamped-dynamic-update-slice",
+    "pallas-shape-rules", "rng-reinit-per-mesh",
+)
+FAMILIES = ("concurrency", "jax")
+FAMILY_RULES = {"concurrency": RULES, "jax": JAX_RULES}
+FAMILY_SCHEMA = {"concurrency": 1, "jax": 1}
+RULE_FAMILY = {rule: fam for fam, rules in FAMILY_RULES.items()
+               for rule in rules}
 
 
 class Finding:
@@ -71,6 +96,22 @@ class Finding:
     def __str__(self) -> str:
         return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
                 f"  (in {self.scope or '<module>'})")
+
+
+def suppressed(lines: List[str], line: int, rule: str) -> bool:
+    """Is ``rule`` disabled on source ``line`` by an inline
+    ``# rtpu-lint: disable=<rule>[,<rule>...]`` comment? The ONE
+    implementation of the suppression protocol — both rule families
+    route through it."""
+    if not 1 <= line <= len(lines):
+        return False
+    text = lines[line - 1]
+    tok = inv.SUPPRESS_TOKEN
+    if tok in text:
+        parts = text.split(tok, 1)[1].split()
+        if parts and rule in parts[0].split(","):
+            return True
+    return False
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -117,15 +158,11 @@ class _FileLinter(ast.NodeVisitor):
     # ------------------------------------------------------------ utils
 
     def _suppressed(self, line: int, rule: str) -> bool:
-        if not 1 <= line <= len(self.lines):
-            return False
-        text = self.lines[line - 1]
-        tok = inv.SUPPRESS_TOKEN
-        if tok in text:
-            parts = text.split(tok, 1)[1].split()
-            if parts and rule in parts[0].split(","):
-                return True
-        if rule == "swallowed-exception" and inv.NOQA_BROAD_EXCEPT in text:
+        if suppressed(self.lines, line, rule):
+            return True
+        if rule == "swallowed-exception" and \
+                1 <= line <= len(self.lines) and \
+                inv.NOQA_BROAD_EXCEPT in self.lines[line - 1]:
             return True
         return False
 
@@ -413,14 +450,18 @@ class _FileLinter(ast.NodeVisitor):
 # --------------------------------------------------------------- driver
 
 
-def lint_source(source: str, module: str, path: str) -> List[Finding]:
+def lint_source(source: str, module: str, path: str,
+                tree: Optional[ast.AST] = None) -> List[Finding]:
     """Lint one module's source; ``module`` selects the invariant
-    tables that apply (tests inject fixture snippets this way)."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("banned-api", path, e.lineno or 1, "",
-                        f"syntax error: {e.msg}")]
+    tables that apply (tests inject fixture snippets this way).
+    ``tree`` skips the parse when the caller already has one
+    (lint_paths parses each file once for both rule families)."""
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [Finding("banned-api", path, e.lineno or 1, "",
+                            f"syntax error: {e.msg}")]
     linter = _FileLinter(module, path, source)
     linter.visit(tree)
     return linter.findings
@@ -461,7 +502,12 @@ def iter_py_files(paths: List[str]):
                     yield os.path.join(dirpath, f)
 
 
-def lint_paths(paths: List[str], root: str) -> List[Finding]:
+def lint_paths(paths: List[str], root: str,
+               families: Tuple[str, ...] = FAMILIES) -> List[Finding]:
+    run_jax = "jax" in families
+    run_conc = "concurrency" in families
+    if run_jax:
+        from ray_tpu.devtools import jaxlint  # deferred: jaxlint imports us
     findings: List[Finding] = []
     for path in iter_py_files(paths):
         try:
@@ -470,35 +516,118 @@ def lint_paths(paths: List[str], root: str) -> List[Finding]:
         except (OSError, UnicodeDecodeError):
             continue
         rel = os.path.relpath(path, root)
-        findings.extend(
-            Finding(f.rule, rel, f.line, f.scope, f.message)
-            for f in lint_source(source, _module_for(path, root), rel))
+        module = _module_for(path, root)
+        rows: List[Finding] = []
+        # ONE parse per file, shared by both families.
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            tree = None
+            # Reported whichever family runs: a jax-only run must not
+            # silently skip (and exit 0 on) a file it could not check.
+            rows.append(Finding("banned-api", rel, e.lineno or 1,
+                                "", f"syntax error: {e.msg}"))
+        if tree is not None:
+            if run_conc:
+                rows.extend(lint_source(source, module, rel, tree=tree))
+            if run_jax:
+                rows.extend(jaxlint.lint_source(source, module, rel,
+                                                tree=tree))
+        findings.extend(rows)  # both linters already emit rel paths
     return findings
 
 
-def load_baseline(path: str) -> Dict[str, dict]:
+def _read_baseline_json(path: str) -> Optional[dict]:
+    """The parsed baseline dict, or None when the file is missing,
+    unparseable, or not a JSON object — callers must distinguish
+    "nothing there" (recoverable) from "parsed fine but empty" ({})."""
     try:
         with open(path, "r", encoding="utf-8") as fh:
             data = json.load(fh)
     except (OSError, ValueError):
-        return {}
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """Merged fingerprint -> entry table across every family section.
+    Reads both the sectioned v2 format and the flat v1 one (whose
+    findings were all concurrency-family)."""
+    data = _read_baseline_json(path) or {}
+    if "families" in data:
+        merged: Dict[str, dict] = {}
+        for fam, section in data["families"].items():
+            want = FAMILY_SCHEMA.get(fam)
+            if want is not None and section.get("schema") != want:
+                # Stale fingerprint scheme for THIS family: its entries
+                # cannot match current fingerprints, so merging them
+                # only hides the problem. Skipping the section makes
+                # the mismatch loud (that family's debt reports as new
+                # -> regenerate with --family <fam> --write-baseline)
+                # while the OTHER family's section keeps working — the
+                # isolation the per-family schema exists to provide.
+                print(f"rtpu-lint: baseline section '{fam}' has schema "
+                      f"{section.get('schema')!r}, current is {want}; "
+                      f"ignoring it — regenerate with --family {fam} "
+                      "--write-baseline", file=sys.stderr)
+                continue
+            merged.update(section.get("findings", {}))
+        return merged
     return data.get("findings", {})
 
 
-def write_baseline(path: str, findings: List[Finding]) -> None:
-    table: Dict[str, dict] = {}
+def write_baseline(path: str, findings: List[Finding],
+                   families: Optional[Tuple[str, ...]] = None) -> None:
+    """Write the sectioned (v2) baseline. With ``families`` given, ONLY
+    those sections are regenerated — the other family's entries are
+    carried over verbatim (the per-family analog of the partial-path
+    hazard: a jax-only rewrite must never drop the concurrency debt)."""
+    fams = tuple(families) if families else FAMILIES
+    sections: Dict[str, dict] = {}
+    existing = _read_baseline_json(path)
+    if families and existing is None and os.path.exists(path):
+        # The file exists but cannot be parsed: carrying "nothing" over
+        # would silently drop the other family's entire debt — the same
+        # truncation hazard the partial-path refusal guards. Refuse.
+        # (A valid-but-empty '{}' baseline parses to a dict and is NOT
+        # refused; a full rewrite never needs the old content at all.)
+        raise ValueError(
+            f"existing baseline {path} is unreadable/corrupt; a "
+            "partial-family rewrite would drop every other family's "
+            "entries — restore the file from version control (do NOT "
+            "delete it: a partial write of a missing file also starts "
+            "from nothing), or rerun without --family to regenerate "
+            "every section")
+    existing = existing or {}
+    for fam, section in existing.get("families", {}).items():
+        if fam not in fams:
+            sections[fam] = section
+    if "findings" in existing and "families" not in existing \
+            and "concurrency" not in fams:
+        # v1 file being partially rewritten: its flat findings ARE the
+        # concurrency section.
+        sections["concurrency"] = {
+            "schema": FAMILY_SCHEMA["concurrency"],
+            "findings": existing["findings"]}
+    tables: Dict[str, Dict[str, dict]] = {fam: {} for fam in fams}
     for f in findings:
-        fp = f.fingerprint()
-        entry = table.setdefault(fp, {
+        fam = RULE_FAMILY.get(f.rule, "concurrency")
+        if fam not in tables:
+            continue
+        entry = tables[fam].setdefault(f.fingerprint(), {
             "count": 0, "rule": f.rule, "path": f.path,
             "message": f.message})
         entry["count"] += 1
+    for fam in fams:
+        sections[fam] = {"schema": FAMILY_SCHEMA.get(fam, 1),
+                         "findings": dict(sorted(tables[fam].items()))}
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1,
-                   "note": "legacy findings tracked-not-fatal; "
-                           "regenerate with python -m "
-                           "ray_tpu.devtools.lint --write-baseline",
-                   "findings": dict(sorted(table.items()))},
+        json.dump({"version": 2,
+                   "note": "legacy findings tracked-not-fatal, "
+                           "sectioned per rule family; regenerate with "
+                           "python -m ray_tpu.devtools.lint "
+                           "--write-baseline [--family X]",
+                   "families": dict(sorted(sections.items()))},
                   fh, indent=1, sort_keys=False)
         fh.write("\n")
 
@@ -527,23 +656,29 @@ def run(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline JSON (default: the packaged one)")
     p.add_argument("--write-baseline", action="store_true",
-                   help="rewrite the baseline from this run's findings")
+                   help="rewrite the baseline from this run's findings "
+                        "(with --family: only that family's section)")
+    p.add_argument("--family", choices=("all",) + FAMILIES,
+                   default="all",
+                   help="rule family to run (default: all)")
     p.add_argument("--all", action="store_true",
                    help="print baselined findings too, not just new")
     p.add_argument("--stats", action="store_true",
                    help="print per-rule finding counts")
     args = p.parse_args(argv)
 
+    families = FAMILIES if args.family == "all" else (args.family,)
     root, roots = default_roots()
     paths = args.paths or roots
-    findings = lint_paths(paths, root)
+    findings = lint_paths(paths, root, families=families)
 
     if args.stats:
         counts: Dict[str, int] = {}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1
-        for rule in RULES:
-            print(f"{rule:24s} {counts.get(rule, 0)}")
+        for fam in families:
+            for rule in FAMILY_RULES[fam]:
+                print(f"{rule:36s} {counts.get(rule, 0)}")
 
     if args.write_baseline:
         if args.paths and (os.path.abspath(args.baseline)
@@ -556,9 +691,15 @@ def run(argv: Optional[List[str]] = None) -> int:
                   "finding outside those paths); rerun with no paths, "
                   "or pass --baseline <other-file>", file=sys.stderr)
             return 2
-        write_baseline(args.baseline, findings)
-        print(f"baseline written: {len(findings)} findings -> "
-              f"{args.baseline}")
+        try:
+            write_baseline(args.baseline, findings,
+                           families=None if args.family == "all"
+                           else families)
+        except ValueError as e:
+            print(f"refusing --write-baseline: {e}", file=sys.stderr)
+            return 2
+        print(f"baseline written: {len(findings)} findings "
+              f"({'+'.join(families)}) -> {args.baseline}")
         return 0
 
     baseline = load_baseline(args.baseline)

@@ -253,9 +253,15 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
     new_kv = None
     if cache_kv is not None:
         ck, cv = cache_kv  # [B, KH, S, D] (engine-native, see init_kv_cache)
-        ck = lax.dynamic_update_slice(
+        # cache_index is bounded BY CONTRACT, not by a clamp: the engine
+        # admits only prompt+new <= max_len (core._make_request) and
+        # parks done-slot writes on a sacrificial row / the scratch
+        # strip, so index+T never exceeds the cache extent. XLA would
+        # clamp an overrun backwards over resident rows — callers
+        # adding a new write path must re-establish the bound.
+        ck = lax.dynamic_update_slice(  # rtpu-lint: disable=unclamped-dynamic-update-slice
             ck, k.swapaxes(1, 2).astype(ck.dtype), (0, 0, cache_index, 0))
-        cv = lax.dynamic_update_slice(
+        cv = lax.dynamic_update_slice(  # rtpu-lint: disable=unclamped-dynamic-update-slice
             cv, v.swapaxes(1, 2).astype(cv.dtype), (0, 0, cache_index, 0))
         new_kv = (ck, cv)
         if (k.shape[1] == 1 and cfg.use_decode_kernel

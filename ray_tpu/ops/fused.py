@@ -25,6 +25,16 @@ and the reference elsewhere. Every op carries a custom VJP (backward in
 plain jnp, checked against autodiff of the reference) so the TRAINING
 path can use the fused forward under ``jax.checkpoint``; models opt in
 via ``LlamaConfig.fused_ops``.
+
+Kernel-body discipline (now ENFORCED by jax-lint's
+``pallas-shape-rules`` — ``python -m ray_tpu.devtools.lint --family
+jax``): every intermediate stays >= 2D (reductions carry
+``keepdims=True``), iota is ``lax.broadcasted_iota`` (never a 1D
+``jnp.arange``), and no reshape happens inside a kernel body —
+relayouts belong to the host-side wrappers and BlockSpecs. These are
+the classic Mosaic lowering failures this file originally worked
+around by hand; the linter keeps the next kernel from rediscovering
+them.
 """
 
 from __future__ import annotations

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.devtools import jax_debug
 from ray_tpu.models import llama
 from ray_tpu.parallel.mesh import logical_spec, param_shardings
 
@@ -82,14 +83,22 @@ def make_train_step(
         metrics = dict(metrics, grad_norm=gnorm)
         return TrainState(state.step + 1, params, opt_state), metrics
 
-    return _with_mesh_context(mesh, jax.jit(step_fn, donate_argnums=(0,)))
+    # Budget 1: a steady-state trainer compiles its step ONCE — a
+    # recompile per step (shape churn, structure churn from a stray
+    # python scalar in the state) is the most expensive silent bug a
+    # training loop can have. The RTPU_DEBUG_JAX witness reports it;
+    # off, wrap_jit returns the jitted step untouched.
+    return _with_mesh_context(mesh, jax_debug.wrap_jit(
+        jax.jit(step_fn, donate_argnums=(0,)), "spmd.train_step",
+        budget=1))
 
 
 def make_eval_step(cfg: llama.LlamaConfig, mesh: Mesh):
     def eval_fn(params, tokens):
         loss, metrics = llama.loss_fn(params, tokens, cfg, mesh=mesh)
         return metrics
-    return _with_mesh_context(mesh, jax.jit(eval_fn))
+    return _with_mesh_context(mesh, jax_debug.wrap_jit(
+        jax.jit(eval_fn), "spmd.eval_step", budget=1))
 
 
 def sharding_summary(params: Any, logical_tree: Any) -> Dict[str, str]:

@@ -53,13 +53,15 @@ class DecodeLoop:
     """
 
     def __init__(self, cfg, *, max_len: int, chunk: int = 8,
-                 spec_window: int = 1, spec_chunk: int = 0):
+                 spec_window: int = 1, spec_chunk: int = 0,
+                 prefill_budget: int = 0):
         import jax
 
         self.cfg = cfg
         self.max_len = max_len
         self.chunk = max(1, int(chunk))
         self.spec_window = max(1, int(spec_window))
+        self.prefill_budget = max(0, int(prefill_budget))
         # Verify iterations per dispatch. The default keeps the token
         # POSITIONS scanned per dispatch comparable to the plain chunk
         # (chunk // window): each verify iteration forwards a whole
@@ -74,6 +76,41 @@ class DecodeLoop:
         self._build()
         if self.spec_window > 1:
             self._build_verify()
+        self._witness()
+
+    def _witness(self) -> None:
+        """Under RTPU_DEBUG_JAX=1, wrap every jit entry point in the
+        recompile witness with its DECLARED steady-state program
+        budget: one chunk program (+ one verify program when built),
+        one prefill program per prompt bucket. Off, wrap_jit returns
+        the functions untouched — zero overhead."""
+        from ray_tpu.devtools import jax_debug
+
+        if not jax_debug.enabled():
+            return
+        self.prefill = jax_debug.wrap_jit(
+            self.prefill, "decode_loop.prefill",
+            budget=self.prefill_budget or None)
+        self.decode_chunk = jax_debug.wrap_jit(
+            self.decode_chunk, "decode_loop.decode_chunk", budget=1)
+        self.decode_step = jax_debug.wrap_jit(
+            self.decode_step, "decode_loop.decode_step", budget=1)
+        if self.spec_window > 1:
+            self.verify_chunk = jax_debug.wrap_jit(
+                self.verify_chunk, "decode_loop.verify_chunk", budget=1)
+
+    def program_counts(self) -> dict:
+        """{program name: distinct compiled signatures} when the
+        RTPU_DEBUG_JAX witness wrapped this loop; {} otherwise."""
+        from ray_tpu.devtools.jax_debug import JitWitness
+
+        out = {}
+        for name in ("prefill", "decode_chunk", "decode_step",
+                     "verify_chunk"):
+            fn = getattr(self, name, None)
+            if isinstance(fn, JitWitness):
+                out[name] = fn.program_count
+        return out
 
     @property
     def scratch_rows(self) -> int:
@@ -101,7 +138,10 @@ class DecodeLoop:
                    for k, v in cache.items()}
             logits, new_row = llama.forward_with_cache(
                 params, tokens, row, cache_index, cfg)
-            cache = {k: jax.lax.dynamic_update_slice_in_dim(
+            # slot is bounded by contract: the scheduler only admits
+            # into slots < max_batch (the cache's axis-1 extent), so
+            # the start can never hit XLA's silent clamp.
+            cache = {k: jax.lax.dynamic_update_slice_in_dim(  # rtpu-lint: disable=unclamped-dynamic-update-slice
                 cache[k], new_row[k], slot, axis=1) for k in cache}
             return logits, cache
 
