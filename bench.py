@@ -47,6 +47,15 @@ Rows:
   ``locality_hit_rate`` and ``object_bytes_pulled_per_task`` for the
   default scheduler vs a forced-random-placement baseline of the same
   workload.
+- chaos_recovery — fault-recovery suite (``--chaos`` runs it
+  standalone) on a real subprocess cluster: ``head_recovery_s`` (the
+  head is SIGKILLed mid-workload; time until a NEW head-dependent
+  submission — an actor creation — completes against the respawned
+  head), ``object_reconstruction_s`` (the only holder of a task output
+  is SIGKILLed; time for ``get()`` to complete via lineage
+  re-execution), and ``leaked_leases`` (the post-drain open-lease census
+  over every node, which must be 0). Needs a loadable native store lib
+  like the dataplane suite.
 - dataplane — multi-writer object-plane suite (``--dataplane`` runs it
   standalone): K-process concurrent large puts through one sharded shm
   store (``single_put_gbps``, ``multi_put_gbps``, ``put_scaling_ratio``
@@ -92,6 +101,7 @@ SERVE_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 LOCALITY_TIMEOUT_S = 420   # per locality child (boots a 4-node cluster)
 DATAPLANE_TIMEOUT_S = 420  # dataplane child (store bench + 2-node cluster)
+CHAOS_TIMEOUT_S = 420      # chaos child (kill head + kill node + recover)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -1086,6 +1096,152 @@ def _merge_dataplane_rows(rows: list) -> dict:
 
 
 # --------------------------------------------------------------------------
+# chaos suite (--chaos): fault-recovery times on a real subprocess cluster
+# --------------------------------------------------------------------------
+
+def chaos_child_main() -> None:
+    """Kill the head mid-workload and the only holder of an object, and
+    time the recovery paths (supervisor respawn + durable-table reload +
+    node re-registration/holder republish; lineage re-execution). Prints
+    one JSON row. No chaos PLAN here — the faults are real SIGKILLs from
+    the bench driver, so the row measures the recovery machinery
+    end-to-end exactly as a production fault would exercise it."""
+    _pin_platform()
+    import os as _os
+    import signal as _signal
+
+    import numpy as _np
+
+    import ray_tpu as rt
+    from ray_tpu.core.runtime_context import require_runtime
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    rt.init(num_cpus=2)
+    runtime = require_runtime()
+
+    @rt.remote
+    def ping(i):
+        return i
+
+    # Warm: pool + leases exist, a background workload is in flight.
+    assert rt.get([ping.remote(i) for i in range(4)],
+                  timeout=120) == list(range(4))
+
+    @rt.remote
+    class Probe:
+        def ok(self):
+            return "ok"
+
+    # --- head_recovery_s: SIGKILL the head, then time a NEW
+    # head-dependent submission (actor creation must traverse
+    # register_actor -> pick -> lease -> create on the RESPAWNED head).
+    background = [ping.remote(i) for i in range(8)]  # mid-workload
+    _os.kill(runtime._head_proc.pid, _signal.SIGKILL)
+    t0 = time.perf_counter()
+    probe = Probe.remote()
+    assert rt.get(probe.ok.remote(), timeout=180) == "ok"
+    head_recovery_s = time.perf_counter() - t0
+    assert rt.get(background, timeout=180) == list(range(8))
+    rt.kill(probe)
+
+    # --- object_reconstruction_s: the ONLY holder of a task output is
+    # SIGKILLed; get() must complete via lineage re-execution.
+    node_b = runtime.add_node(num_cpus=2)
+    time.sleep(1.5)
+    n = 1_000_000
+
+    @rt.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.node_id, soft=True))
+    def produce():
+        return _np.arange(n)
+
+    ref = produce.remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=120,
+                       fetch_local=False)
+    assert ready, "produce timed out"
+    runtime.kill_node(node_b)
+    t0 = time.perf_counter()
+    got = rt.get(ref, timeout=180)
+    object_reconstruction_s = time.perf_counter() - t0
+    assert got[0] == 0 and got[-1] == n - 1
+
+    # --- leak check: after the workload drains, the cluster-wide lease
+    # census must be empty (every fault path returned its lease). A
+    # census with an unreachable node is NOT leak-free — it is
+    # incomplete; keep polling until every alive node answered (the
+    # health sweep removes the killed node from the census set).
+    leaked = None
+    census_errors = None
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        census = runtime.head.retrying_call("cluster_leases", timeout=15)
+        entries = [v for v in census.values() if isinstance(v, dict)]
+        census_errors = [v["error"] for v in entries if "error" in v]
+        leaked = [l for v in entries for l in v.get("leases", ())]
+        if not leaked and not census_errors:
+            break
+        time.sleep(0.5)
+    row = {
+        "metric": "chaos_recovery",
+        "head_recovery_s": round(head_recovery_s, 2),
+        "object_reconstruction_s": round(object_reconstruction_s, 2),
+        "leaked_leases": len(leaked) if leaked is not None else -1,
+        "object_bytes": n * 8, "nodes": 2,
+    }
+    if census_errors:
+        row["census_error"] = census_errors[0]
+    print(json.dumps(row), flush=True)
+    rt.shutdown()
+
+
+def _chaos_rows() -> list:
+    try:
+        proc = _run(["--chaos-child"], CHAOS_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "chaos_recovery",
+                 "error": f"timeout {CHAOS_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "chaos_recovery",
+                "error": "rc=%d: %s" % (proc.returncode,
+                                        " | ".join(tail))})
+    return out
+
+
+def chaos_main() -> int:
+    """Standalone ``--chaos``: recovery rows + one merged tail line.
+    Exit 1 on any error, a non-zero lease leak, or an incomplete
+    census — the verify gate's 'leaked_leases: 0' must not pass at the
+    exit-code level on a leaking or unverifiable run."""
+    rows = _chaos_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_chaos_rows(rows)))
+    clean = all("error" not in r and "census_error" not in r
+                and r.get("leaked_leases", 0) == 0 for r in rows)
+    return 0 if clean else 1
+
+
+def _merge_chaos_rows(rows: list) -> dict:
+    by = {r.get("metric"): r for r in rows}
+    merged = {"metric": "chaos_recovery"}
+    row = by.get("chaos_recovery", {})
+    if "error" in row:
+        merged["error"] = row["error"]
+    else:
+        for k in ("head_recovery_s", "object_reconstruction_s",
+                  "leaked_leases", "census_error"):
+            if row.get(k) is not None:
+                merged[k] = row[k]
+    return merged
+
+
+# --------------------------------------------------------------------------
 # parent supervisor
 # --------------------------------------------------------------------------
 
@@ -1264,6 +1420,16 @@ def main() -> int:
     for r in dp_rows:
         print(json.dumps(r), flush=True)
 
+    # Phase 6: chaos-recovery suite on CPU (kill head / kill holder,
+    # recovery times + lease-leak census). Tracked from this PR.
+    chaos_rows: list = []
+    try:
+        chaos_rows = _chaos_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        chaos_rows = [{"metric": "chaos_recovery", "error": repr(e)[:200]}]
+    for r in chaos_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -1331,6 +1497,13 @@ def main() -> int:
             merged[k] = dp_merged[k]
     if "error" in dp_merged:
         merged["dataplane_error"] = dp_merged["error"]
+    ch_merged = _merge_chaos_rows(chaos_rows)
+    for k in ("head_recovery_s", "object_reconstruction_s",
+              "leaked_leases"):
+        if ch_merged.get(k) is not None:
+            merged[k] = ch_merged[k]
+    if "error" in ch_merged:
+        merged["chaos_error"] = ch_merged["error"]
     print(json.dumps(merged))
     return 0
 
@@ -1352,6 +1525,10 @@ if __name__ == "__main__":
         sys.exit(dataplane_child_main())
     if "--dataplane" in sys.argv:
         sys.exit(dataplane_main())
+    if "--chaos-child" in sys.argv:
+        sys.exit(chaos_child_main())
+    if "--chaos" in sys.argv:
+        sys.exit(chaos_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

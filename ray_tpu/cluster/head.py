@@ -85,8 +85,18 @@ class ActorInfo:
 class HeadServer:
     """All control-plane state + RPC handlers. One instance per cluster."""
 
+    chaos_role = "head"  # fault-injection scope (devtools/chaos.py)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: Optional[str] = None):
+        # Incarnation id: a restarted head is a NEW era. Nodes learn it
+        # from register_node's reply and reconcile era-scoped state when
+        # it changes — head-granted leases from a dead head's in-flight
+        # actor creations are returned instead of leaking (reference:
+        # the GCS restart epoch raylets compare on reconnect).
+        import uuid as _uuid
+
+        self.incarnation = _uuid.uuid4().hex[:12]
         self._lock = make_rlock("head._lock")
         self._nodes: Dict[str, NodeInfo] = {}
         self._actors: Dict[bytes, ActorInfo] = {}
@@ -229,7 +239,10 @@ class HeadServer:
             self._nodes[node_id] = NodeInfo(node_id, address, resources,
                                             labels, store_name)
         self._publish("NODE", {"event": "added", "node_id": node_id})
-        return True
+        # Truthy for legacy callers; nodes compare it across re-registers
+        # to detect a head restart (era change -> republish holder sets,
+        # reconcile head-era leases).
+        return self.incarnation
 
     def rpc_heartbeat(self, conn, node_id: str, available: Dict[str, float],
                       version: Optional[int] = None,
@@ -671,9 +684,13 @@ class HeadServer:
             # a permanent resource leak (nobody knows the lease id). The
             # req_id makes retries return the SAME grant.
             try:
+                # Era-tagged lessee: if this head dies between the grant
+                # and create_actor, nobody would ever return the lease —
+                # the node reconciles "head:<old-era>" leases away when
+                # it re-registers with the restarted head.
                 lease = node.retrying_call(
                     "request_lease", info.resources, True, pg,
-                    _uuid.uuid4().hex, None,
+                    _uuid.uuid4().hex, f"head:{self.incarnation}",
                     getattr(info, "runtime_env", None),
                     timeout=cfg.lease_timeout_ms / 1000.0 + 10)
             except Exception:
@@ -900,7 +917,50 @@ class HeadServer:
             return {"locality_hits": self._locality_hits,
                     "locality_misses": self._locality_misses,
                     "objects_tracked": len(self._object_dir),
-                    "object_bytes_tracked": sum(self._object_sizes.values())}
+                    "object_bytes_tracked": sum(self._object_sizes.values()),
+                    "head_incarnation": self.incarnation}
+
+    @blocking_rpc
+    def rpc_cluster_leases(self, conn):
+        """Cluster-wide open-lease census: fan out to every alive node's
+        list_leases (the chaos bench's leak detector — after a scenario
+        drains, every lease must be returned and every node's available
+        must equal its total). The per-node calls run CONCURRENTLY so
+        total census time is one control-RPC timeout, not N of them — a
+        serial loop over a few mid-death nodes would outrun the caller's
+        own deadline on every attempt."""
+        with self._lock:
+            nodes = [(n.node_id, n.address) for n in self._nodes.values()
+                     if n.alive]
+        results: Dict[str, Any] = {}
+        results_lock = threading.Lock()
+
+        def census_one(node_id: str, address: str) -> None:
+            try:
+                leases, avail = self._pool.get(address).call(
+                    "list_leases", timeout=cfg.rpc_control_timeout_s)
+                entry = {"leases": leases, "available": avail}
+            except Exception as e:  # noqa: BLE001 — census is best-effort
+                entry = {"error": f"unreachable: {e!r}"}
+            with results_lock:
+                results[node_id] = entry
+
+        threads = [threading.Thread(target=census_one, args=na,
+                                    daemon=True, name="lease-census")
+                   for na in nodes]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + cfg.rpc_control_timeout_s + 2.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        # Snapshot under the lock: a straggler thread may still write
+        # results after the join timeout, and the reply must not be
+        # mutated while it serializes.
+        with results_lock:
+            out = dict(results)
+        for node_id, _addr in nodes:
+            out.setdefault(node_id, {"error": "census timed out"})
+        return out
 
     # ------------------------------------------------------------- KV
 

@@ -157,6 +157,8 @@ class Lease:
 
 
 class NodeManager:
+    chaos_role = "node"  # fault-injection scope (devtools/chaos.py)
+
     def __init__(self, head_addr: str, node_id: str,
                  resources: Dict[str, float], labels: Dict[str, str],
                  object_store_bytes: int, host: str = "127.0.0.1"):
@@ -199,6 +201,25 @@ class NodeManager:
         # and fans chunked pulls of large objects out across holders.
         self._pulls: Dict[bytes, threading.Event] = {}
         self._pull_lock = make_lock("node_manager._pull_lock")
+        # Local holder-set mirror: oid -> size of every object the node
+        # believes is resident in ITS store (owner object_batch frames
+        # route through here on their way to the head; pulls record
+        # directly). The head's object directory is ephemeral — after a
+        # head restart, this mirror is what the node RE-PUBLISHES so
+        # pullers, locality scoring, and lineage availability checks see
+        # the node's copies again (reference: raylets resubscribe and
+        # re-push local object tables after GCS restart).
+        self._local_objects: Dict[bytes, int] = {}
+        self._dir_lock = make_lock("node_manager._dir_lock")
+        # Head incarnation learned at (re-)registration: a changed value
+        # means the head restarted (new era).
+        self._head_incarnation: Optional[str] = None
+        # True while a holder-set republish is owed to the head: set on
+        # re-registration, cleared on a successful publish, retried on
+        # every heartbeat lap until then (a send failure right after
+        # re-register would otherwise be unrecoverable — the head knows
+        # the node again, so no further False-ack would ever retrigger).
+        self._republish_needed = False
         self.pull_stats: Dict[str, int] = {
             "bytes_pulled": 0, "pulls_started": 0, "pulls_completed": 0,
             "pulls_coalesced": 0, "multi_source_pulls": 0}
@@ -243,9 +264,11 @@ class NodeManager:
                 logger.warning("metrics exporter failed to start; node "
                                "metrics disabled", exc_info=True)
         self._head = RpcClient(head_addr)
-        self._head.retrying_call("register_node", node_id, self.address,
-                                 resources, labels, self.store_name,
-                                 timeout=10)
+        acked = self._head.retrying_call("register_node", node_id,
+                                         self.address, resources, labels,
+                                         self.store_name, timeout=10)
+        if isinstance(acked, str):
+            self._head_incarnation = acked
         # Workers MUST be spawned from one long-lived thread: PDEATHSIG is
         # delivered when the spawning *thread* exits, and lease handlers run
         # on per-request threads.
@@ -374,11 +397,13 @@ class NodeManager:
                     # node table (nodes are ephemeral state — reference:
                     # RayletNotifyGCSRestart re-registration). Re-register;
                     # the next heartbeat restores our availability view.
-                    self._head.retrying_call(
+                    new_inc = self._head.retrying_call(
                         "register_node", self.node_id, self.address,
                         self.total, self.labels, self.store_name,
                         timeout=cfg.rpc_state_timeout_s)
                     last_sent = {}  # fresh NodeInfo: full snapshot next
+                    self._on_head_reregistered(
+                        new_inc if isinstance(new_inc, str) else None)
             except Exception as e:
                 if self._stop.is_set():
                     return  # shutdown raced the beat: conn loss expected
@@ -392,7 +417,120 @@ class NodeManager:
                     # this loop alive to retry next beat — a dead
                     # heartbeat thread reads as a dead node.
                     logger.debug("head reconnect failed: %r", e2)
+            if self._republish_needed:
+                self._try_republish()
             self._check_worker_deaths()
+
+    def _on_head_reregistered(self, new_inc: Optional[str]) -> None:
+        """The head forgot us (restart or drain): the freshly-registered
+        head needs this node's state pushed back.
+
+        1. Holder-set rehydration: the restarted head's object directory
+           is EMPTY — without a re-publish, pullers can't find our
+           copies, locality scoring goes blind, and lineage recovery
+           sees every object as lost (spurious re-execution). Push the
+           full local mirror (filtered through the store, so evicted
+           entries don't resurrect) as one object_batch frame.
+        2. Era reconciliation: leases granted TO the dead head
+           (lessee "head:<old-era>", in-flight actor creations) can
+           never be returned by their lessee — the restarted head
+           re-drives PENDING actors with fresh leases, so the old-era
+           grants are returned here. Leases whose worker already hosts
+           an actor are the creations that LANDED: they stay.
+        """
+        old_inc = self._head_incarnation
+        if new_inc is not None:
+            # A non-string ack must not WIPE the remembered era: losing
+            # it would silently skip reconciliation at the next genuine
+            # restart (old_inc None -> no stale-lease return).
+            self._head_incarnation = new_inc
+        if new_inc is not None and old_inc is not None \
+                and new_inc != old_inc:
+            with self._lock:
+                stale = [l for l in self._leases.values()
+                         if isinstance(l.lessee, str)
+                         and l.lessee.startswith("head:")
+                         and l.lessee != f"head:{new_inc}"
+                         and not (l.worker is not None
+                                  and l.worker.is_actor_host)]
+            for l in stale:
+                logger.info("reconciling stale head-era lease %s "
+                            "(%s -> head:%s)", l.lease_id[:8], l.lessee,
+                            new_inc)
+                self.rpc_return_lease(None, l.lease_id)
+        self._republish_needed = True
+        self._try_republish()
+
+    def _try_republish(self) -> None:
+        """Push the store-filtered holder-set mirror to the head; retried
+        from the heartbeat loop until one publish succeeds (the head
+        acks True once it knows us again, so a failed send here has no
+        other retrigger). Entries evicted from the store since they
+        were mirrored are pruned rather than resurrected. MUST NOT
+        raise: the per-beat retry runs outside the heartbeat loop's
+        try/except, and a dead heartbeat thread reads as a dead node."""
+        try:
+            entries = [("add", oid, size)
+                       for oid, size in self._store_filtered_mirror()]
+            if entries:
+                self._head.notify("object_batch", self.node_id, entries)
+            self._republish_needed = False
+        except Exception as e:
+            logger.debug("holder-set republish failed (will retry on "
+                         "the next beat): %r", e)
+
+    def rpc_object_batch(self, conn, entries) -> bool:
+        """Owner-side directory updates route THROUGH the node manager
+        (one extra local hop) so the node keeps a mirror of its own
+        holder set — the state it re-publishes after a head restart.
+        Entries are ("add", oid, size) / ("rm", oid, None) in submission
+        order; forwarded to the head as one frame, same best-effort
+        contract as before."""
+        with self._dir_lock:
+            for kind, oid, size in entries:
+                if kind == "add":
+                    self._local_objects[oid] = int(size or 0)
+                else:
+                    self._local_objects.pop(oid, None)
+        try:
+            self._head.notify("object_batch", self.node_id, entries)
+        except Exception as e:
+            logger.debug("object_batch forward to head failed: %r", e)
+        return True
+
+    def _note_local_object(self, oid_bytes: bytes, size: int) -> None:
+        with self._dir_lock:
+            self._local_objects[oid_bytes] = int(size)
+
+    def _store_filtered_mirror(self) -> List[Tuple[bytes, int]]:
+        """The mirror restricted to objects still resident in the store,
+        with departed entries (evicted, deleted by a worker, spilled
+        away) pruned from the dict as a side effect — the ONE
+        reconciliation pass both the republish and the periodic prune
+        use. contains() is one C lookup per entry; the dict is bounded
+        by store slots after each pass. Raises only if the store itself
+        errors (callers decide whether that may propagate)."""
+        from ray_tpu.core.ids import ObjectID
+
+        with self._dir_lock:
+            snapshot = list(self._local_objects.items())
+        live, gone = [], []
+        for oid, size in snapshot:
+            if self.store.contains(ObjectID(oid)):
+                live.append((oid, size))
+            else:
+                gone.append(oid)
+        if gone:
+            with self._dir_lock:
+                for oid in gone:
+                    self._local_objects.pop(oid, None)
+        return live
+
+    def _prune_local_objects(self) -> None:
+        try:
+            self._store_filtered_mirror()
+        except Exception as e:
+            logger.debug("mirror prune pass skipped: %r", e)
 
     def _check_worker_deaths(self) -> None:
         dead = []
@@ -461,8 +599,17 @@ class NodeManager:
 
     def _reap_loop(self) -> None:
         ttl = cfg.worker_pool_idle_ttl_s
+        last_dir_prune = 0.0
         while not self._stop.wait(5.0):
             now = time.monotonic()
+            if now - last_dir_prune >= 60.0:
+                # The holder-set mirror tracks store residency, but only
+                # owner 'rm' frames prune it — pulled copies and objects
+                # evicted/deleted directly in the shared shm store would
+                # otherwise accumulate forever. Periodic store-filtered
+                # prune keeps it O(resident objects).
+                last_dir_prune = now
+                self._prune_local_objects()
             with self._lock:
                 reap = []
                 min_keep = cfg.worker_pool_min_workers
@@ -1305,6 +1452,7 @@ class NodeManager:
             self.store.abort(oid)
             return False
         self.store.seal(oid)
+        self._note_local_object(oid.binary(), total)
         with self._pull_lock:
             self.pull_stats["bytes_pulled"] += total
             if multi_source:

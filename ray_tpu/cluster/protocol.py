@@ -27,8 +27,29 @@ DIRECTLY into the caller-supplied memoryview (e.g. a shm ``create_buffer``
 view), so a pulled object chunk crosses the host at most once. The ``rid``
 rides outside the pickle so the reader can route buffers before decoding.
 
-Chaos injection (`rpc_chaos_failure_prob` flag) drops requests/responses to
-exercise retry paths, mirroring RAY_testing_rpc_failure.
+Chaos injection, two tiers (mirroring RAY_testing_rpc_failure +
+rpc_chaos.h's scripted failures):
+
+- `rpc_chaos_failure_prob`: blind seedless drop of requests/responses —
+  but ONLY for methods in RETRY_SAFE_RPCS below. Dropping a frame whose
+  caller never retries (best-effort notifies like `object_batch` or
+  `worker_unblocked`) doesn't exercise a recovery path, it just corrupts
+  state in ways no production fault would be *expected* to survive.
+- `chaos_plan` / RTPU_CHAOS_PLAN (devtools/chaos.py): a deterministic,
+  seeded plan targeting faults by (method, role, peer, nth call) with
+  drop/delay/sever/kill actions. Targeted rules may hit ANY method —
+  including non-retry-safe ones, deliberately.
+
+Retry-safety contract (what RETRY_SAFE_RPCS asserts): the method is
+either read-only, idempotent by design (dedup keys: `request_lease`
+req_id, `register_actor` actor_id, `create_pg` pg_id, worker-side task
+dedup for `push_tasks`/`push_actor_batch`, seq horizon for actor calls),
+or its caller drives it through `retrying_call`/an acked-retry loop
+(`heartbeat` NACK+resync, `kill_actor` re-ack, completion flusher for
+`task_done`/`batch_done`). Everything else — one-way notifies whose loss
+is tolerated-by-pinning (`add_borrowers`), availability nudges
+(`worker_blocked`/`worker_unblocked`), observability flushes — must not
+be blindly dropped.
 """
 
 from __future__ import annotations
@@ -45,6 +66,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.serialization import SERIALIZER
+from ray_tpu.devtools import chaos as _chaos
+from ray_tpu.devtools.chaos import chaos_enabled as _chaos_enabled
 from ray_tpu.devtools.lock_debug import make_lock
 
 _LEN = struct.Struct("<I")
@@ -238,9 +261,34 @@ def _recv_msg(sock: socket.socket, sink_for: Optional[Callable] = None
         return None
 
 
-def _chaos_drop() -> bool:
+#: Methods safe for BLIND probabilistic drops (see module docstring for
+#: the contract). Grouped by why a lost frame is recovered.
+RETRY_SAFE_RPCS = frozenset({
+    # read-only queries (retrying_call or poll loops at every caller)
+    "ping", "list_nodes", "list_actors", "list_leases", "list_task_events",
+    "cluster_resources", "cluster_leases", "get_actor_info",
+    "get_named_actor", "get_trace", "pick_node", "pick_nodes",
+    "object_locations", "scheduler_stats", "pg_table", "pg_ready",
+    "kv_get", "kv_keys", "get_demand", "has_object", "store_stats",
+    "pull_stats", "wait_object", "wait_objects", "get_object",
+    "stream_consumed", "wait_actor_address",
+    # idempotent by dedup key / state check
+    "register_node", "register_actor", "register_worker",
+    "request_lease", "return_lease", "create_actor", "create_pg",
+    "remove_pg", "reserve_bundle", "release_bundle", "mark_actor_host",
+    "push_tasks", "push_actor_batch", "pull_object", "pull_direct",
+    "push_object", "fetch_object", "subscribe", "unsubscribe",
+    "kv_put", "kv_del", "drain_node",
+    # loop-retried with explicit loss handling
+    "heartbeat", "kill_actor", "actor_died", "worker_dead_at",
+    "task_done", "actor_call_done", "batch_done", "new_job_id",
+})
+
+
+def _chaos_drop(method: str) -> bool:
     p = cfg.rpc_chaos_failure_prob
-    return p > 0 and random.random() < p
+    return (p > 0 and method in RETRY_SAFE_RPCS
+            and random.random() < p)
 
 
 # Per-method handler accounting (reference: common/event_stats.h — the
@@ -327,6 +375,10 @@ class RpcServer:
     def __init__(self, handler_obj: Any, host: str = "127.0.0.1",
                  port: int = 0):
         self.handler_obj = handler_obj
+        # Fault-injection scope: chaos-plan rules target the RECEIVING
+        # process by the role its handler declares (head / node / worker
+        # / driver — set by HeadServer, NodeManager, ClusterCore).
+        self.chaos_role = getattr(handler_obj, "chaos_role", "")
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -360,6 +412,13 @@ class RpcServer:
             target=self._server.serve_forever, daemon=True,
             name=f"rpc-server-{self.address}")
         self._conn_hooks = []
+        # Live peer connections: severed on stop() — server_close() only
+        # closes the LISTENING socket, and a handler thread parked in
+        # recv on an established peer socket would keep serving a
+        # "stopped" server's stale state indefinitely (peers must fail
+        # over to the replacement, not talk to a zombie).
+        self._conns: set = set()
+        self._conns_lock = make_lock("protocol.server._conns_lock")
 
     def start(self) -> "RpcServer":
         self._thread.start()
@@ -374,11 +433,18 @@ class RpcServer:
         # serve_forever returns after shutdown(): join so teardown is
         # ordered (no acceptor thread outliving its server object).
         self._thread.join(timeout=2.0)
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            _shutdown_socket(conn.sock)
 
     def _on_connect(self, conn: "PeerConnection") -> None:
-        pass
+        with self._conns_lock:
+            self._conns.add(conn)
 
     def _on_disconnect(self, conn: "PeerConnection") -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
         hook = getattr(self.handler_obj, "on_peer_disconnect", None)
         if hook is not None:
             try:
@@ -388,8 +454,12 @@ class RpcServer:
 
     def _dispatch(self, conn: "PeerConnection", payload) -> None:
         req_id, method, args = payload
-        if _chaos_drop():
-            return  # request lost
+        if _chaos_enabled():
+            if _chaos.apply(self.chaos_role, method, "request",
+                            conn) is not None:
+                return  # plan dropped the request / severed the peer
+            if _chaos_drop(method):
+                return  # request lost (blind mode, retry-safe only)
         fn = getattr(self.handler_obj, "rpc_" + method, None)
 
         def run():
@@ -407,11 +477,17 @@ class RpcServer:
             if _stats_on():
                 _record_event_stat(method, time.monotonic() - t0, ok)
             try:
-                if req_id > 0 and not _chaos_drop():
-                    try:
-                        conn.send_payload((-req_id, ok, result))
-                    except Exception:
-                        pass
+                if req_id > 0:
+                    lost = False
+                    if _chaos_enabled():
+                        lost = (_chaos.apply(self.chaos_role, method,
+                                             "response", conn) is not None
+                                or _chaos_drop(method))
+                    if not lost:
+                        try:
+                            conn.send_payload((-req_id, ok, result))
+                        except Exception:
+                            pass
             finally:
                 if lease is not None:
                     lease.release()
@@ -619,23 +695,36 @@ class RpcClient:
     def retrying_call(self, method: str, *args,
                       timeout: Optional[float] = None) -> Any:
         """For idempotent methods: retry on timeouts/connection loss (chaos
-        tolerance). Reconnects the socket between attempts."""
+        tolerance). Reconnects the socket between attempts.
+
+        Timeouts stop after ``rpc_retry_max_attempts`` (worst case is
+        unchanged: attempts x per-try timeout). INSTANT connection
+        failures (refused connect to a dead-but-respawning peer) keep
+        retrying for at least ``rpc_retry_min_window_s``: pure attempt
+        counting burns all five tries in ~3s of backoff, which is less
+        than a SIGKILL'd head or node takes to respawn — the chaos
+        scenarios fail exactly there without the window."""
         attempts = cfg.rpc_retry_max_attempts
         delay = cfg.rpc_retry_delay_ms / 1000.0
         per_try = timeout if timeout is not None else 5.0
-        last: Optional[Exception] = None
-        for i in range(attempts):
+        start = time.monotonic()
+        window = cfg.rpc_retry_min_window_s
+        i = 0
+        while True:
             try:
                 return self.call(method, *args, timeout=per_try)
             except (TimeoutError, ConnectionLost) as e:
-                last = e
                 if isinstance(e, ConnectionLost):
                     try:
                         self.reconnect()
                     except OSError:
                         pass
-                time.sleep(delay * (2 ** i))
-        raise last  # type: ignore[misc]
+                i += 1
+                elapsed = time.monotonic() - start
+                if i >= attempts and (isinstance(e, TimeoutError)
+                                      or elapsed >= window):
+                    raise
+                time.sleep(min(delay * (2 ** min(i, 6)), 2.0))
 
     def reconnect(self) -> None:
         host, port = self.address.rsplit(":", 1)

@@ -271,6 +271,9 @@ class ClusterCore:
         self._pool = ClientPool()
         self.head = RpcClient(head_addr)
         self.node = RpcClient(node_addr)
+        # Fault-injection scope (devtools/chaos.py): chaos-plan rules
+        # target this process's RPC server by role.
+        self.chaos_role = "driver" if is_driver else "worker"
         self._server = RpcServer(self).start()
         self.owner_addr = self._server.address
 
@@ -618,7 +621,12 @@ class ClusterCore:
                 if not batch:
                     return
                 try:
-                    self.head.notify("object_batch", self.node_id, batch)
+                    # Via the LOCAL node manager, not the head directly:
+                    # the node mirrors its own holder set from these
+                    # frames and forwards them, so a restarted head can
+                    # be rehydrated by the node (see NodeManager.
+                    # _on_head_reregistered). Same best-effort contract.
+                    self.node.notify("object_batch", batch)
                 except Exception:
                     return  # best-effort, like the old per-object notifies
 

@@ -226,7 +226,24 @@ _d("rpc_retry_delay_ms", int, 100, "base retry backoff")
 _d("rpc_chaos_failure_prob", float, 0.0,
    "fault-injection: probability an RPC is dropped (request or reply). "
    "Equivalent of the reference's RAY_testing_rpc_failure chaos flag "
-   "(src/ray/rpc/rpc_chaos.h)")
+   "(src/ray/rpc/rpc_chaos.h). Blind drops fire only on RETRY_SAFE_RPCS "
+   "(cluster/protocol.py) — methods whose callers retry/dedup; targeted "
+   "drops of anything else go through chaos_plan rules")
+_d("chaos_plan", str, "",
+   "deterministic fault-injection plan (devtools/chaos.py grammar): "
+   "';'-separated rules targeting (rpc method, role, peer, nth call) "
+   "with drop_request/drop_response/delay/sever/kill actions. Set via "
+   "RTPU_CHAOS_PLAN so every spawned head/node/worker process inherits "
+   "the same plan; counters are per process, so nth-rules are "
+   "reproducible wherever request routing is")
+_d("chaos_seed", int, 0,
+   "default RNG seed for chaos_plan prob= rules (per-rule seed= "
+   "overrides); fixed seed + fixed plan => identical fault sequences")
+_d("rpc_retry_min_window_s", float, 8.0,
+   "retrying_call keeps retrying INSTANT connection failures at least "
+   "this long before giving up (attempt counting alone exhausts in "
+   "~3s of backoff — less than a head/node respawn under chaos); slow "
+   "failures (timeouts) still stop after rpc_retry_max_attempts")
 _d("pubsub_poll_timeout_s", float, 30.0, "long-poll timeout")
 
 # --- streaming generators ---

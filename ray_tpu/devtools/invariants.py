@@ -154,6 +154,29 @@ BANNED_JS_SUBSTRINGS = {
                       "use textContent",
 }
 
+# ------------------------------------------------- unbounded retry loops
+
+#: Call attributes that mark a ``while True:`` body as a RETRY loop for
+#: the retry-without-deadline rule: a chaos run (dead peer, dropped
+#: frames) hangs exactly in an unbounded loop around these.
+RETRY_CALL_ATTRS = {"retrying_call"}
+#: Dotted-call suffixes that open connections (retried connects are the
+#: other unbounded-loop shape).
+RETRY_CONNECT_SUFFIXES = {"create_connection"}
+#: Socket-looking ``<x>.connect()`` also counts (SOCKET_NAME_RE on x).
+
+#: Escape hatches: ANY of these anywhere in the loop subtree makes it
+#: bounded. Clock reads / deadline-ish names / attempt counters, or a
+#: stop-event check (daemon loops that exit on shutdown).
+RETRY_DEADLINE_CALLS = {"time.monotonic", "time.time",
+                        "time.perf_counter"}
+RETRY_DEADLINE_NAME_RE = re.compile(
+    r"(deadline|attempt|tries|retries|budget|remaining|elapsed)",
+    re.IGNORECASE)
+RETRY_STOP_NAME_RE = re.compile(r"(stop|shutdown|closed|done|exit)",
+                                re.IGNORECASE)
+RETRY_STOP_ATTRS = {"is_set", "wait"}
+
 # --------------------------------------------------------- bare excepts
 
 #: Logging-ish call names that make a broad except "handled".

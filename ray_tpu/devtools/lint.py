@@ -19,6 +19,11 @@ carries a rule id:
                         uses the bound exception
   daemon-no-join        a daemon Thread stored on self but never
                         joined by any method of the class
+  retry-without-deadline  a ``while True:`` retry loop around
+                        retrying_call / socket connect with no visible
+                        deadline, attempt counter, or stop-event check —
+                        chaos runs (dead peer, dropped frames) hang
+                        exactly there
 
 A second rule family, ``jax`` (``jaxlint.py``), runs from the same CLI:
 JAX/XLA tracing-safety rules (closure-captured-array-into-jit,
@@ -55,6 +60,7 @@ DEFAULT_BASELINE = os.path.join(_HERE, "lint_baseline.json")
 RULES = (
     "lock-order", "blocking-under-lock", "close-without-shutdown",
     "banned-api", "swallowed-exception", "daemon-no-join",
+    "retry-without-deadline",
 )
 
 #: Rule families: "concurrency" = the tables above (the original
@@ -247,6 +253,69 @@ class _FileLinter(ast.NodeVisitor):
                         f"{var}.close() without a prior shutdown() in "
                         f"'{fn.name}' — a reader blocked in recv stays "
                         "alive writing into freed buffers"))
+
+    # ------------------------------------------------ unbounded retries
+
+    def visit_While(self, node):
+        self._check_retry_loop(node)
+        self.generic_visit(node)
+
+    def _check_retry_loop(self, node: ast.While) -> None:
+        """``while True:`` around retrying_call / socket connect with no
+        deadline, attempt counter, or stop-event check: under chaos
+        (peer dead, frames dropped) the loop never exits. Success-path
+        ``break``/``return`` do NOT bound it — the hang case is the one
+        where success never comes."""
+        test = node.test
+        if not (isinstance(test, ast.Constant) and test.value is True
+                or isinstance(test, ast.Constant) and test.value == 1):
+            return
+        # Walk THIS loop only; nested defs run on their own schedule.
+        nodes, todo = [], list(node.body)
+        while todo:
+            sub = todo.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            nodes.append(sub)
+            todo.extend(ast.iter_child_nodes(sub))
+        retry_call = None
+        bounded = False
+        for sub in nodes:
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func) or ""
+                if isinstance(sub.func, ast.Attribute):
+                    attr = sub.func.attr
+                    if attr in inv.RETRY_CALL_ATTRS:
+                        retry_call = retry_call or f".{attr}()"
+                    elif any(dotted.endswith(s)
+                             for s in inv.RETRY_CONNECT_SUFFIXES):
+                        retry_call = retry_call or f"{dotted}()"
+                    elif attr == "connect":
+                        var = _dotted(sub.func.value) or ""
+                        if inv.SOCKET_NAME_RE.search(var):
+                            retry_call = retry_call or f"{var}.connect()"
+                    if attr in inv.RETRY_STOP_ATTRS:
+                        var = _dotted(sub.func.value) or ""
+                        if inv.RETRY_STOP_NAME_RE.search(var):
+                            bounded = True
+                if dotted in inv.RETRY_DEADLINE_CALLS:
+                    bounded = True
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and \
+                    inv.RETRY_DEADLINE_NAME_RE.search(name):
+                bounded = True
+        if retry_call is not None and not bounded:
+            self._emit(
+                "retry-without-deadline", node,
+                f"while True loop retries {retry_call} with no "
+                "deadline, attempt counter, or stop-event check — "
+                "bound it (a chaos run hangs here when the peer "
+                "never recovers)")
 
     # -------------------------------------------------------- lock rules
 

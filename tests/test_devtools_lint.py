@@ -32,6 +32,132 @@ def rules(findings):
     return sorted({f.rule for f in findings})
 
 
+# ------------------------------------------- retry-without-deadline
+
+
+def test_unbounded_retrying_call_loop_flagged():
+    src = (
+        "import time\n"
+        "def f(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return self.head.retrying_call('ping', timeout=5)\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+        "            time.sleep(0.1)\n"
+    )
+    fs = lint_source(src, NM, "x.py")
+    assert rules(fs) == ["retry-without-deadline"]
+
+
+def test_unbounded_socket_connect_loop_flagged():
+    src = (
+        "import socket, time\n"
+        "def f(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            self.sock.connect(('h', 1))\n"
+        "            return\n"
+        "        except OSError as e:\n"
+        "            print(e)\n"
+        "            time.sleep(0.1)\n"
+    )
+    fs = lint_source(src, NM, "x.py")
+    assert rules(fs) == ["retry-without-deadline"]
+
+
+def test_deadline_bounded_retry_loop_clean():
+    src = (
+        "import time\n"
+        "def f(self):\n"
+        "    deadline = time.monotonic() + 30\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return self.head.retrying_call('ping', timeout=5)\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+        "            if time.monotonic() > deadline:\n"
+        "                raise\n"
+    )
+    assert lint_source(src, NM, "x.py") == []
+
+
+def test_attempt_counted_and_stop_event_loops_clean():
+    counted = (
+        "def f(self):\n"
+        "    attempts = 0\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return self.head.retrying_call('ping')\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+        "            attempts += 1\n"
+        "            if attempts > 5:\n"
+        "                raise\n"
+    )
+    assert lint_source(counted, NM, "x.py") == []
+    # Daemon loops that exit on the stop event are bounded by shutdown.
+    daemon = (
+        "def f(self):\n"
+        "    while True:\n"
+        "        if self._stop.is_set():\n"
+        "            return\n"
+        "        try:\n"
+        "            self.head.retrying_call('register_node')\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+    )
+    assert lint_source(daemon, NM, "x.py") == []
+
+
+def test_success_break_alone_does_not_bound_retry_loop():
+    # break on success is the NORMAL exit — the hang case is the one
+    # where success never comes; break must not count as a bound.
+    src = (
+        "def f(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            self.head.retrying_call('ping')\n"
+        "            break\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+    )
+    assert rules(lint_source(src, NM, "x.py")) == ["retry-without-deadline"]
+
+
+def test_retry_rule_ignores_nonretry_while_true_and_nested_defs():
+    plain = (
+        "def f(self):\n"
+        "    while True:\n"
+        "        self.queue.append(1)\n"
+    )
+    assert lint_source(plain, NM, "x.py") == []
+    # A retry loop INSIDE a nested def belongs to that def's own visit;
+    # the outer while must not inherit its calls.
+    nested = (
+        "def f(self):\n"
+        "    while True:\n"
+        "        if self._stop.is_set():\n"
+        "            return\n"
+        "        def cb():\n"
+        "            return self.head.retrying_call('ping')\n"
+        "        self.cbs.append(cb)\n"
+    )
+    assert lint_source(nested, NM, "x.py") == []
+
+
+def test_retry_rule_suppressable_inline():
+    src = (
+        "def f(self):\n"
+        "    while True:  # rtpu-lint: disable=retry-without-deadline\n"
+        "        try:\n"
+        "            return self.head.retrying_call('ping')\n"
+        "        except Exception as e:\n"
+        "            print(e)\n"
+    )
+    assert lint_source(src, NM, "x.py") == []
+
+
 # ------------------------------------------------------------ lock-order
 
 

