@@ -98,6 +98,7 @@ ATTEMPTS = 4
 BACKOFFS_S = (10, 30, 60)  # between attempts
 CHILD_TIMEOUT_S = 2100     # first TPU compiles (4 programs) can take minutes
 SERVE_TIMEOUT_S = 900
+SERVE_ROUTED_TIMEOUT_S = 600  # whole 8-phase sweep child (2 replicas, CPU)
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 LOCALITY_TIMEOUT_S = 420   # per locality child (boots a 4-node cluster)
 DATAPLANE_TIMEOUT_S = 420  # dataplane child (store bench + 2-node cluster)
@@ -763,6 +764,288 @@ def serve_child_main() -> None:
 
 
 # --------------------------------------------------------------------------
+# routed-serve sweep (--serve): routing policies under skewed-prefix load
+# --------------------------------------------------------------------------
+
+def serve_routed_child_main() -> int:
+    """One full routing-policy pass: ONE cluster, a sequence of
+    measurement phases (policy list from RTPU_SERVE_SWEEP_ORDER,
+    default alternating random/scored x3 then one pow2 phase) —
+    adjacent phases share the host-noise window, and alternating the
+    two headline policies several times means a noise burst corrupts
+    at most one phase per side; the parent takes per-policy medians.
+    Each phase deploys a FRESH 2-replica tiny-cpu engine deployment
+    (fresh KV: no residency carry-over between policies), drives
+    closed-loop skewed-prefix traffic, tears the deployment down, and
+    prints one JSON row.
+
+    Workload: 8 prefix groups of 224 tokens (14 cache blocks) + 8
+    fresh suffix tokens, mildly skewed popularity. The full group set
+    (112 blocks) overcommits one replica's 80-block KV pool — blind
+    routing churns eviction — while a 4-group affinity partition (56
+    blocks) stays resident. A prefix HIT prefills only the suffix
+    (16-bucket); a miss pays the full 232-bucket prefill. Decode is
+    held to ONE 1-step dispatch (prefill itself yields the first
+    token) so the policy-neutral decode floor doesn't drown the
+    prefill asymmetry on 2 CPU cores. Streams every request to
+    measure true TTFT."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    order = [p.strip() for p in os.environ.get(
+        "RTPU_SERVE_SWEEP_ORDER",
+        "random,scored,random,scored,random,scored,pow2").split(",")
+        if p.strip()]
+    seconds, n_replicas, concurrency = 8.0, 2, 6
+    prefix_len, suffix_len, new_tokens = 224, 8, 2
+
+    ray_tpu.init(num_cpus=max(8, os.cpu_count() or 8))
+    rng = np.random.default_rng(11)
+    groups = [[int(t) for t in rng.integers(1, 200, prefix_len)]
+              for _ in range(8)]
+    pop = 1.0 / (np.arange(8) + 4.0)
+    pop = pop / pop.sum()
+
+    def make_payload(r):
+        g = int(r.choice(len(groups), p=pop))
+        suffix = [int(t) for t in r.integers(1, 200, suffix_len)]
+        return {"prompt_ids": groups[g] + suffix,
+                "max_new_tokens": new_tokens}
+
+    for phase_i, policy in enumerate(order):
+        GLOBAL_CONFIG.set("serve_router_policy", policy)
+        name = f"routed-{phase_i}-{policy}"
+        handle = serve.run(build_llm_deployment(
+            name=name, num_replicas=n_replicas,
+            engine_kwargs={"max_batch": 4, "max_len": 320,
+                           "prompt_buckets": [16, 232],
+                           "prefix_block": 16, "decode_chunk": 1}),
+            name=name)
+        # Warm every replica's programs off the measured path with a
+        # NEUTRAL prompt (not a group prefix: warmup must not pre-seed
+        # affinity for any policy).
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        replicas = ray_tpu.get(controller.get_replicas.remote(name),
+                               timeout=60)
+        warm_full = {"prompt_ids": [210] * (prefix_len + suffix_len),
+                     "max_new_tokens": new_tokens}
+        warm_small = {"prompt_ids": [210] * 12,
+                      "max_new_tokens": new_tokens}
+        ray_tpu.get([r.handle_request.remote("__call__", (w,), {})
+                     for r in replicas for w in (warm_full, warm_small)],
+                    timeout=900)
+        # Let one snapshot sweep land so scored routing starts informed.
+        time.sleep(1.5)
+
+        stop_at = time.perf_counter() + seconds
+        ttfts: list = []
+        tokens = [0] * concurrency
+        reqs = [0] * concurrency
+        errs = [0] * concurrency
+        last_err: list = [None]
+        lock = threading.Lock()
+
+        def client(i: int) -> None:
+            r = np.random.default_rng(1000 + i)
+            while time.perf_counter() < stop_at:
+                # One failed request must not kill the whole closed-loop
+                # client: a phase quietly running 5 clients instead of 6
+                # would bias exactly the policy comparison the
+                # alternating-median design protects.
+                try:
+                    gen = handle.options("stream", stream=True).remote(
+                        make_payload(r))
+                    t0 = time.perf_counter()
+                    n = 0
+                    for _tok in gen:
+                        if n == 0:
+                            with lock:
+                                ttfts.append(
+                                    (time.perf_counter() - t0) * 1e3)
+                        n += 1
+                    tokens[i] += n
+                    reqs[i] += 1
+                except Exception as e:
+                    errs[i] += 1
+                    with lock:
+                        last_err[0] = repr(e)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        stats = ray_tpu.get([r.handle_request.remote("stats", (), {})
+                             for r in replicas], timeout=60)
+        hits = sum(s["prefix_hits"] for s in stats)
+        misses = sum(s["prefix_misses"] for s in stats)
+        ttfts.sort()
+        row = {
+            "metric": "serve_routed",
+            "config": "tiny-cpu-2rep",
+            "policy": policy,
+            "requests_per_s": round(sum(reqs) / elapsed, 2),
+            "tokens_per_s": round(sum(tokens) / elapsed, 2),
+            "p50_ttft_ms": round(ttfts[len(ttfts) // 2], 2)
+                if ttfts else None,
+            "p99_ttft_ms": round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2)
+                if ttfts else None,
+            "prefix_hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0,
+            "client_errors": sum(errs),
+            "client_last_error": last_err[0],
+            "router": handle._router.stats(),
+        }
+        print(json.dumps(row), flush=True)
+        # Tear the phase's deployment down so the next policy starts
+        # from cold KV on an idle cluster.
+        ray_tpu.get(controller.delete.remote(name), timeout=60)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if not ray_tpu.get(controller.get_replicas.remote(name),
+                                   timeout=10):
+                    break
+            except Exception:  # rtpu-lint: disable=swallowed-exception
+                break  # deployment record gone entirely == torn down
+            time.sleep(0.5)
+    return 0
+
+
+def _serve_routed_rows(rounds: int = 1) -> list:
+    """Run ``rounds`` sweep children. Each child measures the two
+    headline policies (random, scored) as ALTERNATING adjacent phases
+    on one cluster plus a trailing pow2 phase, so every phase pair
+    shares a host-noise window and a burst corrupts at most one phase
+    per side. Odd rounds lead with scored so neither policy always
+    gets the freshest cluster. Per policy, every metric reduces by
+    MEDIAN across all phases of all rounds — robust to a corrupted
+    minority of phases and symmetric across policies. Error rows never
+    kill the bench."""
+    collected: dict = {}
+    errors: dict = {}
+    policies = ("random", "pow2", "scored")
+    for rnd in range(rounds):
+        pair = (["random", "scored"] if rnd % 2 == 0
+                else ["scored", "random"])
+        order = pair * 3 + ["pow2", "pow2"]
+        env = {"JAX_PLATFORMS": "cpu",
+               "RTPU_SERVE_SWEEP_ORDER": ",".join(order)}
+        try:
+            proc = _run(["--serve-routed-child"],
+                        SERVE_ROUTED_TIMEOUT_S, env_extra=env)
+        except subprocess.TimeoutExpired as te:
+            # Phases stream one JSON row each as they finish: salvage
+            # what the child measured before the hang instead of
+            # discarding minutes of completed phases with it.
+            partial = te.stdout or ""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            rows = [ln for ln in _json_lines(partial)
+                    if ln.get("metric") == "serve_routed"
+                    and ln.get("policy")]
+            for row in rows:
+                collected.setdefault(row["policy"], []).append(row)
+            for policy in policies:
+                if not any(r["policy"] == policy for r in rows):
+                    errors.setdefault(policy, {
+                        "metric": "serve_routed", "policy": policy,
+                        "error": f"timeout {SERVE_ROUTED_TIMEOUT_S}s"})
+            continue
+        lines = _json_lines(proc.stdout)
+        rows = [ln for ln in lines
+                if ln.get("metric") == "serve_routed"
+                and ln.get("policy")]
+        for row in rows:
+            collected.setdefault(row["policy"], []).append(row)
+        if proc.returncode != 0 or len(rows) < len(order):
+            tail = (proc.stderr or proc.stdout).strip() \
+                .splitlines()[-3:]
+            for policy in policies:
+                if not any(r["policy"] == policy for r in rows):
+                    errors.setdefault(policy, {
+                        "metric": "serve_routed", "policy": policy,
+                        "error": "rc=%d: %s" % (proc.returncode,
+                                                " | ".join(tail))})
+
+    def _median(vals: list) -> float:
+        vals = sorted(vals)
+        n = len(vals)
+        mid = vals[n // 2] if n % 2 else (vals[n // 2 - 1]
+                                          + vals[n // 2]) / 2
+        return round(mid, 4)
+
+    out = []
+    for p in policies:
+        rows = collected.get(p)
+        if not rows:
+            if p in errors:
+                out.append(errors[p])
+            continue
+        merged = dict(rows[len(rows) // 2])
+        merged["phases"] = len(rows)
+        for key in ("requests_per_s", "tokens_per_s", "p50_ttft_ms",
+                    "p99_ttft_ms", "prefix_hit_rate"):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            if vals:
+                merged[key] = _median(vals)
+        # Router path counters accumulate over every phase: the scored
+        # row must prove the affinity path actually ran.
+        merged["router"] = {
+            k: sum(r.get("router", {}).get(k, 0) for r in rows)
+            for k in ("scored_routes", "pow2_routes",
+                      "affinity_routes")}
+        out.append(merged)
+    return out
+
+
+def _merge_serve_routed_rows(rows: list) -> dict:
+    by = {r.get("policy"): r for r in rows}
+    merged = {"metric": "serve_routed"}
+    sc = by.get("scored", {})
+    if "error" in sc or not sc:
+        merged["error"] = sc.get("error", "scored row missing")
+    else:
+        merged["serve_routed_tokens_per_s"] = sc.get("tokens_per_s")
+        merged["serve_routed_p99_ttft_ms"] = sc.get("p99_ttft_ms")
+        merged["serve_prefix_affinity_hit_rate"] = sc.get("prefix_hit_rate")
+    rnd = by.get("random", {})
+    if rnd and "error" not in rnd:
+        merged["serve_routed_tokens_per_s_random"] = rnd.get("tokens_per_s")
+        merged["serve_routed_p99_ttft_ms_random"] = rnd.get("p99_ttft_ms")
+        merged["serve_prefix_hit_rate_random"] = rnd.get("prefix_hit_rate")
+        if sc.get("tokens_per_s") and rnd.get("tokens_per_s"):
+            merged["serve_routed_speedup_vs_random"] = round(
+                sc["tokens_per_s"] / rnd["tokens_per_s"], 3)
+    p2 = by.get("pow2", {})
+    if p2 and "error" not in p2:
+        merged["serve_routed_tokens_per_s_pow2"] = p2.get("tokens_per_s")
+        merged["serve_routed_p99_ttft_ms_pow2"] = p2.get("p99_ttft_ms")
+    return merged
+
+
+def serve_routed_main() -> int:
+    """Standalone ``--serve``: all three policies + one merged tail line."""
+    rows = _serve_routed_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_serve_routed_rows(rows)))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+# --------------------------------------------------------------------------
 # locality suite (--locality): locality-aware scheduling vs forced-random
 # --------------------------------------------------------------------------
 
@@ -1399,6 +1682,16 @@ def main() -> int:
     if serve_row is not None:
         print(json.dumps(serve_row), flush=True)
 
+    # Phase 3b: routed-serve sweep on CPU (multi-replica skewed-prefix
+    # traffic, random vs pow-2 vs scored routing). Tracked from this PR.
+    routed_rows: list = []
+    try:
+        routed_rows = _serve_routed_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        routed_rows = [{"metric": "serve_routed", "error": repr(e)[:200]}]
+    for r in routed_rows:
+        print(json.dumps(r), flush=True)
+
     # Phase 4: locality-scheduling suite on CPU (multi-node in-process
     # cluster; chip-independent). Tracked round-over-round from this PR.
     loc_rows: list = []
@@ -1482,6 +1775,17 @@ def main() -> int:
             merged[k] = serve_row.get(k)
     elif serve_row:
         merged["serve_error"] = serve_row["error"]
+    routed_merged = _merge_serve_routed_rows(routed_rows)
+    if "error" not in routed_merged:
+        for k in ("serve_routed_tokens_per_s", "serve_routed_p99_ttft_ms",
+                  "serve_prefix_affinity_hit_rate",
+                  "serve_routed_tokens_per_s_random",
+                  "serve_routed_p99_ttft_ms_random",
+                  "serve_routed_speedup_vs_random"):
+            if routed_merged.get(k) is not None:
+                merged[k] = routed_merged[k]
+    else:
+        merged["serve_routed_error"] = routed_merged["error"]
     loc_merged = _merge_locality_rows(loc_rows)
     if "error" not in loc_merged:
         for k in ("locality_hit_rate", "object_bytes_pulled_per_task",
@@ -1513,6 +1817,10 @@ if __name__ == "__main__":
         sys.exit(child_main())
     if "--serve-child" in sys.argv:
         sys.exit(serve_child_main())
+    if "--serve-routed-child" in sys.argv:
+        sys.exit(serve_routed_child_main())
+    if "--serve" in sys.argv:
+        sys.exit(serve_routed_main())
     if "--engine" in sys.argv:
         sys.exit(engine_child_main())
     if "--ops" in sys.argv:

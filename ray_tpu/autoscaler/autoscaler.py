@@ -12,12 +12,20 @@ the head so the scheduler stops routing to the node before termination.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.util import metrics as _m
+
+logger = logging.getLogger(__name__)
+
+STEP_FAILURES = _m.Counter(
+    "rtpu_autoscaler_step_failures_total",
+    "autoscaler reconcile passes that raised (loop backs off and retries)")
 
 
 @dataclasses.dataclass
@@ -61,6 +69,14 @@ class Autoscaler:
 
     def stop(self) -> None:
         self._stop.set()
+        # Join the loop (bounded): the Event wakes the wait immediately,
+        # so only an in-flight step() holds the thread — letting a live
+        # reconcile pass race interpreter teardown is how half-drained
+        # nodes leak.
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=10.0)
 
     def step(self) -> Dict[str, Any]:
         """One reconcile pass; returns what it did (tested directly)."""
@@ -166,7 +182,10 @@ class Autoscaler:
                 pid = self._provider.create_node(node_type)
                 self._managed[pid] = None
                 self._launched += 1
-            except Exception:
+            except Exception as e:
+                logger.warning("create_node(%s) failed (rest of this "
+                               "step's launches skipped): %r",
+                               node_type, e)
                 break
         return taken
 
@@ -198,8 +217,9 @@ class Autoscaler:
                     try:
                         self._rt.head.retrying_call(
                             "drain_node", cid, timeout=10)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.warning("re-drain of %s before terminate "
+                                       "retry failed: %r", cid, e)
             try:
                 self._provider.terminate_node(pid)
             except Exception:
@@ -242,8 +262,10 @@ class Autoscaler:
                     try:
                         self._rt.head.retrying_call(
                             "drain_node", n["node_id"], timeout=10)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        logger.warning("drain of idle node %s failed "
+                                       "(terminating anyway): %r",
+                                       n["node_id"], e)
                 # Only report the node reaped once the provider actually
                 # dropped it. Drain removes the node from the head's
                 # state, so a failed terminate afterwards moves the pid to
@@ -264,8 +286,21 @@ class Autoscaler:
     # ---------------------------------------------------------------- loop
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.config.poll_interval_s):
+        failures = 0
+        while not self._stop.wait(
+                self.config.poll_interval_s * min(2 ** failures, 16)):
             try:
                 self.step()
-            except Exception:
-                pass
+                failures = 0
+            except Exception as e:
+                # A dead head or a cloud-API outage must not kill the
+                # loop, but it must not be silent either: count it,
+                # log it, and back the poll off (up to 16x) so a down
+                # head isn't hammered every interval.
+                failures += 1
+                STEP_FAILURES.inc()
+                logger.warning(
+                    "autoscaler step failed (%d consecutive, next try "
+                    "in %.1fs): %r", failures,
+                    self.config.poll_interval_s * min(2 ** failures, 16),
+                    e)

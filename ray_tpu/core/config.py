@@ -279,6 +279,70 @@ _d("serve_router_refresh_s", float, 2.0,
    "router fallback replica-set poll period (long-poll push is primary)")
 _d("serve_handle_timeout_s", float, 60.0,
    "deployment-handle call timeout (handle.remote().result() default)")
+_d("serve_router_policy", str, "scored",
+   "replica selection policy: 'scored' (prefix-affinity + queue depth + "
+   "KV headroom over controller-pushed load snapshots, pow-2 when "
+   "snapshots are missing/stale), 'pow2' (local-inflight "
+   "power-of-two-choices only), 'random' (uniform; bench baseline)")
+_d("serve_router_score_all_max", int, 8,
+   "scored routing considers EVERY replica when the set is at most this "
+   "large; beyond it, falls back to scoring a pow-2 sample (O(1) "
+   "routing at large fan-out, full information when small)")
+_d("serve_router_prefix_blocks", int, 8,
+   "leading prompt blocks hashed for prefix-affinity scoring (deeper "
+   "matches than this add no routing signal, only hashing cost)")
+_d("serve_router_prefix_weight", float, 1.5,
+   "scored routing: weight of the prefix-affinity term (fraction of "
+   "the prompt already resident on the candidate). Calibrated above "
+   "queue_weight: a full-prefix miss re-prefills the whole prompt — "
+   "typically several hit-request service times — so affinity should "
+   "survive a one-to-two-request queue imbalance, not flip on it")
+_d("serve_router_queue_weight", float, 1.0,
+   "scored routing: weight of the queue-pressure penalty (snapshot "
+   "queue depth + engine waiting + caller-local in-flight, normalized "
+   "by the replica's slot count)")
+_d("serve_router_kv_weight", float, 0.5,
+   "scored routing: weight of the KV-pressure penalty (1 - free/total "
+   "cache blocks on the candidate)")
+_d("serve_snapshot_ttl_s", float, 5.0,
+   "replica load snapshots older than this are treated as absent "
+   "(scored routing falls back to pow-2 rather than trust a dead "
+   "controller's last word)")
+_d("serve_snapshot_prefix_hashes", int, 256,
+   "cap on resident prefix-block chain hashes exported per replica "
+   "load snapshot")
+_d("serve_slo_ttft_budget_ms", float, 0.0,
+   "admission control: p99 TTFT budget per deployment at the ingress "
+   "proxy — past it, new requests queue (bounded) then shed with a "
+   "503. 0 disables admission control")
+_d("serve_slo_queue_depth", int, 32,
+   "admission control: max requests parked per deployment while the "
+   "p99 budget is breached before shedding")
+_d("serve_slo_queue_timeout_s", float, 5.0,
+   "admission control: max seconds a request waits in the admission "
+   "queue before shedding")
+_d("serve_slo_window", int, 64,
+   "admission control: sliding window of recent TTFT samples the "
+   "p99 estimate is computed over")
+_d("serve_slo_min_samples", int, 8,
+   "admission control: TTFT samples required before the p99 estimate "
+   "can gate admission (cold deployments admit freely)")
+_d("serve_slo_probe_inflight", int, 1,
+   "admission control: in-flight requests still admitted while over "
+   "budget — fresh samples must keep flowing or the p99 estimate "
+   "could never recover")
+_d("serve_autoscale_up_sustain_s", float, 2.0,
+   "serve autoscaling: seconds load must exceed target before scaling "
+   "up (one-tick spikes don't add replicas)")
+_d("serve_autoscale_down_sustain_s", float, 10.0,
+   "serve autoscaling: seconds load must sit below the down threshold "
+   "before scaling down (idle gaps between bursts don't thrash)")
+_d("serve_autoscale_down_threshold", float, 0.5,
+   "serve autoscaling: scale down only while mean ongoing per replica "
+   "is under this fraction of target_ongoing_requests")
+_d("serve_autoscale_cooldown_s", float, 5.0,
+   "serve autoscaling: min seconds between replica-count changes "
+   "(hysteresis both directions)")
 
 # --- client tier ---
 _d("client_ref_flush_period_s", float, 0.2,

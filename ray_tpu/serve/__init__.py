@@ -7,6 +7,7 @@ batching, an HTTP ingress, and a native TPU continuous-batching LLM
 engine (the reference delegates that part to vLLM; serve/llm.py here).
 """
 
+from ray_tpu.serve._private.slo import DeploymentOverloadedError
 from ray_tpu.serve.api import (Deployment, DeploymentHandle,
                                DeploymentResponse,
                                DeploymentResponseGenerator, delete,
@@ -17,7 +18,8 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.schema import deploy_config
 
 __all__ = [
-    "Deployment", "DeploymentHandle", "DeploymentResponse",
+    "Deployment", "DeploymentHandle", "DeploymentOverloadedError",
+    "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "delete", "deployment",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run", "shutdown", "status", "start_http", "start_grpc",

@@ -11,9 +11,12 @@ count (reference autoscaling_policy.py:12).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "rtpu-serve-controller"
 
@@ -37,6 +40,13 @@ class ServeController:
         # (reference: long_poll.py:204 LongPollHost).
         self._set_versions: Dict[str, int] = {}
         self._set_cond = threading.Condition(self._lock)
+        # Replica LOAD snapshots, polled once per reconcile tick and
+        # piggybacked on the same long-poll (listen_for_update): the
+        # load generation bumps each poll sweep, so every parked router
+        # wakes with fresh queue/KV/prefix-hash metrics ~one reconcile
+        # period after they were measured — one RPC round of freshness,
+        # no extra poll loop anywhere.
+        self._load_gens: Dict[str, int] = {}
         # node_id -> (proxy actor, address); reconciled to one per node
         # when HTTP is enabled (reference: proxy_state.py ProxyStateManager).
         self._proxies: Dict[str, Any] = {}
@@ -67,14 +77,18 @@ class ServeController:
                     "cls": cls, "init_args": init_args,
                     "init_kwargs": init_kwargs, "config": dict(config),
                     "replicas": [], "version": 0, "last_scale": 0.0,
+                    "loads": {}, "policy": None,
                 }
             else:
                 d.update(cls=cls, init_args=init_args,
                          init_kwargs=init_kwargs, config=dict(config))
                 d["version"] += 1
-                # Code/config changed: replace the replica set.
+                # Code/config changed: replace the replica set (and
+                # drop load state keyed to the old one).
                 self._stop_replicas(d["replicas"])
                 d["replicas"] = []
+                d["loads"] = {}
+                d["policy"] = None
                 self._bump_set(name)
         self._reconcile_once(name)
         return True
@@ -137,28 +151,53 @@ class ServeController:
     # ---------------------------------------------------------- reconcile
 
     def _desired_replicas(self, d: Dict[str, Any]) -> int:
+        from ray_tpu.serve._private.autoscaling_policy import \
+            ServeAutoscalePolicy
+
+        from ray_tpu.core.config import GLOBAL_CONFIG as gcfg
+
         with self._lock:
             cfg = dict(d["config"])
             replicas = list(d["replicas"])
+            loads_map = dict(d["loads"])
+            loads_age = time.monotonic() - d.get("loads_mono",
+                                                 float("-inf"))
+            policy = d["policy"]
+        if loads_age > gcfg.serve_snapshot_ttl_s:
+            # Sweep has not landed recently (every replica poll failing,
+            # e.g. wedged engines): spike-era snapshots frozen in the
+            # cache must not keep driving scale decisions — same TTL the
+            # router applies. The queue_len fallback below still runs.
+            loads_map = {}
         n = cfg.get("num_replicas", 1)
         auto = cfg.get("autoscaling_config")
         if not auto:
             return n
-        # Autoscaling: mean ongoing per replica vs target (RPCs below run
-        # WITHOUT the routing lock).
         if not replicas:
             return max(1, auto.get("min_replicas", 1))
-        try:
-            lens = self._ray.get(
-                [r.queue_len.remote() for r in replicas], timeout=5)
-        except Exception:
-            return len(replicas)
-        target = max(auto.get("target_ongoing_requests", 2), 1e-6)
-        desired = int(round(len(replicas) * (sum(lens) / len(lens))
-                            / target)) if lens else len(replicas)
-        lo = auto.get("min_replicas", 1)
-        hi = auto.get("max_replicas", max(lo, len(replicas)))
-        return min(max(desired, lo), hi)
+        if policy is None:
+            # Per-deployment policy state (sustain windows, cooldown);
+            # reset on redeploy by deploy() so config changes take.
+            policy = ServeAutoscalePolicy(auto)
+            with self._lock:
+                d["policy"] = policy
+        # The snapshot sweep (this same reconcile tick) already holds
+        # every replica's load — queue depth, engine waiting, decode
+        # utilization. No extra RPC here; replicas the sweep missed
+        # contribute None and the policy treats the tick accordingly.
+        loads = [loads_map.get(r) for r in replicas]
+        if not any(s is not None for s in loads):
+            # Snapshot sweep hasn't covered this set yet (first tick
+            # after deploy): fall back to a direct queue-length poll so
+            # a cold controller still reacts (legacy behavior).
+            try:
+                lens = self._ray.get(
+                    [r.queue_len.remote() for r in replicas], timeout=5)
+                loads = [{"queue_depth": q} for q in lens]
+            except Exception as e:
+                logger.debug("queue_len fallback poll failed: %r", e)
+                return len(replicas)
+        return policy.desired(len(replicas), loads, time.monotonic())
 
     def _reconcile_once(self, name: str) -> None:
         with self._reconcile_mutex:
@@ -225,6 +264,10 @@ class ServeController:
 
         while not self._shutdown:
             time.sleep(cfg.serve_reconcile_period_s)
+            try:
+                self._poll_loads()
+            except Exception as e:
+                logger.debug("load-snapshot sweep failed: %r", e)
             for name in list(self._deployments):
                 try:
                     self._reconcile_once(name)
@@ -235,6 +278,60 @@ class ServeController:
                 self._ensure_proxies()
             except Exception:
                 pass
+
+    def _poll_loads(self) -> None:
+        """One load-snapshot sweep: poll every replica of every
+        deployment, cache the results, bump the load generation so
+        parked listen_for_update long-polls wake with them. Replicas
+        that fail to answer keep no entry — the router falls back to
+        pow-2 for them, and the autoscaling policy sees a None."""
+        with self._lock:
+            items = [(n, list(d["replicas"]))
+                     for n, d in self._deployments.items()]
+        changed = []
+        for name, replicas in items:
+            if not replicas:
+                continue
+            loads: Dict[Any, Any] = {}
+            try:
+                snaps = self._ray.get(
+                    [r.load_snapshot.remote() for r in replicas],
+                    timeout=5)
+                loads = dict(zip(replicas, snaps))
+            except Exception:
+                # Batch gather fails whole on one dead replica: fall
+                # back to per-replica harvesting so the rest still
+                # report. Submit every RPC up front and drain against
+                # ONE shared deadline — a serial 2s-per-replica loop
+                # would let a single wedged replica stall the whole
+                # reconcile thread ~2s x N and stale out every other
+                # deployment's snapshots.
+                refs = [(r, r.load_snapshot.remote()) for r in replicas]
+                deadline = time.monotonic() + 5.0
+                for r, ref in refs:
+                    try:
+                        loads[r] = self._ray.get(
+                            ref, timeout=max(0.1, deadline
+                                             - time.monotonic()))
+                    except Exception as e:
+                        logger.debug("load_snapshot poll failed for a "
+                                     "replica of %s: %r", name, e)
+            if loads:
+                changed.append((name, loads))
+        if not changed:
+            return
+        with self._lock:
+            for name, loads in changed:
+                d = self._deployments.get(name)
+                if d is None:
+                    continue
+                # Keep only entries for replicas still in the set.
+                current = set(d["replicas"])
+                d["loads"] = {r: s for r, s in loads.items()
+                              if r in current}
+                d["loads_mono"] = time.monotonic()
+                self._load_gens[name] = self._load_gens.get(name, 0) + 1
+            self._set_cond.notify_all()
 
     def _check_replica_health(self) -> None:
         """Dead replicas are pruned; reconcile replaces them next tick."""
@@ -280,6 +377,40 @@ class ServeController:
                 raise KeyError(f"no deployment named {name!r}")
             return self._set_versions.get(name, 0), list(d["replicas"])
 
+    def _loads_for(self, d: Dict[str, Any],
+                   replicas: List[Any]) -> List[Any]:
+        """Callers hold self._lock. Snapshot list aligned with
+        ``replicas`` (None where the sweep has nothing fresh). Each
+        snapshot ships ``age_s`` — seconds since this controller's
+        sweep landed it, measured on ONE clock — so the router restamps
+        freshness onto its own clock instead of trusting the replica
+        host's wall time."""
+        loads = d["loads"]
+        if not loads:
+            return [None for _ in replicas]
+        age = round(max(0.0, time.monotonic()
+                        - d.get("loads_mono", float("-inf"))), 3)
+        out: List[Any] = []
+        for r in replicas:
+            s = loads.get(r)
+            if s is not None:
+                s = dict(s)
+                s["age_s"] = age
+            out.append(s)
+        return out
+
+    def get_replica_set_with_loads(self, name: str):
+        """(set_version, replicas, load_gen, loads) — the scored
+        router's seed; ``loads`` aligns with ``replicas``."""
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is None:
+                raise KeyError(f"no deployment named {name!r}")
+            replicas = list(d["replicas"])
+            return (self._set_versions.get(name, 0), replicas,
+                    self._load_gens.get(name, 0),
+                    self._loads_for(d, replicas))
+
     def listen_for_change(self, name: str, known_version: int,
                           timeout: float = 30.0):
         """Long-poll: blocks until the replica set's version moves past
@@ -302,6 +433,35 @@ class ServeController:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return v, (None if d is None else list(d["replicas"]))
+                self._set_cond.wait(remaining)
+
+    def listen_for_update(self, name: str, known_set_version: int,
+                          known_load_gen: int, timeout: float = 30.0):
+        """Long-poll for EITHER a replica-set change or a fresh
+        load-snapshot sweep: returns (set_version, replicas, load_gen,
+        loads) the moment either counter moves past the caller's
+        (replicas/loads are None when the deployment was deleted).
+        The snapshot sweep runs once per reconcile period, so a parked
+        router observes replica load at reconcile-period freshness for
+        the cost of one RPC round per period — the metrics PUSH path,
+        piggybacked on the set-change channel it already held open."""
+        deadline = time.monotonic() + timeout
+        with self._set_cond:
+            while True:
+                d = self._deployments.get(name)
+                v = self._set_versions.get(name, 0)
+                g = self._load_gens.get(name, 0)
+                if v != known_set_version or g != known_load_gen:
+                    if d is None:
+                        return v, None, g, None
+                    replicas = list(d["replicas"])
+                    return v, replicas, g, self._loads_for(d, replicas)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    if d is None:
+                        return v, None, g, None
+                    replicas = list(d["replicas"])
+                    return v, replicas, g, self._loads_for(d, replicas)
                 self._set_cond.wait(remaining)
 
     # -------------------------------------------------------- HTTP proxies

@@ -6,7 +6,15 @@ loop multiplexes every in-flight request over awaited object refs, instead
 of parking one thread per request (the previous stdlib
 BaseHTTPRequestHandler design collapsed under concurrency). Endpoints:
 `POST /<deployment>[/<method>][?stream=1]` with a JSON body,
-`GET /-/healthz` liveness, `GET /-/routes` deployment listing.
+`GET /-/healthz` liveness, `GET /-/routes` deployment listing,
+`GET /-/slo` SLO admission state.
+
+SLO admission (slo.py): every POST passes the per-process
+AdmissionController first — past the configured p99 TTFT budget
+requests queue (bounded) then shed as HTTP 503 with a JSON
+``{"error": "overloaded", ...}`` body, and the proxy feeds the
+controller one TTFT sample per admitted request (time to full result,
+or to the first streamed chunk).
 """
 
 from __future__ import annotations
@@ -14,13 +22,31 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Any, Dict
+
+from ray_tpu.serve._private.slo import (AdmissionController,
+                                        DeploymentOverloadedError)
 
 
 class HTTPProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
         self._handles: Dict[str, Any] = {}
+        self._admission = AdmissionController()
+        # Admission waits get their OWN pool: a queued acquire() parks a
+        # thread for up to queue_timeout_s, and on a small box the
+        # shared default executor (min(32, cpu+4) threads) would fill
+        # with waiters and starve the routing calls — including the
+        # probe requests whose TTFT samples are the only way the gate
+        # reopens. Waiters beyond the clamp queue for a pool thread
+        # before their timeout clock starts; acquire() still sheds them
+        # once the admission queue itself is full.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._gate_pool = ThreadPoolExecutor(
+            max_workers=min(64, self._admission.queue_depth + 4),
+            thread_name_prefix="serve-slo-gate")
         self.port = None
         self._loop = asyncio.new_event_loop()
         started = threading.Event()
@@ -49,6 +75,7 @@ class HTTPProxyActor:
         app = web.Application()
         app.router.add_get("/-/healthz", self._healthz)
         app.router.add_get("/-/routes", self._routes)
+        app.router.add_get("/-/slo", self._slo)
         app.router.add_post("/{tail:.*}", self._post)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
@@ -63,6 +90,11 @@ class HTTPProxyActor:
         from aiohttp import web
 
         return web.json_response({"status": "ok"})
+
+    async def _slo(self, request):
+        from aiohttp import web
+
+        return web.json_response(self._admission.snapshot())
 
     async def _routes(self, request):
         from aiohttp import web
@@ -101,29 +133,62 @@ class HTTPProxyActor:
         except (ValueError, json.JSONDecodeError) as e:
             return web.json_response({"error": f"bad json: {e}"},
                                      status=400)
+        loop = asyncio.get_event_loop()
+        # SLO gate first (off-loop on the dedicated gate pool: a queued
+        # admission parks up to the queue timeout). A shed request
+        # never touches the router.
+        try:
+            if self._admission.budget_ms <= 0:
+                # Gating disabled (the default): acquire() cannot park,
+                # so the hot path skips the executor round-trip.
+                self._admission.acquire(name)
+            else:
+                await loop.run_in_executor(self._gate_pool,
+                                           self._admission.acquire, name)
+        except DeploymentOverloadedError as e:
+            return web.json_response(
+                {"error": "overloaded", "deployment": name,
+                 "detail": str(e)}, status=503)
+        t_admit = time.perf_counter()
+        unknown = False
         try:
             h = self._get_handle(name)
             if stream:
-                return await self._stream(request, h, method, payload)
+                return await self._stream(request, h, method, payload,
+                                          name, t_admit)
             # Routing runs in the executor: choose() is normally a dict
             # pick, but the first call (or an unknown/scaled-to-zero
             # deployment) does a synchronous controller fetch that must
             # not stall the loop. The await then multiplexes the
             # in-flight request on the loop.
-            resp = await asyncio.get_event_loop().run_in_executor(
+            resp = await loop.run_in_executor(
                 None, lambda: h.options(method).remote(payload))
             result = await resp.result_async(timeout=120)
+            # Full-result latency stands in for TTFT on the unary path
+            # (first byte == last byte here); the stream path records
+            # true first-chunk time.
+            self._admission.record_ttft(
+                name, (time.perf_counter() - t_admit) * 1e3)
             return web.json_response({"result": result})
         except Exception as e:  # noqa: BLE001 — surfaced as 500
             # The controller's KeyError arrives wrapped as a remote
             # TaskError; match it by message for the 404.
             if "no deployment named" in str(e) or isinstance(e, KeyError):
                 self._handles.pop(name, None)
+                unknown = True
                 return web.json_response(
                     {"error": f"no deployment {name!r}"}, status=404)
             return web.json_response({"error": str(e)}, status=500)
+        finally:
+            self._admission.release(name)
+            if unknown:
+                # acquire() ran before the deployment lookup, so a 404
+                # leaves behind admission state for a name that does
+                # not exist — drop it or scanners grow the dict forever.
+                self._admission.forget(name)
 
-    async def _stream(self, request, h, method, payload):
+    async def _stream(self, request, h, method, payload,
+                      name=None, t_admit=None):
         """Chunked transfer: one JSON line per streamed item (reference:
         proxy_response_generator.py writes streaming responses the same
         incremental way over ASGI)."""
@@ -138,8 +203,13 @@ class HTTPProxyActor:
         resp = web.StreamResponse(
             headers={"Content-Type": "application/jsonlines"})
         await resp.prepare(request)
+        first = True
         try:
             async for item in gen:
+                if first and t_admit is not None:
+                    self._admission.record_ttft(
+                        name, (time.perf_counter() - t_admit) * 1e3)
+                first = False
                 await resp.write(
                     (json.dumps({"item": item}) + "\n").encode())
         except asyncio.CancelledError:

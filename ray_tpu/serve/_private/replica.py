@@ -8,11 +8,14 @@ pow-2 router and the autoscaler consume).
 
 from __future__ import annotations
 
+import logging
 import queue as _queue_mod
 import threading
 import time
 import uuid
 from typing import Any, Dict, Optional, Tuple
+
+_logger = logging.getLogger(__name__)
 
 # Per-request serve context (multiplexed model id, ...). A ContextVar so
 # asyncio deployments interleave safely too.
@@ -178,6 +181,25 @@ class ReplicaActor:
 
     def queue_len(self) -> int:
         return self._ongoing
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Compact load view the controller polls once per reconcile
+        tick and piggybacks on the router long-poll (one RPC round of
+        freshness). Base fields come from the replica's own gauges; a
+        user callable exposing ``load_snapshot()`` (e.g. the LLM engine
+        deployment) merges richer signals — queue depth, KV headroom,
+        resident prefix-block hashes, EWMA TTFT."""
+        snap: Dict[str, Any] = {"queue_depth": self._ongoing,
+                                "ts": time.time()}
+        hook = getattr(self._callable, "load_snapshot", None)
+        if hook is not None:
+            try:
+                extra = hook()
+                if extra:
+                    snap.update(extra)
+            except Exception as e:
+                _logger.debug("user load_snapshot failed: %r", e)
+        return snap
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:

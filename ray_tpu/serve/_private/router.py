@@ -1,13 +1,30 @@
-"""Client-side router: power-of-two-choices replica selection.
+"""Client-side router: metrics-scored replica selection over pushed
+load snapshots, with power-of-two-choices as the no-metrics fallback.
 
 Parity target: reference python/ray/serve/_private/replica_scheduler/
 pow_2_scheduler.py:52 — sample two replicas, send to the one with the
-shorter queue. Queue lengths are the CALLER's local in-flight view.
-Replica-set changes arrive by LONG-POLL PUSH from the controller
-(reference: long_poll.py LongPollClient): a background thread blocks in
-`listen_for_change` and applies updates the moment the set version moves
-— scale-ups/downs and dead-replica prunes propagate in one RPC round,
-not on a refresh timer.
+shorter queue — extended the way the reference's prefix-aware router
+(llm/.../prefix_aware/prefix_aware_router.py) and queue-len-gated
+replica scheduler extend it: when fresh per-replica load snapshots are
+available (pushed by the controller, see below), `choose` scores
+candidates on
+
+- PREFIX AFFINITY: how much of the request's leading prompt blocks are
+  already resident in the candidate's KV cache (block-chain hashes,
+  engine/kv_manager.py) — repeat-prefix traffic lands where its KV
+  blocks live and skips re-prefill;
+- QUEUE PRESSURE: snapshot queue depth + engine-internal waiting line +
+  the caller's own in-flight counts, normalized per slot;
+- KV HEADROOM: fraction of cache blocks already occupied.
+
+Replica-set changes AND load snapshots arrive by LONG-POLL PUSH from
+the controller (reference: long_poll.py LongPollClient): a background
+thread blocks in `listen_for_update` and wakes the moment the set
+version OR the load generation moves — set changes propagate in one
+RPC round, and snapshots refresh once per controller reconcile period
+with no extra poll loop. When any replica in the set lacks a fresh
+snapshot (new controller, mid-rollout, metrics disabled), `choose`
+falls back to exactly the pow-2 local-inflight policy.
 """
 
 from __future__ import annotations
@@ -16,7 +33,7 @@ import logging
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
@@ -35,31 +52,81 @@ class Router:
         self._lock = make_lock("serve.router._lock")
         self._replicas: List[Any] = []
         self._version = -1
+        self._load_gen = -1
+        # replica -> load snapshot (dict) from the last controller
+        # push; prefix hash lists become sets once, at apply time.
+        self._loads: Dict[Any, Dict[str, Any]] = {}
         self._inflight: Dict[Any, int] = {}
         # Multiplex affinity: model id -> replica that last served it
         # (cache locality; reference routers rank replicas by loaded
         # model sets the same way).
         self._model_affinity: Dict[str, Any] = {}
+        # Routing-decision counters (router.stats(); bench/tests read
+        # them to assert which path ran).
+        self._scored_routes = 0
+        self._pow2_routes = 0
+        self._affinity_routes = 0  # scored routes that matched >=1 block
         self._poller_started = False
+        self._poll_thread: Optional[threading.Thread] = None
         self._stopped = False
 
     # ------------------------------------------------------------- updates
 
-    def _apply(self, version: int, replicas: Optional[List[Any]]) -> None:
+    def _apply(self, version: int, replicas: Optional[List[Any]],
+               load_gen: int = -1,
+               loads: Optional[List[Any]] = None) -> None:
         with self._lock:
             self._version = version
             self._replicas = list(replicas or [])
             self._inflight = {r: self._inflight.get(r, 0)
                               for r in self._replicas}
+            if load_gen >= 0:
+                self._load_gen = load_gen
+            new_loads: Dict[Any, Dict[str, Any]] = {}
+            for r, snap in zip(self._replicas, loads or []):
+                if snap is None:
+                    continue
+                snap = dict(snap)
+                hashes = snap.get("prefix_hashes")
+                if hashes is not None and not isinstance(hashes,
+                                                         frozenset):
+                    snap["prefix_hashes"] = frozenset(hashes)
+                # The controller ships snapshot AGE (its own clock, one
+                # process): restamp onto THIS process's clock so the
+                # TTL check in _fresh_loads never compares wall clocks
+                # across hosts — NTP skew would otherwise silently pin
+                # scored routing on (always-stale) or off (never-stale).
+                age = snap.pop("age_s", None)
+                if age is not None:
+                    snap["ts"] = time.time() - float(age)
+                new_loads[r] = snap
+            self._loads = new_loads
 
     def _seed(self) -> None:
         """Synchronous first fetch (and recovery fetch after errors)."""
         import ray_tpu
 
-        version, replicas = ray_tpu.get(
-            self._controller.get_replica_set.remote(self._deployment),
-            timeout=30)
-        self._apply(version, replicas)
+        try:
+            version, replicas, gen, loads = ray_tpu.get(
+                self._controller.get_replica_set_with_loads.remote(
+                    self._deployment), timeout=30)
+        except Exception as e:
+            # The controller's unknown-deployment KeyError arrives
+            # WRAPPED as a remote TaskError, so match it by message too
+            # (callers map it to a 404) — the legacy fallback below
+            # would only raise the same error after a second RPC.
+            if isinstance(e, KeyError) or "no deployment named" in str(e):
+                raise
+            # Older controller actor still running pre-snapshot code
+            # (rolling restart): seed from the legacy endpoint and let
+            # routing run on the pow-2 fallback.
+            logger.debug("get_replica_set_with_loads failed (%r): "
+                         "seeding from legacy get_replica_set", e)
+            version, replicas = ray_tpu.get(
+                self._controller.get_replica_set.remote(self._deployment),
+                timeout=30)
+            gen, loads = -1, None
+        self._apply(version, replicas, gen, loads)
 
     def _ensure_poller(self) -> None:
         with self._lock:
@@ -71,8 +138,10 @@ class Router:
         except Exception as e:
             logger.debug("router seed for %s failed (poller will "
                          "retry): %r", self._deployment, e)
-        threading.Thread(target=self._poll_loop, daemon=True,
-                         name=f"serve-longpoll-{self._deployment}").start()
+        t = threading.Thread(target=self._poll_loop, daemon=True,
+                             name=f"serve-longpoll-{self._deployment}")
+        self._poll_thread = t
+        t.start()
 
     def _poll_loop(self) -> None:
         import ray_tpu
@@ -81,27 +150,32 @@ class Router:
         deleted_backoff = 0.0
         while not self._stopped:
             try:
-                version, replicas = ray_tpu.get(
-                    self._controller.listen_for_change.remote(
-                        self._deployment, self._version, 30.0),
+                version, replicas, gen, loads = ray_tpu.get(
+                    self._controller.listen_for_update.remote(
+                        self._deployment, self._version, self._load_gen,
+                        30.0),
                     timeout=60)
                 failures = 0
+                if self._stopped:
+                    return  # stop() raced the park: exit, don't re-park
                 if replicas is None:
                     # Deployment deleted. The next listen parks on the
                     # controller condvar, but each park still holds a
                     # concurrency slot for its 30s window — back off
                     # between polls so a process full of stale handles
                     # doesn't pin the controller's slot pool.
-                    self._apply(version, [])
+                    self._apply(version, [], gen, None)
                     deleted_backoff = min(300.0,
                                           max(1.0, deleted_backoff * 2))
                     time.sleep(deleted_backoff)
                     continue
                 deleted_backoff = 0.0
-                self._apply(version, replicas)
+                self._apply(version, replicas, gen, loads)
             except Exception:
                 failures += 1
                 time.sleep(min(5.0, 0.5 * failures))
+                if self._stopped:
+                    return
                 # The controller may have been replaced (serve restart):
                 # re-resolve by name so the poller survives it.
                 if failures % 5 == 0:
@@ -116,13 +190,119 @@ class Router:
 
     def stop(self) -> None:
         self._stopped = True
+        # Bounded join: the poller re-checks _stopped after every
+        # wake (a controller push lands ~once per reconcile period, the
+        # listen window caps the worst case), so a short join reaps the
+        # common case and a parked thread dies with the process instead
+        # of re-parking forever.
+        t = self._poll_thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=2.0)
 
     # ------------------------------------------------------------- routing
 
-    def choose(self, model_id: Optional[str] = None):
-        """Pow-2: two random candidates, fewer local in-flight wins.
-        A multiplexed model id prefers its affine replica (model cache
-        locality) unless that replica disappeared."""
+    def _fresh_loads(self) -> Optional[Dict[Any, Dict[str, Any]]]:
+        """Callers hold self._lock. The snapshot map iff EVERY replica
+        has one fresh enough to trust; else None (pow-2 fallback)."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        if len(self._loads) < len(self._replicas):
+            return None
+        ttl = cfg.serve_snapshot_ttl_s
+        now = time.time()
+        for r in self._replicas:
+            snap = self._loads.get(r)
+            if snap is None or now - snap.get("ts", 0.0) > ttl:
+                return None
+        return self._loads
+
+    def _score(self, replica, snap: Dict[str, Any],
+               chain: Sequence[int], prompt_len: int):
+        """Higher is better: prefix affinity minus queue and KV
+        pressure (weights are config knobs). Returns (score,
+        match_depth in blocks)."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        affinity = 0.0
+        depth = 0
+        resident = snap.get("prefix_hashes")
+        bs = snap.get("prefix_block_size", 0)
+        if chain and resident and bs:
+            for h in chain:
+                if h in resident:
+                    depth += 1
+                else:
+                    break
+            if depth:
+                affinity = min(1.0, depth * bs / max(1, prompt_len))
+        slots = max(1, snap.get("slots", 1))
+        queue = (snap.get("queue_depth", 0) + snap.get("waiting", 0)
+                 + self._inflight.get(replica, 0))
+        kv = 0.0
+        total_blocks = snap.get("kv_total_blocks", 0)
+        if total_blocks:
+            kv = 1.0 - snap.get("kv_free_blocks", 0) / total_blocks
+        return (cfg.serve_router_prefix_weight * affinity
+                - cfg.serve_router_queue_weight * queue / slots
+                - cfg.serve_router_kv_weight * kv), depth
+
+    def _choose_scored(self, loads: Dict[Any, Dict[str, Any]],
+                       prefix_tokens: Optional[Sequence[int]]):
+        """Callers hold self._lock and have verified fresh loads."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.serve.engine.kv_manager import chain_hashes
+
+        if len(self._replicas) <= cfg.serve_router_score_all_max:
+            cands = self._replicas
+        else:
+            cands = random.sample(self._replicas, 2)
+        # One chain per block size present (homogeneous deployments pay
+        # one hash pass over the leading blocks).
+        chains: Dict[int, List[int]] = {}
+        if prefix_tokens:
+            max_blocks = cfg.serve_router_prefix_blocks
+            for r in cands:
+                bs = loads[r].get("prefix_block_size", 0)
+                if bs and bs not in chains:
+                    chains[bs] = chain_hashes(
+                        list(prefix_tokens)[:bs * max_blocks], bs)
+        best: List[Any] = []
+        best_key = None
+        match_depth: Dict[Any, int] = {}
+        for r in cands:
+            snap = loads[r]
+            s, depth = self._score(
+                r, snap,
+                chains.get(snap.get("prefix_block_size", 0), ()),
+                len(prefix_tokens or ()))
+            match_depth[r] = depth
+            # Ties break toward the caller's shorter local queue, then
+            # RANDOM: with no resident prefixes anywhere (cold start)
+            # every score ties, and a deterministic tie-break would
+            # seed every prefix group's home on the same replica — the
+            # convoy that makes affinity routing slower than random.
+            key = (s, -self._inflight.get(r, 0))
+            if best_key is None or key > best_key:
+                best, best_key = [r], key
+            elif key == best_key:
+                best.append(r)
+        choice = best[0] if len(best) == 1 else random.choice(best)
+        self._scored_routes += 1
+        if match_depth.get(choice):
+            self._affinity_routes += 1
+        return choice
+
+    def choose(self, model_id: Optional[str] = None,
+               prefix_tokens: Optional[Sequence[int]] = None):
+        """Pick a replica. With fresh snapshots for the whole set and
+        policy 'scored': score prefix affinity + queue + KV headroom.
+        Otherwise pow-2: two random candidates, fewer local in-flight
+        wins (byte-identical to the pre-snapshot router). A multiplexed
+        model id prefers its affine replica (model cache locality)
+        unless that replica disappeared."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
         self._ensure_poller()
         with self._lock:
             empty = not self._replicas
@@ -131,6 +311,7 @@ class Router:
             # Propagates the controller's KeyError for an unknown
             # deployment — callers (the proxy) map it to a 404.
             self._seed()
+        policy = cfg.serve_router_policy
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
@@ -141,12 +322,20 @@ class Router:
                 if affine is not None and affine in self._replicas:
                     choice = affine
             if choice is None:
-                if len(self._replicas) == 1:
+                if policy == "random":
+                    choice = random.choice(self._replicas)
+                elif len(self._replicas) == 1:
                     choice = self._replicas[0]
                 else:
-                    a, b = random.sample(self._replicas, 2)
-                    choice = (a if self._inflight.get(a, 0)
-                              <= self._inflight.get(b, 0) else b)
+                    loads = (self._fresh_loads()
+                             if policy == "scored" else None)
+                    if loads is not None:
+                        choice = self._choose_scored(loads, prefix_tokens)
+                    else:
+                        a, b = random.sample(self._replicas, 2)
+                        choice = (a if self._inflight.get(a, 0)
+                                  <= self._inflight.get(b, 0) else b)
+                        self._pow2_routes += 1
                 if model_id is not None:
                     self._model_affinity[model_id] = choice
                     while len(self._model_affinity) > 4096:
@@ -166,5 +355,12 @@ class Router:
         race for the immediate retry)."""
         try:
             self._seed()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("router re-seed for %s failed (retry rides the "
+                         "poller): %r", self._deployment, e)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"scored_routes": self._scored_routes,
+                    "pow2_routes": self._pow2_routes,
+                    "affinity_routes": self._affinity_routes}

@@ -1,0 +1,214 @@
+"""SLO admission control: p99-TTFT-budgeted ingress backpressure.
+
+Parity target: the reference proxy's request-queueing + backoff
+behavior (python/ray/serve/_private/proxy.py timeout/draining paths)
+hardened into an explicit SLO: the ingress tracks a sliding window of
+per-deployment TTFT samples and, while the p99 estimate exceeds the
+configured budget (``serve_slo_ttft_budget_ms``), parks new arrivals in
+a bounded queue instead of piling them onto an already-saturated
+replica set. Queue overflow — or a queue wait past
+``serve_slo_queue_timeout_s`` — sheds the request with a typed
+``DeploymentOverloadedError`` (the HTTP proxy maps it to a 503), so
+past saturation p99 of ADMITTED requests stays near the budget and the
+overload is visible in a counter instead of as unbounded tail latency.
+
+Recovery: while over budget, up to ``serve_slo_probe_inflight``
+requests stay admitted at a time. Without the probe trickle no new TTFT
+samples would arrive, the window would never slide past the breach, and
+admission would stay closed until the queue timeout — the probes keep
+the estimator live so the gate reopens one reconcile of samples after
+the backlog drains.
+
+Pure host-side state (no actor/RPC dependencies): unit-tested directly
+in tests/test_serve_slo.py, wired into HTTPProxyActor per process.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Deque, Dict, Optional
+
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.util import metrics as _m
+
+ADMITTED_TOTAL = _m.Counter(
+    "rtpu_serve_admitted_total",
+    "ingress requests admitted past SLO admission control")
+QUEUED_TOTAL = _m.Counter(
+    "rtpu_serve_queued_total",
+    "ingress requests that waited in the admission queue")
+SHED_TOTAL = _m.Counter(
+    "rtpu_serve_shed_total",
+    "ingress requests shed (503) by SLO admission control")
+TTFT_P99_MS = _m.Gauge(
+    "rtpu_serve_ttft_p99_ms",
+    "sliding-window p99 TTFT per deployment at the ingress")
+
+
+class DeploymentOverloadedError(RayTpuError):
+    """The deployment is past its TTFT budget and the admission queue is
+    full (or the queued wait timed out): the request was shed, not run.
+    HTTP ingress maps this to 503."""
+
+
+class _DeploymentState:
+    __slots__ = ("ttfts", "inflight", "queued", "admitted_total",
+                 "queued_total", "shed_total")
+
+    def __init__(self, window: int):
+        self.ttfts: Deque[float] = collections.deque(maxlen=window)  # ms
+        self.inflight = 0
+        self.queued = 0
+        self.admitted_total = 0
+        self.queued_total = 0
+        self.shed_total = 0
+
+
+class AdmissionController:
+    """Per-process SLO gate; one instance guards one ingress."""
+
+    def __init__(self, *, budget_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = None,
+                 window: Optional[int] = None,
+                 min_samples: Optional[int] = None,
+                 probe_inflight: Optional[int] = None):
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        self.budget_ms = (cfg.serve_slo_ttft_budget_ms
+                          if budget_ms is None else budget_ms)
+        self.queue_depth = (cfg.serve_slo_queue_depth
+                            if queue_depth is None else queue_depth)
+        self.queue_timeout_s = (cfg.serve_slo_queue_timeout_s
+                                if queue_timeout_s is None
+                                else queue_timeout_s)
+        self.window = cfg.serve_slo_window if window is None else window
+        self.min_samples = (cfg.serve_slo_min_samples
+                            if min_samples is None else min_samples)
+        self.probe_inflight = (cfg.serve_slo_probe_inflight
+                               if probe_inflight is None
+                               else probe_inflight)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._deployments: Dict[str, _DeploymentState] = {}
+
+    def _state(self, name: str) -> _DeploymentState:
+        st = self._deployments.get(name)
+        if st is None:
+            st = self._deployments[name] = _DeploymentState(self.window)
+        return st
+
+    @staticmethod
+    def _p99(samples: Deque[float]) -> float:
+        vals = sorted(samples)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.99))]
+
+    @staticmethod
+    def _p50(samples: Deque[float]) -> float:
+        vals = sorted(samples)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    def _admittable(self, st: _DeploymentState) -> bool:
+        """Callers hold the lock."""
+        if self.budget_ms <= 0:
+            return True
+        if not st.ttfts or len(st.ttfts) < self.min_samples:
+            return True  # cold/empty estimator never gates (an empty
+            # window must not reach _p99 even when min_samples == 0)
+        if self._p99(st.ttfts) <= self.budget_ms:
+            return True
+        # Over budget: only the probe trickle gets through.
+        return st.inflight < self.probe_inflight
+
+    # ----------------------------------------------------------- gate API
+
+    def acquire(self, name: str) -> None:
+        """Block until admitted; raises DeploymentOverloadedError when
+        shed. Every successful acquire must be paired with release()."""
+        with self._cond:
+            st = self._state(name)
+            if self._admittable(st):
+                st.inflight += 1
+                st.admitted_total += 1
+                ADMITTED_TOTAL.inc(labels={"deployment": name})
+                return
+            if st.queued >= self.queue_depth:
+                st.shed_total += 1
+                SHED_TOTAL.inc(labels={"deployment": name})
+                raise DeploymentOverloadedError(
+                    f"deployment {name!r} is over its "
+                    f"{self.budget_ms:.0f} ms p99 TTFT budget and the "
+                    f"admission queue ({self.queue_depth}) is full")
+            st.queued += 1
+            st.queued_total += 1
+            QUEUED_TOTAL.inc(labels={"deployment": name})
+            deadline = time.monotonic() + self.queue_timeout_s
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        st.shed_total += 1
+                        SHED_TOTAL.inc(labels={"deployment": name})
+                        raise DeploymentOverloadedError(
+                            f"deployment {name!r}: admission queue wait "
+                            f"exceeded {self.queue_timeout_s:.1f}s "
+                            f"(p99 TTFT over budget)")
+                    self._cond.wait(remaining)
+                    if self._admittable(st):
+                        st.inflight += 1
+                        st.admitted_total += 1
+                        ADMITTED_TOTAL.inc(labels={"deployment": name})
+                        return
+            finally:
+                st.queued -= 1
+
+    def release(self, name: str) -> None:
+        with self._cond:
+            st = self._deployments.get(name)
+            if st is None:
+                return
+            if st.inflight > 0:
+                st.inflight -= 1
+            self._cond.notify_all()
+
+    def forget(self, name: str) -> None:
+        """Drop a deployment's admission state once idle. The ingress
+        calls this on the unknown-deployment 404 path — acquire() runs
+        before the deployment lookup, so without eviction every scanned
+        URL path would leak a window-sized state entry forever."""
+        with self._cond:
+            st = self._deployments.get(name)
+            if st is not None and st.inflight == 0 and st.queued == 0:
+                del self._deployments[name]
+
+    def record_ttft(self, name: str, ttft_ms: float) -> None:
+        """Feed the estimator (one sample per admitted request, at
+        first-token/first-result time)."""
+        with self._cond:
+            st = self._state(name)
+            st.ttfts.append(ttft_ms)
+            TTFT_P99_MS.set(self._p99(st.ttfts),
+                            labels={"deployment": name})
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- inspection
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for name, st in self._deployments.items():
+                out[name] = {
+                    "budget_ms": self.budget_ms,
+                    "p50_ttft_ms": round(self._p50(st.ttfts), 3),
+                    "p99_ttft_ms": (round(self._p99(st.ttfts), 3)
+                                    if st.ttfts else 0.0),
+                    "samples": len(st.ttfts),
+                    "inflight": st.inflight,
+                    "queued": st.queued,
+                    "admitted_total": st.admitted_total,
+                    "queued_total": st.queued_total,
+                    "shed_total": st.shed_total,
+                }
+            return out

@@ -232,8 +232,24 @@ class DeploymentHandle:
             return None
         return {"multiplexed_model_id": self._model_id}
 
+    @staticmethod
+    def _prefix_hint(args, kwargs) -> Optional[list]:
+        """Routing hint for prefix-affinity scoring: LLM payloads carry
+        token ids as ``{"prompt_ids": [...]}`` (the HTTP proxy's JSON
+        body arrives here verbatim, so ingress traffic threads its
+        prefix hashes to the router with no proxy-side parsing)."""
+        payload = args[0] if args else kwargs.get("request")
+        if isinstance(payload, dict):
+            ids = payload.get("prompt_ids")
+            if isinstance(ids, (list, tuple)) and ids \
+                    and isinstance(ids[0], int):
+                return list(ids)
+        return None
+
     def remote(self, *args, **kwargs):
-        replica = self._router.choose(model_id=self._model_id)
+        replica = self._router.choose(
+            model_id=self._model_id,
+            prefix_tokens=self._prefix_hint(args, kwargs))
         if self._stream:
             try:
                 sid = ray_tpu.get(replica.handle_request_streaming.remote(
@@ -254,7 +270,9 @@ class DeploymentHandle:
             retry=lambda: self._route_once(args, kwargs))
 
     def _route_once(self, args, kwargs) -> DeploymentResponse:
-        replica = self._router.choose(model_id=self._model_id)
+        replica = self._router.choose(
+            model_id=self._model_id,
+            prefix_tokens=self._prefix_hint(args, kwargs))
         ref = replica.handle_request.remote(self._method, args, kwargs,
                                             self._context())
         return DeploymentResponse(ref, self._router, replica)

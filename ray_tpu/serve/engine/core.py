@@ -200,6 +200,28 @@ class InferenceEngine:
         out.update(self.metrics.snapshot())
         return out
 
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Compact load view for the serve routing/autoscaling path
+        (replica.py forwards it; the controller aggregates it and the
+        router scores on it). Cheap host-side reads only — safe to call
+        from an RPC thread while the engine thread ticks."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        m = self.metrics.snapshot()
+        return {
+            "waiting": self._queue.qsize() + self.scheduler.queue_depth(),
+            "active": len(self.scheduler.active),
+            "slots": self.max_batch,
+            "free_slots": self.kv.free_slots(),
+            "kv_free_blocks": self.kv.free_blocks(),
+            "kv_total_blocks": self.kv.total_blocks(),
+            "decode_utilization": m["decode_utilization"],
+            "ewma_ttft_ms": m["ttft_ms_ewma"],
+            "prefix_block_size": self.kv.block_size,
+            "prefix_hashes": self.kv.resident_hashes(
+                cfg.serve_snapshot_prefix_hashes),
+        }
+
     def close(self) -> None:
         self._shutdown = True
         # Join the engine thread: a daemon thread still inside a jitted

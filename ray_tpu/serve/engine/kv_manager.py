@@ -31,6 +31,21 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Chain hashes for every COMPLETE ``block_size``-token block of
+    ``tokens``: ``h_i = H(h_{i-1}, block_i)``. Module-level so the serve
+    router can compute a request's leading-block hashes with the exact
+    algorithm the replica-side cache indexes by (token ids are ints, so
+    Python's tuple hash is stable across processes — str/bytes hash
+    randomization does not apply)."""
+    out: List[int] = []
+    h = 0
+    for i in range(len(tokens) // block_size):
+        h = hash((h, tuple(tokens[i * block_size:(i + 1) * block_size])))
+        out.append(h)
+    return out
+
+
 @dataclasses.dataclass
 class SlotInfo:
     """Per-slot bookkeeping (device rows themselves live in the engine)."""
@@ -42,6 +57,10 @@ class SlotInfo:
     #                                  tokens (not yet verified; rolled
     #                                  back to the accepted count when
     #                                  the verify chunk returns)
+    pending_chain: Tuple[int, ...] = ()  # chain over the IN-FLIGHT prompt
+    #                                  (its KV rows exist once prefill
+    #                                  lands; exported as a routing hint
+    #                                  only, never probed for reuse)
 
 
 class KVCacheManager:
@@ -71,13 +90,7 @@ class KVCacheManager:
 
     def _chain(self, tokens: Sequence[int]) -> List[int]:
         """Chain hashes for every COMPLETE block of ``tokens``."""
-        out: List[int] = []
-        h = 0
-        bs = self.block_size
-        for i in range(len(tokens) // bs):
-            h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
-            out.append(h)
-        return out
+        return chain_hashes(tokens, self.block_size)
 
     # ---------------------------------------------------------- allocation
 
@@ -161,6 +174,7 @@ class KVCacheManager:
         # engine releases the slot with its final token contents.
         info.resident = tuple(prompt_ids[:cached_len])
         info.chain = tuple(self._chain(info.resident))
+        info.pending_chain = tuple(want)
         return slot, cached_len
 
     def grow(self, slot: int, n: int = 1) -> None:
@@ -212,6 +226,7 @@ class KVCacheManager:
         info.length = 0
         info.spec_rows = 0  # a pending reservation dies with the slot
         #                     (device-failure path releases mid-flight)
+        info.pending_chain = ()
         info.resident = tuple(resident_tokens or ())
         info.chain = tuple(self._chain(info.resident))
         for h in info.chain:
@@ -227,6 +242,51 @@ class KVCacheManager:
                     self._index.pop(h, None)
 
     # ------------------------------------------------------------- stats
+
+    def free_blocks(self) -> int:
+        return self.total_blocks() - self.used_blocks()
+
+    def resident_hashes(self, cap: int = 256) -> List[int]:
+        """Chain hashes of prefixes a new request could land on: every
+        indexed free-slot chain hash plus the pending chains of in-use
+        slots (their prompts' KV rows are materializing right now, so
+        repeat-prefix traffic routed here hits once the slot frees).
+        The routing-snapshot export — capped, order-insensitive.
+
+        Called from the replica RPC thread while the engine thread
+        mutates ``_index``; there is no lock, so retry the lock-free
+        scan when a concurrent resize trips the iteration (an empty
+        export just means one pow-2-routed tick, never a wrong one)."""
+        for _ in range(4):
+            try:
+                return self._resident_hashes_scan(cap)
+            except RuntimeError:  # dict resized mid-iteration
+                continue
+        return []
+
+    def _resident_hashes_scan(self, cap: int) -> List[int]:
+        out = set(self._index.keys())
+        for s in self._slots:
+            if s.in_use:
+                out.update(s.pending_chain)
+        if len(out) <= cap:
+            return list(out)
+        # Over cap: keep the SHALLOW hashes of every chain. The router
+        # matches contiguously from block 1 and stops at the first
+        # missing hash, so dropping a chain's h_1 zeroes that prefix's
+        # whole affinity signal while its deeper hashes uselessly
+        # occupy cap slots — walk the chains breadth-first by depth
+        # instead of slicing an arbitrarily-ordered set.
+        chains = [s.pending_chain if s.in_use else s.chain
+                  for s in self._slots]
+        picked: Set[int] = set()
+        for depth in range(max((len(c) for c in chains), default=0)):
+            for c in chains:
+                if depth < len(c):
+                    picked.add(c[depth])
+                    if len(picked) >= cap:
+                        return list(picked)
+        return list(picked)
 
     def hit_rate(self) -> float:
         total = self.hits + self.misses

@@ -83,6 +83,10 @@ class EngineMetrics:
         self.spec_chunks = 0       # chunks through the verify program
         self._ttfts = collections.deque(maxlen=256)   # seconds
         self._tpots = collections.deque(maxlen=1024)  # seconds/token
+        # EWMA TTFT (alpha 0.3): the load-snapshot freshness signal —
+        # a single float the router can compare across replicas without
+        # shipping the whole window.
+        self._ewma_ttft_s: Optional[float] = None
 
     # ------------------------------------------------------------ records
 
@@ -93,6 +97,9 @@ class EngineMetrics:
             self.prefill_tokens += prefill_tokens
             self.tokens_generated += 1  # prefill yields the first token
             self._ttfts.append(ttft_s)
+            self._ewma_ttft_s = (ttft_s if self._ewma_ttft_s is None
+                                 else 0.3 * ttft_s
+                                 + 0.7 * self._ewma_ttft_s)
         REQUESTS_TOTAL.inc(labels=self._labels)
         TOKENS_TOTAL.inc(labels=self._labels)
         TTFT_SECONDS.observe(ttft_s, labels=self._labels)
@@ -168,5 +175,6 @@ class EngineMetrics:
                     self.spec_accepted / self.spec_drafted, 4)
                     if self.spec_drafted else 0.0,
                 "ttft_ms_p50": round(self._p50(self._ttfts) * 1e3, 3),
+                "ttft_ms_ewma": round((self._ewma_ttft_s or 0.0) * 1e3, 3),
                 "tpot_ms_p50": round(self._p50(self._tpots) * 1e3, 3),
             }

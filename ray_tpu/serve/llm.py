@@ -74,6 +74,12 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
         def stats(self):
             return self.engine.stats()
 
+        def load_snapshot(self):
+            """Replica load export (replica.py merges this into its
+            base snapshot): queue/KV/prefix-hash state for the scored
+            router and the autoscaling policy."""
+            return self.engine.load_snapshot()
+
     opts: Dict[str, Any] = {}
     if use_tpu:
         opts["resources"] = {"TPU": 1.0}

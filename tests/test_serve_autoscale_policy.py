@@ -1,0 +1,109 @@
+"""ServeAutoscalePolicy against synthetic snapshot streams: sustained
+scale-up, hysteresis/cooldown, min/max clamps, blind ticks.
+"""
+
+from ray_tpu.serve._private.autoscaling_policy import (ServeAutoscalePolicy,
+                                                       snapshot_load)
+
+
+def make_policy(**kw):
+    auto = {"min_replicas": kw.pop("min_replicas", 1),
+            "max_replicas": kw.pop("max_replicas", 8),
+            "target_ongoing_requests": kw.pop("target", 2)}
+    base = dict(up_sustain_s=2.0, down_sustain_s=5.0,
+                down_threshold=0.5, cooldown_s=3.0)
+    base.update(kw)
+    return ServeAutoscalePolicy(auto, **base)
+
+
+def loaded(q, waiting=0):
+    return {"queue_depth": q, "waiting": waiting}
+
+
+def test_snapshot_load_counts_engine_waiting():
+    assert snapshot_load({"queue_depth": 2, "waiting": 3}) == 5.0
+    assert snapshot_load({"queue_depth": 2}) == 2.0
+
+
+def test_scale_up_requires_sustained_load():
+    p = make_policy()
+    # mean 8 per replica vs target 2 -> raw 4, but not until sustained.
+    assert p.desired(1, [loaded(8)], 0.0) == 1
+    assert p.desired(1, [loaded(8)], 1.0) == 1
+    assert p.desired(1, [loaded(8)], 2.5) == 4
+
+
+def test_one_tick_spike_never_scales():
+    p = make_policy()
+    assert p.desired(1, [loaded(50)], 0.0) == 1
+    # Back in the dead band: the sustain timer must reset.
+    assert p.desired(1, [loaded(2)], 1.0) == 1
+    assert p.desired(1, [loaded(50)], 3.0) == 1  # new breach, new timer
+    assert p.desired(1, [loaded(50)], 5.5) == 8  # clamped to max
+
+
+def test_max_replicas_clamp():
+    p = make_policy(max_replicas=3)
+    p.desired(1, [loaded(100)], 0.0)
+    assert p.desired(1, [loaded(100)], 2.5) == 3
+
+
+def test_scale_down_needs_sustained_idle_and_steps_gradually():
+    p = make_policy()
+    # Idle at 4 replicas: nothing until down_sustain_s elapses.
+    assert p.desired(4, [loaded(0)] * 4, 0.0) == 4
+    assert p.desired(4, [loaded(0)] * 4, 4.0) == 4
+    assert p.desired(4, [loaded(0)] * 4, 5.5) == 3  # one step down
+    # Cooldown + fresh sustain window before the next step.
+    assert p.desired(3, [loaded(0)] * 3, 6.0) == 3
+    assert p.desired(3, [loaded(0)] * 3, 10.0) == 3  # 4s idle < 5s sustain
+    assert p.desired(3, [loaded(0)] * 3, 11.0) == 2
+    # Never below the floor.
+    assert p.desired(1, [loaded(0)], 100.0) == 1
+
+
+def test_idle_gap_between_bursts_does_not_scale_down():
+    p = make_policy()
+    assert p.desired(2, [loaded(0), loaded(0)], 0.0) == 2
+    # Load returns inside the sustain window: timer resets.
+    assert p.desired(2, [loaded(2), loaded(2)], 3.0) == 2
+    assert p.desired(2, [loaded(0), loaded(0)], 6.0) == 2
+    assert p.desired(2, [loaded(0), loaded(0)], 10.0) == 2  # 4s < 5s
+    assert p.desired(2, [loaded(0), loaded(0)], 11.5) == 1
+
+
+def test_cooldown_gates_both_directions():
+    p = make_policy(up_sustain_s=0.0, down_sustain_s=0.0, cooldown_s=10.0)
+    assert p.desired(1, [loaded(10)], 0.0) == 5
+    # Load still high immediately after: cooldown holds the line.
+    assert p.desired(5, [loaded(10)] * 5, 1.0) == 5
+    assert p.desired(5, [loaded(10)] * 5, 11.0) == 8  # cooled -> max clamp
+
+
+def test_blind_tick_holds_current():
+    p = make_policy()
+    assert p.desired(3, [None, None, None], 0.0) == 3
+
+
+def test_partial_snapshot_coverage_damps_missing_replicas():
+    p = make_policy(up_sustain_s=0.0, cooldown_s=0.0)
+    # One replica answered with heavy load, one (booting) contributed
+    # nothing: the mean stays over the FULL set — mean 4 vs target 2
+    # doubles the count instead of quadrupling it, so replicas that
+    # haven't come up yet damp the next decision rather than letting
+    # the saturated survivors compound the target tick over tick.
+    assert p.desired(2, [loaded(8), None], 0.0) == 4
+
+
+def test_scaled_to_zero_comes_up_to_floor():
+    p = make_policy(min_replicas=2)
+    assert p.desired(0, [], 0.0) == 2
+
+
+def test_dead_band_holds_and_resets_timers():
+    p = make_policy()
+    assert p.desired(2, [loaded(3), loaded(3)], 0.0) == 2  # over target
+    # Dead band (between 0.5*target and target): both timers reset.
+    assert p.desired(2, [loaded(1.5), loaded(1.5)], 1.0) == 2
+    assert p.desired(2, [loaded(3), loaded(3)], 2.0) == 2
+    assert p.desired(2, [loaded(3), loaded(3)], 4.5) == 3

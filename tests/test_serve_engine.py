@@ -244,6 +244,25 @@ def test_kv_manager_slot_exhaustion_returns_none():
     assert kv.free_slots() == 0
 
 
+def test_resident_hashes_cap_keeps_shallow_hashes():
+    """The router matches chains contiguously from block 1, so the
+    snapshot cap must keep every chain's SHALLOW hashes — an arbitrary
+    subset could drop h_1 and zero a resident prefix's affinity."""
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+
+    kv = KVCacheManager(num_slots=2, max_len=64, block_size=4)
+    a, b = list(range(100, 140)), list(range(200, 240))  # 10 blocks each
+    s0, _ = kv.acquire(a)
+    kv.release(s0, resident_tokens=a)
+    s1, _ = kv.acquire(b)
+    kv.release(s1, resident_tokens=b)
+    assert len(kv.resident_hashes(cap=1024)) == 20
+    capped = set(kv.resident_hashes(cap=6))
+    assert len(capped) == 6
+    for slot in (s0, s1):  # 3 shallowest of BOTH chains survive
+        assert set(kv._slots[slot].chain[:3]) <= capped
+
+
 # --------------------------------------------------------------- admission
 
 

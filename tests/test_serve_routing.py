@@ -1,0 +1,301 @@
+"""Scored (prefix-affinity / queue / KV) routing + router lifecycle.
+
+Unit tier drives Router directly with injected replica sets and load
+snapshots (no cluster: choose() only RPCs when unseeded). Cluster tier
+covers the controller snapshot push end-to-end and the
+controller-replacement re-resolve path.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.serve as serve
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.serve._private.router import Router
+from ray_tpu.serve.engine.kv_manager import chain_hashes
+
+
+def make_router(replicas, loads=None, policy="scored"):
+    """A seeded Router with no controller and no poller thread."""
+    r = Router.__new__(Router)
+    from ray_tpu.devtools.lock_debug import make_lock
+
+    r._controller = None
+    r._deployment = "unit"
+    r._lock = make_lock("serve.router._lock")
+    r._replicas = []
+    r._version = -1
+    r._load_gen = -1
+    r._loads = {}
+    r._inflight = {}
+    r._model_affinity = {}
+    r._scored_routes = 0
+    r._pow2_routes = 0
+    r._affinity_routes = 0
+    r._poller_started = True  # unit mode: never spawn the long-poller
+    r._poll_thread = None
+    r._stopped = False
+    r._apply(1, replicas, 1, loads)
+    return r
+
+
+def snap(**kw):
+    base = {"ts": time.time(), "queue_depth": 0, "waiting": 0,
+            "slots": 4, "kv_free_blocks": 8, "kv_total_blocks": 8,
+            "prefix_block_size": 4, "prefix_hashes": []}
+    base.update(kw)
+    return base
+
+
+@pytest.fixture(autouse=True)
+def _scored_policy():
+    old = cfg.serve_router_policy
+    cfg.set("serve_router_policy", "scored")
+    yield
+    cfg.set("serve_router_policy", old)
+
+
+def test_scored_prefers_prefix_affinity():
+    prompt = list(range(16))
+    chain = chain_hashes(prompt, 4)
+    r = make_router(
+        ["a", "b", "c"],
+        [snap(), snap(prefix_hashes=chain), snap()])
+    for _ in range(8):
+        choice = r.choose(prefix_tokens=prompt)
+        assert choice == "b"
+        r.done(choice)
+    st = r.stats()
+    assert st["scored_routes"] == 8
+    assert st["affinity_routes"] == 8
+    assert st["pow2_routes"] == 0
+
+
+def test_deeper_prefix_match_wins():
+    prompt = list(range(16))
+    chain = chain_hashes(prompt, 4)  # 4 blocks
+    r = make_router(
+        ["shallow", "deep"],
+        [snap(prefix_hashes=chain[:1]), snap(prefix_hashes=chain[:3])])
+    assert r.choose(prefix_tokens=prompt) == "deep"
+
+
+def test_scored_prefers_short_queue():
+    r = make_router(["busy", "idle"],
+                    [snap(queue_depth=6), snap(queue_depth=0)])
+    assert r.choose() == "idle"
+
+
+def test_engine_waiting_counts_as_queue_pressure():
+    # A saturated engine parks callers inside generate(): its replica
+    # gauge alone under-reads, the snapshot's waiting line must count.
+    r = make_router(["stuffed", "free"],
+                    [snap(queue_depth=1, waiting=9), snap(queue_depth=2)])
+    assert r.choose() == "free"
+
+
+def test_kv_pressure_breaks_ties():
+    r = make_router(["full", "roomy"],
+                    [snap(kv_free_blocks=0), snap(kv_free_blocks=8)])
+    assert r.choose() == "roomy"
+
+
+def test_affinity_loses_to_overload():
+    # Prefix affinity is a preference, not a pin: a hot replica whose
+    # queue is deep enough loses to a cold-but-idle one.
+    prompt = list(range(16))
+    chain = chain_hashes(prompt, 4)
+    r = make_router(
+        ["hot", "idle"],
+        [snap(prefix_hashes=chain, queue_depth=20), snap()])
+    assert r.choose(prefix_tokens=prompt) == "idle"
+
+
+def test_pow2_fallback_when_snapshots_stale(monkeypatch):
+    stale = snap()
+    stale["ts"] = time.time() - 3600.0
+    r = make_router(["a", "b"], [stale, snap()])
+    # Deterministic sample: byte-compatible legacy pow-2 must run.
+    monkeypatch.setattr(random, "sample", lambda seq, k: list(seq)[:k])
+    r._inflight["a"] = 3
+    assert r.choose() == "b"  # fewer local in-flight wins
+    st = r.stats()
+    assert st["pow2_routes"] == 1 and st["scored_routes"] == 0
+
+
+def test_age_restamps_freshness_on_local_clock():
+    """Controller-shipped age_s overrides the replica host's wall-clock
+    ts: a snapshot stamped by a skewed replica clock stays fresh when
+    its AGE is small, and goes stale when its age is past the TTL —
+    freshness never compares clocks across hosts."""
+    skewed = snap(age_s=0.1)
+    skewed["ts"] = time.time() - 3600.0  # replica clock an hour behind
+    r = make_router(["a", "b"], [skewed, snap(age_s=0.1)])
+    r.choose()
+    assert r.stats()["scored_routes"] == 1  # fresh by age, not by ts
+
+    old = snap(age_s=3600.0)
+    old["ts"] = time.time()  # replica clock claims "right now"
+    r2 = make_router(["a", "b"], [old, snap(age_s=3600.0)])
+    r2.choose()
+    assert r2.stats()["pow2_routes"] == 1  # stale by age despite ts
+
+
+def test_pow2_fallback_byte_compatible_with_legacy():
+    """Same RNG stream + same inflight updates => the metrics-absent
+    router replays the pre-snapshot policy decision for decision."""
+    replicas = [f"r{i}" for i in range(5)]
+    r = make_router(replicas, loads=None)  # no snapshots at all
+
+    def legacy(replicas, inflight, rng):
+        a, b = rng.sample(replicas, 2)
+        return a if inflight.get(a, 0) <= inflight.get(b, 0) else b
+
+    random.seed(1234)
+    got = []
+    for _ in range(50):
+        c = r.choose()
+        got.append(c)  # inflight grows: decisions feed back
+    random.seed(1234)
+    rng = random
+    inflight = {}
+    want = []
+    for _ in range(50):
+        c = legacy(replicas, inflight, rng)
+        inflight[c] = inflight.get(c, 0) + 1
+        want.append(c)
+    assert got == want
+
+
+def test_random_policy():
+    cfg.set("serve_router_policy", "random")
+    r = make_router(["a", "b", "c"],
+                    [snap(queue_depth=99), snap(queue_depth=99), snap()])
+    seen = {r.choose() for _ in range(64)}
+    assert seen == {"a", "b", "c"}
+
+
+def test_done_underflow_guard():
+    r = make_router(["a", "b"], [snap(), snap()])
+    # done() without (or beyond) a matching choose: never negative.
+    r.done("a")
+    r.done("a")
+    assert r._inflight["a"] == 0
+    c = r.choose()
+    assert r._inflight[c] == 1
+    r.done(c)
+    r.done(c)
+    assert r._inflight[c] == 0
+    # Routing still balanced afterwards: with counts sane, the local
+    # in-flight feedback spreads un-done() requests across replicas
+    # (a leaked negative count would pin everything to one).
+    counts = {"a": 0, "b": 0}
+    for _ in range(4):
+        counts[r.choose()] += 1
+    assert counts["a"] >= 1 and counts["b"] >= 1, counts
+
+
+def test_stop_joins_poller():
+    r = make_router(["a"], [snap()])
+    done = threading.Event()
+
+    def fake_poll():
+        while not r._stopped:
+            time.sleep(0.01)
+        done.set()
+
+    t = threading.Thread(target=fake_poll, daemon=True)
+    r._poll_thread = t
+    t.start()
+    r.stop()
+    assert done.wait(2.0)
+    assert not t.is_alive()
+
+
+# ---------------------------------------------------------------- cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # Cluster boot needs a loadable native store lib; on machines where
+    # the checked-in .so does not load (glibc mismatch) skip like
+    # test_dataplane does unless RTPU_SHM_STORE_SO points at a rebuild.
+    from ray_tpu.core import shm_store
+    try:
+        shm_store._load_lib()
+    except OSError as e:
+        pytest.skip(f"native store lib unavailable: {e}")
+    rt = ray_tpu.init(num_cpus=16)
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_snapshots_flow_to_router(cluster):
+    @serve.deployment(name="snapflow", num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind())
+    assert h.remote(1).result() == 1
+    # The controller's sweep runs once per reconcile period; the
+    # long-poller must deliver snapshots for BOTH replicas shortly.
+    router = h._router
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with router._lock:
+            if len(router._loads) == 2 and router._fresh_loads():
+                break
+        time.sleep(0.2)
+    with router._lock:
+        fresh = router._fresh_loads()
+    assert fresh is not None and len(fresh) == 2
+    for s in fresh.values():
+        assert "queue_depth" in s and "ts" in s
+    before = router.stats()["scored_routes"]
+    assert h.remote(2).result() == 2
+    assert router.stats()["scored_routes"] == before + 1
+    serve.delete("snapflow")
+
+
+def test_controller_replacement_reresolves(cluster):
+    @serve.deployment(name="cr", num_replicas=1)
+    class CR:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(CR.bind())
+    assert h.remote(1).result() == 2
+    router = h._router
+    old_controller = ray_tpu.get_actor("rtpu-serve-controller")
+    with router._lock:
+        old_set = list(router._replicas)
+    ray_tpu.kill(old_controller)
+    # Mid-poll the controller dies; the poller's re-resolve path
+    # (failures % 5 == 0 -> get_actor + reseed) must latch onto the
+    # REPLACEMENT controller and its new replica set.
+    deadline = time.time() + 90
+    new_h = None
+    while time.time() < deadline and new_h is None:
+        try:
+            new_h = serve.run(CR.options(num_replicas=2).bind())
+        except Exception:
+            time.sleep(1.0)  # old name may still be unregistering
+    assert new_h is not None, "could not start replacement controller"
+    converged = False
+    while time.time() < deadline and not converged:
+        with router._lock:
+            current = list(router._replicas)
+        converged = (len(current) == 2
+                     and not (set(current) & set(old_set)))
+        if not converged:
+            time.sleep(0.5)
+    assert converged, "router never converged on the new replica set"
+    # And the SAME router object routes to the new set.
+    assert h.remote(5).result(timeout=30) == 6
+    serve.delete("cr")
