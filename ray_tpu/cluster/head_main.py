@@ -21,6 +21,10 @@ def main() -> None:
     bind_to_parent()  # PDEATHSIG armed in the CHILD (no preexec_fn fork)
 
     faulthandler.register(signal.SIGUSR1)
+    from ray_tpu.util import flight_recorder as _flight
+
+    _flight.set_role("head")
+    _flight.install_signal_handler()  # SIGUSR2 = dump the event ring
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)

@@ -38,7 +38,9 @@ from ray_tpu.core.shm_store import ShmStore
 from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
 from ray_tpu.devtools.lock_debug import make_lock, make_rlock
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -164,6 +166,7 @@ class NodeManager:
                  object_store_bytes: int, host: str = "127.0.0.1"):
         self.node_id = node_id
         self.head_addr = head_addr
+        _flight.set_role("node", node_id=node_id)
         self.total = dict(resources)
         self.available = dict(resources)
         self.labels = labels
@@ -269,6 +272,21 @@ class NodeManager:
                                          self.store_name, timeout=10)
         if isinstance(acked, str):
             self._head_incarnation = acked
+        # Heartbeat-RTT clock offset estimate vs the head (EWMA; None
+        # until the first probe). trace_dump uses it to align this
+        # node's span/flight timestamps onto the head's clock.
+        self._clock_offset_s: Optional[float] = None
+        self._evictions_seen = 0
+        # Spans emitted IN this process (pull-manager fetches) have no
+        # runtime to flush through: route them straight to the head.
+        from ray_tpu.util import tracing as _tracing
+
+        def _trace_sink(spans, _head=self._head, _nid=node_id):
+            for s in spans:
+                s.setdefault("node", _nid)
+            _head.notify("trace_spans", spans)
+
+        _tracing.set_sink(_trace_sink)
         # Workers MUST be spawned from one long-lived thread: PDEATHSIG is
         # delivered when the spawning *thread* exits, and lease handlers run
         # on per-request threads.
@@ -352,6 +370,7 @@ class NodeManager:
         last_beat = 0.0
         last_sent: Dict[str, float] = {}
         version = 0
+        beats = 0
         while True:
             self._hb_wake.wait(period)
             self._hb_wake.clear()
@@ -387,6 +406,12 @@ class NodeManager:
                 # false node death under RPC chaos.
                 acked = self._head.call("heartbeat", self.node_id, payload,
                                         version, is_delta, timeout=period)
+                _flight.record("hb", acked=str(acked), delta=is_delta)
+                beats += 1
+                sync_every = cfg.clock_sync_period_beats
+                if sync_every > 0 and beats % sync_every == 1 % sync_every:
+                    self._sync_clock()
+                    self._note_evictions()
                 if acked is True:
                     last_sent = avail
                     version += 1
@@ -420,6 +445,49 @@ class NodeManager:
             if self._republish_needed:
                 self._try_republish()
             self._check_worker_deaths()
+
+    def _sync_clock(self) -> None:
+        """Heartbeat-RTT clock offset vs the head: one clock_probe RPC,
+        offset = head_time - (t_send + rtt/2), EWMA-smoothed. Best
+        effort — a miss keeps the previous estimate."""
+        try:
+            t0 = time.time()
+            m0 = time.monotonic()
+            head_t = self._head.call("clock_probe", timeout=2.0)
+            rtt = time.monotonic() - m0
+            off = float(head_t) - (t0 + rtt / 2.0)
+            self._clock_offset_s = (off if self._clock_offset_s is None
+                                    else 0.7 * self._clock_offset_s
+                                    + 0.3 * off)
+            # Offline dumps (SIGUSR2 / chaos-kill) must carry it too.
+            _flight.set_clock_offset(self._clock_offset_s)
+        except Exception as e:
+            logger.debug("clock probe failed: %r", e)
+
+    def _note_evictions(self) -> None:
+        """Flight-record store evictions since the last look (polled on
+        the clock-sync lap; the store evicts internally, so the node
+        only sees the counter move)."""
+        try:
+            _used, _cap, _n, n_evictions = self.store.stats()
+        except Exception as e:
+            logger.debug("store stats read failed: %r", e)
+            return
+        if n_evictions > self._evictions_seen:
+            _flight.record("store_evict",
+                           n=n_evictions - self._evictions_seen,
+                           total=n_evictions)
+            self._evictions_seen = n_evictions
+
+    def rpc_clock_probe(self, conn):
+        return time.time()
+
+    def rpc_dump_flight(self, conn):
+        """This node's flight ring + its head-relative clock offset."""
+        payload = _flight.dump_payload(
+            clock_offset_s=self._clock_offset_s or 0.0)
+        payload["node_id"] = self.node_id
+        return payload
 
     def _on_head_reregistered(self, new_inc: Optional[str]) -> None:
         """The head forgot us (restart or drain): the freshly-registered
@@ -556,6 +624,8 @@ class NodeManager:
             self._on_worker_dead(w)
 
     def _on_worker_dead(self, w: WorkerProc) -> None:
+        _flight.record("worker_dead", worker=w.worker_id[:12],
+                       addr=w.address or "")
         with self._lock:
             lease = self._leases.pop(w.lease_id, None) if w.lease_id else None
             if lease is not None and lease.blocked == 0:
@@ -1168,6 +1238,8 @@ class NodeManager:
         w.lease_id = lease_id
         with self._lock:
             self._leases[lease_id] = lease
+        _flight.record("lease_grant", lease=lease_id[:12],
+                       worker=w.address, lessee=str(lessee)[:40])
         return w.address, lease_id
 
     def rpc_return_lease(self, conn, lease_id: str, pool_worker: bool = True):
@@ -1176,6 +1248,8 @@ class NodeManager:
         still be executing a stale copy — never pool it (double-dispatch);
         terminate it and let the death sweep reap (execution-side dedup
         makes the re-routed copies safe)."""
+        _flight.record("lease_return", lease=lease_id[:12],
+                       pooled=pool_worker)
         with self._lock:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
@@ -1322,14 +1396,17 @@ class NodeManager:
         return BufferLease((total, pickle.PickleBuffer(view)), buf.release)
 
     @blocking_rpc
-    def rpc_pull_object(self, conn, oid_bytes: bytes, timeout_ms: int):
+    def rpc_pull_object(self, conn, oid_bytes: bytes, timeout_ms: int,
+                        trace: Optional[Dict[str, str]] = None):
         """Pull an object into the local store via the pull manager
         (reference: object_manager/pull_manager.h). Concurrent pulls of
         one object COALESCE onto a single in-flight transfer (followers
         wait on the leader's completion event instead of opening their
         own streams); the transfer fetches from the nearest holder and
         fans chunks of large objects out across several holders in
-        parallel. Returns True when the object is locally available."""
+        parallel. Returns True when the object is locally available.
+        ``trace`` (optional wire span context) parents the pull's
+        per-holder fetch spans to the requesting task's trace."""
         from ray_tpu.core.ids import ObjectID
 
         oid = ObjectID(oid_bytes)
@@ -1355,7 +1432,7 @@ class NodeManager:
             if leader:
                 ok = False
                 try:
-                    ok = self._pull_once(oid, deadline)
+                    ok = self._pull_once(oid, deadline, trace=trace)
                 finally:
                     with self._pull_lock:
                         self._pulls.pop(oid_bytes, None)
@@ -1375,7 +1452,8 @@ class NodeManager:
                 return self.store.contains(oid)
             time.sleep(cfg.spill_restore_poll_s)
 
-    def _pull_once(self, oid, deadline: float) -> bool:
+    def _pull_once(self, oid, deadline: float,
+                   trace: Optional[Dict[str, str]] = None) -> bool:
         """One directory lookup + transfer attempt. The head orders the
         holder list nearest-first for this node (same-zone label ahead of
         cross-zone), so the primary stream dials the cheapest copy."""
@@ -1390,19 +1468,28 @@ class NodeManager:
         addrs = [addr for node_id, addr in locs if node_id != self.node_id]
         if not addrs:
             return False
-        return self._pull_from_holders(oid, addrs, deadline)
+        return self._pull_from_holders(oid, addrs, deadline, trace=trace)
 
-    def _pull_from_holders(self, oid, addrs: List[str],
-                           deadline: float) -> bool:
+    def _pull_from_holders(self, oid, addrs: List[str], deadline: float,
+                           trace: Optional[Dict[str, str]] = None) -> bool:
         from ray_tpu.core.shm_store import ShmObjectExistsError
 
         chunk = cfg.object_transfer_chunk_bytes
+        # Trace parent for the per-holder fetch spans (arg-pull
+        # decomposition of the requesting task's trace). None when the
+        # requester is untraced: zero span allocation on that path.
+        pull_rec = _tracing.start_span(
+            "pull.object", parent=trace,
+            attrs={"oid": oid.hex()[:12]}) if trace else None
+        pull_ctx = _tracing.ctx_of(pull_rec)
         first = None
         src = None
+        src_addr = None
         # Inside the try: connecting to a DEAD holder (post node death,
         # pre directory cleanup) must read as "pull failed", not crash
         # the pull RPC — fall through to the next-nearest holder.
         for addr in addrs:
+            t_f0 = time.time() if pull_ctx else 0.0
             try:
                 client = self._pool.get(addr)
                 first = client.call(
@@ -1411,18 +1498,33 @@ class NodeManager:
             except Exception as e:
                 logger.debug("fetch_object from holder %s failed: %r; "
                              "trying next holder", addr, e)
+                if pull_ctx:
+                    _tracing.emit_span("pull.fetch", t_f0, time.time(),
+                                       parent=pull_ctx,
+                                       attrs={"holder": addr}, ok=False)
                 continue
             if first is not None:
                 src = client
+                src_addr = addr
                 break
         if first is None:
+            _tracing.end_span(pull_rec, ok=False)
+            if pull_ctx:
+                # Failure spans are the diagnostically important ones:
+                # ship them now, not at some later pull's high-water
+                # flush (this process has no runtime; flush -> sink).
+                _tracing.flush()
             return False
         total, data = first
         try:
             mv = self.store.create_buffer(oid, total)
         except ShmObjectExistsError:
+            _tracing.end_span(pull_rec)
+            if pull_ctx:
+                _tracing.flush()
             return True
         multi_source = False
+        t_stream0 = time.time() if pull_ctx else 0.0
         try:
             mv[:len(data)] = data
             offsets = list(range(len(data), total, chunk))
@@ -1430,7 +1532,7 @@ class NodeManager:
                             and total >= cfg.pull_fanout_min_bytes)
             if multi_source:
                 if not self._fanout_fetch(oid, mv, offsets, chunk, addrs,
-                                          deadline):
+                                          deadline, trace=pull_ctx):
                     raise IOError("multi-source pull failed")
             else:
                 for off in offsets:
@@ -1450,8 +1552,17 @@ class NodeManager:
                         mv[off:off + len(data)] = data
         except BaseException:
             self.store.abort(oid)
+            _tracing.end_span(pull_rec, ok=False)
+            if pull_ctx:
+                _tracing.flush()
             return False
+        if pull_ctx and not multi_source:
+            _tracing.emit_span(
+                "pull.fetch", t_stream0, time.time(), parent=pull_ctx,
+                attrs={"holder": src_addr, "bytes": total})
         self.store.seal(oid)
+        _flight.record("store_seal", oid=oid.hex()[:12], bytes=total,
+                       via="pull")
         self._note_local_object(oid.binary(), total)
         with self._pull_lock:
             self.pull_stats["bytes_pulled"] += total
@@ -1465,10 +1576,16 @@ class NodeManager:
                               total)
         except Exception:
             pass
+        if pull_rec is not None:
+            pull_rec["attrs"]["bytes"] = total
+            pull_rec["attrs"]["multi_source"] = multi_source
+            _tracing.end_span(pull_rec)
+            _tracing.flush()
         return True
 
     def _fanout_fetch(self, oid, mv, offsets: List[int], chunk: int,
-                      addrs: List[str], deadline: float) -> bool:
+                      addrs: List[str], deadline: float,
+                      trace: Optional[Dict[str, str]] = None) -> bool:
         """Parallel range fetch: stripe the remaining chunks across up to
         `pull_fanout_max_holders` holders, one fetch thread per holder
         (reference: the object manager requests chunks from multiple
@@ -1481,11 +1598,16 @@ class NodeManager:
 
         def fetch_stripe(k: int) -> None:
             stripe = offsets[k::n]
+            t_s0 = time.time() if trace else 0.0
             try:
                 client = self._pool.get(addrs[k])
             except Exception:
                 with failed_lock:
                     failed.extend(stripe)
+                if trace:
+                    _tracing.emit_span(
+                        "pull.fetch", t_s0, time.time(), parent=trace,
+                        attrs={"holder": addrs[k]}, ok=False)
                 return
             total = len(mv)
             for j, off in enumerate(stripe):
@@ -1508,6 +1630,10 @@ class NodeManager:
                 if not landed:
                     _, data = nxt
                     mv[off:off + len(data)] = data
+            if trace:
+                _tracing.emit_span(
+                    "pull.fetch", t_s0, time.time(), parent=trace,
+                    attrs={"holder": addrs[k], "chunks": len(stripe)})
 
         threads = [threading.Thread(target=fetch_stripe, args=(k,),
                                     daemon=True,

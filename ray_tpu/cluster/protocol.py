@@ -69,6 +69,7 @@ from ray_tpu.core.serialization import SERIALIZER
 from ray_tpu.devtools import chaos as _chaos
 from ray_tpu.devtools.chaos import chaos_enabled as _chaos_enabled
 from ray_tpu.devtools.lock_debug import make_lock
+from ray_tpu.util import flight_recorder as _flight
 
 _LEN = struct.Struct("<I")
 
@@ -267,7 +268,8 @@ RETRY_SAFE_RPCS = frozenset({
     # read-only queries (retrying_call or poll loops at every caller)
     "ping", "list_nodes", "list_actors", "list_leases", "list_task_events",
     "cluster_resources", "cluster_leases", "get_actor_info",
-    "get_named_actor", "get_trace", "pick_node", "pick_nodes",
+    "get_named_actor", "get_trace", "trace_tail", "trace_stats",
+    "clock_probe", "dump_flight", "pick_node", "pick_nodes",
     "object_locations", "scheduler_stats", "pg_table", "pg_ready",
     "kv_get", "kv_keys", "get_demand", "has_object", "store_stats",
     "pull_stats", "wait_object", "wait_objects", "get_object",
@@ -454,6 +456,11 @@ class RpcServer:
 
     def _dispatch(self, conn: "PeerConnection", payload) -> None:
         req_id, method, args = payload
+        # Flight recorder: one ring append per dispatched RPC — the
+        # post-mortem record of what this process was serving in the
+        # seconds before a kill (heartbeats are recorded by their loops).
+        if method != "heartbeat":
+            _flight.record("rpc", m=method, notify=req_id == 0)
         if _chaos_enabled():
             if _chaos.apply(self.chaos_role, method, "request",
                             conn) is not None:

@@ -98,7 +98,7 @@ class _InflightTask:
     __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
                  "sched_key", "resources", "strategy", "name", "sys_retries",
                  "runtime_env", "streaming", "arg_ids", "enqueued_at",
-                 "pref_node")
+                 "pref_node", "trace_ctx", "submit_t")
 
     def __init__(self, spec_blob, return_ids, worker_addr, retries_left,
                  sched_key, resources, strategy, name, runtime_env=None,
@@ -124,6 +124,12 @@ class _InflightTask:
         # answer only depends on arg_ids + the slow-changing locality
         # cache. Re-resolved while unknown (locations may arrive late).
         self.pref_node: Any = False
+        # Distributed tracing: the submitter's wire span context (None
+        # when tracing is off — the dispatcher-side span emits are gated
+        # on it, so the untraced hot path allocates nothing) and the
+        # wall-clock submit time the dispatch span starts from.
+        self.trace_ctx: Optional[Dict[str, str]] = None
+        self.submit_t = 0.0
 
 
 class _StreamState:
@@ -274,6 +280,9 @@ class ClusterCore:
         # Fault-injection scope (devtools/chaos.py): chaos-plan rules
         # target this process's RPC server by role.
         self.chaos_role = "driver" if is_driver else "worker"
+        from ray_tpu.util import flight_recorder as _fl
+
+        _fl.set_role(self.chaos_role, node_id=node_id)
         self._server = RpcServer(self).start()
         self.owner_addr = self._server.address
 
@@ -755,11 +764,19 @@ class ClusterCore:
                                            else 600.0)
             ok = False
             failed_pulls = 0
+            pull_trace = None
+            if cfg.tracing_enabled:
+                # Parent the node-side pull (and its per-holder fetch
+                # spans) to the requesting task's span.
+                from ray_tpu.util import tracing as _tr
+
+                pull_trace = _tr.current()
             with self._blocked_scope():
                 while not ok and time.monotonic() < deadline:
                     try:
                         ok = bool(self.node.call("pull_object", oid.binary(),
-                                                 5000, timeout=8))
+                                                 5000, pull_trace,
+                                                 timeout=8))
                     except ConnectionLost:
                         # Dead socket fails instantly — back off + reconnect
                         # or this loop becomes a hot spin for the full
@@ -1285,6 +1302,18 @@ class ClusterCore:
     def rpc_ping(self, conn):
         return "pong"
 
+    def rpc_clock_probe(self, conn):
+        return time.time()
+
+    def rpc_dump_flight(self, conn):
+        """This process's flight-recorder ring (drivers/workers serve it
+        too — trace_dump and post-mortems read any process)."""
+        from ray_tpu.util import flight_recorder as _fl
+
+        payload = _fl.dump_payload()
+        payload["node_id"] = self.node_id
+        return payload
+
     # ------------------------------------------------------------------ tasks
 
     def current_task_id(self) -> TaskID:
@@ -1439,12 +1468,16 @@ class ClusterCore:
         spec["args"] = tuple(args)
         spec["kwargs"] = dict(kwargs)
         spec["return_ids"] = [o.binary() for o in return_ids]
+        trace_ctx = None
+        t_submit = 0.0
         if cfg.tracing_enabled:
             from ray_tpu.util import tracing
 
+            t_submit = time.time()
             ctx = tracing.current()
             if ctx is not None:
                 spec["trace"] = ctx
+                trace_ctx = ctx
         spec_blob = SERIALIZER.encode(spec)
         if tmpl.spread:
             sched_key = _sched_key(tmpl.func, tmpl.resources, tmpl.strategy)
@@ -1456,6 +1489,8 @@ class ClusterCore:
                              tmpl.effective_retries, sched_key,
                              tmpl.resources, tmpl.strategy, tmpl.name,
                              tmpl.runtime_env, streaming=tmpl.streaming)
+        info.trace_ctx = trace_ctx
+        info.submit_t = t_submit
         _metrics.TASKS_SUBMITTED.inc()
         arg_ids = self._register_submitted_args(task_id_bytes, args, kwargs)
         info.arg_ids = arg_ids
@@ -1465,12 +1500,29 @@ class ClusterCore:
             with self._streams_lock:
                 self._streams[task_id_bytes] = _StreamState()
             self._enqueue_task(task_id_bytes, info)
+            self._emit_submit_span(info, t_submit)
             return ObjectRefGenerator(self, task_id)
         self.lineage.record(task_id_bytes, _LineageRecord(
             spec_blob, sched_key, tmpl.resources, tmpl.strategy, tmpl.name,
             return_ids, arg_ids, runtime_env=tmpl.runtime_env))
         self._enqueue_task(task_id_bytes, info)
+        self._emit_submit_span(info, t_submit)
         return refs
+
+    @staticmethod
+    def _emit_submit_span(info: "_InflightTask", t_submit: float) -> None:
+        """task.submit: spec build + arg registration + enqueue (the
+        owner-side cost before the dispatcher takes over). Gated on the
+        task's captured wire context so the untraced path is one None
+        check."""
+        if info.trace_ctx is None:
+            return
+        from ray_tpu.util import tracing
+
+        tracing.emit_span("task.submit", t_submit, time.time(),
+                          parent=info.trace_ctx,
+                          attrs={"task": info.name,
+                                 "args": len(info.arg_ids)})
 
     # ------------------------------------------------- streaming generators
 
@@ -1876,6 +1928,7 @@ class ClusterCore:
         env_err = None
         lease = None
         hint = self._locality_hint_for(sample)
+        t_lease0 = time.time() if sample.trace_ctx is not None else 0.0
         try:
             lease = self._request_new_lease(sample.resources, sample.strategy,
                                             sample.runtime_env, hint,
@@ -1885,6 +1938,21 @@ class ClusterCore:
         finally:
             with self._lease_lock:
                 kq.pending_lease_requests -= 1
+        if sample.trace_ctx is not None:
+            # task.lease: pick_node + request_lease round-trip for the
+            # sampled task's scheduling key (grants are shared by the
+            # key's whole queue; the span is parented to the task whose
+            # shape/locality hint drove the request).
+            from ray_tpu.util import tracing as _tr
+
+            _tr.emit_span(
+                "task.lease", t_lease0, time.time(),
+                parent=sample.trace_ctx,
+                attrs={"task": sample.name,
+                       "granted": lease is not None,
+                       "node": (lease.node_id or "") if lease else "",
+                       "worker": lease.worker_addr if lease else ""},
+                ok=env_err is None)
         if env_err is not None:
             # The env can never materialize: every queued task of this key
             # fails NOW with the real install error (not a hang).
@@ -1968,6 +2036,21 @@ class ClusterCore:
             waiter = worker.call_async(
                 "push_tasks",
                 [(tid, info.spec_blob) for tid, info in survivors])
+            for _tid, info in survivors:
+                if info.trace_ctx is not None:
+                    # task.dispatch: submit -> lease pairing -> push
+                    # frame on the wire (one span per push ATTEMPT —
+                    # emitted only after the frame actually sent, so a
+                    # dead-worker failure below records nothing; a
+                    # chaos re-dispatch legitimately emits another).
+                    from ray_tpu.util import tracing as _tr
+
+                    _tr.emit_span(
+                        "task.dispatch", info.submit_t or time.time(),
+                        time.time(), parent=info.trace_ctx,
+                        attrs={"task": info.name,
+                               "worker": lease.worker_addr,
+                               "node": lease.node_id or ""})
             self._push_acks.append(
                 [waiter, survivors, lease, kq, 0,
                  time.monotonic() + cfg.push_ack_timeout_s])

@@ -380,7 +380,29 @@ _d("tracing_enabled", bool, False,
    "distributed spans: task specs carry the submitter's trace context, "
    "executors open child spans, spans flush to the head trace ring "
    "(reference: the opt-in OpenTelemetry hooks in util/tracing/)")
-_d("trace_ring_size", int, 20_000, "head-side retained span cap")
+_d("trace_ring_size", int, 20_000, "head-side retained span cap (entries)")
+_d("trace_ring_max_bytes", int, 16 * 1024**2,
+   "head-side retained span cap in approximate BYTES (spans carry user "
+   "attrs; entry count alone lets one chatty tracer eat the head's "
+   "memory); overflow drops oldest spans and counts them into "
+   "rtpu_trace_spans_dropped_total")
+_d("trace_attr_max_bytes", int, 1024,
+   "per-attribute value size cap at the head's span sink: larger values "
+   "are truncated with a '...[truncated]' marker on ingest")
+_d("flight_recorder_enabled", bool, True,
+   "always-on per-process ring of structured runtime events (RPC "
+   "dispatch, heartbeats, lease churn, store seal/evict, engine ticks); "
+   "dumped via rpc_dump_flight, SIGUSR2, chaos kills, and unhandled "
+   "worker death (util/flight_recorder.py)")
+_d("flight_recorder_size", int, 4096,
+   "flight-recorder ring capacity (events per process)")
+_d("flight_recorder_dump_dir", str, "",
+   "directory for flight-recorder dump files (SIGUSR2 / chaos-kill / "
+   "worker-death); empty = the log dir")
+_d("clock_sync_period_beats", int, 10,
+   "node managers probe the head clock every N heartbeat laps and keep "
+   "an RTT-corrected EWMA offset estimate (trace_dump aligns per-node "
+   "event clocks with it); 0 disables probing")
 
 # --- logging ---
 _d("log_dir", str, "/tmp/ray_tpu/logs", "per-process log files")

@@ -294,6 +294,18 @@ def apply(role: str, method: str, side: str, conn=None) -> Optional[str]:
         if rule.action == "kill":
             print(f"RTPU_CHAOS: kill ({rule!r}) on {method} [{side}]",
                   flush=True)
+            # SIGKILL leaves no trace: dump the flight-recorder ring
+            # first so the scenario's post-mortem has the seconds
+            # before this death (best-effort; never blocks the kill).
+            try:
+                from ray_tpu.util import flight_recorder as _flight
+
+                path = _flight.dump_to_file(reason=f"chaos-kill:{method}")
+                if path:
+                    print(f"RTPU_CHAOS: flight dump {path}", flush=True)
+            except Exception as e:  # noqa: BLE001 — never block the kill
+                print(f"RTPU_CHAOS: flight dump failed: {e!r}",
+                      flush=True)
             _kill_self()
             return DROP  # only reachable under the unit-test monkeypatch
         if rule.action == "delay":

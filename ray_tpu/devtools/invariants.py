@@ -177,6 +177,19 @@ RETRY_STOP_NAME_RE = re.compile(r"(stop|shutdown|closed|done|exit)",
                                 re.IGNORECASE)
 RETRY_STOP_ATTRS = {"is_set", "wait"}
 
+# ------------------------------------------------- unclosed tracing spans
+
+#: util/tracing context-manager constructors: calling one WITHOUT using
+#: it as a context manager (``with tracing.span(...)``, a name later
+#: with-ed, or ``stack.enter_context(...)``) leaks the ContextVar
+#: parentage — the span never ends, and every later span in the thread/
+#: task silently parents under it. Attribute calls are matched when the
+#: receiver looks like the tracing module (``tracing`` / ``_tracing``);
+#: ``remote_span`` is unambiguous enough to match as a bare name too.
+TRACING_SPAN_ATTRS = {"trace", "span", "remote_span"}
+TRACING_SPAN_NAMES = {"remote_span"}
+TRACING_RECEIVER_RE = re.compile(r"(^|_)tracing$")
+
 # --------------------------------------------------------- bare excepts
 
 #: Logging-ish call names that make a broad except "handled".

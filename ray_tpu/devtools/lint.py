@@ -24,6 +24,12 @@ carries a rule id:
                         deadline, attempt counter, or stop-event check —
                         chaos runs (dead peer, dropped frames) hang
                         exactly there
+  span-not-closed       a ``tracing.trace/span/remote_span(...)`` call
+                        not used as a context manager (directly in a
+                        ``with``, via a name later with-ed, or through
+                        ``stack.enter_context``) — the span never ends
+                        and its ContextVar parentage leaks onto every
+                        later span in the thread
 
 A second rule family, ``jax`` (``jaxlint.py``), runs from the same CLI:
 JAX/XLA tracing-safety rules (closure-captured-array-into-jit,
@@ -60,7 +66,7 @@ DEFAULT_BASELINE = os.path.join(_HERE, "lint_baseline.json")
 RULES = (
     "lock-order", "blocking-under-lock", "close-without-shutdown",
     "banned-api", "swallowed-exception", "daemon-no-join",
-    "retry-without-deadline",
+    "retry-without-deadline", "span-not-closed",
 )
 
 #: Rule families: "concurrency" = the tables above (the original
@@ -185,6 +191,7 @@ class _FileLinter(ast.NodeVisitor):
         self._scope.append(node.name)
         if self._check_sockets:
             self._check_close_without_shutdown(node)
+        self._check_span_not_closed(node)
         # A nested def's body runs LATER, on whatever thread calls it —
         # not under the with-locks lexically enclosing the def. Clear
         # the held stack for its body so closures defined inside a lock
@@ -253,6 +260,79 @@ class _FileLinter(ast.NodeVisitor):
                         f"{var}.close() without a prior shutdown() in "
                         f"'{fn.name}' — a reader blocked in recv stays "
                         "alive writing into freed buffers"))
+
+    # -------------------------------------------------- unclosed spans
+
+    @staticmethod
+    def _is_span_call(call: ast.Call) -> Optional[str]:
+        """'tracing.span'-style descriptor if this call constructs a
+        tracing context manager, else None."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in inv.TRACING_SPAN_ATTRS:
+            recv = _dotted(fn.value)
+            if recv is not None and \
+                    inv.TRACING_RECEIVER_RE.search(recv.split(".")[-1]):
+                return f"{recv}.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in inv.TRACING_SPAN_NAMES:
+            return fn.id
+        return None
+
+    def _check_span_not_closed(self, fn) -> None:
+        """Within one function: a tracing.trace/span/remote_span call
+        must be consumed as a context manager — directly as a ``with``
+        item, assigned to a name that is later a ``with`` item, or
+        passed to ``.enter_context(...)``. Anything else opens a span
+        that never ends and leaks its ContextVar parentage onto every
+        later span in the thread/task."""
+        span_calls: List[Tuple[ast.Call, str]] = []
+        ok_ids: set = set()  # id() of span calls consumed correctly
+        with_names: set = set()
+        assigned: Dict[str, List[ast.Call]] = {}
+        # Walk THIS function only: nested defs get their own visit.
+        todo = list(ast.iter_child_nodes(fn))
+        nodes = []
+        while todo:
+            sub = todo.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            nodes.append(sub)
+            todo.extend(ast.iter_child_nodes(sub))
+        for sub in nodes:
+            if isinstance(sub, ast.Call):
+                desc = self._is_span_call(sub)
+                if desc is not None:
+                    span_calls.append((sub, desc))
+                fn_attr = sub.func
+                if isinstance(fn_attr, ast.Attribute) and \
+                        fn_attr.attr == "enter_context":
+                    for arg in sub.args:
+                        ok_ids.add(id(arg))
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    ok_ids.add(id(item.context_expr))
+                    if isinstance(item.context_expr, ast.Name):
+                        with_names.add(item.context_expr.id)
+            elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call):
+                if self._is_span_call(sub.value) is not None:
+                    assigned.setdefault(sub.targets[0].id,
+                                        []).append(sub.value)
+        for name, calls in assigned.items():
+            if name in with_names:
+                for c in calls:
+                    ok_ids.add(id(c))
+        for call, desc in span_calls:
+            if id(call) in ok_ids:
+                continue
+            self._emit(
+                "span-not-closed", call,
+                f"{desc}(...) is not used as a context manager — the "
+                "span never ends and its ContextVar parentage leaks "
+                "onto every later span in this thread (use `with`, or "
+                "stack.enter_context)")
 
     # ------------------------------------------------ unbounded retries
 

@@ -117,8 +117,21 @@ class HTTPProxyActor:
             h = self._handles[name] = serve_api.get_deployment_handle(name)
         return h
 
+    @staticmethod
+    def _attached(fn, trace_ctx):
+        """Run ``fn`` with the request's trace context attached: executor
+        threads don't inherit the event loop's ContextVars, so the
+        handle's route span would otherwise detach from the request
+        root. No-op (plain call) when tracing is off."""
+        from ray_tpu.util import tracing
+
+        with tracing.attach(trace_ctx):
+            return fn()
+
     async def _post(self, request):
         from aiohttp import web
+
+        from ray_tpu.util import tracing
 
         parts = [p for p in request.path.split("/") if p]
         name = parts[0] if parts else ""
@@ -134,6 +147,15 @@ class HTTPProxyActor:
             return web.json_response({"error": f"bad json: {e}"},
                                      status=400)
         loop = asyncio.get_event_loop()
+        # Request-lifecycle trace: serve.request roots the tree; every
+        # downstream span (admission, route, replica, engine phases,
+        # delivery) parents under it. All None when tracing is off.
+        root = tracing.start_span(
+            "serve.request", attrs={"deployment": name, "method": method,
+                                    "stream": stream})
+        root_ctx = tracing.ctx_of(root)
+        root_ok = False
+        t_adm0w = time.time() if root is not None else 0.0
         # SLO gate first (off-loop on the dedicated gate pool: a queued
         # admission parks up to the queue timeout). A shed request
         # never touches the router.
@@ -146,29 +168,52 @@ class HTTPProxyActor:
                 await loop.run_in_executor(self._gate_pool,
                                            self._admission.acquire, name)
         except DeploymentOverloadedError as e:
+            if root is not None:
+                tracing.emit_span(
+                    "serve.admission", t_adm0w, time.time(),
+                    parent=root_ctx, attrs={"shed": True}, ok=False)
+                tracing.end_span(root, ok=False)
+                # Off-loop: flush() is a blocking socket send to the
+                # head — a stalled head must not freeze the event loop.
+                loop.run_in_executor(None, tracing.flush)
             return web.json_response(
                 {"error": "overloaded", "deployment": name,
                  "detail": str(e)}, status=503)
         t_admit = time.perf_counter()
+        if root is not None:
+            # SLO queue wait (0 on the unparked fast path) — the first
+            # TTFT component of the request timeline.
+            t_now = time.time()
+            tracing.emit_span(
+                "serve.admission", t_adm0w, t_now, parent=root_ctx,
+                attrs={"queued_ms": round((t_now - t_adm0w) * 1e3, 3)})
         unknown = False
         try:
             h = self._get_handle(name)
             if stream:
-                return await self._stream(request, h, method, payload,
-                                          name, t_admit)
+                resp = await self._stream(request, h, method, payload,
+                                          name, t_admit, root_ctx)
+                root_ok = True
+                return resp
             # Routing runs in the executor: choose() is normally a dict
             # pick, but the first call (or an unknown/scaled-to-zero
             # deployment) does a synchronous controller fetch that must
             # not stall the loop. The await then multiplexes the
             # in-flight request on the loop.
             resp = await loop.run_in_executor(
-                None, lambda: h.options(method).remote(payload))
+                None, lambda: self._attached(
+                    lambda: h.options(method).remote(payload), root_ctx))
+            t_del0 = time.time() if root is not None else 0.0
             result = await resp.result_async(timeout=120)
+            if root is not None:
+                tracing.emit_span("serve.delivery", t_del0, time.time(),
+                                  parent=root_ctx)
             # Full-result latency stands in for TTFT on the unary path
             # (first byte == last byte here); the stream path records
             # true first-chunk time.
             self._admission.record_ttft(
                 name, (time.perf_counter() - t_admit) * 1e3)
+            root_ok = True
             return web.json_response({"result": result})
         except Exception as e:  # noqa: BLE001 — surfaced as 500
             # The controller's KeyError arrives wrapped as a remote
@@ -181,6 +226,11 @@ class HTTPProxyActor:
             return web.json_response({"error": str(e)}, status=500)
         finally:
             self._admission.release(name)
+            if root is not None:
+                tracing.end_span(root, ok=root_ok)
+                # Off-loop (see the shed path): the span ship must never
+                # park the proxy's event loop on a slow head socket.
+                loop.run_in_executor(None, tracing.flush)
             if unknown:
                 # acquire() ran before the deployment lookup, so a 404
                 # leaves behind admission state for a name that does
@@ -188,28 +238,35 @@ class HTTPProxyActor:
                 self._admission.forget(name)
 
     async def _stream(self, request, h, method, payload,
-                      name=None, t_admit=None):
+                      name=None, t_admit=None, trace_ctx=None):
         """Chunked transfer: one JSON line per streamed item (reference:
         proxy_response_generator.py writes streaming responses the same
         incremental way over ASGI)."""
         from aiohttp import web
+
+        from ray_tpu.util import tracing
 
         # Routing/stream setup failures (unknown deployment, no replicas)
         # happen BEFORE the response is prepared — let them propagate to
         # _post's JSON error mapping. Setup runs off-loop: it does a
         # blocking handle_request_streaming round-trip.
         gen = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: h.options(method, stream=True).remote(payload))
+            None, lambda: self._attached(
+                lambda: h.options(method, stream=True).remote(payload),
+                trace_ctx))
         resp = web.StreamResponse(
             headers={"Content-Type": "application/jsonlines"})
         await resp.prepare(request)
         first = True
+        t_del0 = time.time() if trace_ctx is not None else 0.0
+        items = 0
         try:
             async for item in gen:
                 if first and t_admit is not None:
                     self._admission.record_ttft(
                         name, (time.perf_counter() - t_admit) * 1e3)
                 first = False
+                items += 1
                 await resp.write(
                     (json.dumps({"item": item}) + "\n").encode())
         except asyncio.CancelledError:
@@ -235,6 +292,11 @@ class HTTPProxyActor:
             await resp.write_eof()
         except (ConnectionResetError, OSError):
             pass
+        if trace_ctx is not None:
+            # serve.delivery: first write through eof — the stream's
+            # client-facing half of the timeline.
+            tracing.emit_span("serve.delivery", t_del0, time.time(),
+                              parent=trace_ctx, attrs={"items": items})
         return resp
 
     # ----------------------------------------------------------- actor API

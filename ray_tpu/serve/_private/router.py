@@ -248,8 +248,11 @@ class Router:
                 - cfg.serve_router_kv_weight * kv), depth
 
     def _choose_scored(self, loads: Dict[Any, Dict[str, Any]],
-                       prefix_tokens: Optional[Sequence[int]]):
-        """Callers hold self._lock and have verified fresh loads."""
+                       prefix_tokens: Optional[Sequence[int]],
+                       decision: Optional[Dict[str, Any]] = None):
+        """Callers hold self._lock and have verified fresh loads.
+        ``decision`` (optional dict) is filled with the winning score and
+        prefix-match depth — the routing-decision span's attributes."""
         from ray_tpu.core.config import GLOBAL_CONFIG as cfg
         from ray_tpu.serve.engine.kv_manager import chain_hashes
 
@@ -291,16 +294,26 @@ class Router:
         self._scored_routes += 1
         if match_depth.get(choice):
             self._affinity_routes += 1
+        if decision is not None:
+            decision["score"] = round(float(best_key[0]), 4) \
+                if best_key is not None else 0.0
+            decision["match_blocks"] = match_depth.get(choice, 0)
+            decision["candidates"] = len(cands)
         return choice
 
     def choose(self, model_id: Optional[str] = None,
-               prefix_tokens: Optional[Sequence[int]] = None):
+               prefix_tokens: Optional[Sequence[int]] = None,
+               decision: Optional[Dict[str, Any]] = None):
         """Pick a replica. With fresh snapshots for the whole set and
         policy 'scored': score prefix affinity + queue + KV headroom.
         Otherwise pow-2: two random candidates, fewer local in-flight
         wins (byte-identical to the pre-snapshot router). A multiplexed
         model id prefers its affine replica (model cache locality)
-        unless that replica disappeared."""
+        unless that replica disappeared.
+
+        ``decision`` (optional dict) is populated with which path chose
+        (policy actually taken, score, prefix-match depth) — the serve
+        trace's routing-decision span reads it; None costs nothing."""
         from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
         self._ensure_poller()
@@ -317,31 +330,42 @@ class Router:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} has no replicas")
             choice = None
+            taken = policy
             if model_id is not None:
                 affine = self._model_affinity.get(model_id)
                 if affine is not None and affine in self._replicas:
                     choice = affine
+                    taken = "model_affinity"
             if choice is None:
                 if policy == "random":
                     choice = random.choice(self._replicas)
                 elif len(self._replicas) == 1:
                     choice = self._replicas[0]
+                    taken = "single"
                 else:
                     loads = (self._fresh_loads()
                              if policy == "scored" else None)
                     if loads is not None:
-                        choice = self._choose_scored(loads, prefix_tokens)
+                        choice = self._choose_scored(loads, prefix_tokens,
+                                                     decision)
                     else:
                         a, b = random.sample(self._replicas, 2)
                         choice = (a if self._inflight.get(a, 0)
                                   <= self._inflight.get(b, 0) else b)
                         self._pow2_routes += 1
+                        if policy == "scored":
+                            taken = "pow2_fallback"
+                        elif policy != "random":
+                            taken = "pow2"
                 if model_id is not None:
                     self._model_affinity[model_id] = choice
                     while len(self._model_affinity) > 4096:
                         self._model_affinity.pop(
                             next(iter(self._model_affinity)))
             self._inflight[choice] = self._inflight.get(choice, 0) + 1
+            if decision is not None:
+                decision["policy"] = taken
+                decision["replicas"] = len(self._replicas)
             return choice
 
     def done(self, replica) -> None:

@@ -227,10 +227,46 @@ class DeploymentHandle:
             raise AttributeError(item)
         return self.options(item)
 
-    def _context(self) -> Optional[Dict[str, Any]]:
-        if self._model_id is None:
-            return None
-        return {"multiplexed_model_id": self._model_id}
+    def _context(self, trace_ctx: Optional[Dict[str, str]] = None
+                 ) -> Optional[Dict[str, Any]]:
+        ctx: Optional[Dict[str, Any]] = None
+        if self._model_id is not None:
+            ctx = {"multiplexed_model_id": self._model_id}
+        if trace_ctx is not None:
+            ctx = dict(ctx or ())
+            ctx["trace"] = trace_ctx
+        return ctx
+
+    def _route(self, args, kwargs):
+        """Router choice wrapped in the ``serve.route`` span + the
+        ``route`` TTFT-breakdown sample. Returns (replica, wire trace
+        context to ship to the replica — None when tracing is off)."""
+        import time as _time
+
+        from ray_tpu.serve.engine.metrics import SERVE_TTFT_BREAKDOWN_MS
+        from ray_tpu.util import tracing
+
+        traced = tracing.enabled()
+        decision: Optional[Dict[str, Any]] = {} if traced else None
+        t0 = _time.perf_counter()
+        t0w = _time.time() if traced else 0.0
+        replica = self._router.choose(
+            model_id=self._model_id,
+            prefix_tokens=self._prefix_hint(args, kwargs),
+            decision=decision)
+        SERVE_TTFT_BREAKDOWN_MS.observe(
+            (_time.perf_counter() - t0) * 1e3,
+            labels={"component": "route"})
+        if not traced:
+            return replica, None
+        parent = tracing.current()
+        decision["deployment"] = self._name
+        route_ctx = tracing.emit_span("serve.route", t0w, _time.time(),
+                                      parent=parent, attrs=decision)
+        # With an enclosing request span (the proxy) the replica parents
+        # there; a bare traced handle call roots its tree at the route
+        # span so the request still forms one connected trace.
+        return replica, (parent if parent is not None else route_ctx)
 
     @staticmethod
     def _prefix_hint(args, kwargs) -> Optional[list]:
@@ -247,13 +283,12 @@ class DeploymentHandle:
         return None
 
     def remote(self, *args, **kwargs):
-        replica = self._router.choose(
-            model_id=self._model_id,
-            prefix_tokens=self._prefix_hint(args, kwargs))
+        replica, trace_ctx = self._route(args, kwargs)
         if self._stream:
             try:
                 sid = ray_tpu.get(replica.handle_request_streaming.remote(
-                    self._method, args, kwargs, self._context()), timeout=60)
+                    self._method, args, kwargs, self._context(trace_ctx)),
+                    timeout=60)
             except BaseException:
                 # The choose() above counted us in-flight; a failed stream
                 # setup must not permanently bias pow-2 away from the
@@ -262,7 +297,7 @@ class DeploymentHandle:
                 raise
             return DeploymentResponseGenerator(replica, sid, self._router)
         ref = replica.handle_request.remote(self._method, args, kwargs,
-                                            self._context())
+                                            self._context(trace_ctx))
         # One replay budget for a dead-replica result (submission itself
         # never raises for dead actors in this runtime).
         return DeploymentResponse(
@@ -270,11 +305,9 @@ class DeploymentHandle:
             retry=lambda: self._route_once(args, kwargs))
 
     def _route_once(self, args, kwargs) -> DeploymentResponse:
-        replica = self._router.choose(
-            model_id=self._model_id,
-            prefix_tokens=self._prefix_hint(args, kwargs))
+        replica, trace_ctx = self._route(args, kwargs)
         ref = replica.handle_request.remote(self._method, args, kwargs,
-                                            self._context())
+                                            self._context(trace_ctx))
         return DeploymentResponse(ref, self._router, replica)
 
     def __reduce__(self):

@@ -26,8 +26,11 @@ from ray_tpu.devtools import jax_debug
 from ray_tpu.serve.engine.decode_loop import DecodeLoop
 from ray_tpu.serve.engine.drafter import PromptLookupDrafter, SpecControl
 from ray_tpu.serve.engine.kv_manager import KVCacheManager
-from ray_tpu.serve.engine.metrics import EngineMetrics
+from ray_tpu.serve.engine.metrics import (SERVE_TTFT_BREAKDOWN_MS,
+                                          EngineMetrics)
 from ray_tpu.serve.engine.scheduler import EngineRequest, Scheduler
+from ray_tpu.util import flight_recorder as _flight
+from ray_tpu.util import tracing as _tracing
 
 
 class InferenceEngine:
@@ -165,6 +168,12 @@ class InferenceEngine:
         req = EngineRequest(list(prompt_ids), max_new_tokens, eos_id,
                             stream_queue=queue.Queue() if stream else None,
                             arrival_t=time.perf_counter())
+        if _tracing.enabled():
+            # Captured on the CALLER's thread (replica request context /
+            # driver span); the engine thread parents its queued/prefill/
+            # decode-chunk spans to it. Stays None when tracing is off,
+            # which gates every engine-side span emit.
+            req.trace_ctx = _tracing.current()
         if not req.prompt_ids:
             raise ValueError("empty prompt")
         if not all(isinstance(t, (int, np.integer))
@@ -252,6 +261,7 @@ class InferenceEngine:
         self.scheduler.drain_into(self._queue)
         for adm in self.scheduler.admissions():
             req, slot, cached = adm.request, adm.slot, adm.cached_len
+            t_pf0 = time.perf_counter()
             try:
                 suffix = req.prompt_ids[cached:]
                 padded = np.zeros((1, adm.bucket), np.int32)
@@ -279,6 +289,26 @@ class InferenceEngine:
                     req.stream_queue.put(("error", e))
                 continue
             req.first_token_t = time.perf_counter()
+            queue_s = max(0.0, t_pf0 - req.arrival_t)
+            prefill_s = max(0.0, req.first_token_t - t_pf0)
+            SERVE_TTFT_BREAKDOWN_MS.observe(queue_s * 1e3,
+                                            labels={"component": "queue"})
+            SERVE_TTFT_BREAKDOWN_MS.observe(prefill_s * 1e3,
+                                            labels={"component": "prefill"})
+            if req.trace_ctx is not None:
+                # Wall-clock span boundaries reconstructed from the
+                # perf_counter intervals measured above.
+                now_w = time.time()
+                _tracing.emit_span(
+                    "engine.queued", now_w - prefill_s - queue_s,
+                    now_w - prefill_s, parent=req.trace_ctx,
+                    attrs={"prompt_len": len(req.prompt_ids)})
+                _tracing.emit_span(
+                    "engine.prefill", now_w - prefill_s, now_w,
+                    parent=req.trace_ctx,
+                    attrs={"prefill_tokens": len(suffix),
+                           "cached_tokens": cached,
+                           "bucket": adm.bucket, "slot": slot})
             self.metrics.record_admit(req.first_token_t - req.arrival_t,
                                       len(suffix), cached)
             req.generated.append(first)
@@ -299,6 +329,11 @@ class InferenceEngine:
                 })
             if req.stream_queue is not None:
                 req.stream_queue.put(("done", None))
+            if req.trace_ctx is not None:
+                # Ship this request's engine spans now: a sub-64-span
+                # buffer would otherwise hold them past the caller's
+                # trace query (one small frame per finished request).
+                _tracing.flush()
         return done
 
     def _roster_arrays(self, active):
@@ -348,8 +383,14 @@ class InferenceEngine:
 
     def _plain_tick(self) -> None:
         active = self.scheduler.active
+        # Chunk-span wall boundaries: computed ONLY when some roster
+        # member is traced — the tracing-off tick is byte-identical (no
+        # extra clock reads, no span dicts).
+        traced_tick = (_tracing.enabled()
+                       and any(r.trace_ctx is not None for r in active))
         tokens, lengths, remaining, eos_ids, done = \
             self._roster_arrays(active)
+        t0w = time.time() if traced_tick else 0.0
         t0 = time.perf_counter()
         try:
             toks_d, n_valid_d, _len_d, _done_d, self.cache = \
@@ -363,6 +404,7 @@ class InferenceEngine:
             self._fail_roster(e)
             return
         elapsed = time.perf_counter() - t0
+        t1w = time.time() if traced_tick else 0.0
         # Device utilization denominator: every slot live at dispatch is
         # scanned for the full chunk (static shapes) whether or not it
         # freezes mid-chunk — delivered/live_steps < 1.0 shows the
@@ -372,6 +414,11 @@ class InferenceEngine:
         for req in list(active):
             n = int(n_valid[req.slot])
             delivered += n
+            if req.trace_ctx is not None and n:
+                _tracing.emit_span(
+                    "engine.decode_chunk", t0w, t1w,
+                    parent=req.trace_ctx,
+                    attrs={"tokens": n, "slot": req.slot})
             for j in range(n):
                 tok = int(chunk_ids[req.slot, j])
                 req.length += 1
@@ -382,6 +429,7 @@ class InferenceEngine:
                 if self._maybe_finish(req, tok):
                     break  # device froze the slot here; rest are repeats
         self.metrics.record_chunk(delivered, live_steps, elapsed)
+        _flight.record("engine_tick", tok=delivered, act=len(active))
 
     # -------------------------------------------------------- speculation
 
@@ -442,6 +490,9 @@ class InferenceEngine:
         for req in active:
             self.kv.begin_speculation(
                 req.slot, min(C * W, self.max_len - req.length))
+        traced_tick = (_tracing.enabled()
+                       and any(r.trace_ctx is not None for r in active))
+        t0w = time.time() if traced_tick else 0.0
         t0 = time.perf_counter()
         try:
             emits_d, counts_d, _len_d, _done_d, self.cache = \
@@ -456,6 +507,7 @@ class InferenceEngine:
             self._fail_roster(e)
             return
         elapsed = time.perf_counter() - t0
+        t1w = time.time() if traced_tick else 0.0
         live_steps = len(active) * C * W  # token-positions scanned
         delivered = 0
         accepted_total = 0
@@ -468,7 +520,15 @@ class InferenceEngine:
             # in-flight reservation into the free pool.
             self.kv.commit_speculation(s, n)
             delivered += n
-            accepted_total += int(np.maximum(counts[s] - 1, 0).sum())
+            req_accepted = int(np.maximum(counts[s] - 1, 0).sum())
+            accepted_total += req_accepted
+            if req.trace_ctx is not None and n:
+                _tracing.emit_span(
+                    "engine.decode_chunk", t0w, t1w,
+                    parent=req.trace_ctx,
+                    attrs={"tokens": n, "slot": s, "spec": True,
+                           "spec_accepted": req_accepted,
+                           "drafted": int(ndraft[s])})
             finished = False
             for i in range(C):
                 for j in range(int(counts[s, i])):
@@ -490,6 +550,8 @@ class InferenceEngine:
                     req.spec.observe(consumed, acc)
         self.metrics.record_chunk(delivered, live_steps, elapsed)
         self.metrics.record_spec(int(ndraft.sum()), accepted_total)
+        _flight.record("engine_tick", tok=delivered, act=len(active),
+                       spec=True)
 
     @staticmethod
     def _spec_outcome(counts_row, drafted: int, K: int, W: int):

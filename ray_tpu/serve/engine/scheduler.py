@@ -51,6 +51,12 @@ class EngineRequest:
     # the adaptive draft allowance + lifetime drafted/accepted counters
     # (drafter.SpecControl), attached by the engine at request creation.
     spec: Optional[Any] = None
+    # Distributed tracing: the caller's wire span context, captured at
+    # request creation on the CALLER's thread (the engine thread has no
+    # ContextVar view of it). None when tracing is off — every engine
+    # span emit gates on this, so the untraced decode path allocates no
+    # span state.
+    trace_ctx: Optional[Any] = None
 
     def remaining(self) -> int:
         """Token budget left (per-request accounting)."""

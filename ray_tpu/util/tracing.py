@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
@@ -35,6 +36,10 @@ _current_span: "contextvars.ContextVar[Optional[Dict[str, Any]]]" = \
 _buffer: List[Dict[str, Any]] = []
 _buffer_lock = threading.Lock()
 _FLUSH_AT = 64
+# Runtime-less processes (node managers) register an explicit flush sink
+# so their spans (e.g. pull-manager per-holder fetches) still reach the
+# head's trace ring.
+_sink: Optional[Callable[[list], None]] = None
 
 
 def enabled() -> bool:
@@ -57,6 +62,14 @@ def _record(span: Dict[str, Any]) -> None:
         flush()
 
 
+def set_sink(sink: Optional[Callable[[list], None]]) -> None:
+    """Register a flush destination for processes with no runtime (node
+    managers): called with the span batch instead of the runtime's head
+    client."""
+    global _sink
+    _sink = sink
+
+
 def flush() -> None:
     """Ship buffered spans to the head (best-effort; spans are telemetry)."""
     with _buffer_lock:
@@ -68,7 +81,16 @@ def flush() -> None:
 
         rt = get_runtime()
         if rt is None or not hasattr(rt, "head"):
+            if _sink is not None:
+                _sink(spans)
             return
+        # Tag the span batch with this process's node id so trace_dump
+        # can apply that node's clock offset when merging clusters whose
+        # hosts disagree on wall time.
+        nid = getattr(rt, "node_id", None)
+        if nid:
+            for s in spans:
+                s.setdefault("node", nid)
         rt.head.notify("trace_spans", spans)
     except Exception:
         pass
@@ -123,6 +145,7 @@ def _span_impl(name, attrs, new_trace: bool,
         "end": None,
         "attrs": dict(attrs or {}),
         "ok": True,
+        "pid": os.getpid(),
     }
     token = _current_span.set(rec)
     try:
@@ -143,6 +166,100 @@ def remote_span(name: str, wire_ctx: Optional[Dict[str, str]]):
     with _span_impl(name, None, new_trace=False,
                     remote_parent=wire_ctx) as h:
         yield h
+
+
+# -------------------------------------------------- manual / hot-path API
+#
+# The context-manager API owns the ContextVar parentage; hot paths (the
+# engine's per-chunk accounting, dispatcher threads pairing tasks with
+# leases) instead record FINISHED spans with explicit parents and their
+# own measured timestamps — no ContextVar traffic, no allocation at all
+# when tracing is off (callers gate on a None wire context).
+
+
+def _new_rec(name: str, parent: Optional[Dict[str, str]],
+             attrs: Optional[Dict[str, Any]], start: float,
+             end: Optional[float], ok: bool) -> Dict[str, Any]:
+    """One span record shape for the whole manual API: parent falls
+    back to the calling thread's current span; no parent starts a
+    fresh trace."""
+    if parent is None:
+        parent = current()
+    if parent is not None and parent.get("trace_id"):
+        trace_id, parent_id = parent["trace_id"], parent["span_id"]
+    else:
+        trace_id, parent_id = uuid.uuid4().hex[:16], ""
+    return {
+        "trace_id": trace_id,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent_id,
+        "name": name,
+        "start": start,
+        "end": end,
+        "attrs": dict(attrs or {}),
+        "ok": ok,
+        "pid": os.getpid(),
+    }
+
+
+def emit_span(name: str, start: float, end: float,
+              parent: Optional[Dict[str, str]] = None,
+              attrs: Optional[Dict[str, Any]] = None,
+              ok: bool = True) -> Optional[Dict[str, str]]:
+    """Record a completed span [start, end] (wall-clock seconds).
+    ``parent`` is a wire context ({trace_id, span_id}); None falls back
+    to the calling thread's current span, and a missing parent starts a
+    fresh trace. Returns the new span's wire context (for chaining)."""
+    if not enabled():
+        return None
+    rec = _new_rec(name, parent, attrs, start, end, ok)
+    _record(rec)
+    return {"trace_id": rec["trace_id"], "span_id": rec["span_id"]}
+
+
+def start_span(name: str, parent: Optional[Dict[str, str]] = None,
+               attrs: Optional[Dict[str, Any]] = None
+               ) -> Optional[Dict[str, Any]]:
+    """Open a manually-managed span (no ContextVar): returns the record,
+    finish it with ``end_span``. For request lifecycles that span
+    threads/event loops (the serve proxy)."""
+    if not enabled():
+        return None
+    return _new_rec(name, parent, attrs, time.time(), None, True)
+
+
+def end_span(rec: Optional[Dict[str, Any]], ok: bool = True) -> None:
+    """Close + record a ``start_span`` record. None-safe (tracing off)."""
+    if rec is None:
+        return
+    rec["end"] = time.time()
+    if not ok:
+        rec["ok"] = False
+    _record(rec)
+
+
+def ctx_of(rec: Optional[Dict[str, Any]]) -> Optional[Dict[str, str]]:
+    """The wire context of a ``start_span`` record (None-safe)."""
+    if rec is None:
+        return None
+    return {"trace_id": rec["trace_id"], "span_id": rec["span_id"]}
+
+
+@contextlib.contextmanager
+def attach(wire_ctx: Optional[Dict[str, str]]):
+    """Re-enter a wire context on THIS thread without recording a span:
+    child spans opened inside parent to it. Needed where ContextVars
+    don't propagate (run_in_executor hops in the serve proxy)."""
+    if not enabled() or not wire_ctx:
+        yield
+        return
+    token = _current_span.set({"trace_id": wire_ctx["trace_id"],
+                               "span_id": wire_ctx["span_id"],
+                               "attrs": {}})
+    try:
+        yield
+    finally:
+        _current_span.reset(token)
 
 
 # ---------------------------------------------------------------- queries

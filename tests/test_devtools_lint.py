@@ -414,6 +414,83 @@ def test_daemon_thread_with_join_clean():
     assert lint_source(src, "m", "m.py") == []
 
 
+# ---------------------------------------------------- span-not-closed
+
+
+def test_span_call_without_with_flagged():
+    src = (
+        "from ray_tpu.util import tracing\n"
+        "def f(name):\n"
+        "    tracing.trace('run')\n"            # never closed
+        "    h = tracing.span('child')\n"       # assigned, never with-ed
+        "    return h\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["span-not-closed"]
+    assert len(fs) == 2
+
+
+def test_span_as_context_manager_clean():
+    src = (
+        "from ray_tpu.util import tracing\n"
+        "import contextlib\n"
+        "def f(spec, name):\n"
+        "    with tracing.trace('run') as t:\n"
+        "        with tracing.span('child'):\n"
+        "            pass\n"
+        "    cm = tracing.remote_span('task', spec)\n"
+        "    with cm as h:\n"                    # assigned-then-with
+        "        pass\n"
+        "    with contextlib.ExitStack() as stack:\n"
+        "        stack.enter_context(tracing.span('s'))\n"
+        "    return t\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_bare_remote_span_and_alias_receiver_flagged():
+    src = (
+        "from ray_tpu.util import tracing as _tracing\n"
+        "from ray_tpu.util.tracing import remote_span\n"
+        "def f(spec):\n"
+        "    remote_span('task', spec)\n"        # bare-name constructor
+        "    _tracing.remote_span('task2', spec)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["span-not-closed"]
+    assert len(fs) == 2
+
+
+def test_span_rule_ignores_other_receivers_and_emit_api():
+    src = (
+        "def f(tracer, tracing):\n"
+        "    tracer.span('not the module')\n"    # receiver not tracing-like
+        "    tracing.emit_span('a', 0, 1)\n"     # manual API: no CM needed
+        "    tracing.start_span('b')\n"
+        "    tracing.current()\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_span_rule_nested_def_has_own_scope():
+    # The with lives in a NESTED def: the outer call is still unclosed.
+    src = (
+        "from ray_tpu.util import tracing\n"
+        "def outer():\n"
+        "    tracing.span('leak')\n"
+        "    def inner():\n"
+        "        with tracing.span('fine'):\n"
+        "            pass\n"
+        "    return inner\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["span-not-closed"]
+    assert len(fs) == 1
+
+
+def test_span_rule_suppressable_inline():
+    src = (
+        "from ray_tpu.util import tracing\n"
+        "def f():\n"
+        "    tracing.span('x')  # rtpu-lint: disable=span-not-closed\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
 # --------------------------------------------------------------- baseline
 
 

@@ -33,6 +33,33 @@ def test_timeline_records_task_execution(cluster):
     assert all(e["args"]["status"] == "ok" for e in events[-3:])
 
 
+def test_timeline_ring_resizes_with_config():
+    """Regression: maxlen used to bind at import time, so a
+    task_events_buffer_size set via _system_config/env AFTER import was
+    silently ignored. The ring must now size lazily and re-size on a
+    config change (keeping the newest events)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.util import timeline
+
+    old = cfg.get("task_events_buffer_size")
+    try:
+        timeline.clear()
+        cfg.set("task_events_buffer_size", 8)
+        for i in range(50):
+            timeline.record_instant(f"ev-{i}")
+        events = timeline.dump_timeline()
+        assert len(events) == 8
+        assert events[-1]["name"] == "ev-49"  # newest kept
+        # Growing the config grows the live ring too.
+        cfg.set("task_events_buffer_size", 32)
+        for i in range(20):
+            timeline.record_instant(f"more-{i}")
+        assert len(timeline.dump_timeline()) == 8 + 20
+    finally:
+        cfg.set("task_events_buffer_size", old)
+        timeline.clear()
+
+
 def test_metrics_counters_and_prometheus_text(cluster):
     from ray_tpu.util import metrics
 

@@ -1,0 +1,185 @@
+"""Serve-path trace propagation: one request, one connected timeline.
+
+Proxy admission -> router choice -> replica -> engine prefill + decode
+chunks -> delivery, spec on/off, plus the tracing-off guarantee (no span
+state anywhere on the request path).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+ENGINE_KW = {"max_batch": 2, "max_len": 64, "prompt_buckets": [8, 16],
+             "decode_chunk": 2}
+
+
+@pytest.fixture(scope="module")
+def traced_serve():
+    import os
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    # controller + three single-replica deployments + the HTTP proxy
+    # actor all need a CPU each.
+    rt = ray_tpu.init(num_cpus=6, ignore_reinit_error=True,
+                      _system_config={"tracing_enabled": True})
+    yield rt
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    GLOBAL_CONFIG.set("tracing_enabled", False)
+    os.environ.pop("RTPU_TRACING_ENABLED", None)
+
+
+def _deploy(name, **extra_engine_kw):
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    kw = dict(ENGINE_KW, **extra_engine_kw)
+    return serve.run(build_llm_deployment(name=name, num_replicas=1,
+                                          engine_kwargs=kw), name=name)
+
+
+def _trace_spans(trace_id, want_names, timeout=25):
+    deadline = time.time() + timeout
+    spans = []
+    while time.time() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if want_names <= {s["name"] for s in spans}:
+            return spans
+        time.sleep(0.4)
+    return spans
+
+
+def _assert_connected(spans, root_name):
+    """Every span reaches the root by parent links within the trace."""
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        hops = 0
+        cur = s
+        while cur["parent_id"]:
+            cur = by_id.get(cur["parent_id"])
+            assert cur is not None, \
+                f"{s['name']} has a dangling parent chain"
+            hops += 1
+            assert hops < 20
+        assert cur["name"] == root_name, (s["name"], cur["name"])
+
+
+def test_handle_request_full_span_chain(traced_serve):
+    """Route -> replica -> engine queued/prefill/decode chunks, one
+    connected tree under the caller's root span."""
+    h = _deploy("traced-llm")
+    want = {"serve.route", "serve.replica:__call__", "engine.queued",
+            "engine.prefill", "engine.decode_chunk"}
+    with tracing.trace("req") as root:
+        out = h.remote({"prompt_ids": [1, 2, 3, 4],
+                        "max_new_tokens": 6}).result(timeout=180)
+    assert out["num_generated"] == 6
+    spans = _trace_spans(root.trace_id, want)
+    names = {s["name"] for s in spans}
+    assert want <= names, names
+    _assert_connected(spans, "req")
+    # Decode chunks carry per-request delivered-token counts that sum
+    # (with prefill's first token) to the generation.
+    chunk_toks = sum(s["attrs"]["tokens"] for s in spans
+                     if s["name"] == "engine.decode_chunk")
+    assert chunk_toks == 5  # prefill emits the first of 6
+    route = next(s for s in spans if s["name"] == "serve.route")
+    assert route["attrs"]["deployment"] == "traced-llm"
+    assert "policy" in route["attrs"]
+    prefill = next(s for s in spans if s["name"] == "engine.prefill")
+    assert prefill["attrs"]["prefill_tokens"] == 4
+
+
+def test_streaming_spec_on_span_chain(traced_serve):
+    """Spec-on streaming request: same connected chain; decode-chunk
+    spans carry the spec accept counts."""
+    h = _deploy("traced-llm-spec", spec_draft_len=2, spec_chunk=2)
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]  # lookup-friendly
+    want = {"serve.route", "serve.replica:stream", "engine.prefill",
+            "engine.decode_chunk"}
+    with tracing.trace("sreq") as root:
+        toks = list(h.options("stream", stream=True).remote(
+            {"prompt_ids": prompt, "max_new_tokens": 8}))
+    assert len(toks) == 8
+    spans = _trace_spans(root.trace_id, want)
+    names = {s["name"] for s in spans}
+    assert want <= names, names
+    _assert_connected(spans, "sreq")
+    spec_chunks = [s for s in spans if s["name"] == "engine.decode_chunk"
+                   and s["attrs"].get("spec")]
+    if spec_chunks:  # drafts proposed: accept counts must be reported
+        assert all("spec_accepted" in s["attrs"] for s in spec_chunks)
+
+
+def test_http_proxy_admission_to_delivery(traced_serve):
+    """The ingress path: serve.request roots admission -> route ->
+    replica -> engine -> delivery in ONE trace."""
+    from ray_tpu import serve
+
+    _deploy("traced-http")
+    _proxy, port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/traced-http",
+        data=json.dumps({"prompt_ids": [1, 2, 3],
+                         "max_new_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as r:
+        out = json.load(r)
+    assert out["result"]["num_generated"] == 4
+    # Find the request's trace via the head span tail.
+    rt = traced_serve
+    deadline = time.time() + 25
+    trace_id = None
+    while time.time() < deadline and trace_id is None:
+        for s in rt.head.retrying_call("trace_tail", 5000, timeout=10):
+            if s["name"] == "serve.request" and \
+                    s["attrs"].get("deployment") == "traced-http":
+                trace_id = s["trace_id"]
+                break
+        time.sleep(0.4)
+    assert trace_id, "no serve.request span reached the head"
+    want = {"serve.request", "serve.admission", "serve.route",
+            "serve.replica:__call__", "engine.prefill",
+            "engine.decode_chunk", "serve.delivery"}
+    spans = _trace_spans(trace_id, want)
+    assert want <= {s["name"] for s in spans}, {s["name"] for s in spans}
+    _assert_connected(spans, "serve.request")
+
+
+def test_tracing_off_request_path_is_span_free():
+    """With tracing off: requests carry no trace context anywhere, the
+    span buffer stays empty, and the engine's one-sync-per-chunk
+    discipline is unchanged (the RTPU_DEBUG_JAX witness asserts the
+    program/sync budget in tests/test_jax_debug.py; here we check the
+    metric the witness counts)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.util.tracing import _buffer
+
+    old = cfg.get("tracing_enabled")
+    cfg.set("tracing_enabled", False)
+    engine = LLMEngine(**ENGINE_KW)
+    try:
+        before = len(_buffer)
+        req = engine._make_request([1, 2, 3, 4], 6, None)
+        assert req.trace_ctx is None  # gates every engine span emit
+        engine._queue.put(req)
+        out = req.future.result(timeout=180)
+        assert out["num_generated"] == 6
+        assert len(_buffer) == before  # no span dict ever allocated
+        snap = engine.stats()
+        # 1 prefill sync + ceil(5/2) decode-chunk syncs.
+        assert snap["decode_host_syncs"] == 3
+    finally:
+        engine.close()
+        cfg.set("tracing_enabled", old)

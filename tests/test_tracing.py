@@ -111,6 +111,120 @@ def test_chrome_trace_export(traced_cluster, tmp_path):
     assert json.load(open(out))["traceEvents"]
 
 
+def test_runtime_spans_cover_task_path(traced_cluster):
+    """Submit -> lease -> dispatch -> arg fetch -> execute -> result
+    seal: the task path's phases land as spans on ONE trace, parented
+    to the driver root."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def consume(arr):
+        return int(arr.sum())
+
+    # A big-enough arg to live in plasma: the arg-fetch span must fire.
+    ref = ray_tpu.put(np.ones(300_000, np.int64))
+    with tracing.trace("task-path") as root:
+        assert ray_tpu.get(consume.remote(ref), timeout=60) == 300_000
+    tracing.flush()
+    want = {"task.submit", "task.dispatch", "task.arg_fetch",
+            "task.result_seal"}
+
+    def complete(names):
+        # Execution spans are named by qualname (task:<...>.consume).
+        return want <= names and any(
+            n.startswith("task:") and n.endswith("consume")
+            for n in names)
+
+    deadline = time.time() + 20
+    spans = []
+    while time.time() < deadline:
+        spans = tracing.get_trace(root.trace_id)
+        if complete({s["name"] for s in spans}):
+            break
+        time.sleep(0.3)
+    names = {s["name"] for s in spans}
+    assert complete(names), names
+    # The lease span may or may not appear (grants are reused across
+    # tasks of one scheduling key); when present it names the node.
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["task.submit"]["parent_id"] == root.span_id
+    assert by_name["task.dispatch"]["attrs"]["worker"]
+    assert by_name["task.arg_fetch"]["attrs"]["refs"] == 1
+    assert by_name["task.result_seal"]["attrs"]["returns"] == 1
+    # Phases order sanely on the timeline.
+    assert by_name["task.submit"]["start"] <= \
+        by_name["task.dispatch"]["end"]
+    assert by_name["task.arg_fetch"]["end"] <= \
+        by_name["task.result_seal"]["start"]
+
+
+def test_fresh_sched_key_emits_lease_span(traced_cluster):
+    """First submission of a NEW scheduling key must request a lease —
+    and trace it."""
+    @ray_tpu.remote
+    def fresh_keyed():
+        return 7
+
+    with tracing.trace("leasing") as root:
+        assert ray_tpu.get(fresh_keyed.remote(), timeout=60) == 7
+    tracing.flush()
+    deadline = time.time() + 20
+    lease_spans = []
+    while time.time() < deadline:
+        spans = tracing.get_trace(root.trace_id)
+        lease_spans = [s for s in spans if s["name"] == "task.lease"]
+        if lease_spans:
+            break
+        time.sleep(0.3)
+    assert lease_spans, "no task.lease span for a fresh scheduling key"
+    assert lease_spans[0]["attrs"]["granted"] is True
+
+
+def test_head_trace_ring_bounds_and_truncation():
+    """Satellite: the head bounds its span ring by BYTES (not just
+    entries), truncates oversized attr values, and counts evictions
+    into rtpu_trace_spans_dropped_total instead of silently rotating."""
+    from ray_tpu.cluster.head import TRACE_SPANS_DROPPED, HeadServer
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    head = HeadServer(port=0)
+    try:
+        def span(i, attrs=None):
+            return {"trace_id": "t1", "span_id": f"s{i}",
+                    "parent_id": "", "name": f"n{i}", "start": 1.0,
+                    "end": 2.0, "attrs": attrs or {}, "ok": True}
+
+        # Oversized attribute value: truncated on ingest.
+        head.rpc_trace_spans(None, [span(0, {"blob": "x" * 100_000})])
+        got = head.rpc_get_trace(None, "t1")
+        assert len(got[0]["attrs"]["blob"]) <= \
+            cfg.trace_attr_max_bytes + len("...[truncated]")
+        assert got[0]["attrs"]["blob"].endswith("...[truncated]")
+
+        # Byte bound: shrink it, flood, assert eviction + counting.
+        old_bytes = cfg.get("trace_ring_max_bytes")
+        cfg.set("trace_ring_max_bytes", 20_000)
+        base_dropped = TRACE_SPANS_DROPPED.get()
+        try:
+            head.rpc_trace_spans(
+                None, [span(i, {"pad": "y" * 800}) for i in range(1, 200)])
+            stats = head.rpc_trace_stats(None)
+            assert stats["bytes"] <= 20_000
+            assert TRACE_SPANS_DROPPED.get() > base_dropped
+            # Entry-count bound still applies too.
+            old_n = cfg.get("trace_ring_size")
+            cfg.set("trace_ring_size", 5)
+            try:
+                head.rpc_trace_spans(None, [span(1000)])
+                assert head.rpc_trace_stats(None)["spans"] <= 5
+            finally:
+                cfg.set("trace_ring_size", old_n)
+        finally:
+            cfg.set("trace_ring_max_bytes", old_bytes)
+    finally:
+        head.shutdown()
+
+
 def test_disabled_tracing_is_free():
     """Without the flag, spans are no-op handles and nothing buffers."""
     import ray_tpu.core.config as c
