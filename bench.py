@@ -1524,14 +1524,66 @@ def chaos_child_main() -> None:
     }
     if census_errors:
         row["census_error"] = census_errors[0]
+    if _os.environ.get("RTPU_DEBUG_RPC") == "1":
+        # RPC-contract witness status: the whole recovery run executed
+        # with duplicate delivery injected on every idempotent request
+        # and per-(sender,receiver) outbox sequence checks. "Clean"
+        # means zero violations in the driver's registry AND zero
+        # RTPU_DEBUG_RPC: lines across this session's head/node/worker
+        # logs (read BEFORE shutdown — the session log dir is restored
+        # after it).
+        from ray_tpu.core.config import GLOBAL_CONFIG as _gcfg
+        from ray_tpu.devtools import rpc_debug as _rpcdbg
+
+        log_hits = 0
+        try:
+            for fn in _os.listdir(_gcfg.log_dir):
+                p = _os.path.join(_gcfg.log_dir, fn)
+                if _os.path.isfile(p):
+                    with open(p, "rb") as fh:
+                        log_hits += fh.read().count(b"RTPU_DEBUG_RPC:")
+        except OSError:
+            pass
+        # Cluster-wide witness stats ride the flight-dump payloads (the
+        # one RPC every process serves): aggregate the driver's own
+        # registry with the head's and every alive node's, so the row
+        # proves duplicate injection actually COVERED the server side.
+        viol = len(_rpcdbg.violations())
+        dups = sum(_rpcdbg.dup_audit_counts().values())
+        try:
+            peers = [runtime.head.call("dump_flight", timeout=10)]
+            for nv in runtime.head.call("list_nodes", timeout=10):
+                if nv.get("alive"):
+                    peers.append(runtime._pool.get(nv["address"]).call(
+                        "dump_flight", timeout=10))
+            for payload in peers:
+                rd = (payload or {}).get("rpc_debug") or {}
+                viol += int(rd.get("violations", 0))
+                dups += int(rd.get("dup_audits", 0))
+        except Exception as e:
+            row["rpc_witness_poll_error"] = repr(e)[:120]
+        # Registry aggregate and log scan are overlapping evidence (a
+        # live server's violation appears in BOTH): report them as
+        # separate fields rather than a double-counting sum. Clean
+        # requires both zero — the log scan also covers processes that
+        # died before they could be polled.
+        row["rpc_witness_clean"] = bool(viol == 0 and log_hits == 0)
+        row["rpc_witness_violations"] = viol
+        row["rpc_witness_log_lines"] = log_hits
+        row["rpc_dup_audits"] = dups
     print(json.dumps(row), flush=True)
     rt.shutdown()
 
 
 def _chaos_rows() -> list:
     try:
+        # RTPU_DEBUG_RPC=1: the recovery suite doubles as the RPC
+        # contract audit — duplicate delivery on idempotent methods,
+        # outbox sequence checks, classification-hole refusal — and the
+        # row records witness-clean status alongside the timings.
         proc = _run(["--chaos-child"], CHAOS_TIMEOUT_S,
-                    env_extra={"JAX_PLATFORMS": "cpu"})
+                    env_extra={"JAX_PLATFORMS": "cpu",
+                               "RTPU_DEBUG_RPC": "1"})
     except subprocess.TimeoutExpired:
         return [{"metric": "chaos_recovery",
                  "error": f"timeout {CHAOS_TIMEOUT_S}s"}]
@@ -1556,7 +1608,9 @@ def chaos_main() -> int:
         print(json.dumps(r), flush=True)
     print(json.dumps(_merge_chaos_rows(rows)))
     clean = all("error" not in r and "census_error" not in r
-                and r.get("leaked_leases", 0) == 0 for r in rows)
+                and r.get("leaked_leases", 0) == 0
+                and r.get("rpc_witness_clean", True)
+                for r in rows)
     return 0 if clean else 1
 
 
@@ -1568,7 +1622,9 @@ def _merge_chaos_rows(rows: list) -> dict:
         merged["error"] = row["error"]
     else:
         for k in ("head_recovery_s", "object_reconstruction_s",
-                  "leaked_leases", "census_error"):
+                  "leaked_leases", "census_error", "rpc_witness_clean",
+                  "rpc_witness_violations", "rpc_witness_log_lines",
+                  "rpc_dup_audits"):
             if row.get(k) is not None:
                 merged[k] = row[k]
     return merged

@@ -126,7 +126,18 @@ class ClientRuntime:
             except (ConnectionLost, OSError):
                 pass
 
+    #: Gateway methods whose NAMES are in the global RETRY_SAFE_RPCS
+    #: (they collide with head/worker handlers): blind chaos drops may
+    #: eat these frames, so THIS side must be the required retry loop —
+    #: the contract retry-safety is predicated on. Safe to retry at the
+    #: gateway too: three are pure reads, kill_actor is idempotent.
+    _RETRY_SAFE_GATEWAY = frozenset({
+        "ping", "kill_actor", "list_actors", "cluster_resources"})
+
     def _call(self, method: str, *args, timeout: Optional[float] = None):
+        if method in self._RETRY_SAFE_GATEWAY:
+            return self._conn.retrying_call(method, *args,
+                                            timeout=timeout)
         return self._conn.call(method, *args, timeout=timeout)
 
     def _make_ref(self, oid: bytes, owner: Optional[str]) -> ObjectRef:
@@ -213,8 +224,8 @@ class ClientRuntime:
             "release_resources": release_resources,
             "allow_out_of_order_execution": allow_out_of_order_execution,
         }
-        aid = self._call("create_actor", cls, tuple(args), dict(kwargs),
-                         opts, timeout=120)
+        aid = self._call("client_create_actor", cls, tuple(args),
+                         dict(kwargs), opts, timeout=120)
         self._actor_classes[ActorID(aid)] = cls
         return ActorID(aid)
 

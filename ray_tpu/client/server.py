@@ -18,6 +18,7 @@ Session model: every connected peer gets a ``_Session`` holding
 from __future__ import annotations
 
 import argparse
+import logging
 import sys
 import threading
 from typing import Any, Dict, List, Optional, Tuple
@@ -26,6 +27,8 @@ from ray_tpu.cluster.protocol import RpcServer, blocking_rpc
 from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.resources import ResourceSet
+
+logger = logging.getLogger(__name__)
 
 
 class _Session:
@@ -40,6 +43,10 @@ class _Session:
 
 class ClientGateway:
     """RPC handler object for one gateway server (any number of clients)."""
+
+    # Fault-injection scope (devtools/chaos.py): chaos-plan rules target
+    # this server's RPCs with role=client.
+    chaos_role = "client"
 
     def __init__(self, runtime):
         self.rt = runtime
@@ -189,8 +196,14 @@ class ClientGateway:
     # ------------------------------------------------------------ actors
 
     @blocking_rpc
-    def rpc_create_actor(self, conn, cls, args, kwargs,
-                         opts: Dict[str, Any]) -> bytes:
+    def rpc_client_create_actor(self, conn, cls, args, kwargs,
+                                opts: Dict[str, Any]) -> bytes:
+        """Session-scoped actor creation. Named ``client_create_actor``
+        on the wire, NOT ``create_actor``: the worker-side handler of
+        that name is idempotent by actor-id dedup, but this one mints a
+        fresh actor per call — sharing the name would put it in
+        RETRY_SAFE_RPCS' blind-drop/duplicate-delivery class and a
+        re-delivered frame would create two actors."""
         s = self._session(conn)
         resources = opts.get("resources")
         aid = self.rt.create_actor(
@@ -218,8 +231,9 @@ class ClientGateway:
             # Disconnect cleanup already ran; don't orphan the actor.
             try:
                 self.rt.kill_actor(aid, no_restart=True)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("post-disconnect kill of %s failed: %r",
+                             aid.hex()[:8], e)
         return aid.binary()
 
     @blocking_rpc

@@ -24,6 +24,7 @@ reference (python/ray/_private/accelerators/tpu.py:154).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -32,9 +33,12 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.task_spec import pg_key_from_strategy
 from ray_tpu.cluster.persistence import HeadStore
 from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
+from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock, make_rlock
 from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 
 #: Spans evicted from the head's trace ring by the byte/entry bounds —
 #: silent ring rotation hid exactly the "where did my spans go" question
@@ -42,6 +46,7 @@ from ray_tpu.util import metrics as _metrics
 TRACE_SPANS_DROPPED = _metrics.Counter(
     "rtpu_trace_spans_dropped_total",
     "spans evicted from the head trace ring by the entry/byte bounds")
+
 
 class _TransientReservationFailure(Exception):
     """A node rejected a bundle after local re-check; retry placement."""
@@ -928,6 +933,13 @@ class HeadServer:
 
     # ------------------------------------------------------------- objects
 
+    # NOTE: every in-tree production sender rides the batched
+    # ``object_batch`` stream (owner outbox -> node _head_object_batch);
+    # the two single-object handlers below remain as the unit-test
+    # seeding seam (test_pull_manager/test_chaos pre-load directory
+    # state through them) and for wire compatibility. A NEW direct
+    # notify of either from an outbox-owning module is a
+    # direct-notify-bypasses-outbox lint finding.
     def rpc_object_added(self, conn, oid: bytes, node_id: str,
                          size: Optional[int] = None):
         with self._lock:
@@ -952,6 +964,10 @@ class HeadServer:
         frame + one lock acquisition per put burst instead of per object
         (the per-put notify serialized multi-writer put throughput at the
         head's dispatch path)."""
+        if _rpcdbg.enabled():
+            # RTPU_DEBUG_RPC: assert the node's directory stream arrived
+            # in order (strips the sequence stamp).
+            entries = _rpcdbg.check_outbox("head", entries)
         with self._lock:
             for kind, oid, size in entries:
                 if kind == "add":
@@ -1050,7 +1066,10 @@ class HeadServer:
         with self._lock:
             k = (ns, key)
             if not overwrite and k in self._kv:
-                return False
+                # Idempotent under re-delivery: a RETRY of the put that
+                # already landed (same value) acks True; only a genuine
+                # conflict (different value, someone else won) is False.
+                return self._kv[k] == value
             self._kv[k] = value
         if self._store is not None:
             self._store.kv_put(ns, key, value)
@@ -1150,23 +1169,52 @@ class HeadServer:
             self._store.save_pg(pg_id, self._pgs[pg_id])
         return True
 
+    @blocking_rpc
     def rpc_remove_pg(self, conn, pg_id: bytes):
+        # blocking: the release fan-out below joins threads for up to a
+        # control-timeout window — inline on the reader thread it would
+        # head-of-line-block every other RPC from the same peer.
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
         if self._store is not None:
             self._store.delete_pg(pg_id)
         if pg is None:
-            return False
+            # Already removed (re-delivered request): same ack as the
+            # first delivery — the bundles are gone either way.
+            return True
+        # Concurrent release fan-out with a total join deadline: a
+        # serial per-node loop paying a full control timeout per
+        # MID-DEATH node would outrun the caller's own deadline (the
+        # PR 8 cluster_leases failure shape). Each release still rides
+        # retrying_call — a transiently dropped release on an ALIVE
+        # node would otherwise leak the bundle's reserved resources
+        # forever (only node DEATH reconciles bundles) — and a thread
+        # outliving the join keeps retrying in the background so the
+        # release eventually lands even when the handler has answered.
+        targets = []
         for idx, node_id in enumerate(pg["bundle_nodes"]):
             with self._lock:
                 n = self._nodes.get(node_id)
             if n is not None:
-                try:
-                    self._pool.get(n.address).retrying_call(
-                        "release_bundle", pg_id, idx,
-                                timeout=cfg.rpc_control_timeout_s)
-                except Exception:
-                    pass
+                targets.append((idx, n.address))
+
+        def release_one(idx: int, address: str) -> None:
+            try:
+                self._pool.get(address).retrying_call(
+                    "release_bundle", pg_id, idx,
+                    timeout=cfg.rpc_control_timeout_s)
+            except Exception as e:  # noqa: BLE001 — best-effort; death
+                logger.debug("release_bundle %d of pg %s at %s failed: "
+                             "%r", idx, pg_id.hex()[:8], address, e)
+
+        threads = [threading.Thread(target=release_one, args=t,
+                                    daemon=True, name="pg-release")
+                   for t in targets]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + cfg.rpc_control_timeout_s + 2.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
         return True
 
     def rpc_pg_table(self, conn):

@@ -37,6 +37,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.shm_store import ShmStore
 from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
+from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock, make_rlock
 from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import metrics as _metrics
@@ -214,6 +215,10 @@ class NodeManager:
         # re-push local object tables after GCS restart).
         self._local_objects: Dict[bytes, int] = {}
         self._dir_lock = make_lock("node_manager._dir_lock")
+        # Serializes the node->head directory stream (stamp + send as
+        # one unit; see _head_object_batch). Leaf lock: nothing else is
+        # taken under it.
+        self._head_batch_lock = make_lock("node_manager._head_batch_lock")
         # Head incarnation learned at (re-)registration: a changed value
         # means the head restarted (new era).
         self._head_incarnation: Optional[str] = None
@@ -238,6 +243,11 @@ class NodeManager:
         # BEFORE the worker pop so a retry arriving mid-flight waits for the
         # original outcome instead of double-acquiring. Evicted oldest-first.
         self._lease_grants: Dict[str, list] = {}
+        # Recently-returned lease ids: a RETRIED return (lost ack) must
+        # ack True like the original did — "False" is reserved for a
+        # lease this node never granted or already reaped. Bounded FIFO.
+        self._returned_leases: set = set()
+        self._returned_order = collections.deque()
         self._pool = ClientPool()
         self._server = RpcServer(self, host).start()
         self.address = self._server.address
@@ -541,11 +551,33 @@ class NodeManager:
             entries = [("add", oid, size)
                        for oid, size in self._store_filtered_mirror()]
             if entries:
-                self._head.notify("object_batch", self.node_id, entries)
+                self._head_object_batch(entries)
             self._republish_needed = False
         except Exception as e:
             logger.debug("holder-set republish failed (will retry on "
                          "the next beat): %r", e)
+
+    def _head_object_batch(self, entries) -> None:
+        """The ONE sender of this node's object-directory frames to the
+        head (republish, owner-batch forward, pull landings all route
+        here): a single ordered stream per node means a head-side
+        add/remove inversion is impossible by construction — and under
+        RTPU_DEBUG_RPC the stream carries per-(node, head) sequence
+        stamps so the witness can prove it. Direct ``object_added`` /
+        ``object_removed`` notifies from this module are an outbox
+        bypass (the ``dist`` lint family flags them).
+
+        Stamp and send are atomic under one lock: heartbeat republish,
+        per-peer forward threads, and pull landings all call here, and
+        a seq assigned before losing the send race would put frames on
+        the wire in reverse order — a false inversion at the head (the
+        owner-side flusher holds _obj_notify_flush_lock across its
+        stamp+send for the same reason)."""
+        with self._head_batch_lock:
+            if _rpcdbg.enabled():
+                entries = _rpcdbg.stamp_outbox(f"node:{self.node_id}",
+                                               list(entries))
+            self._head.notify("object_batch", self.node_id, entries)
 
     def rpc_object_batch(self, conn, entries) -> bool:
         """Owner-side directory updates route THROUGH the node manager
@@ -554,6 +586,11 @@ class NodeManager:
         Entries are ("add", oid, size) / ("rm", oid, None) in submission
         order; forwarded to the head as one frame, same best-effort
         contract as before."""
+        if _rpcdbg.enabled():
+            # RTPU_DEBUG_RPC: assert the owner's outbox stream arrived
+            # in order (strips the sequence stamp).
+            entries = _rpcdbg.check_outbox(f"node:{self.node_id}",
+                                           entries)
         with self._dir_lock:
             for kind, oid, size in entries:
                 if kind == "add":
@@ -561,7 +598,7 @@ class NodeManager:
                 else:
                     self._local_objects.pop(oid, None)
         try:
-            self._head.notify("object_batch", self.node_id, entries)
+            self._head_object_batch(entries)
         except Exception as e:
             logger.debug("object_batch forward to head failed: %r", e)
         return True
@@ -1253,7 +1290,15 @@ class NodeManager:
         with self._lock:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
-                return False
+                # Re-delivered return of a lease already returned: ack
+                # True exactly like the first delivery (at-most-once —
+                # the RTPU_DEBUG_RPC duplicate audit holds this line).
+                return lease_id in self._returned_leases
+            self._returned_leases.add(lease_id)
+            self._returned_order.append(lease_id)
+            while len(self._returned_order) > 4096:
+                self._returned_leases.discard(
+                    self._returned_order.popleft())
             if lease.blocked == 0:  # blocked leases already released
                 self._release_resources(lease)
             w = lease.worker
@@ -1572,8 +1617,11 @@ class NodeManager:
         if multi_source:
             _metrics.PULLS_MULTI_SOURCE.inc()
         try:
-            self._head.notify("object_added", oid.binary(), self.node_id,
-                              total)
+            # Through the node's single ordered directory stream — a
+            # direct object_added here could overtake a still-queued
+            # forwarded removal of the same oid at the head (the PR 4
+            # outbox-bypass inversion, node-side edition).
+            self._head_object_batch([("add", oid.binary(), total)])
         except Exception:
             pass
         if pull_rec is not None:

@@ -40,16 +40,40 @@ rpc_chaos.h's scripted failures):
   drop/delay/sever/kill actions. Targeted rules may hit ANY method —
   including non-retry-safe ones, deliberately.
 
-Retry-safety contract (what RETRY_SAFE_RPCS asserts): the method is
-either read-only, idempotent by design (dedup keys: `request_lease`
-req_id, `register_actor` actor_id, `create_pg` pg_id, worker-side task
-dedup for `push_tasks`/`push_actor_batch`, seq horizon for actor calls),
-or its caller drives it through `retrying_call`/an acked-retry loop
-(`heartbeat` NACK+resync, `kill_actor` re-ack, completion flusher for
-`task_done`/`batch_done`). Everything else — one-way notifies whose loss
-is tolerated-by-pinning (`add_borrowers`), availability nudges
-(`worker_blocked`/`worker_unblocked`), observability flushes — must not
-be blindly dropped.
+Retry-safety contract — ENFORCED, not advisory: every ``rpc_*`` handler
+in the tree must appear in exactly one of the classification sets below
+(the ``dist`` rtpu-lint family's ``unclassified-rpc-handler`` rule fails
+on any handler in neither, and the ``RTPU_DEBUG_RPC=1`` runtime witness
+in ``devtools/rpc_debug.py`` fails loudly on any *dispatched* method it
+cannot classify):
+
+- ``READONLY_RPCS``: pure queries. Safe to drop blindly (callers retry
+  or poll) and trivially safe to re-deliver; responses may legitimately
+  differ across calls (stats move), so the duplicate-delivery audit
+  skips them.
+- ``IDEMPOTENT_RPCS``: mutating, but at-most-once by design — a dedup
+  key (`request_lease` req_id, `register_actor` actor_id, `create_pg`
+  pg_id, worker-side task dedup for `push_tasks`/`push_actor_batch`,
+  seq horizon for actor calls) or a state check makes a re-delivered
+  request a no-op returning the SAME response. This is the set the
+  RTPU_DEBUG_RPC witness audits by double-delivering requests and
+  asserting response equivalence — ROADMAP item 3's WAL replay /
+  re-delivery semantics lean on exactly this property.
+- ``ACKED_RETRY_RPCS``: safe to retry because the caller drives an
+  acked-retry loop with explicit loss handling (`heartbeat`
+  NACK+resync, `kill_actor` re-ack, completion flusher for
+  `task_done`/`batch_done`) even though a duplicate may observably
+  differ (`new_job_id` burns an id per delivery — callers use one).
+- ``NON_RETRYABLE_RPCS``: everything else, DECLARED — one-way notifies
+  whose loss is tolerated-by-pinning (`add_borrowers`), availability
+  nudges (`worker_blocked`/`worker_unblocked`), outbox-ordered
+  directory frames (`object_batch`), observability flushes, and the
+  client-gateway session surface (no caller-side retry loop exists).
+  Must never be blindly dropped or re-delivered.
+
+``RETRY_SAFE_RPCS`` (the blind-drop + retrying_call gate) is the union
+of the first three. Forgetting to classify a new handler is a lint
+failure, not a review catch.
 """
 
 from __future__ import annotations
@@ -67,6 +91,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.serialization import SERIALIZER
 from ray_tpu.devtools import chaos as _chaos
+from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.chaos import chaos_enabled as _chaos_enabled
 from ray_tpu.devtools.lock_debug import make_lock
 from ray_tpu.util import flight_recorder as _flight
@@ -262,10 +287,10 @@ def _recv_msg(sock: socket.socket, sink_for: Optional[Callable] = None
         return None
 
 
-#: Methods safe for BLIND probabilistic drops (see module docstring for
-#: the contract). Grouped by why a lost frame is recovered.
-RETRY_SAFE_RPCS = frozenset({
-    # read-only queries (retrying_call or poll loops at every caller)
+#: Pure queries: blind-droppable (callers retry or poll), re-delivery
+#: is harmless, but responses may differ call-to-call (stats move, time
+#: passes) so the duplicate-delivery audit does not compare them.
+READONLY_RPCS = frozenset({
     "ping", "list_nodes", "list_actors", "list_leases", "list_task_events",
     "cluster_resources", "cluster_leases", "get_actor_info",
     "get_named_actor", "get_trace", "trace_tail", "trace_stats",
@@ -274,16 +299,64 @@ RETRY_SAFE_RPCS = frozenset({
     "kv_get", "kv_keys", "get_demand", "has_object", "store_stats",
     "pull_stats", "wait_object", "wait_objects", "get_object",
     "stream_consumed", "wait_actor_address",
-    # idempotent by dedup key / state check
+    # chunk serving is a pure read of a sealed object (the pull
+    # manager's fan-out retries recover lost chunks)
+    "fetch_object",
+})
+
+#: At-most-once by dedup key / state check: a re-delivered request is a
+#: no-op returning the SAME response. The RTPU_DEBUG_RPC witness
+#: double-delivers these and asserts response equivalence — the audit
+#: that makes WAL replay (ROADMAP item 3) testable today.
+IDEMPOTENT_RPCS = frozenset({
     "register_node", "register_actor", "register_worker",
     "request_lease", "return_lease", "create_actor", "create_pg",
     "remove_pg", "reserve_bundle", "release_bundle", "mark_actor_host",
     "push_tasks", "push_actor_batch", "pull_object", "pull_direct",
-    "push_object", "fetch_object", "subscribe", "unsubscribe",
+    "push_object", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "drain_node",
-    # loop-retried with explicit loss handling
+})
+
+#: Caller-side acked-retry loops with explicit loss handling; a
+#: duplicate may observably differ (new_job_id burns an id) but the
+#: protocol tolerates it by construction.
+ACKED_RETRY_RPCS = frozenset({
     "heartbeat", "kill_actor", "actor_died", "worker_dead_at",
     "task_done", "actor_call_done", "batch_done", "new_job_id",
+})
+
+#: Methods safe for BLIND probabilistic drops (see module docstring for
+#: the full contract): the union of the three recovery groups above.
+RETRY_SAFE_RPCS = READONLY_RPCS | IDEMPOTENT_RPCS | ACKED_RETRY_RPCS
+
+#: Explicitly NOT retry-safe: one-way notifies whose loss is tolerated
+#: by design, ordering-sensitive outbox frames, observability flushes,
+#: and the client-gateway session surface. Declared so that "forgot to
+#: classify" is distinguishable from "classified as unsafe" — the dist
+#: lint family and the RTPU_DEBUG_RPC witness both fail on handlers in
+#: NEITHER set.
+NON_RETRYABLE_RPCS = frozenset({
+    # loss tolerated by transfer pins / periodic re-flush
+    "add_borrowers", "remove_borrower",
+    # best-effort recovery nudge (owner re-checks liveness itself)
+    "recover_object",
+    # availability nudges: a lost unblock self-corrects at lease return
+    "worker_blocked", "worker_unblocked",
+    # outbox-ordered object-directory frames: re-delivery or reordering
+    # inverts add/remove pairs (PR 4's round-2 bug) — they ride ONE
+    # batched outbox per process, never a retry loop
+    "object_added", "object_removed", "object_batch",
+    # observability / control flushes (best-effort by contract)
+    "trace_spans", "publish", "report_task_events", "report_backlog",
+    # cancellation: re-delivery could cancel a legitimately re-executed
+    # retry of the same task id
+    "cancel_task",
+    # client-gateway session surface: the remote driver has no
+    # caller-side retry loop, and session state (held refs, actor
+    # ownership) makes duplicates observable
+    "client_hello", "put", "get", "wait", "release", "hold",
+    "submit_task", "cancel", "client_create_actor", "submit_actor_task",
+    "get_actor", "nodes", "kv",
 })
 
 
@@ -468,6 +541,14 @@ class RpcServer:
             if _chaos_drop(method):
                 return  # request lost (blind mode, retry-safe only)
         fn = getattr(self.handler_obj, "rpc_" + method, None)
+        # RTPU_DEBUG_RPC witness (devtools/rpc_debug.py): when off this
+        # is one env lookup and ``audit`` stays None — the dispatch path
+        # is otherwise untouched (same contract as RTPU_DEBUG_JAX /
+        # RTPU_DEBUG_LOCKS). When on, every dispatched method must be
+        # classified, and idempotent requests are double-delivered with
+        # their responses compared (the at-most-once audit).
+        audit = _rpcdbg.dispatch_audit(method, self.handler_obj) \
+            if _rpcdbg.enabled() else None
 
         def run():
             t0 = time.monotonic() if _stats_on() else 0.0
@@ -475,7 +556,10 @@ class RpcServer:
             try:
                 if fn is None:
                     raise RpcError(f"no such rpc method: {method}")
-                result = fn(conn, *args)
+                if audit is not None:
+                    result = audit(fn, conn, args)
+                else:
+                    result = fn(conn, *args)
                 ok = True
             except BaseException as e:  # noqa: BLE001
                 result, ok = e, False

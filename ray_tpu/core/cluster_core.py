@@ -40,6 +40,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.serialization import SERIALIZER, capture_exception
 from ray_tpu.core.shm_store import ShmObjectExistsError, ShmStore
 from ray_tpu.core.task_spec import PlacementGroupSpec, pg_key_from_strategy
+from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock
 from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
                                       RpcServer, blocking_rpc)
@@ -635,6 +636,12 @@ class ClusterCore:
                     # frames and forwards them, so a restarted head can
                     # be rehydrated by the node (see NodeManager.
                     # _on_head_reregistered). Same best-effort contract.
+                    if _rpcdbg.enabled():
+                        # RTPU_DEBUG_RPC: per-sender sequence stamp so
+                        # the node can assert no frame reordering /
+                        # re-delivery (add/rm inversion witness).
+                        batch = _rpcdbg.stamp_outbox(self.owner_addr,
+                                                     batch)
                     self.node.notify("object_batch", batch)
                 except Exception:
                     return  # best-effort, like the old per-object notifies

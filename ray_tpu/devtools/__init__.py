@@ -11,13 +11,18 @@ conventions and TSan wiring:
 - ``python -m ray_tpu.devtools.lint``: AST-based, stdlib-only linter
   enforcing the declared invariants against a checked-in baseline
   (``lint_baseline.json``, sectioned per rule family) — legacy
-  violations are tracked-not-fatal, NEW violations fail the run. Two
-  rule families: ``concurrency`` (tables in ``invariants.py``) and
+  violations are tracked-not-fatal, NEW violations fail the run. Three
+  rule families: ``concurrency`` (tables in ``invariants.py``),
   ``jax`` (``jaxlint.py``: tracing-safety rules codified from the
   model path's post-review bugs — closure constant-folding into jit,
   donation-then-read, hot-path host syncs, unclamped
   dynamic_update_slice, Mosaic kernel shape rules, per-mesh RNG
-  re-init).
+  re-init), and ``dist`` (``distlint.py``: the distributed RPC
+  contract — every handler classified in ``protocol.py``'s
+  retry/idempotency sets, retrying_call only against retry-safe
+  methods, object-directory frames riding their batched outbox,
+  fan-out loops deadline-bounded on a monotonic clock, every server
+  class chaos-role-targetable).
 - ``lock_debug``: ``RTPU_DEBUG_LOCKS=1`` swaps the cluster core's lock
   creation for an ordering witness that records the per-thread lock
   acquisition graph, detects order cycles online, and reports
@@ -28,4 +33,9 @@ conventions and TSan wiring:
   fetches per tag (one-sync-per-chunk is assertable), and wires
   ``jax.transfer_guard`` around engine ticks
   (``RTPU_DEBUG_JAX_TRANSFER_GUARD=disallow``). Zero overhead off.
+- ``rpc_debug``: ``RTPU_DEBUG_RPC=1`` audits the RPC contract at
+  dispatch — unclassified methods fail loudly, idempotent requests are
+  delivered twice with responses compared (the at-most-once audit),
+  and outbox frames carry per-(sender, receiver) sequence checks that
+  catch add/remove inversions on arrival. Zero overhead off.
 """

@@ -22,7 +22,7 @@ worker/head/node processes inherit the env export)::
     keys (all optional):
       method=<glob>   rpc method name, fnmatch glob        (default *)
       role=<glob>     receiving process's role: head, node,
-                      worker, driver                        (default *)
+                      worker, driver, client                (default *)
       peer=<glob>     remote peer "ip:port" of the connection (default *;
                       colons inside a value are fine — a ':'-piece with
                       no '=' is folded into the preceding value)

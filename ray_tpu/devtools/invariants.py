@@ -298,3 +298,62 @@ RNG_SINGLE_INIT_MODULES = {"__graft_entry__", "bench"}
 #: expression, so ``with mesh_context(m)``, ``with mesh:`` and
 #: ``with use_abstract_mesh(...)`` all count.
 MESH_CONTEXT_MARKERS = ("mesh",)
+
+# ======================================================================
+# Distributed RPC-contract invariants (rule family "dist", distlint.py).
+#
+# Each table encodes a protocol bug shipped BY HAND in an earlier PR:
+# PR 4's round-2 review found a direct head notify overtaking the same
+# process's still-queued batched object_added (permanent stale
+# directory); PR 8's first cut of rpc_cluster_leases fanned out
+# serially and outran its caller's deadline on mid-death nodes, and its
+# retry windows were exhausted before a SIGKILLed head respawned; PRs
+# 8-10 each appended to RETRY_SAFE_RPCS as a review afterthought — or
+# forgot to.
+# ======================================================================
+
+#: Modules that own a BATCHED object-directory outbox, mapped to the
+#: only functions allowed to send directory frames on the wire. Any
+#: other ``notify``/``call`` of an OUTBOX_METHODS method from these
+#: modules bypasses the ordered stream — the frame can overtake (or be
+#: overtaken by) a still-queued add/remove of the same object.
+OUTBOX_OWNER_MODULES: dict[str, set[str]] = {
+    "ray_tpu.core.cluster_core": {"_flush_object_notifies"},
+    "ray_tpu.cluster.node_manager": {"_head_object_batch"},
+}
+#: Object-directory update methods that must ride the outbox stream.
+OUTBOX_METHODS = {"object_added", "object_removed", "object_batch"}
+
+#: Modules whose loops fan RPCs out per node / replica / worker. A
+#: SERIAL loop of blocking calls with only per-call timeouts has an
+#: unbounded total: N mid-death peers x one control timeout each
+#: outruns every caller's own deadline (the PR 8 cluster_leases bug).
+DIST_FANOUT_MODULES = {
+    "ray_tpu.cluster.head",
+    "ray_tpu.cluster.node_manager",
+    "ray_tpu.core.cluster_core",
+    "ray_tpu.cluster.worker_main",
+    "ray_tpu.serve._private.controller",
+    "ray_tpu.autoscaler.autoscaler",
+}
+#: Blocking client-call attribute names the fan-out rule looks for
+#: inside a loop body.
+FANOUT_RPC_ATTRS = {"call", "retrying_call", "call_into"}
+#: Concurrency evidence INSIDE the loop body: pipelined/async dispatch
+#: or per-item threads make a serial-total bound irrelevant.
+FANOUT_CONCURRENCY_ATTRS = {"call_async", "submit", "start"}
+FANOUT_THREAD_SUFFIXES = ("Thread",)
+
+#: Names that read as wall-clock deadline/timeout state for the
+#: wall-clock-deadline rule: ``time.time()`` feeding arithmetic or
+#: comparisons against one of these must be ``time.monotonic()`` (an
+#: NTP step mid-wait stretches or collapses the deadline). Plain
+#: timestamping (span starts, cross-process freshness stamps) is
+#: exempt — those NEED the epoch clock.
+WALLCLOCK_DEADLINE_NAME_RE = re.compile(
+    r"(deadline|timeout|timeout_s|expire|expiry|expires)", re.IGNORECASE)
+
+#: Base classes known (from their own module) to set ``chaos_role`` in
+#: ``__init__`` — AST analysis is per-file, so subclasses of these are
+#: exempt from missing-chaos-role.
+CHAOS_ROLE_BASES = {"ClusterCore", "WorkerRuntime"}

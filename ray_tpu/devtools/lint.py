@@ -35,8 +35,12 @@ A second rule family, ``jax`` (``jaxlint.py``), runs from the same CLI:
 JAX/XLA tracing-safety rules (closure-captured-array-into-jit,
 donation-then-read, host-sync-in-hot-path,
 unclamped-dynamic-update-slice, pallas-shape-rules,
-rng-reinit-per-mesh). ``--family {all,concurrency,jax}`` selects which
-families run (default: all).
+rng-reinit-per-mesh). A third, ``dist`` (``distlint.py``), enforces the
+distributed RPC contract (unclassified-rpc-handler, retry-unsafe-call,
+direct-notify-bypasses-outbox, serial-fanout-no-deadline,
+wall-clock-deadline, missing-chaos-role).
+``--family {all,concurrency,jax,dist}`` selects which families run
+(default: all).
 
 Baseline workflow: legacy findings live in ``lint_baseline.json``,
 sectioned per rule family with a per-family schema version
@@ -71,17 +75,24 @@ RULES = (
 
 #: Rule families: "concurrency" = the tables above (the original
 #: rtpu-lint rule set), "jax" = the tracing-safety family in
-#: ``jaxlint.py``. Each family versions its fingerprinting scheme
+#: ``jaxlint.py``, "dist" = the distributed RPC-contract family in
+#: ``distlint.py``. Each family versions its fingerprinting scheme
 #: independently (FAMILY_SCHEMA) so a rule rewrite in one family never
-#: invalidates the other's baseline section.
+#: invalidates the others' baseline sections.
 JAX_RULES = (
     "closure-captured-array-into-jit", "donation-then-read",
     "host-sync-in-hot-path", "unclamped-dynamic-update-slice",
     "pallas-shape-rules", "rng-reinit-per-mesh",
 )
-FAMILIES = ("concurrency", "jax")
-FAMILY_RULES = {"concurrency": RULES, "jax": JAX_RULES}
-FAMILY_SCHEMA = {"concurrency": 1, "jax": 1}
+DIST_RULES = (
+    "unclassified-rpc-handler", "retry-unsafe-call",
+    "direct-notify-bypasses-outbox", "serial-fanout-no-deadline",
+    "wall-clock-deadline", "missing-chaos-role",
+)
+FAMILIES = ("concurrency", "jax", "dist")
+FAMILY_RULES = {"concurrency": RULES, "jax": JAX_RULES,
+                "dist": DIST_RULES}
+FAMILY_SCHEMA = {"concurrency": 1, "jax": 1, "dist": 1}
 RULE_FAMILY = {rule: fam for fam, rules in FAMILY_RULES.items()
                for rule in rules}
 
@@ -655,8 +666,11 @@ def lint_paths(paths: List[str], root: str,
                families: Tuple[str, ...] = FAMILIES) -> List[Finding]:
     run_jax = "jax" in families
     run_conc = "concurrency" in families
+    run_dist = "dist" in families
     if run_jax:
         from ray_tpu.devtools import jaxlint  # deferred: jaxlint imports us
+    if run_dist:
+        from ray_tpu.devtools import distlint  # deferred: ditto
     findings: List[Finding] = []
     for path in iter_py_files(paths):
         try:
@@ -682,6 +696,9 @@ def lint_paths(paths: List[str], root: str,
             if run_jax:
                 rows.extend(jaxlint.lint_source(source, module, rel,
                                                 tree=tree))
+            if run_dist:
+                rows.extend(distlint.lint_source(source, module, rel,
+                                                 tree=tree))
         findings.extend(rows)  # both linters already emit rel paths
     return findings
 

@@ -131,8 +131,10 @@ class ServeController:
         on slow requests."""
 
         def drain_and_kill(r):
-            deadline = time.time() + drain_timeout_s
-            while time.time() < deadline:
+            # monotonic, not wall clock: an NTP step during the drain
+            # window would stretch or collapse the deadline.
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
                 try:
                     if self._ray.get(r.queue_len.remote(), timeout=5) == 0:
                         break
@@ -224,9 +226,9 @@ class ServeController:
             to_add = desired - current
             # Hysteresis: autoscaling changes at most once per 5s.
             if cfg.get("autoscaling_config") and to_add != 0:
-                if time.time() - d["last_scale"] < 5.0:
+                if time.monotonic() - d["last_scale"] < 5.0:
                     return
-                d["last_scale"] = time.time()
+                d["last_scale"] = time.monotonic()
             cls, args, kwargs = d["cls"], d["init_args"], d["init_kwargs"]
             res = dict(cfg.get("ray_actor_options", {}))
         if to_add > 0:

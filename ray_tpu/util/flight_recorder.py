@@ -109,7 +109,7 @@ def dump_payload(clock_offset_s: Optional[float] = None) -> Dict[str, Any]:
     """The RPC/dump-file payload: ring + enough identity to merge dumps
     from many processes (``scripts/trace_dump.py``). ``clock_offset_s``
     defaults to the process's registered estimate (set_clock_offset)."""
-    return {
+    payload = {
         "role": _role,
         "pid": os.getpid(),
         "node_id": _node_id,
@@ -118,6 +118,18 @@ def dump_payload(clock_offset_s: Optional[float] = None) -> Dict[str, Any]:
                            else _clock_offset_s),
         "events": snapshot(),
     }
+    # RTPU_DEBUG_RPC witness stats ride the flight dump: it is the one
+    # channel every process (head/node/worker) already serves, so a
+    # driver can aggregate cluster-wide duplicate-audit coverage and
+    # violation counts without a new RPC surface.
+    from ray_tpu.devtools import rpc_debug as _rpcdbg
+
+    if _rpcdbg.enabled():
+        payload["rpc_debug"] = {
+            "violations": len(_rpcdbg.violations()),
+            "dup_audits": sum(_rpcdbg.dup_audit_counts().values()),
+        }
+    return payload
 
 
 def dump_to_file(reason: str = "manual",

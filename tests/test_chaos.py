@@ -147,6 +147,9 @@ def test_plan_rearm_after_clear_resets_counters():
 
 class _EchoHandler:
     chaos_role = "node"
+    # Local classification (RTPU_DEBUG_RPC witness + dist lint): echo is
+    # a pure function, safe to retry/re-deliver.
+    extra_retry_safe_rpcs = frozenset({"echo"})
 
     def __init__(self):
         self.calls = 0

@@ -1,0 +1,416 @@
+"""dist-lint rule family: one positive + one negative fixture per rule,
+the two resurrected protocol-bug fixtures (PR 4 outbox bypass, PR 8
+serial fan-out), classification-set extraction, and the per-family
+baseline mechanics for the ``dist`` section.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+
+from ray_tpu.devtools import lint
+from ray_tpu.devtools.distlint import (_protocol_sets,
+                                       extract_classification_sets,
+                                       lint_source)
+
+CORE = "ray_tpu.core.cluster_core"       # declared outbox-owner module
+NODE = "ray_tpu.cluster.node_manager"    # declared outbox-owner module
+HEAD = "ray_tpu.cluster.head"            # declared fan-out module
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+#: Hermetic classification header prepended to handler fixtures so they
+#: do not depend on the repo's live protocol.py sets.
+SETS = (
+    "READONLY_RPCS = frozenset({'ping', 'list_nodes'})\n"
+    "IDEMPOTENT_RPCS = frozenset({'request_lease'})\n"
+    "ACKED_RETRY_RPCS = frozenset({'heartbeat'})\n"
+    "RETRY_SAFE_RPCS = READONLY_RPCS | IDEMPOTENT_RPCS | "
+    "ACKED_RETRY_RPCS\n"
+    "NON_RETRYABLE_RPCS = frozenset({'object_batch', 'trace_spans'})\n"
+)
+
+
+# ------------------------------------------------ unclassified-rpc-handler
+
+
+def test_unclassified_handler_flagged():
+    """The PRs 8-10 failure mode: a new handler lands with no entry in
+    either classification set — its retry semantics are undeclared."""
+    src = SETS + (
+        "class Server:\n"
+        "    chaos_role = 'node'\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n"
+        "    def rpc_mystery(self, conn):\n"
+        "        return 1\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["unclassified-rpc-handler"]
+    assert "'mystery'" in fs[0].message
+
+
+def test_fully_classified_class_clean():
+    src = SETS + (
+        "class Server:\n"
+        "    chaos_role = 'node'\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n"
+        "    def rpc_object_batch(self, conn, entries):\n"
+        "        return True\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_class_local_extra_declaration_honored():
+    """Servers outside the control plane (fixtures, plugins) declare
+    their methods on the class — same attrs the runtime witness reads."""
+    src = SETS + (
+        "class Echo:\n"
+        "    chaos_role = 'node'\n"
+        "    extra_retry_safe_rpcs = frozenset({'echo'})\n"
+        "    def rpc_echo(self, conn, x):\n"
+        "        return x\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_module_level_rpc_function_not_a_handler():
+    """util.state.rpc_event_stats is a plain function, not a served
+    handler — only methods on classes are classification-checked."""
+    src = SETS + (
+        "def rpc_event_stats():\n"
+        "    return {}\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_repo_protocol_sets_extracted():
+    """The static extractor resolves the real protocol.py tables,
+    including the union assignment."""
+    retry_safe, non_retryable = _protocol_sets()
+    assert "ping" in retry_safe and "request_lease" in retry_safe
+    assert "object_batch" in non_retryable
+    assert not (retry_safe & non_retryable)
+
+
+def test_set_extraction_resolves_unions():
+    tree = ast.parse(SETS)
+    sets = extract_classification_sets(tree)
+    assert sets["RETRY_SAFE_RPCS"] == {"ping", "list_nodes",
+                                       "request_lease", "heartbeat"}
+
+
+# ------------------------------------------------------ retry-unsafe-call
+
+
+def test_retry_unsafe_call_flagged():
+    src = SETS + (
+        "def flush(client):\n"
+        "    client.retrying_call('trace_spans', [], timeout=5)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["retry-unsafe-call"]
+    assert "'trace_spans'" in fs[0].message
+
+
+def test_retry_safe_call_clean():
+    src = SETS + (
+        "def probe(client):\n"
+        "    return client.retrying_call('list_nodes', timeout=5)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_retry_unsafe_conditional_name_resolved():
+    """A method name bound through a conditional is checked per arm."""
+    src = SETS + (
+        "def done(client, kind):\n"
+        "    method = 'heartbeat' if kind == 'a' else 'object_batch'\n"
+        "    client.retrying_call(method, timeout=5)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["retry-unsafe-call"]
+    assert "'object_batch'" in fs[0].message  # the unsafe arm only
+
+
+# ------------------------------------------ direct-notify-bypasses-outbox
+
+
+def test_pr4_outbox_bypass_regression_caught():
+    """The EXACT PR 4 round-2 bug shape: a dag-channel delete notified
+    the head DIRECTLY while the same process's object_added for that
+    oid was still queued in the batched outbox — the remove overtook
+    the add and the directory entry went permanently stale."""
+    src = (
+        "class Core:\n"
+        "    def delete_channel_obj(self, oid):\n"
+        "        self.head.notify('object_removed', oid, self.node_id)\n")
+    fs = lint_source(src, CORE, "cluster_core.py")
+    assert rules(fs) == ["direct-notify-bypasses-outbox"]
+    assert "object_removed" in fs[0].message
+
+
+def test_designated_outbox_sender_clean():
+    src = (
+        "class Core:\n"
+        "    def _flush_object_notifies(self):\n"
+        "        self.node.notify('object_batch', [])\n")
+    assert lint_source(src, CORE, "cluster_core.py") == []
+
+
+def test_node_manager_single_sender_enforced():
+    src = (
+        "class NodeManager:\n"
+        "    def _head_object_batch(self, entries):\n"
+        "        self._head.notify('object_batch', self.node_id, entries)\n"
+        "    def _on_pull_landed(self, oid, total):\n"
+        "        self._head.notify('object_added', oid, self.node_id,\n"
+        "                          total)\n")
+    fs = lint_source(src, NODE, "node_manager.py")
+    assert rules(fs) == ["direct-notify-bypasses-outbox"]
+
+
+def test_outbox_rule_scoped_to_owner_modules():
+    """A module without a batched outbox may notify directly."""
+    src = (
+        "class Other:\n"
+        "    def report(self):\n"
+        "        self.head.notify('object_added', b'x', 'n1', 4)\n")
+    assert lint_source(src, "ray_tpu.dag.other", "other.py") == []
+
+
+# ------------------------------------------- serial-fanout-no-deadline
+
+
+def test_pr8_serial_fanout_regression_caught():
+    """The EXACT PR 8 bug shape: rpc_cluster_leases fanned out to every
+    node SERIALLY, each call paying a full control timeout against a
+    mid-death node, so the census outran its caller's own deadline on
+    every attempt. Note the except CONTINUES to the next node — the
+    loop keeps paying."""
+    src = (
+        "class Head:\n"
+        "    def rpc_cluster_leases(self, conn):\n"
+        "        results = {}\n"
+        "        for node_id, address in self._node_list():\n"
+        "            try:\n"
+        "                results[node_id] = self._pool.get(address).call(\n"
+        "                    'list_leases', timeout=5)\n"
+        "            except Exception as e:\n"
+        "                results[node_id] = {'error': repr(e)}\n"
+        "        return results\n")
+    fs = lint_source(src, HEAD, "head.py")
+    assert rules(fs) == ["serial-fanout-no-deadline",
+                         "unclassified-rpc-handler"] or \
+        "serial-fanout-no-deadline" in rules(fs)
+
+
+def test_fanout_with_total_deadline_clean():
+    src = (
+        "import time\n"
+        "class Head:\n"
+        "    def census(self):\n"
+        "        deadline = time.monotonic() + 10.0\n"
+        "        for node_id, address in self._node_list():\n"
+        "            remaining = deadline - time.monotonic()\n"
+        "            if remaining <= 0:\n"
+        "                break\n"
+        "            self._pool.get(address).call('list_leases',\n"
+        "                                         timeout=remaining)\n")
+    assert lint_source(src, HEAD, "head.py") == []
+
+
+def test_concurrent_fanout_clean():
+    src = (
+        "import threading\n"
+        "class Head:\n"
+        "    def census(self, nodes):\n"
+        "        for na in nodes:\n"
+        "            threading.Thread(target=self._one, args=na,\n"
+        "                             daemon=True).start()\n"
+        "    def _one(self, node_id, address):\n"
+        "        self._pool.get(address).call('list_leases', timeout=5)\n")
+    assert lint_source(src, HEAD, "head.py") == []
+
+
+def test_bounded_range_loop_clean():
+    src = (
+        "class Core:\n"
+        "    def grant(self, client):\n"
+        "        for hop in range(4):\n"
+        "            client.call('pick_node', timeout=10)\n")
+    assert lint_source(src, CORE, "cluster_core.py") == []
+
+
+def test_escape_on_failure_poll_clean():
+    """A single-peer poll loop whose except handler EXITS the loop
+    cannot keep paying timeouts — not the fan-out shape."""
+    src = (
+        "class W:\n"
+        "    def wait_consumed(self, owner, tid):\n"
+        "        while self._gated(tid):\n"
+        "            try:\n"
+        "                c = self._pool.get(owner).call('stream_consumed',\n"
+        "                                               tid, timeout=10)\n"
+        "            except Exception:\n"
+        "                break\n"
+        "            self._note(c)\n")
+    assert lint_source(src, "ray_tpu.cluster.worker_main",
+                       "worker_main.py") == []
+
+
+def test_fanout_rule_scoped_to_dist_modules():
+    src = (
+        "class T:\n"
+        "    def sweep(self, peers):\n"
+        "        for p in peers:\n"
+        "            p.call('anything', timeout=5)\n")
+    assert lint_source(src, "ray_tpu.tune.runner", "runner.py") == []
+
+
+# ---------------------------------------------------- wall-clock-deadline
+
+
+def test_wall_clock_deadline_flagged():
+    src = (
+        "import time\n"
+        "def drain(drain_timeout_s):\n"
+        "    deadline = time.time() + drain_timeout_s\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["wall-clock-deadline"]
+    assert len(fs) == 2  # the assignment AND the comparison
+
+
+def test_monotonic_deadline_clean():
+    src = (
+        "import time\n"
+        "def drain(drain_timeout_s):\n"
+        "    deadline = time.monotonic() + drain_timeout_s\n"
+        "    while time.monotonic() < deadline:\n"
+        "        pass\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_plain_timestamping_exempt():
+    """Span starts and cross-process freshness stamps NEED the epoch
+    clock — bare reads and duration math on non-deadline names are not
+    findings."""
+    src = (
+        "import time\n"
+        "def span(emit, t_start):\n"
+        "    t0 = time.time()\n"
+        "    emit('serve.route', t0, time.time())\n"
+        "    dur = time.time() - t_start\n"
+        "    return dur\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_wall_clock_suppression_honored():
+    src = (
+        "import time\n"
+        "def probe(timeout_s):\n"
+        "    deadline = time.time() + timeout_s  # rtpu-lint: disable=wall-clock-deadline\n"
+        "    return deadline\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# ----------------------------------------------------- missing-chaos-role
+
+
+def test_missing_chaos_role_flagged():
+    src = SETS + (
+        "class Server:\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["missing-chaos-role"]
+    assert "Server" in fs[0].message
+
+
+def test_class_attr_chaos_role_clean():
+    src = SETS + (
+        "class Server:\n"
+        "    chaos_role = 'head'\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_init_assigned_chaos_role_clean():
+    src = SETS + (
+        "class Server:\n"
+        "    def __init__(self, is_driver):\n"
+        "        self.chaos_role = 'driver' if is_driver else 'worker'\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_known_role_base_exempt():
+    src = SETS + (
+        "class WorkerRuntime(ClusterCore):\n"
+        "    def rpc_ping(self, conn):\n"
+        "        return 'pong'\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_non_server_class_needs_no_role():
+    src = SETS + (
+        "class Plain:\n"
+        "    def ping(self):\n"
+        "        return 'pong'\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# ------------------------------------------------------ family mechanics
+
+
+def test_dist_family_registered():
+    assert "dist" in lint.FAMILIES
+    assert lint.FAMILY_RULES["dist"] == lint.DIST_RULES
+    for rule in lint.DIST_RULES:
+        assert lint.RULE_FAMILY[rule] == "dist"
+
+
+def test_partial_dist_write_preserves_other_families(tmp_path):
+    """--family dist --write-baseline must carry the concurrency and
+    jax sections over verbatim (the PR 5/7 partial-rewrite hazard,
+    per-family edition)."""
+    path = tmp_path / "baseline.json"
+    conc = lint.Finding("swallowed-exception", "a.py", 3, "f", "m1")
+    jax = lint.Finding("pallas-shape-rules", "b.py", 4, "g", "m2")
+    lint.write_baseline(str(path), [conc, jax])
+    dist = lint.Finding("wall-clock-deadline", "c.py", 5, "h", "m3")
+    lint.write_baseline(str(path), [dist], families=("dist",))
+    data = json.loads(path.read_text())
+    assert conc.fingerprint() in data["families"]["concurrency"]["findings"]
+    assert jax.fingerprint() in data["families"]["jax"]["findings"]
+    assert dist.fingerprint() in data["families"]["dist"]["findings"]
+    # And a dist-only rewrite with no findings empties ONLY dist.
+    lint.write_baseline(str(path), [], families=("dist",))
+    data = json.loads(path.read_text())
+    assert data["families"]["dist"]["findings"] == {}
+    assert conc.fingerprint() in data["families"]["concurrency"]["findings"]
+
+
+def test_cli_dist_family_selection(tmp_path):
+    """--family dist runs only the dist rules over the given paths."""
+    src = SETS + (
+        "class Server:\n"
+        "    def rpc_mystery(self, conn):\n"
+        "        return 1\n"
+        "    def close(self):\n"
+        "        try:\n"
+        "            self.sock_a.close()\n"
+        "        except Exception:\n"
+        "            pass\n")
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    b = tmp_path / "empty.json"
+    b.write_text("{}")
+    rc = lint.run([str(p), "--baseline", str(b), "--family", "dist"])
+    assert rc == 1  # unclassified handler + missing chaos role
+    findings = lint.lint_paths([str(p)], str(tmp_path),
+                               families=("dist",))
+    assert set(rules(findings)) == {"unclassified-rpc-handler",
+                                    "missing-chaos-role"}

@@ -473,8 +473,11 @@ def test_cli_family_selection(tmp_path):
 
 
 def test_rule_family_map_is_total():
-    assert set(lint.RULE_FAMILY) == set(lint.RULES) | set(lint.JAX_RULES)
+    assert set(lint.RULE_FAMILY) == (set(lint.RULES) | set(lint.JAX_RULES)
+                                     | set(lint.DIST_RULES))
     for rule in lint.RULES:
         assert lint.RULE_FAMILY[rule] == "concurrency"
     for rule in lint.JAX_RULES:
         assert lint.RULE_FAMILY[rule] == "jax"
+    for rule in lint.DIST_RULES:
+        assert lint.RULE_FAMILY[rule] == "dist"

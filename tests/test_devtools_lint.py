@@ -724,11 +724,11 @@ def test_repo_baseline_file_checked_in():
     data = json.load(open(DEFAULT_BASELINE))
     assert data["version"] == 2
     fams = data["families"]
-    # Both rule families have a section with a schema version; the
-    # concurrency section carries the legacy debt, the jax section
-    # starts (and should stay) empty — new jax findings are fixed or
-    # allow-commented, not baselined.
-    assert set(fams) == {"concurrency", "jax"}
+    # Every rule family has a section with a schema version; the
+    # concurrency section carries the legacy debt, the jax and dist
+    # sections start (and should stay) empty — their findings are fixed
+    # or allow-commented, not baselined.
+    assert set(fams) == {"concurrency", "jax", "dist"}
     for sec in fams.values():
         assert isinstance(sec["schema"], int)
     assert fams["concurrency"]["findings"]
