@@ -53,9 +53,13 @@ Rows:
   submission — an actor creation — completes against the respawned
   head), ``object_reconstruction_s`` (the only holder of a task output
   is SIGKILLed; time for ``get()`` to complete via lineage
-  re-execution), and ``leaked_leases`` (the post-drain open-lease census
-  over every node, which must be 0). Needs a loadable native store lib
-  like the dataplane suite.
+  re-execution), ``leaked_leases`` (the post-drain open-lease census
+  over every node, which must be 0), and ``leaked_resources`` (the
+  RTPU_DEBUG_RES cluster-wide acquire/release balance — BufferLease
+  pins, node lease-table entries, KV reservations — aggregated over
+  dump_flight, which must also be 0; the child always runs under
+  RTPU_DEBUG_RPC=1 + RTPU_DEBUG_RES=1). Needs a loadable native store
+  lib like the dataplane suite.
 - dataplane — multi-writer object-plane suite (``--dataplane`` runs it
   standalone): K-process concurrent large puts through one sharded shm
   store (``single_put_gbps``, ``multi_put_gbps``, ``put_scaling_ratio``
@@ -1524,6 +1528,46 @@ def chaos_child_main() -> None:
     }
     if census_errors:
         row["census_error"] = census_errors[0]
+    _witness_log_hits: dict = {}
+
+    def _log_witness_hits(marker: bytes, fresh: bool = False) -> int:
+        """Count witness lines across this session's process logs (read
+        BEFORE shutdown — the session log dir is restored after it).
+        Both witness markers are counted in ONE pass over the logs and
+        memoized — the chaos child always runs with both flags on, and
+        re-reading every worker log per marker doubles teardown I/O for
+        nothing. ``fresh=True`` re-scans: the res verdict runs AFTER an
+        up-to-20s settle window, and a late imbalance line (a worker's
+        engine-close report — workers are not in the dump_flight poll
+        set) must not hide behind a pre-settle snapshot."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as _gcfg
+
+        markers = (b"RTPU_DEBUG_RPC:", b"RTPU_DEBUG_RES:")
+        if fresh or not _witness_log_hits:
+            _witness_log_hits.clear()
+            _witness_log_hits.update({m: 0 for m in markers})
+            try:
+                for fn in _os.listdir(_gcfg.log_dir):
+                    p = _os.path.join(_gcfg.log_dir, fn)
+                    if _os.path.isfile(p):
+                        with open(p, "rb") as fh:
+                            data = fh.read()
+                        for m in markers:
+                            _witness_log_hits[m] += data.count(m)
+            except OSError:
+                pass
+        return _witness_log_hits.get(marker, 0)
+
+    def _poll_flight_payloads() -> list:
+        """dump_flight payloads from the head + every alive node (the
+        one RPC every process serves — both witnesses ride it)."""
+        peers = [runtime.head.call("dump_flight", timeout=10)]
+        for nv in runtime.head.call("list_nodes", timeout=10):
+            if nv.get("alive"):
+                peers.append(runtime._pool.get(nv["address"]).call(
+                    "dump_flight", timeout=10))
+        return peers
+
     if _os.environ.get("RTPU_DEBUG_RPC") == "1":
         # RPC-contract witness status: the whole recovery run executed
         # with duplicate delivery injected on every idempotent request
@@ -1532,18 +1576,9 @@ def chaos_child_main() -> None:
         # RTPU_DEBUG_RPC: lines across this session's head/node/worker
         # logs (read BEFORE shutdown — the session log dir is restored
         # after it).
-        from ray_tpu.core.config import GLOBAL_CONFIG as _gcfg
         from ray_tpu.devtools import rpc_debug as _rpcdbg
 
-        log_hits = 0
-        try:
-            for fn in _os.listdir(_gcfg.log_dir):
-                p = _os.path.join(_gcfg.log_dir, fn)
-                if _os.path.isfile(p):
-                    with open(p, "rb") as fh:
-                        log_hits += fh.read().count(b"RTPU_DEBUG_RPC:")
-        except OSError:
-            pass
+        log_hits = _log_witness_hits(b"RTPU_DEBUG_RPC:")
         # Cluster-wide witness stats ride the flight-dump payloads (the
         # one RPC every process serves): aggregate the driver's own
         # registry with the head's and every alive node's, so the row
@@ -1551,12 +1586,7 @@ def chaos_child_main() -> None:
         viol = len(_rpcdbg.violations())
         dups = sum(_rpcdbg.dup_audit_counts().values())
         try:
-            peers = [runtime.head.call("dump_flight", timeout=10)]
-            for nv in runtime.head.call("list_nodes", timeout=10):
-                if nv.get("alive"):
-                    peers.append(runtime._pool.get(nv["address"]).call(
-                        "dump_flight", timeout=10))
-            for payload in peers:
+            for payload in _poll_flight_payloads():
                 rd = (payload or {}).get("rpc_debug") or {}
                 viol += int(rd.get("violations", 0))
                 dups += int(rd.get("dup_audits", 0))
@@ -1571,6 +1601,67 @@ def chaos_child_main() -> None:
         row["rpc_witness_violations"] = viol
         row["rpc_witness_log_lines"] = log_hits
         row["rpc_dup_audits"] = dups
+    if _os.environ.get("RTPU_DEBUG_RES") == "1":
+        # Resource-lifetime witness verdict: after the workload drains,
+        # the CLUSTER-WIDE balance registries (driver + head + every
+        # alive node, over the same dump_flight channel) must show zero
+        # outstanding leak-kind resources — BufferLease pins, node
+        # lease-table entries, KV speculation reservations. Transient
+        # in-flight acquisitions settle within the retry window; a real
+        # leak (the PR 2/PR 8 shapes) never does.
+        from ray_tpu.devtools import res_debug as _resdbg
+
+        leaked = None
+        res_acquires = 0
+        peer_viol = 0
+        poll_error = None
+        res_deadline = time.monotonic() + 20
+        while time.monotonic() < res_deadline:
+            own = _resdbg.dump_payload()
+            leaked = own["leaked"]
+            res_acquires = sum(own["acquired"].values())
+            peer_viol = 0
+            poll_error = None
+            try:
+                for payload in _poll_flight_payloads():
+                    rd = (payload or {}).get("res_debug") or {}
+                    leaked += int(rd.get("leaked", 0))
+                    res_acquires += sum(
+                        (rd.get("acquired") or {}).values())
+                    # Peer violation counts ride the same payload: a
+                    # node/head check_balanced failure (e.g. a "thread"
+                    # imbalance, which is not a LEAK_KIND and never
+                    # shows in `leaked`) must not pass the verdict —
+                    # and the head's stdout is a PIPE, so its
+                    # RTPU_DEBUG_RES: lines never reach the log scan.
+                    peer_viol += int(rd.get("violations", 0))
+            except Exception as e:
+                # A transient poll failure (a node mid-respawn) is
+                # RETRIED until the deadline, like a nonzero leak; it
+                # neither passes a verdict built from partial data nor
+                # fails the run off one dropped frame. Only the LAST
+                # lap's outcome stands — incomplete = not clean, the
+                # same rule the lease census applies.
+                poll_error = repr(e)[:120]
+                leaked = None
+            if leaked == 0:
+                break
+            time.sleep(0.5)
+        if poll_error is not None:
+            row["res_witness_poll_error"] = poll_error
+        res_viol = len(_resdbg.violations()) + peer_viol
+        # Fresh scan AFTER the settle window: a worker's late
+        # RTPU_DEBUG_RES line is this verdict's only evidence channel.
+        res_log_hits = _log_witness_hits(b"RTPU_DEBUG_RES:",
+                                         fresh=True)
+        row["leaked_resources"] = leaked if leaked is not None else -1
+        # Coverage evidence, like rpc_dup_audits: a leaked_resources=0
+        # verdict over zero observed acquires would be vacuous.
+        row["res_acquires_audited"] = res_acquires
+        row["res_witness_clean"] = bool(leaked == 0 and res_viol == 0
+                                        and res_log_hits == 0)
+        row["res_witness_violations"] = res_viol
+        row["res_witness_log_lines"] = res_log_hits
     print(json.dumps(row), flush=True)
     rt.shutdown()
 
@@ -1581,9 +1672,13 @@ def _chaos_rows() -> list:
         # contract audit — duplicate delivery on idempotent methods,
         # outbox sequence checks, classification-hole refusal — and the
         # row records witness-clean status alongside the timings.
+        # RTPU_DEBUG_RES=1 alongside: the same run also audits resource
+        # lifetimes — every BufferLease pin, node lease grant, and KV
+        # reservation must settle (cluster-wide leaked_resources == 0).
         proc = _run(["--chaos-child"], CHAOS_TIMEOUT_S,
                     env_extra={"JAX_PLATFORMS": "cpu",
-                               "RTPU_DEBUG_RPC": "1"})
+                               "RTPU_DEBUG_RPC": "1",
+                               "RTPU_DEBUG_RES": "1"})
     except subprocess.TimeoutExpired:
         return [{"metric": "chaos_recovery",
                  "error": f"timeout {CHAOS_TIMEOUT_S}s"}]
@@ -1610,6 +1705,8 @@ def chaos_main() -> int:
     clean = all("error" not in r and "census_error" not in r
                 and r.get("leaked_leases", 0) == 0
                 and r.get("rpc_witness_clean", True)
+                and r.get("leaked_resources", 0) == 0
+                and r.get("res_witness_clean", True)
                 for r in rows)
     return 0 if clean else 1
 
@@ -1624,7 +1721,9 @@ def _merge_chaos_rows(rows: list) -> dict:
         for k in ("head_recovery_s", "object_reconstruction_s",
                   "leaked_leases", "census_error", "rpc_witness_clean",
                   "rpc_witness_violations", "rpc_witness_log_lines",
-                  "rpc_dup_audits"):
+                  "rpc_dup_audits", "leaked_resources",
+                  "res_witness_clean", "res_witness_violations",
+                  "res_witness_log_lines", "res_acquires_audited"):
             if row.get(k) is not None:
                 merged[k] = row[k]
     return merged
@@ -1909,7 +2008,7 @@ def main() -> int:
         merged["dataplane_error"] = dp_merged["error"]
     ch_merged = _merge_chaos_rows(chaos_rows)
     for k in ("head_recovery_s", "object_reconstruction_s",
-              "leaked_leases"):
+              "leaked_leases", "leaked_resources"):
         if ch_merged.get(k) is not None:
             merged[k] = ch_merged[k]
     if "error" in ch_merged:

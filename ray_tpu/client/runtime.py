@@ -87,6 +87,7 @@ class ClientRuntime:
         self._flush_lock = threading.Lock()
         self._actor_classes: Dict[ActorID, Any] = {}
         self._shutdown = False
+        self._stop_event = threading.Event()
         info = self._conn.call("client_hello", 1, timeout=30)
         self.protocol_version = info["protocol_version"]
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True,
@@ -99,7 +100,9 @@ class ClientRuntime:
         from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
         while not self._shutdown:
-            time.sleep(cfg.client_ref_flush_period_s)
+            self._stop_event.wait(cfg.client_ref_flush_period_s)
+            if self._shutdown:
+                return
             self.flush_refs()
 
     def flush_refs(self) -> None:
@@ -322,8 +325,15 @@ class ClientRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        self._stop_event.set()  # wake the flusher out of its sleep
         try:
             self.flush_refs()
         except Exception:
             pass
+        # Ordered teardown: the flusher must not race flush_refs against
+        # the closing connection (it exits promptly — the stop event is
+        # set before the join).
+        if self._flusher.is_alive() and \
+                self._flusher is not threading.current_thread():
+            self._flusher.join(timeout=5.0)
         self._conn.close()

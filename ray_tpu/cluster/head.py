@@ -33,6 +33,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.task_spec import pg_key_from_strategy
 from ray_tpu.cluster.persistence import HeadStore
 from ray_tpu.cluster.protocol import ClientPool, RpcServer, blocking_rpc
+from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock, make_rlock
 from ray_tpu.util import flight_recorder as _flight
@@ -164,8 +165,9 @@ class HeadServer:
         self._server = RpcServer(self, host, port).start()
         self.address = self._server.address
         self._stop = threading.Event()
-        self._health_thread = threading.Thread(
-            target=self._health_loop, daemon=True, name="head-health")
+        self._health_thread = _resdbg.track_thread(threading.Thread(
+            target=self._health_loop, daemon=True, name="head-health"),
+            owner=self)
         self._health_thread.start()
 
     # -------------------------------------------------------- persistence
@@ -222,6 +224,10 @@ class HeadServer:
         self._pool.close_all()
         if self._store is not None:
             self._store.close()
+        # RTPU_DEBUG_RES: the health sweep must be gone after the join
+        # above (reports, never raises; witness off = one env read).
+        _resdbg.check_balanced("head.shutdown", kinds=("thread",),
+                               owner=self)
 
     # ------------------------------------------------------------- publish
 

@@ -37,6 +37,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.shm_store import ShmStore
 from ray_tpu.cluster.protocol import (ClientPool, RpcClient, RpcServer,
                                       blocking_rpc)
+from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock, make_rlock
 from ray_tpu.util import flight_recorder as _flight
@@ -665,6 +666,8 @@ class NodeManager:
                        addr=w.address or "")
         with self._lock:
             lease = self._leases.pop(w.lease_id, None) if w.lease_id else None
+            if lease is not None:
+                _resdbg.note_release("lease", lease.lease_id)
             if lease is not None and lease.blocked == 0:
                 self._release_resources(lease)
             # Reclaim leases this worker REQUESTED (nested submission):
@@ -674,6 +677,7 @@ class NodeManager:
                            if l.lessee == w.address]
                 for l in orphans:
                     self._leases.pop(l.lease_id, None)
+                    _resdbg.note_release("lease", l.lease_id)
                     if l.blocked == 0:
                         self._release_resources(l)
                     lw = l.worker
@@ -918,16 +922,21 @@ class NodeManager:
             env["RTPU_WORKER_ID"] = worker_id
             log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
         logf = open(log_path, "ab", buffering=0)
-        proc = subprocess.Popen(
-            [py, "-m", "ray_tpu.cluster.worker_main",
-             "--node-addr", self.address,
-             "--head-addr", self.head_addr,
-             "--node-id", self.node_id,
-             "--store-name", self.store_name,
-             "--worker-id", worker_id],
-            stdout=logf, stderr=logf, env=env,
-            cwd=spawn_cwd,
-        )
+        try:
+            proc = subprocess.Popen(
+                [py, "-m", "ray_tpu.cluster.worker_main",
+                 "--node-addr", self.address,
+                 "--head-addr", self.head_addr,
+                 "--node-id", self.node_id,
+                 "--store-name", self.store_name,
+                 "--worker-id", worker_id],
+                stdout=logf, stderr=logf, env=env,
+                cwd=spawn_cwd,
+            )
+        except BaseException:
+            logf.close()  # Popen failed: the log fd would leak per retry
+            raise
+        logf.close()  # the child holds its own dup of the log fd
         w = WorkerProc(proc, worker_id, tpu=tpu,
                        env_hash=runtime_env_hash(runtime_env))
         with self._lock:
@@ -1275,6 +1284,11 @@ class NodeManager:
         w.lease_id = lease_id
         with self._lock:
             self._leases[lease_id] = lease
+            # Registered under the SAME lock as the table insert: the
+            # death sweep pops (and note_release-s) under this lock, so
+            # an acquire landing after a racing release could otherwise
+            # mint a phantom permanently-open entry in the witness.
+            _resdbg.note_acquire("lease", key=lease_id, owner=self)
         _flight.record("lease_grant", lease=lease_id[:12],
                        worker=w.address, lessee=str(lessee)[:40])
         return w.address, lease_id
@@ -1288,6 +1302,7 @@ class NodeManager:
         _flight.record("lease_return", lease=lease_id[:12],
                        pooled=pool_worker)
         with self._lock:
+            _resdbg.note_release("lease", lease_id)
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 # Re-delivered return of a lease already returned: ack
@@ -1399,7 +1414,11 @@ class NodeManager:
                        for k, v in bundle.items() if v > 0):
                 return False
             for k, v in bundle.items():
-                self.available[k] = self.available.get(k, 0) - v
+                # Keyed by resource NAME (CPU/TPU/custom + PG bundle
+                # keys) — the key domain is the cluster's declared
+                # resource vocabulary, not per-request state; entries
+                # are overwritten in place, never accumulated.
+                self.available[k] = self.available.get(k, 0) - v  # rtpu-lint: disable=unbounded-registry-growth
             self._bundles[(pg_id, idx)] = dict(bundle)
             self._bundle_avail[(pg_id, idx)] = dict(bundle)
             self._avail_cond.notify_all()
@@ -1608,6 +1627,7 @@ class NodeManager:
         self.store.seal(oid)
         _flight.record("store_seal", oid=oid.hex()[:12], bytes=total,
                        via="pull")
+        _resdbg.note_event("store_seal")
         self._note_local_object(oid.binary(), total)
         with self._pull_lock:
             self.pull_stats["bytes_pulled"] += total

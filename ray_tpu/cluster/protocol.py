@@ -91,6 +91,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 from ray_tpu.core.serialization import SERIALIZER
 from ray_tpu.devtools import chaos as _chaos
+from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.chaos import chaos_enabled as _chaos_enabled
 from ray_tpu.devtools.lock_debug import make_lock
@@ -126,13 +127,21 @@ class BufferLease:
     """Wraps an RPC handler's result whose out-of-band buffers BORROW
     memory (e.g. pinned shm views): the payload is sent scatter-gather
     straight from the borrowed views — no ``bytes()`` staging copy — and
-    ``release`` runs once the frame is on the socket (or dropped)."""
+    ``release`` runs once the frame is on the socket (or dropped).
+
+    Under ``RTPU_DEBUG_RES=1`` every lease registers in the resource
+    witness's balance registry at construction and settles when its
+    release runs — a lease dropped on an error path (the PR 2
+    forever-pinned-borrow shape) stays outstanding in every
+    ``res_debug`` snapshot. Witness off: ``wrap_release`` returns the
+    callable untouched."""
 
     __slots__ = ("value", "_release")
 
     def __init__(self, value: Any, release: Callable):
         self.value = value
-        self._release = release
+        self._release = _resdbg.wrap_release("buffer_lease", release,
+                                             owner=self)
 
     def release(self) -> None:
         rel, self._release = self._release, None
@@ -821,8 +830,14 @@ class RpcClient:
         host, port = self.address.rsplit(":", 1)
         new_sock = socket.create_connection(
             (host, int(port)), timeout=cfg.rpc_connect_timeout_s)
-        new_sock.settimeout(None)
-        new_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            new_sock.settimeout(None)
+            new_sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except BaseException:
+            # Not yet published to self._sock: nobody else can ever
+            # close this fd — it would leak once per failed reconnect.
+            _shutdown_socket(new_sock)
+            raise
         with self._pending_lock:
             old = self._sock
             self._sock = new_sock  # supersede the old reader atomically

@@ -40,6 +40,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.serialization import SERIALIZER, capture_exception
 from ray_tpu.core.shm_store import ShmObjectExistsError, ShmStore
 from ray_tpu.core.task_spec import PlacementGroupSpec, pg_key_from_strategy
+from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.devtools import rpc_debug as _rpcdbg
 from ray_tpu.devtools.lock_debug import make_lock
 from ray_tpu.cluster.protocol import (ClientPool, ConnectionLost, RpcClient,
@@ -329,6 +330,14 @@ class ClusterCore:
             maxlen=cfg.recent_tasks_ring)
         self._actors: Dict[ActorID, _ActorConn] = {}
         self._actors_lock = make_lock("cluster_core._actors_lock")
+        # Bounded memo of RETIRED actors (dead conns dropped from
+        # _actors — which otherwise grew one _ActorConn per actor ever
+        # called, for the life of the driver): actor_id -> death
+        # reason, so a late call on a retired actor still fails fast
+        # with the real cause. Same shape/cap as the node's
+        # return-lease memo.
+        self._dead_actor_reasons: "_collections.OrderedDict" = \
+            _collections.OrderedDict()
         self._actor_classes: Dict[ActorID, Any] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupSpec] = {}
         # Cancelled task ids: consulted at (re)dispatch so a cancel issued
@@ -396,8 +405,9 @@ class ClusterCore:
                          name="obj-notify").start()
         threading.Thread(target=self._push_ack_loop, daemon=True,
                          name="push-acks").start()
-        self._lease_reaper = threading.Thread(
-            target=self._lease_reaper_loop, daemon=True, name="lease-reaper")
+        self._lease_reaper = _resdbg.track_thread(threading.Thread(
+            target=self._lease_reaper_loop, daemon=True,
+            name="lease-reaper"), owner=self)
         self._lease_reaper.start()
 
     # ------------------------------------------------------------------ refs
@@ -695,6 +705,7 @@ class ClusterCore:
         with self._obj_loc_lock:
             self._obj_locality.pop(oid.binary(), None)
         if self.store.delete(oid):
+            _resdbg.note_event("store_delete")
             self._queue_object_notify("rm", oid.binary())
 
     # ------------------------------------------------------------------ put/get
@@ -758,6 +769,7 @@ class ClusterCore:
             self.store.abort(oid)
             raise
         self.store.seal(oid)
+        _resdbg.note_event("store_seal")
         self._queue_object_notify("add", oid.binary(), total)
 
     def _read_plasma(self, oid: ObjectID, timeout: Optional[float],
@@ -2460,9 +2472,35 @@ class ClusterCore:
         with self._actors_lock:
             conn = self._actors.get(actor_id)
             if conn is None:
+                reason = self._dead_actor_reasons.get(actor_id)
+                if reason is not None:
+                    # Retired actor: hand back an EPHEMERAL dead conn
+                    # (not registered — registering would re-leak the
+                    # entry retirement just reclaimed). Callers fail
+                    # fast on conn.dead exactly as before.
+                    conn = _ActorConn(actor_id)
+                    conn.dead = True
+                    conn.death_reason = reason
+                    return conn
                 conn = _ActorConn(actor_id)
                 self._actors[actor_id] = conn
             return conn
+
+    def _retire_actor_conn(self, conn: _ActorConn) -> None:
+        """Drop a DEAD actor's conn from the registry. The _actors dict
+        held one _ActorConn (pending map, sender state, address) per
+        actor ever called, forever — the PR 8 lease-table shape on the
+        driver side. The bounded memo preserves the death reason for
+        late callers; beyond the cap the oldest retirement is forgotten
+        and a late call re-resolves against the head (which also
+        answers DEAD)."""
+        with self._actors_lock:
+            self._actors.pop(conn.actor_id, None)
+            memo = self._dead_actor_reasons
+            memo[conn.actor_id] = conn.death_reason or "actor died"
+            memo.move_to_end(conn.actor_id)
+            while len(memo) > 4096:
+                memo.popitem(last=False)
 
     def _resolve_actor_address(self, conn: _ActorConn,
                                timeout: float = 60.0) -> Optional[str]:
@@ -2490,6 +2528,13 @@ class ClusterCore:
             if state == "DEAD":
                 conn.dead = True
                 conn.death_reason = payload
+                # Retire here too: an actor first discovered dead at
+                # resolution (worker died before any conn existed, or a
+                # memo-evicted late call re-resolving) would otherwise
+                # park its conn in _actors forever — the exact leak
+                # retirement exists to close. The conn object stays
+                # valid for the caller failing its pending entries.
+                self._retire_actor_conn(conn)
                 return None
             # PENDING: keep waiting until our own deadline.
         return None
@@ -2702,6 +2747,8 @@ class ClusterCore:
             seqs = list(conn.pending)
         for seq in seqs:
             self._fail_actor_call(conn, seq)
+        if conn.dead:
+            self._retire_actor_conn(conn)
 
     def get_actor(self, name: str, namespace: str = "default") -> ActorID:
         found = self.head.retrying_call("get_named_actor", name, namespace, timeout=10)
@@ -2732,6 +2779,7 @@ class ClusterCore:
             seqs = list(conn.pending)
         for seq in seqs:
             self._fail_actor_call(conn, seq)
+        self._retire_actor_conn(conn)
 
     def list_actors(self):
         return self.head.retrying_call("list_actors", timeout=10)
@@ -2795,6 +2843,12 @@ class ClusterCore:
             self.store.close()
         except Exception:
             pass
+        # RTPU_DEBUG_RES balance assertion: this core's tracked threads
+        # must have exited by now (the reaper was joined above). The
+        # check reports (RTPU_DEBUG_RES: line + violations registry) and
+        # never blocks teardown; witness off = one env read.
+        _resdbg.check_balanced("cluster_core.shutdown", kinds=("thread",),
+                               owner=self)
         runtime_context.set_runtime(None)
 
 

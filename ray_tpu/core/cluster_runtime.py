@@ -33,15 +33,21 @@ def _spawn(args: List[str], log_name: str) -> subprocess.Popen:
 
     os.makedirs(cfg.log_dir, exist_ok=True)
     logf = open(os.path.join(cfg.log_dir, log_name), "ab", buffering=0)
-    env = spawn_env()  # child arms PDEATHSIG itself (see process_util:
-    # preexec_fn would force fork()-with-threads, the JAX deadlock class)
-    # Children must import ray_tpu from wherever the driver imported it
-    # (repo checkouts aren't pip-installed).
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.Popen(args, stdout=subprocess.PIPE, stderr=logf,
-                            env=env, cwd=os.getcwd())
+    try:
+        env = spawn_env()  # child arms PDEATHSIG itself (see process_util:
+        # preexec_fn would force fork()-with-threads, the JAX deadlock
+        # class). Children must import ray_tpu from wherever the driver
+        # imported it (repo checkouts aren't pip-installed).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(args, stdout=subprocess.PIPE, stderr=logf,
+                                env=env, cwd=os.getcwd())
+    except BaseException:
+        logf.close()  # Popen failed: nobody else will ever close the fd
+        raise
+    logf.close()  # the child holds its own dup; the parent's copy leaks
+    return proc
 
 
 def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float) -> Dict[str, str]:

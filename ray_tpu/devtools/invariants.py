@@ -357,3 +357,98 @@ WALLCLOCK_DEADLINE_NAME_RE = re.compile(
 #: ``__init__`` — AST analysis is per-file, so subclasses of these are
 #: exempt from missing-chaos-role.
 CHAOS_ROLE_BASES = {"ClusterCore", "WorkerRuntime"}
+
+# ======================================================================
+# Resource-lifetime invariants (rule family "res", reslint.py).
+#
+# The single most recurring post-review bug class across PRs 1-11:
+# PR 8's lease-table leak (head-driven creations' leases had no owner
+# to return them), PR 2's forever-pinned borrows (the release half of
+# the borrow protocol was simply missing), PR 4's dead-creator PENDING
+# placeholders and the leaking _local_objects mirror, unjoined daemon
+# threads re-fixed in three different PRs, and unbounded memo/registry
+# dicts (the PR 11 return-lease memo needed a hand-picked 4096 cap in
+# review). Each table below feeds a reslint rule; the runtime half is
+# devtools/res_debug.py (RTPU_DEBUG_RES=1).
+# ======================================================================
+
+#: Constructor names whose result is a RELEASABLE handle for the
+#: acquire-without-release rule (matched on the dotted call target's
+#: last component). ``BufferLease`` wraps pinned shm views — dropping
+#: one on an error path pins the arena slot forever (PR 2's borrow-pin
+#: shape).
+RES_ACQUIRE_CONSTRUCTORS = {"BufferLease"}
+
+#: Attribute-call names that acquire a releasable resource
+#: (``store.pin(...)``, ``buf.pin()``). Kept separate from the
+#: constructors so fixtures can exercise both shapes.
+RES_ACQUIRE_ATTRS = {"pin"}
+
+#: Attribute-call names that release a tracked resource. ``seal`` and
+#: ``abort`` resolve a store create; ``return_lease`` resolves a grant.
+RES_RELEASE_ATTRS = {"release", "close", "unpin", "free", "abort",
+                     "seal", "return_lease", "cancel"}
+
+#: Failure-arm cleanup evidence for the begin-without-commit rule: a
+#: handler that calls one of these attrs — or a same-class helper whose
+#: NAME matches RES_CLEANUP_NAME_RE — resolves the in-flight
+#: reservation (``_fail_roster`` releases every active slot, which
+#: clears the pending speculation).
+RES_COMMIT_ATTRS = {"commit_speculation", "release"}
+RES_CLEANUP_NAME_RE = re.compile(
+    r"(fail|abort|rollback|release|clean|reset|clear)", re.IGNORECASE)
+
+#: Modules whose classes hold long-lived registries fed by RPC handlers
+#: or daemon loops — the unbounded-registry-growth rule only scans
+#: these (a dataclass accumulating in a batch script is not the bug
+#: class; a server-side dict that grows per request forever is).
+RES_REGISTRY_MODULES = {
+    "ray_tpu.cluster.head",
+    "ray_tpu.cluster.node_manager",
+    "ray_tpu.cluster.worker_main",
+    "ray_tpu.cluster.protocol",
+    "ray_tpu.core.cluster_core",
+    "ray_tpu.serve._private.controller",
+    "ray_tpu.serve._private.router",
+    "ray_tpu.serve._private.proxy",
+    "ray_tpu.serve._private.slo",
+    "ray_tpu.devtools.rpc_debug",
+    "ray_tpu.devtools.res_debug",
+    "ray_tpu.util.tracing",
+    "ray_tpu.util.metrics",
+}
+
+#: Method-name heuristics for the registry rule: growth sites are RPC
+#: handlers and long-lived loops (plus same-class helpers they call);
+#: a method whose name matches the reaper RE counts as eviction
+#: evidence for every attr it touches.
+RES_LOOP_NAME_RE = re.compile(r"(_loop$|_forever$|_main$)")
+RES_REAPER_NAME_RE = re.compile(
+    r"(reap|evict|prune|sweep|expire|trim|clean|drain|gc|invalidate|"
+    r"remove|forget|scrub)", re.IGNORECASE)
+
+#: Attribute-call names that shrink a container (eviction evidence),
+#: checked class-wide on the same ``self.<attr>``.
+RES_EVICT_ATTRS = {"pop", "popleft", "popitem", "clear", "discard",
+                   "remove", "popright"}
+
+#: Thread-lifecycle rule: a class exposing one of these methods owns
+#: its threads' teardown; every daemon ``Thread``/``Timer`` attr must
+#: be joined/cancelled — or a stop-event set — somewhere REACHABLE from
+#: one of them through same-class helper calls (PR 5's daemon-no-join
+#: only required a join *somewhere in the class*; the lease-reaper
+#: regression showed the join has to be on the stop path to matter).
+RES_STOP_METHOD_NAMES = {"stop", "close", "shutdown", "__exit__",
+                         "__del__"}
+RES_STOP_EVENT_NAME_RE = re.compile(
+    r"(stop|shutdown|close|done|exit|quit)", re.IGNORECASE)
+
+#: fd-leak-on-error: calls that open an OS-level handle. Dotted-suffix
+#: match for the socket forms; exact Name match for builtins.
+RES_OPEN_CALL_SUFFIXES = {"socket.socket", "socket.create_connection",
+                          "socket.fromfd", "os.fdopen", "os.open"}
+RES_OPEN_NAME_CALLS = {"open"}
+#: Closing attrs for the fd rule (shutdown alone wakes readers but the
+#: fd still needs close; either counts as "handled" here — the
+#: close-without-shutdown rule owns the pairing).
+RES_CLOSE_ATTRS = {"close", "shutdown", "detach"}

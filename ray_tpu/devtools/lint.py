@@ -38,8 +38,12 @@ unclamped-dynamic-update-slice, pallas-shape-rules,
 rng-reinit-per-mesh). A third, ``dist`` (``distlint.py``), enforces the
 distributed RPC contract (unclassified-rpc-handler, retry-unsafe-call,
 direct-notify-bypasses-outbox, serial-fanout-no-deadline,
-wall-clock-deadline, missing-chaos-role).
-``--family {all,concurrency,jax,dist}`` selects which families run
+wall-clock-deadline, missing-chaos-role). A fourth, ``res``
+(``reslint.py``), enforces resource lifetimes (acquire-without-release,
+begin-without-commit, unbounded-registry-growth, thread-without-stop,
+fd-leak-on-error) with ``res_debug.py``'s RTPU_DEBUG_RES runtime
+witness as its dynamic half.
+``--family {all,concurrency,jax,dist,res}`` selects which families run
 (default: all).
 
 Baseline workflow: legacy findings live in ``lint_baseline.json``,
@@ -89,10 +93,15 @@ DIST_RULES = (
     "direct-notify-bypasses-outbox", "serial-fanout-no-deadline",
     "wall-clock-deadline", "missing-chaos-role",
 )
-FAMILIES = ("concurrency", "jax", "dist")
+RES_RULES = (
+    "acquire-without-release", "begin-without-commit",
+    "unbounded-registry-growth", "thread-without-stop",
+    "fd-leak-on-error",
+)
+FAMILIES = ("concurrency", "jax", "dist", "res")
 FAMILY_RULES = {"concurrency": RULES, "jax": JAX_RULES,
-                "dist": DIST_RULES}
-FAMILY_SCHEMA = {"concurrency": 1, "jax": 1, "dist": 1}
+                "dist": DIST_RULES, "res": RES_RULES}
+FAMILY_SCHEMA = {"concurrency": 1, "jax": 1, "dist": 1, "res": 1}
 RULE_FAMILY = {rule: fam for fam, rules in FAMILY_RULES.items()
                for rule in rules}
 
@@ -667,10 +676,13 @@ def lint_paths(paths: List[str], root: str,
     run_jax = "jax" in families
     run_conc = "concurrency" in families
     run_dist = "dist" in families
+    run_res = "res" in families
     if run_jax:
         from ray_tpu.devtools import jaxlint  # deferred: jaxlint imports us
     if run_dist:
         from ray_tpu.devtools import distlint  # deferred: ditto
+    if run_res:
+        from ray_tpu.devtools import reslint  # deferred: ditto
     findings: List[Finding] = []
     for path in iter_py_files(paths):
         try:
@@ -699,6 +711,9 @@ def lint_paths(paths: List[str], root: str,
             if run_dist:
                 rows.extend(distlint.lint_source(source, module, rel,
                                                  tree=tree))
+            if run_res:
+                rows.extend(reslint.lint_source(source, module, rel,
+                                                tree=tree))
         findings.extend(rows)  # both linters already emit rel paths
     return findings
 
@@ -839,12 +854,33 @@ def run(argv: Optional[List[str]] = None) -> int:
     findings = lint_paths(paths, root, families=families)
 
     if args.stats:
+        # One table: family / rule / current findings / baselined
+        # budget — the at-a-glance debt readout per family. Purely
+        # informational; the exit code below is unchanged by --stats.
         counts: Dict[str, int] = {}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1
+        base_counts: Dict[str, int] = {}
+        data = _read_baseline_json(args.baseline) or {}
+        sections = data.get("families", {})
+        if not sections and "findings" in data:  # v1 flat = concurrency
+            sections = {"concurrency": {"findings": data["findings"]}}
+        for section in sections.values():
+            for entry in section.get("findings", {}).values():
+                rule = entry.get("rule", "?")
+                base_counts[rule] = (base_counts.get(rule, 0)
+                                     + entry.get("count", 0))
+        print(f"{'family':12s} {'rule':36s} {'found':>6s} "
+              f"{'baseline':>9s}")
         for fam in families:
+            fam_found = fam_base = 0
             for rule in FAMILY_RULES[fam]:
-                print(f"{rule:36s} {counts.get(rule, 0)}")
+                n, b = counts.get(rule, 0), base_counts.get(rule, 0)
+                fam_found += n
+                fam_base += b
+                print(f"{fam:12s} {rule:36s} {n:6d} {b:9d}")
+            print(f"{fam:12s} {'TOTAL':36s} {fam_found:6d} "
+                  f"{fam_base:9d}")
 
     if args.write_baseline:
         if args.paths and (os.path.abspath(args.baseline)

@@ -323,10 +323,25 @@ def check_outbox(receiver: str, entries: list) -> list:
         _, sender, n = e
         inverted = False
         with _REGISTRY._mu:
-            last = _REGISTRY.recv_seq.get((sender, receiver))
+            # pop + reinsert = move-to-end: eviction below is LRU by
+            # last frame, not insertion order (a dict updated in place
+            # keeps its original position, so plain FIFO would evict
+            # the busiest LIVE streams — inserted at cluster start —
+            # while dead respawned senders survived).
+            last = _REGISTRY.recv_seq.pop((sender, receiver), None)
             if last is not None and n <= last:
                 inverted = True
             _REGISTRY.recv_seq[(sender, receiver)] = max(n, last or 0)
+            # Bounded: every respawned peer is a NEW sender (that is
+            # the point of the per-incarnation stream), so a long
+            # chaos run accretes dead-sender entries forever — the
+            # exact unbounded-registry-growth shape this repo's res
+            # lint family polices. Evict the least-recently-heard-from
+            # stream (a dead sender, by construction); losing its
+            # high-water mark can only relax a check, never fabricate
+            # a violation.
+            while len(_REGISTRY.recv_seq) > 4096:
+                _REGISTRY.recv_seq.pop(next(iter(_REGISTRY.recv_seq)))
         if inverted:
             _REGISTRY.note_violation(
                 "outbox-inversion",

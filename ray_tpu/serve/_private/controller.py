@@ -47,6 +47,12 @@ class ServeController:
         # period after they were measured — one RPC round of freshness,
         # no extra poll loop anywhere.
         self._load_gens: Dict[str, int] = {}
+        # One monotonic clock feeds BOTH version dicts: values are
+        # unique across names and time, so delete() can POP a
+        # deployment's entries (no per-name leak) without a later
+        # redeploy ever re-minting a version a parked router already
+        # saw (the != comparator would miss that change forever).
+        self._version_clock = 0
         # node_id -> (proxy actor, address); reconciled to one per node
         # when HTTP is enabled (reference: proxy_state.py ProxyStateManager).
         self._proxies: Dict[str, Any] = {}
@@ -61,7 +67,8 @@ class ServeController:
 
     def _bump_set(self, name: str) -> None:
         """Callers hold self._lock. Wakes every long-poller."""
-        self._set_versions[name] = self._set_versions.get(name, 0) + 1
+        self._version_clock += 1
+        self._set_versions[name] = self._version_clock
         self._set_cond.notify_all()
 
     # ------------------------------------------------------------- deploy
@@ -97,7 +104,15 @@ class ServeController:
         with self._lock:
             d = self._deployments.pop(name, None)
             if d is not None:
-                self._bump_set(name)
+                # Pop the version entries too — they were the per-name
+                # leak (one int pair per deployment name ever created).
+                # Parked long-pollers wake via notify_all, read the
+                # default version 0 (!= anything the unique clock ever
+                # minted), observe replicas=None, and re-park at 0; a
+                # redeploy mints a fresh clock value and wakes them.
+                self._set_versions.pop(name, None)
+                self._load_gens.pop(name, None)
+                self._set_cond.notify_all()
         if d:
             self._stop_replicas(d["replicas"])
         return d is not None
@@ -332,7 +347,8 @@ class ServeController:
                 d["loads"] = {r: s for r, s in loads.items()
                               if r in current}
                 d["loads_mono"] = time.monotonic()
-                self._load_gens[name] = self._load_gens.get(name, 0) + 1
+                self._version_clock += 1
+                self._load_gens[name] = self._version_clock
             self._set_cond.notify_all()
 
     def _check_replica_health(self) -> None:

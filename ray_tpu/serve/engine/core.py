@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ray_tpu.devtools import jax_debug
+from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.serve.engine.decode_loop import DecodeLoop
 from ray_tpu.serve.engine.drafter import PromptLookupDrafter, SpecControl
 from ray_tpu.serve.engine.kv_manager import KVCacheManager
@@ -129,8 +130,9 @@ class InferenceEngine:
 
         self._queue: "queue.Queue[EngineRequest]" = queue.Queue()
         self._shutdown = False
-        self._thread = threading.Thread(target=self._engine_loop,
-                                        daemon=True, name="llm-engine")
+        self._thread = _resdbg.track_thread(
+            threading.Thread(target=self._engine_loop, daemon=True,
+                             name="llm-engine"), owner=self)
         self._thread.start()
 
     # ------------------------------------------------------------- public
@@ -240,6 +242,16 @@ class InferenceEngine:
         if (self._thread.is_alive()
                 and self._thread is not threading.current_thread()):
             self._thread.join(timeout=60.0)
+        # RTPU_DEBUG_RES balance assertion: no in-flight KV speculation
+        # reservation may outlive the engine (commit_speculation or the
+        # slot's release settles each one), and the engine thread must
+        # have exited by the join above. Reports, never raises; witness
+        # off = one env read.
+        _resdbg.check_balanced("engine.close", kinds=("kv_spec",),
+                               owner=self.kv)
+        if self._thread is not threading.current_thread():
+            _resdbg.check_balanced("engine.close", kinds=("thread",),
+                                   owner=self)
 
     # ------------------------------------------------------------- engine
 

@@ -129,6 +129,14 @@ def dump_payload(clock_offset_s: Optional[float] = None) -> Dict[str, Any]:
             "violations": len(_rpcdbg.violations()),
             "dup_audits": sum(_rpcdbg.dup_audit_counts().values()),
         }
+    # RTPU_DEBUG_RES witness rides the same channel: the per-process
+    # acquire/release balance snapshot (outstanding leases / pins /
+    # reservations) lets the chaos bench aggregate a cluster-wide
+    # leaked_resources count over dump_flight.
+    from ray_tpu.devtools import res_debug as _resdbg
+
+    if _resdbg.enabled():
+        payload["res_debug"] = _resdbg.dump_payload()
     return payload
 
 

@@ -474,10 +474,13 @@ def test_cli_family_selection(tmp_path):
 
 def test_rule_family_map_is_total():
     assert set(lint.RULE_FAMILY) == (set(lint.RULES) | set(lint.JAX_RULES)
-                                     | set(lint.DIST_RULES))
+                                     | set(lint.DIST_RULES)
+                                     | set(lint.RES_RULES))
     for rule in lint.RULES:
         assert lint.RULE_FAMILY[rule] == "concurrency"
     for rule in lint.JAX_RULES:
         assert lint.RULE_FAMILY[rule] == "jax"
     for rule in lint.DIST_RULES:
         assert lint.RULE_FAMILY[rule] == "dist"
+    for rule in lint.RES_RULES:
+        assert lint.RULE_FAMILY[rule] == "res"

@@ -1,0 +1,459 @@
+"""res-lint rule family: positive + negative fixtures per rule, the two
+resurrected lifetime-bug fixtures (PR 2 borrow-pin, PR 8 lease-table),
+and the per-family baseline mechanics for the ``res`` section — the
+4-family matrix: a partial ``--family res --write-baseline`` must carry
+concurrency/jax/dist over verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.devtools import lint
+from ray_tpu.devtools.reslint import lint_source
+
+CORE = "ray_tpu.core.cluster_core"  # declared registry module
+OTHER = "some.batch.script"         # NOT a registry module
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------- acquire-without-release
+
+
+def test_acquire_never_released_flagged():
+    src = ("def f(view, rel):\n"
+           "    lease = BufferLease(view, rel)\n"
+           "    do_work()\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["acquire-without-release"]
+    assert "never" in fs[0].message
+
+
+def test_pr2_borrow_pin_success_path_only_flagged():
+    """The resurrected PR 2 shape: the pin IS released — but only on
+    the straight-line path. The exception path (the transfer that
+    failed) pinned the borrowed object forever."""
+    src = ("def send_borrowed(store, oid, conn):\n"
+           "    buf = store.pin(oid)\n"
+           "    conn.sendall(buf.view)\n"
+           "    buf.release()\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["acquire-without-release"]
+    assert "success path only" in fs[0].message
+
+
+def test_try_finally_release_clean():
+    src = ("def send_borrowed(store, oid, conn):\n"
+           "    buf = store.pin(oid)\n"
+           "    try:\n"
+           "        conn.sendall(buf.view)\n"
+           "    finally:\n"
+           "        buf.release()\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_with_and_enter_context_clean():
+    src = ("def f(store, oid, stack):\n"
+           "    with store.pin(oid) as buf:\n"
+           "        use(buf)\n"
+           "    h = store.pin(oid)\n"
+           "    stack.enter_context(h)\n"
+           "    use(h)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_ownership_escape_clean():
+    """Returned / stored / passed-onward handles transfer ownership —
+    the in-tree rpc_fetch_object shape (returns its BufferLease to the
+    response path, which releases once the frame is on the wire)."""
+    src = ("def fetch(view, rel):\n"
+           "    return BufferLease(view, rel)\n"
+           "def keep(self, view, rel):\n"
+           "    self._lease = BufferLease(view, rel)\n"
+           "def hand_off(view, rel, sink):\n"
+           "    lease = BufferLease(view, rel)\n"
+           "    sink.send(lease)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_discarded_acquire_flagged():
+    src = ("def f(view, rel):\n"
+           "    BufferLease(view, rel)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["acquire-without-release"]
+    assert "discarded" in fs[0].message
+
+
+def test_acquire_suppression_honored():
+    src = ("def f(view, rel):\n"
+           "    lease = BufferLease(view, rel)  "
+           "# rtpu-lint: disable=acquire-without-release\n"
+           "    do_work()\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# ------------------------------------------------- begin-without-commit
+
+
+def test_begin_no_failure_arm_flagged():
+    src = ("def tick(self):\n"
+           "    self.kv.begin_speculation(slot, 4)\n"
+           "    emits = self.loop.verify_chunk(tokens)\n"
+           "    self.kv.commit_speculation(slot, n)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["begin-without-commit"]
+    assert "no try" in fs[0].message
+
+
+def test_begin_handler_without_cleanup_flagged():
+    src = ("def tick(self):\n"
+           "    self.kv.begin_speculation(slot, 4)\n"
+           "    try:\n"
+           "        emits = self.loop.verify_chunk(tokens)\n"
+           "    except Exception as e:\n"
+           "        logger.warning('tick failed: %r', e)\n"
+           "        return\n"
+           "    self.kv.commit_speculation(slot, n)\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["begin-without-commit"]
+    assert "failure arm" in fs[0].message
+
+
+def test_begin_with_release_on_failure_clean():
+    src = ("def tick(self):\n"
+           "    self.kv.begin_speculation(slot, 4)\n"
+           "    try:\n"
+           "        emits = self.loop.verify_chunk(tokens)\n"
+           "    except Exception:\n"
+           "        self.kv.release(slot)\n"
+           "        return\n"
+           "    self.kv.commit_speculation(slot, n)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_begin_with_cleanup_helper_clean():
+    """The in-tree _spec_tick shape: the except arm routes through a
+    same-class cleanup helper (_fail_roster releases every slot)."""
+    src = ("def tick(self):\n"
+           "    self.kv.begin_speculation(slot, 4)\n"
+           "    try:\n"
+           "        emits = self.loop.verify_chunk(tokens)\n"
+           "    except BaseException as e:\n"
+           "        self._fail_roster(e)\n"
+           "        return\n"
+           "    self.kv.commit_speculation(slot, n)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# --------------------------------------------- unbounded-registry-growth
+
+
+def test_pr8_lease_table_shape_flagged():
+    """The resurrected PR 8 shape: leases granted from an RPC handler
+    into a dict nothing ever pops."""
+    src = ("class NodeLeases:\n"
+           "    def __init__(self):\n"
+           "        self._leases = {}\n"
+           "    def rpc_request_lease(self, conn, rid):\n"
+           "        lease = self._grant(rid)\n"
+           "        self._leases[rid] = lease\n"
+           "        return lease\n"
+           "    def _grant(self, rid):\n"
+           "        return object()\n")
+    fs = lint_source(src, CORE, "m.py")
+    assert rules(fs) == ["unbounded-registry-growth"]
+    assert "_leases" in fs[0].message
+
+
+def test_growth_via_helper_flagged():
+    """The PR 4 _local_objects shape: the handler grows the dict one
+    helper away."""
+    src = ("class Mirror:\n"
+           "    def rpc_object_added(self, conn, oid, size):\n"
+           "        self._note(oid, size)\n"
+           "    def _note(self, oid, size):\n"
+           "        self._local_objects[oid] = size\n")
+    fs = lint_source(src, CORE, "m.py")
+    assert rules(fs) == ["unbounded-registry-growth"]
+    assert "_local_objects" in fs[0].message
+
+
+def test_eviction_anywhere_in_class_clean():
+    src = ("class NodeLeases:\n"
+           "    def rpc_request_lease(self, conn, rid):\n"
+           "        self._leases[rid] = object()\n"
+           "        return rid\n"
+           "    def rpc_return_lease(self, conn, rid):\n"
+           "        self._leases.pop(rid, None)\n")
+    assert lint_source(src, CORE, "m.py") == []
+
+
+def test_maxlen_and_cap_check_clean():
+    src = ("import collections\n"
+           "class Memo:\n"
+           "    def __init__(self):\n"
+           "        self._order = collections.deque(maxlen=4096)\n"
+           "    def rpc_note(self, conn, x):\n"
+           "        self._order.append(x)\n"
+           "        self._seen[x] = 1\n"
+           "        if len(self._seen) > 4096:\n"
+           "            self._trim()\n")
+    assert lint_source(src, CORE, "m.py") == []
+
+
+def test_reaper_method_counts_as_evidence():
+    src = ("class Mirror:\n"
+           "    def rpc_object_added(self, conn, oid):\n"
+           "        self._mirror[oid] = 1\n"
+           "    def _reap_loop(self):\n"
+           "        self._mirror = self._store_filtered(self._mirror)\n")
+    assert lint_source(src, CORE, "m.py") == []
+
+
+def test_alias_drain_counts_as_evidence():
+    """The outbox shape: the loop drains through a local alias."""
+    src = ("class Outbox:\n"
+           "    def rpc_enqueue(self, conn, e):\n"
+           "        self._outbox.append(e)\n"
+           "    def _flush(self):\n"
+           "        outbox = self._outbox\n"
+           "        while outbox:\n"
+           "            outbox.popleft()\n")
+    assert lint_source(src, CORE, "m.py") == []
+
+
+def test_registry_rule_scoped_to_declared_modules():
+    src = ("class Accumulator:\n"
+           "    def rpc_add(self, conn, x):\n"
+           "        self._rows[x] = 1\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ------------------------------------------------- thread-without-stop
+
+
+def test_thread_not_joined_from_stop_flagged():
+    src = ("import threading\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n"
+           "    def stop(self):\n"
+           "        self._sock.close()\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["thread-without-stop"]
+    assert "_t" in fs[0].message
+
+
+def test_join_in_unrelated_method_still_flagged():
+    """Generalizes PR 5's daemon-no-join: a join the stop path never
+    reaches is teardown theater — daemon-no-join passes, this rule
+    does not."""
+    src = ("import threading\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n"
+           "    def debug_restart(self):\n"
+           "        self._t.join()\n"
+           "    def stop(self):\n"
+           "        pass\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["thread-without-stop"]
+
+
+def test_join_via_stop_helper_clean():
+    src = ("import threading\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n"
+           "    def stop(self):\n"
+           "        self._teardown()\n"
+           "    def _teardown(self):\n"
+           "        self._t.join(timeout=2.0)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_stop_event_set_clean():
+    src = ("import threading\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self._stop = threading.Event()\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n"
+           "    def shutdown(self):\n"
+           "        self._stop.set()\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_timer_cancelled_clean_and_uncancelled_flagged():
+    clean = ("import threading\n"
+             "class A:\n"
+             "    def __init__(self):\n"
+             "        self._timer = threading.Timer(5.0, self._fire)\n"
+             "    def close(self):\n"
+             "        self._timer.cancel()\n")
+    assert lint_source(clean, "m", "m.py") == []
+    leaky = ("import threading\n"
+             "class A:\n"
+             "    def __init__(self):\n"
+             "        self._timer = threading.Timer(5.0, self._fire)\n"
+             "    def close(self):\n"
+             "        pass\n")
+    assert rules(lint_source(leaky, "m", "m.py")) == \
+        ["thread-without-stop"]
+
+
+def test_class_without_stop_surface_skipped():
+    """No stop/close/shutdown at all: PR 5's daemon-no-join owns that
+    case (baselined debt); this rule polices classes that CLAIM a
+    teardown surface."""
+    src = ("import threading\n"
+           "class FireAndForget:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# --------------------------------------------------- fd-leak-on-error
+
+
+def test_socket_risky_then_stored_flagged():
+    src = ("import socket\n"
+           "def connect(self, addr):\n"
+           "    sock = socket.create_connection(addr)\n"
+           "    sock.setsockopt(1, 2, 3)\n"
+           "    self._sock = sock\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["fd-leak-on-error"]
+    assert "'sock'" in fs[0].message
+
+
+def test_guarded_open_clean():
+    """The fixed reconnect shape: risky setup inside a try whose
+    handler closes the fd and re-raises."""
+    src = ("import socket\n"
+           "def connect(self, addr):\n"
+           "    sock = socket.create_connection(addr)\n"
+           "    try:\n"
+           "        sock.setsockopt(1, 2, 3)\n"
+           "    except BaseException:\n"
+           "        sock.close()\n"
+           "        raise\n"
+           "    self._sock = sock\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_with_open_and_immediate_escape_clean():
+    src = ("def read(p):\n"
+           "    with open(p) as f:\n"
+           "        return f.read()\n"
+           "def make(p):\n"
+           "    f = open(p, 'ab')\n"
+           "    return f\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_straight_line_close_accepted():
+    """A local open that the same straight line closes is accepted:
+    the exception window exists but the close-site is visible — the
+    rule hunts handles that ESCAPE (stored/returned) past unguarded
+    raising calls, not every unguarded read."""
+    src = ("def read(p):\n"
+           "    f = open(p)\n"
+           "    data = f.read()\n"
+           "    f.close()\n"
+           "    return data\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_fd_suppression_honored():
+    src = ("import socket\n"
+           "def connect(self, addr):\n"
+           "    sock = socket.create_connection(addr)  "
+           "# rtpu-lint: disable=fd-leak-on-error\n"
+           "    sock.setsockopt(1, 2, 3)\n"
+           "    self._sock = sock\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+# ------------------------------------------------------ family mechanics
+
+
+def test_res_family_registered():
+    assert "res" in lint.FAMILIES
+    assert lint.FAMILY_RULES["res"] == lint.RES_RULES
+    for rule in lint.RES_RULES:
+        assert lint.RULE_FAMILY[rule] == "res"
+
+
+def test_partial_res_write_preserves_other_three_families(tmp_path):
+    """The 4-family matrix: --family res --write-baseline must carry
+    concurrency, jax, AND dist over verbatim (the PR 5/7/11
+    partial-rewrite hazard, fourth edition)."""
+    path = tmp_path / "baseline.json"
+    conc = lint.Finding("swallowed-exception", "a.py", 3, "f", "m1")
+    jax = lint.Finding("pallas-shape-rules", "b.py", 4, "g", "m2")
+    dist = lint.Finding("wall-clock-deadline", "c.py", 5, "h", "m3")
+    lint.write_baseline(str(path), [conc, jax, dist])
+    before = json.loads(path.read_text())
+    res = lint.Finding("acquire-without-release", "d.py", 6, "i", "m4")
+    lint.write_baseline(str(path), [res], families=("res",))
+    data = json.loads(path.read_text())
+    for fam in ("concurrency", "jax", "dist"):
+        assert data["families"][fam] == before["families"][fam]
+    assert res.fingerprint() in data["families"]["res"]["findings"]
+    # And a res-only rewrite with no findings empties ONLY res.
+    lint.write_baseline(str(path), [], families=("res",))
+    data = json.loads(path.read_text())
+    assert data["families"]["res"]["findings"] == {}
+    for fam in ("concurrency", "jax", "dist"):
+        assert data["families"][fam] == before["families"][fam]
+
+
+def test_cli_res_family_selection(tmp_path):
+    """--family res runs only the res rules over the given paths."""
+    src = ("import threading\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self._t = threading.Thread(target=self._loop,\n"
+           "                                   daemon=True)\n"
+           "    def stop(self):\n"
+           "        try:\n"
+           "            self.sock_a.close()\n"
+           "        except Exception:\n"
+           "            pass\n")
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    b = tmp_path / "empty.json"
+    b.write_text("{}")
+    rc = lint.run([str(p), "--baseline", str(b), "--family", "res"])
+    assert rc == 1  # thread-without-stop
+    findings = lint.lint_paths([str(p)], str(tmp_path),
+                               families=("res",))
+    assert rules(findings) == ["thread-without-stop"]
+    # The concurrency-family findings in the same source (swallowed
+    # except, close-without-shutdown) are NOT reported by a res run.
+    assert all(f.rule in lint.RES_RULES for f in findings)
+
+
+def test_stats_table_covers_all_four_families(capsys, tmp_path):
+    """--stats prints one family/rule/found/baseline table and leaves
+    the exit code untouched."""
+    b = tmp_path / "empty.json"
+    b.write_text("{}")
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    rc = lint.run([str(p), "--baseline", str(b), "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for fam in lint.FAMILIES:
+        assert fam in out
+    for rule in lint.RES_RULES:
+        assert rule in out
+    assert "TOTAL" in out

@@ -1,17 +1,19 @@
 """Tier-1 guard: the repo lints clean against its checked-in baseline,
-across ALL THREE rule families.
+across ALL FOUR rule families.
 
 A NEW violation of any codified invariant — concurrency family (lock
 order, blocking-under-lock, close-without-shutdown, banned jax<0.5 /
 dashboard APIs, swallowed exceptions, unjoined daemon threads), jax
 family (closure-captured-array-into-jit, donation-then-read,
 host-sync-in-hot-path, unclamped-dynamic-update-slice,
-pallas-shape-rules, rng-reinit-per-mesh), or dist family
+pallas-shape-rules, rng-reinit-per-mesh), dist family
 (unclassified-rpc-handler, retry-unsafe-call,
 direct-notify-bypasses-outbox, serial-fanout-no-deadline,
-wall-clock-deadline, missing-chaos-role) — fails this test, the same
-check `python -m ray_tpu.devtools.lint` runs standalone. After an
-intentional change, regenerate with
+wall-clock-deadline, missing-chaos-role), or res family
+(acquire-without-release, begin-without-commit,
+unbounded-registry-growth, thread-without-stop, fd-leak-on-error) —
+fails this test, the same check `python -m ray_tpu.devtools.lint` runs
+standalone. After an intentional change, regenerate with
 ``python -m ray_tpu.devtools.lint --write-baseline`` (add
 ``--family X`` to touch only one family's section).
 """
@@ -59,6 +61,26 @@ def test_repo_jax_family_clean_with_empty_baseline_section():
         + "\n".join(str(f) for f in fresh))
     baseline = lint._read_baseline_json(lint.DEFAULT_BASELINE)
     assert baseline["families"]["jax"]["findings"] == {}
+
+
+def test_repo_res_family_clean():
+    """The res family holds the same strong line as jax and dist: its
+    baseline section is EMPTY — every releasable handle is released on
+    every path, every registry fed by a handler or loop has eviction
+    evidence, every daemon thread stops on the teardown path, every fd
+    survives its error paths. Resource lifetime is the single most
+    re-found bug class across PRs 1-11 (the lease-table leak, the
+    forever-pinned borrows, the _local_objects mirror, the unjoined
+    threads): fix or allow-comment new findings, never baseline them —
+    ROADMAP item 3's durable control plane is only trustworthy if its
+    tables provably don't leak."""
+    fresh = _fresh(families=("res",))
+    assert not fresh, (
+        "new res-lint findings (fix or allow-comment with a one-line "
+        "justification — the res baseline section stays empty):\n"
+        + "\n".join(str(f) for f in fresh))
+    baseline = lint._read_baseline_json(lint.DEFAULT_BASELINE)
+    assert baseline["families"]["res"]["findings"] == {}
 
 
 def test_repo_dist_family_clean():

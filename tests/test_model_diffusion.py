@@ -68,6 +68,8 @@ def test_config_validation():
         diffusion.DiffusionConfig(widths=(60, 128, 256), norm_groups=8)
 
 
+@pytest.mark.slow  # tier-1 budget relief (PR 12): 32.5s measured on a quiet box;
+# convergence smoke — forward/sharded-step coverage stays tier-1
 def test_diffusion_learns_toy_distribution():
     """Learning gate: loss on a constant-image distribution drops well
     below the untrained level (eps-prediction becomes non-trivial)."""

@@ -44,6 +44,8 @@ def test_pipeline_matches_dense_forward(pp2_mesh):
                                rtol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget relief (PR 12): 26.5s measured on a quiet box;
+# convergence smoke — pipeline step shape/math stays tier-1
 def test_pipeline_train_step_decreases_loss(pp2_mesh):
     cfg = llama.tiny_config(n_layers=4)
     pcfg = pipeline.PipelineConfig(stages=2, microbatches=4)
@@ -119,6 +121,8 @@ def test_moe_overflow_drops_are_bounded():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # tier-1 budget relief (PR 12): 17.7s measured on a quiet box;
+# EP-mesh train smoke — MoE dispatch math stays tier-1
 def test_mixtral_train_step_ep_mesh():
     """End-to-end MoE training over an ep-sharded mesh."""
     import optax

@@ -1,0 +1,202 @@
+"""Regression tests for the real leaks the ``res`` lint family
+surfaced in-tree (PR 12): the serve controller's per-deployment version
+dicts, the driver's per-actor conn registry, and the client runtime's
+unjoined ref-flusher. Each test pins the FIX's behavior — delete/kill
+paths must shrink the registry they previously grew forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.devtools.lock_debug import make_lock, make_rlock
+
+
+# ------------------------------------------------ controller version dicts
+
+
+def make_controller():
+    """A bare ServeController (no reconcile loop, no cluster) with just
+    the replica-set/version machinery wired — the unit idiom
+    test_serve_routing.py uses for the Router."""
+    from ray_tpu.serve._private.controller import ServeController
+
+    c = ServeController.__new__(ServeController)
+    c._lock = make_rlock("serve.controller._lock")
+    c._set_cond = threading.Condition(c._lock)
+    c._deployments = {}
+    c._set_versions = {}
+    c._load_gens = {}
+    c._version_clock = 0
+    c._stop_replicas = lambda replicas: None
+    return c
+
+
+def test_delete_pops_version_entries():
+    """The leak: _set_versions/_load_gens grew one entry per deployment
+    NAME ever created, forever. delete() must pop both."""
+    c = make_controller()
+    for i in range(5):
+        name = f"dep-{i}"
+        c._deployments[name] = {"replicas": []}
+        with c._lock:
+            c._bump_set(name)
+        c._load_gens[name] = c._version_clock
+        assert c.delete(name)
+    assert c._set_versions == {}
+    assert c._load_gens == {}
+    assert c._deployments == {}
+
+
+def test_version_clock_never_remints_a_seen_version():
+    """Popping on delete is only safe because versions are minted from
+    one monotonic clock: a redeploy must never reuse a version a parked
+    router already saw (the != comparator would park through the change
+    forever)."""
+    c = make_controller()
+    seen = set()
+    for _ in range(3):
+        c._deployments["d"] = {"replicas": []}
+        with c._lock:
+            c._bump_set("d")
+        v = c._set_versions["d"]
+        assert v not in seen
+        seen.add(v)
+        assert c.delete("d")
+    # Deleted state reads version 0 — also never minted.
+    assert 0 not in seen
+
+
+def test_parked_poller_wakes_on_delete_then_reparks():
+    c = make_controller()
+    c._deployments["d"] = {"replicas": ["r1"]}
+    with c._lock:
+        c._bump_set("d")
+    known = c._set_versions["d"]
+    got = []
+
+    def poll():
+        got.append(c.listen_for_change("d", known, timeout=10.0))
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert c.delete("d")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    v, replicas = got[0]
+    assert replicas is None  # poller observed the deletion...
+    assert v != known        # ...at a version it had not seen
+    # And a fresh poll at the post-delete version PARKS (no 1-RPC/s
+    # spin against a deleted deployment).
+    t0 = time.monotonic()
+    v2, replicas2 = c.listen_for_change("d", v, timeout=0.4)
+    assert time.monotonic() - t0 >= 0.35
+    assert v2 == v and replicas2 is None
+
+
+# ------------------------------------------------- driver actor registry
+
+
+def make_core():
+    from ray_tpu.core.cluster_core import ClusterCore
+    import collections
+
+    core = ClusterCore.__new__(ClusterCore)
+    core._actors = {}
+    core._actors_lock = make_lock("cluster_core._actors_lock")
+    core._dead_actor_reasons = collections.OrderedDict()
+    return core
+
+
+def test_retired_actor_conn_leaves_registry():
+    from ray_tpu.core.ids import ActorID
+
+    core = make_core()
+    aid = ActorID(b"a" * 12)
+    conn = core._actor_conn(aid)
+    assert aid in core._actors
+    conn.dead = True
+    conn.death_reason = "killed via ray_tpu.kill"
+    core._retire_actor_conn(conn)
+    assert aid not in core._actors  # the per-actor leak is reclaimed
+    # A late call still fails fast with the real cause, via an
+    # EPHEMERAL conn that is NOT re-registered.
+    late = core._actor_conn(aid)
+    assert late.dead and late.death_reason == "killed via ray_tpu.kill"
+    assert aid not in core._actors
+
+
+def test_dead_actor_memo_bounded():
+    from ray_tpu.core.ids import ActorID
+
+    core = make_core()
+    for i in range(4100):
+        aid = ActorID(i.to_bytes(12, "big"))
+        conn = core._actor_conn(aid)
+        conn.dead = True
+        conn.death_reason = f"r{i}"
+        core._retire_actor_conn(conn)
+    assert core._actors == {}
+    assert len(core._dead_actor_reasons) == 4096
+    # Oldest retirements fell off; newest kept.
+    assert ActorID((0).to_bytes(12, "big")) not in \
+        core._dead_actor_reasons
+    assert ActorID((4099).to_bytes(12, "big")) in \
+        core._dead_actor_reasons
+
+
+# ------------------------------------------------- client ref-flusher join
+
+
+def test_client_shutdown_joins_flusher_promptly(monkeypatch):
+    """The flusher slept a full client_ref_flush_period_s per lap with
+    no wake event: shutdown() left it running (daemon) against a closed
+    connection. The stop event must wake it and shutdown must join it —
+    well inside one flush period."""
+    from ray_tpu.client.runtime import ClientRuntime
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    old = cfg.get("client_ref_flush_period_s")
+    cfg.set("client_ref_flush_period_s", 60.0)
+    try:
+        rt = ClientRuntime.__new__(ClientRuntime)
+        rt._shutdown = False
+        rt._stop_event = threading.Event()
+        rt._holds_buf = []
+        rt._holds_lock = threading.Lock()
+        rt._flush_lock = threading.Lock()
+
+        class _Refcount:
+            def take_dropped(self):
+                return []
+
+            def count(self, o):
+                return 1
+
+        class _Conn:
+            closed = False
+
+            def call(self, *a, **kw):
+                return None
+
+            def notify(self, *a, **kw):
+                return None
+
+            def close(self):
+                self.closed = True
+
+        rt.refcount = _Refcount()
+        rt._conn = _Conn()
+        rt._flusher = threading.Thread(target=rt._flush_loop,
+                                       daemon=True)
+        rt._flusher.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        rt.shutdown()
+        assert time.monotonic() - t0 < 10.0  # not one 60s sleep lap
+        assert not rt._flusher.is_alive()
+        assert rt._conn.closed
+    finally:
+        cfg.set("client_ref_flush_period_s", old)

@@ -115,6 +115,8 @@ def test_remote_env_runner_group(cluster):
         group.stop()
 
 
+@pytest.mark.slow  # tier-1 budget relief (PR 12): 50.3s measured on a quiet box;
+# learning gate — PPO step mechanics stay covered by faster tests
 def test_ppo_cartpole_learning_gate():
     """The learning-regression gate: CartPole mean return >= 450 within a
     bounded iteration budget (reference: PPO CartPole learning tests)."""

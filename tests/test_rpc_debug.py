@@ -247,3 +247,36 @@ def test_flag_off_unclassified_method_serves(monkeypatch):
     finally:
         client.close()
         server.stop()
+
+
+def test_recv_seq_streams_bounded_lru(witness):
+    """Every respawned peer is a new sender, so the receiver-side
+    stream table accretes dead senders over a long chaos run — it is
+    now LRU-bounded at 4096 streams (the res-family audit; eviction
+    can only relax a monotonicity check, never fabricate a violation).
+    LRU by last frame, not insertion order: a busy LIVE stream must
+    survive even though it was registered first."""
+    for i in range(4096):
+        frame = rpc_debug.stamp_outbox(f"node:{i}", [("add", b"o", 1)])
+        rpc_debug.check_outbox("head", frame)
+    # node:0 — the oldest-INSERTED stream — speaks again (it is live).
+    frame = rpc_debug.stamp_outbox("node:0", [("add", b"o", 1)])
+    rpc_debug.check_outbox("head", frame)
+    # Two fresh senders push the table over the cap twice.
+    for i in range(4096, 4098):
+        frame = rpc_debug.stamp_outbox(f"node:{i}", [("add", b"o", 1)])
+        rpc_debug.check_outbox("head", frame)
+    assert rpc_debug.violations() == []
+    with rpc_debug._REGISTRY._mu:
+        assert len(rpc_debug._REGISTRY.recv_seq) == 4096
+        # The live (recently-heard) stream survived; the idle ones
+        # registered right after it were evicted instead.
+        assert ("node:0", "head") in rpc_debug._REGISTRY.recv_seq
+        assert ("node:1", "head") not in rpc_debug._REGISTRY.recv_seq
+        assert ("node:2", "head") not in rpc_debug._REGISTRY.recv_seq
+        assert ("node:4097", "head") in rpc_debug._REGISTRY.recv_seq
+    # And the survivor's high-water mark is intact: a replay of its
+    # first frame is still caught as an inversion.
+    rpc_debug.check_outbox("head", [(rpc_debug.SEQ_KIND, "node:0", 1)])
+    assert any(v["kind"] == "outbox-inversion"
+               for v in rpc_debug.violations())
