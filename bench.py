@@ -222,11 +222,17 @@ def _bench_8b_proxy(on_tpu: bool, devices, kind: str) -> dict:
             "error": f"all depth pairs failed: {last_err!r:.300}"}
 
 
-def _bench_decode(on_tpu: bool, quantize: str = None) -> dict:
+def _bench_decode(on_tpu: bool, quantize: str = None,
+                  paged: bool = False) -> dict:
     """Steady-state decode throughput of the native LLM engine
     (``quantize="int8"`` measures the weight-only-quantized engine on
     the identical workload — the decode path is weight-bandwidth bound,
-    so halving the weight bytes is the headline lever)."""
+    so halving the weight bytes is the headline lever; ``paged=True``
+    routes decode attention through the paged block-table kernel,
+    which on TPU streams only the pages covering each sequence's valid
+    rows instead of the whole cache extent — off-TPU the row runs the
+    gather reference and exists for cross-round comparability, not
+    speed)."""
     import threading
 
     import numpy as np
@@ -245,7 +251,7 @@ def _bench_decode(on_tpu: bool, quantize: str = None) -> dict:
     # tunnel per-token sync alone caps throughput at ~13 steps/s.
     engine = LLMEngine(cfg, max_batch=max_batch, max_len=256,
                        prompt_buckets=[32], decode_chunk=8,
-                       quantize=quantize)
+                       quantize=quantize, paged_decode=paged)
     rng = np.random.default_rng(0)
 
     hi = min(1000, cfg.vocab_size - 1)
@@ -279,7 +285,8 @@ def _bench_decode(on_tpu: bool, quantize: str = None) -> dict:
     if client_errors and not sum(counts):
         raise RuntimeError(f"all decode clients failed: {client_errors[0]}")
     tps = sum(counts) / elapsed
-    metric = ("llm_decode_tokens_per_s_int8" if quantize == "int8"
+    metric = ("llm_decode_tokens_per_s_paged" if paged
+              else "llm_decode_tokens_per_s_int8" if quantize == "int8"
               else "llm_decode_tokens_per_s")
     row = {"metric": metric, "value": round(tps, 1),
            "unit": "tokens/s",
@@ -287,6 +294,8 @@ def _bench_decode(on_tpu: bool, quantize: str = None) -> dict:
            "max_batch": max_batch}
     if quantize:
         row["quantize"] = quantize
+    if paged:
+        row["paged_decode"] = True
     if client_errors:
         # Dead clients deflate throughput: a plausible-but-wrong number
         # must carry the evidence (module invariant).
@@ -511,15 +520,138 @@ def _bench_engine_spec(on_tpu: bool) -> list:
     return [row_on, row_off]
 
 
+def _bench_engine_mixed(on_tpu: bool) -> list:
+    """Mixed long-prompt + long-decode sweep: streaming decode clients'
+    p99 TPOT while long prompts keep arriving, chunked prefill ON vs
+    OFF on otherwise identical engines.
+
+    Unchunked, every long-prompt admission prefills its whole bucket in
+    one dispatch between the roster's decode chunks — the in-flight
+    streams stall for the full prefill and the stall lands in their
+    inter-token p99. Chunked, the same prompt materializes
+    ``prefill_chunk`` tokens per tick, bounding any single stall (this
+    is also what keeps the PR 9 SLO admission gate from shedding on a
+    single long prompt). Greedy outputs are identical in both phases —
+    only the interleaving changes."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    if on_tpu:
+        cfg = dataclasses.replace(llama.LLAMA3_1B, max_seq_len=512,
+                                  use_decode_kernel=True)
+        seconds = 8.0
+    else:
+        cfg = llama.tiny_config(max_seq_len=256)
+        seconds = 4.0
+    long_prompt_len, decode_new = 200, 48
+    rng = np.random.default_rng(3)
+    hi = min(1000, cfg.vocab_size - 1)
+    long_prompts = [[int(t) for t in rng.integers(1, hi,
+                                                  long_prompt_len)]
+                    for _ in range(4)]
+    decode_prompts = [[int(t) for t in rng.integers(1, hi, 16)]
+                      for _ in range(2)]
+
+    def run(prefill_chunk: int) -> dict:
+        engine = LLMEngine(cfg, max_batch=4, max_len=256,
+                           prompt_buckets=[32, 224], decode_chunk=8,
+                           prefill_chunk=prefill_chunk,
+                           name=f"bench-mixed-{prefill_chunk}")
+        # Warm every program: both prefill buckets + decode.
+        engine.generate(long_prompts[0], max_new_tokens=2)
+        engine.generate(decode_prompts[0], max_new_tokens=2)
+        stop_at = time.perf_counter() + seconds
+        gaps: list = []
+        gaps_lock = threading.Lock()
+        errors: list = []
+        decoded = [0, 0]  # per-thread counts (no shared-counter race)
+
+        def decode_client(i):
+            try:
+                while time.perf_counter() < stop_at:
+                    last = None
+                    local = []
+                    for _ in engine.generate_stream(
+                            decode_prompts[i], max_new_tokens=decode_new,
+                            timeout=300):
+                        now = time.perf_counter()
+                        if last is not None:
+                            local.append(now - last)  # TPOT, not TTFT
+                        last = now
+                        decoded[i] += 1
+                    with gaps_lock:
+                        gaps.extend(local)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                errors.append(repr(e)[:200])
+
+        def prompt_client(i):
+            try:
+                while time.perf_counter() < stop_at:
+                    engine.generate(long_prompts[i % len(long_prompts)],
+                                    max_new_tokens=2, timeout=300)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                errors.append(repr(e)[:200])
+
+        threads = ([threading.Thread(target=decode_client, args=(i,))
+                    for i in range(2)]
+                   + [threading.Thread(target=prompt_client, args=(i,))
+                      for i in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        engine.close()
+        if errors and not gaps:
+            raise RuntimeError(f"mixed-bench clients failed: {errors[0]}")
+        gaps.sort()
+        p = {q: round(gaps[min(int(q / 100 * len(gaps)),
+                               len(gaps) - 1)] * 1e3, 3)
+             for q in (50, 99)} if gaps else {50: None, 99: None}
+        return {"p50_tpot_ms": p[50], "p99_tpot_ms": p[99],
+                "decode_tokens": sum(decoded),
+                "tpot_samples": len(gaps), "errors": errors}
+
+    chunk = 32
+    on = run(prefill_chunk=chunk)
+    off = run(prefill_chunk=0)
+    common = {"workload": "mixed-long-prompt",
+              "long_prompt_len": long_prompt_len,
+              "decode_new_tokens": decode_new, "max_batch": 4,
+              "config": "llama3-1b" if on_tpu else "tiny-cpu"}
+    rows = []
+    for tag, r, pc in (("chunked", on, chunk), ("unchunked", off, 0)):
+        row = {"metric": f"llm_engine_mixed_{tag}",
+               "prefill_chunk": pc, **{k: v for k, v in r.items()
+                                       if k != "errors"}, **common}
+        if r["errors"]:
+            row["client_errors"] = len(r["errors"])
+            row["client_error_sample"] = r["errors"][0]
+        rows.append(row)
+    if on["p99_tpot_ms"] and off["p99_tpot_ms"]:
+        # >1 means chunked prefill flattened the decode tail.
+        rows[0]["p99_tpot_flatness_vs_unchunked"] = round(
+            off["p99_tpot_ms"] / on["p99_tpot_ms"], 2)
+    return rows
+
+
 def engine_child_main() -> None:
-    """Standalone engine suite (``bench.py --engine``): engine row plus
-    the speculative-decoding on/off pair, one JSON row each."""
+    """Standalone engine suite (``bench.py --engine``): engine row, the
+    paged-decode row, the speculative-decoding on/off pair, and the
+    mixed long-prompt sweep (chunked prefill on/off), one JSON row
+    each."""
     _pin_platform()
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
     print(json.dumps(_bench_engine(on_tpu)), flush=True)
+    print(json.dumps(_bench_decode(on_tpu, paged=True)), flush=True)
     for row in _bench_engine_spec(on_tpu):
+        print(json.dumps(row), flush=True)
+    for row in _bench_engine_mixed(on_tpu):
         print(json.dumps(row), flush=True)
 
 
@@ -735,6 +867,17 @@ def child_main() -> None:
                  "unit": "tokens/s", "error": repr(e)[:300]}
     print(json.dumps(row_q), flush=True)
 
+    # --- row 3c: same decode workload, paged block-table kernel --------
+    try:
+        row_p = _bench_decode(on_tpu, paged=True)
+        if row_dec.get("value") and row_p.get("value"):
+            row_p["speedup_vs_unpaged"] = round(
+                row_p["value"] / row_dec["value"], 3)
+    except Exception as e:  # noqa: BLE001
+        row_p = {"metric": "llm_decode_tokens_per_s_paged", "value": 0.0,
+                 "unit": "tokens/s", "error": repr(e)[:300]}
+    print(json.dumps(row_p), flush=True)
+
     # --- row 4: engine suite (decode + TTFT + prefix-cache) -------------
     try:
         row_eng = _bench_engine(on_tpu)
@@ -748,6 +891,15 @@ def child_main() -> None:
     except Exception as e:  # noqa: BLE001
         spec_rows = [{"metric": "llm_engine_spec", "error": repr(e)[:300]}]
     for r in spec_rows:
+        print(json.dumps(r), flush=True)
+
+    # --- rows 6b: mixed long-prompt sweep, chunked prefill on/off -------
+    try:
+        mixed_rows = _bench_engine_mixed(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        mixed_rows = [{"metric": "llm_engine_mixed_chunked",
+                       "error": repr(e)[:300]}]
+    for r in mixed_rows:
         print(json.dumps(r), flush=True)
 
     # --- rows 7+: per-kernel ops microbench (fused glue + int8 matmul) --
@@ -1945,6 +2097,11 @@ def main() -> int:
     if "error" not in decq and decq.get("value"):
         merged["llm_decode_tokens_per_s_int8"] = decq.get("value")
         merged["llm_decode_int8_speedup"] = decq.get("speedup_vs_f32")
+    decp = by_metric.get("llm_decode_tokens_per_s_paged", {})
+    if "error" not in decp and decp.get("value"):
+        merged["llm_decode_tokens_per_s_paged"] = decp.get("value")
+        merged["llm_decode_paged_speedup"] = \
+            decp.get("speedup_vs_unpaged")
     ops_merged = _merge_ops_rows(
         [r for r in rows if r.get("metric") in ("ops_microbench",
                                                 "decode_matmul_gbps")])
@@ -1974,6 +2131,18 @@ def main() -> int:
             spec.get("llm_decode_tokens_per_s")
     elif spec:
         merged["spec_error"] = spec["error"]
+    mx_on = by_metric.get("llm_engine_mixed_chunked", {})
+    mx_off = by_metric.get("llm_engine_mixed_unchunked", {})
+    if "error" not in mx_on and mx_on.get("p99_tpot_ms") is not None:
+        merged["llm_mixed_p99_tpot_ms_chunked"] = mx_on["p99_tpot_ms"]
+        if mx_off.get("p99_tpot_ms") is not None:
+            merged["llm_mixed_p99_tpot_ms_unchunked"] = \
+                mx_off["p99_tpot_ms"]
+        if mx_on.get("p99_tpot_flatness_vs_unchunked") is not None:
+            merged["llm_mixed_p99_tpot_flatness"] = \
+                mx_on["p99_tpot_flatness_vs_unchunked"]
+    elif mx_on:
+        merged["mixed_error"] = mx_on["error"]
     if serve_row and "error" not in serve_row:
         for k in ("serve_llm_requests_per_s", "serve_llm_tokens_per_s",
                   "serve_llm_p50_ttft_ms", "serve_llm_p99_ttft_ms"):

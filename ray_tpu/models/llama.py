@@ -65,6 +65,17 @@ class LlamaConfig:
     # kernel glue under the Pallas interpreter off-TPU (test coverage for
     # the dispatch itself).
     use_decode_kernel: Any = True
+    # Paged decode attention (ops/paged_decode.py): single-token decode
+    # reads the block-granular KV cache IN PLACE through a block-table
+    # index — only ceil(length/page) pages stream per sequence, vs the
+    # whole cache extent for the contiguous kernel. True = Pallas kernel
+    # on TPU / jnp gather reference elsewhere; "interpret" = the kernel
+    # under the Pallas interpreter off-TPU (test escape hatch); False =
+    # never. Takes precedence over ``use_decode_kernel`` for decode
+    # steps. The cache's row extent must be a multiple of
+    # ``decode_page`` (the engine pads its allocation).
+    paged_decode: Any = False
+    decode_page: int = 16
     # Fused Pallas kernels for the per-layer glue (ops/fused.py):
     # RMSNorm(+residual), rotary folded over the QK projection outputs,
     # and SwiGLU each become one VMEM pass instead of several XLA HBM
@@ -264,7 +275,26 @@ def _block(x, layer, positions, cfg: LlamaConfig, mesh: Optional[Mesh],
         cv = lax.dynamic_update_slice(  # rtpu-lint: disable=unclamped-dynamic-update-slice
             cv, v.swapaxes(1, 2).astype(cv.dtype), (0, 0, cache_index, 0))
         new_kv = (ck, cv)
-        if (k.shape[1] == 1 and cfg.use_decode_kernel
+        if k.shape[1] == 1 and cfg.paged_decode:
+            # Paged decode step: the cache is read IN PLACE as a pool of
+            # decode_page-row pages through a block table. The table here
+            # is slot-identity (each sequence's pages are its own rows,
+            # in order — kv_manager keeps prefixes slot-affine), so the
+            # paged read is bit-equal to the contiguous one; the
+            # indirection is the seam for cross-slot paging.
+            from ray_tpu.ops import paged_decode_attention
+
+            page = cfg.decode_page
+            bq, s_cache = x.shape[0], ck.shape[2]
+            np_row = s_cache // page
+            table = jnp.arange(bq * np_row,
+                               dtype=jnp.int32).reshape(bq, np_row)
+            lengths = jnp.broadcast_to(cache_index + 1, (bq,))
+            attn = paged_decode_attention(
+                q[:, 0], ck, cv, table, lengths.astype(jnp.int32),
+                page_size=page,
+                interpret=cfg.paged_decode == "interpret")[:, None]
+        elif (k.shape[1] == 1 and cfg.use_decode_kernel
                 and (jax.default_backend() == "tpu"
                      or cfg.use_decode_kernel == "interpret")):
             # Serving decode step: one query over the cache prefix — the

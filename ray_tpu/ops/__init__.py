@@ -15,6 +15,13 @@ causal_attention           (portable dense reference)       always available; po
 blockwise_attention        (portable online-softmax scan)   seq a multiple of ``block_k``
 decode_attention           Pallas single-query kernel       on TPU, or ``interpret=True`` off-TPU;
                                                             jnp reference elsewhere
+paged_decode_attention     Pallas block-table kernel:       ``LlamaConfig.paged_decode`` (engine knob
+                           reads the paged KV cache IN      ``paged_decode=True``): kernel on TPU or
+                           PLACE through the table's        under ``interpret``; jnp gather reference
+                           index map, streaming only        elsewhere. Cache rows must be a multiple
+                           ceil(len/page) pages/seq         of ``decode_page`` (engine pads). Greedy
+                                                            output token-identical to the unpaged
+                                                            paths (identity table == contiguous read)
 ring_attention             shard_map ppermute ring          mesh ``sp`` axis > 1 (the ONLY module
                                                             allowed to import shard_map — rtpu-lint
                                                             banned-API rule)
@@ -47,6 +54,10 @@ from ray_tpu.ops.fused import (
     swiglu_reference,
 )
 from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.paged_decode import (
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -62,6 +73,8 @@ __all__ = [
     "fused_rms_norm_residual",
     "fused_swiglu",
     "online_softmax_update",
+    "paged_decode_attention",
+    "paged_decode_attention_reference",
     "repeat_kv",
     "ring_attention",
     "rms_norm",

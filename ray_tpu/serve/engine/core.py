@@ -2,11 +2,14 @@
 
 One background thread runs the Orca-style tick: drain the mailbox into
 the scheduler, admit waiting requests into free slots (prefix-aware,
-bucket-padded prefill), then dispatch ONE device-resident decode chunk
-for the whole roster and fetch its K tokens in a single host sync
-(decode_loop.py). Requests finish mid-chunk on the on-device EOS/budget
-mask; the host discards the frozen overshoot, recycles the slot into the
-prefix cache (kv_manager.py), and streams tokens to waiting consumers.
+bucket-padded prefill — long prompts optionally split into
+``prefill_chunk``-token pieces advanced one per tick), then dispatch
+ONE device-resident decode chunk for the whole roster and fetch K
+tokens in a single host sync (decode_loop.py; with ``multi_step`` the
+fetch lands the PREVIOUS chunk while the next one executes). Requests
+finish mid-chunk on the on-device EOS/budget mask; the host discards
+the frozen overshoot, recycles the slot into the prefix cache
+(kv_manager.py), and streams tokens to waiting consumers.
 
 ``serve/llm.py`` keeps the public surface (``LLMEngine.generate`` /
 ``generate_stream`` / ``build_llm_deployment``) as a facade over this
@@ -15,6 +18,7 @@ class.
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 import time
@@ -32,6 +36,19 @@ from ray_tpu.serve.engine.metrics import (SERVE_TTFT_BREAKDOWN_MS,
 from ray_tpu.serve.engine.scheduler import EngineRequest, Scheduler
 from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import tracing as _tracing
+
+
+class _PrefillJob:
+    """One admission's prefill progress: ``idx`` chunks of ``adm.chunks``
+    dispatched, next chunk writing at row ``pos``. Engine-thread-only."""
+
+    __slots__ = ("adm", "pos", "idx", "t_pf0")
+
+    def __init__(self, adm, pos: int):
+        self.adm = adm
+        self.pos = pos
+        self.idx = 0
+        self.t_pf0 = 0.0
 
 
 class InferenceEngine:
@@ -67,6 +84,36 @@ class InferenceEngine:
     the f32 engine (quantization error), but spec-on vs spec-off WITHIN
     a quantized engine keeps the token-identical invariant (both run
     the same quantized weights).
+
+    ``prefill_chunk`` > 0 splits long prompt suffixes into chunks of
+    that many real tokens and dispatches ONE chunk per engine tick,
+    interleaved with the roster's decode chunks (Sarathi-style chunked
+    prefill, Agrawal et al. 2024): a long prompt no longer stalls
+    every co-batched request's TPOT for its whole prefill. Only the
+    final chunk's logits are fetched (still one counted prefill sync
+    per admission), the KV manager commits the materialized prefix
+    chain per chunk, and greedy output is token-identical to the
+    unchunked path (same positions, same rows, same math).
+
+    ``multi_step`` (default on, plain-decode path only) double-buffers
+    decode dispatch: each tick enqueues chunk N+1 from chunk N's
+    device-carried state BEFORE fetching chunk N's tokens, so the
+    per-tick host sync overlaps the next chunk's device execution.
+    Exactly one host sync per FETCHED chunk either way (the witness
+    budget is unchanged); at most one trailing chunk per burst is
+    dispatched wastefully (every roster member already frozen on
+    device) and dropped unfetched. Disabled automatically while
+    speculation drafts (drafts are proposed from host-visible tokens,
+    which an in-flight chunk would lag by one dispatch).
+
+    ``paged_decode`` routes decode attention through the paged
+    block-table kernel (``ops/paged_decode.py``): the block-granular
+    KV cache is read IN PLACE via a slot-identity block table —
+    bit-equal to the contiguous read, streaming only the pages that
+    cover each sequence's valid rows. True = Pallas kernel on TPU /
+    jnp gather reference elsewhere; "interpret" = Pallas interpreter
+    off-TPU. The page size is ``prefix_block`` (the KV manager's block
+    granularity) and the cache allocation is padded to a page multiple.
     """
 
     def __init__(self, cfg=None, params=None, *, max_batch: int = 4,
@@ -79,6 +126,9 @@ class InferenceEngine:
                  spec_adaptive: bool = True,
                  spec_chunk: int = 0,
                  quantize: Optional[str] = None,
+                 prefill_chunk: int = 0,
+                 multi_step: bool = True,
+                 paged_decode: Any = False,
                  seed: int = 0,
                  name: Optional[str] = None):
         import jax
@@ -87,6 +137,17 @@ class InferenceEngine:
 
         self._jax = jax
         self.cfg = cfg or llama.tiny_config(max_seq_len=max_len)
+        if paged_decode:
+            # The paged kernel's page size IS the KV manager's block
+            # granularity — one notion of "block" engine-wide.
+            self.cfg = dataclasses.replace(self.cfg,
+                                           paged_decode=paged_decode,
+                                           decode_page=prefix_block)
+        # A cfg-level LlamaConfig.paged_decode counts too (its own
+        # decode_page): the cache padding below must track EITHER spelling
+        # or the first decode tick dies on the kernel's page-multiple
+        # check.
+        self.paged_decode = self.cfg.paged_decode
         self.params = (params if params is not None
                        else llama.init_params(self.cfg,
                                               jax.random.PRNGKey(seed)))
@@ -119,15 +180,32 @@ class InferenceEngine:
         # past max_len absorbs parked/overrun writes so they can never
         # clamp back onto resident rows (decode_loop docstring). Row
         # accounting everywhere else still uses the logical max_len.
-        self.cache = llama.init_kv_cache(
-            self.cfg, max_batch, self.max_len + self.loop.scratch_rows)
+        cache_rows = self.max_len + self.loop.scratch_rows
+        if self.paged_decode:
+            # The paged kernel reads the cache as whole pages; pad the
+            # allocation to a page multiple (padded rows sit past the
+            # scratch strip — never written, masked out by lengths).
+            page = self.cfg.decode_page
+            cache_rows = -(-cache_rows // page) * page
+        self.cache = llama.init_kv_cache(self.cfg, max_batch, cache_rows)
 
         self.kv = KVCacheManager(max_batch, self.max_len,
                                  block_size=prefix_block)
         self.scheduler = Scheduler(self.kv, max_len=self.max_len,
-                                   prompt_buckets=self.buckets)
+                                   prompt_buckets=self.buckets,
+                                   prefill_chunk=prefill_chunk)
+        self.prefill_chunk = self.scheduler.prefill_chunk
+        self.multi_step = bool(multi_step)
         self.metrics = EngineMetrics(name)
 
+        # Chunked-prefill jobs in flight (admitted requests whose
+        # suffix is still materializing, one chunk per tick) and the
+        # multi-step tick's in-flight decode chunk (dispatched, not yet
+        # fetched). Engine-thread-only state; bounded by max_batch and
+        # one chunk respectively.
+        self._prefilling: List[_PrefillJob] = []
+        self._inflight: Optional[Dict[str, Any]] = None
+        self._last_retire_t = 0.0  # TPOT cadence anchor (see _retire_chunk)
         self._queue: "queue.Queue[EngineRequest]" = queue.Queue()
         self._shutdown = False
         self._thread = _resdbg.track_thread(
@@ -199,6 +277,7 @@ class InferenceEngine:
         out = {"active": len(self.scheduler.active),
                "free_slots": self.kv.free_slots(),
                "quantize": self.quantize,
+               "prefilling": len(self._prefilling),
                "waiting": (self._queue.qsize()
                            + self.scheduler.queue_depth())}
         if self.quantize is not None:
@@ -222,6 +301,11 @@ class InferenceEngine:
         return {
             "waiting": self._queue.qsize() + self.scheduler.queue_depth(),
             "active": len(self.scheduler.active),
+            # Admitted but still materializing their prompt (chunked
+            # prefill): they hold slots and will decode — surfaced
+            # separately so routers that predate the key see unchanged
+            # waiting/active semantics.
+            "prefilling": len(self._prefilling),
             "slots": self.max_batch,
             "free_slots": self.kv.free_slots(),
             "kv_free_blocks": self.kv.free_blocks(),
@@ -270,64 +354,106 @@ class InferenceEngine:
         return self._jax.device_put(value)
 
     def _admit(self) -> None:
+        """Match waiting requests to free slots; each admission becomes
+        a prefill job (one chunk per tick — a single chunk when
+        ``prefill_chunk`` is off, so unchunked admissions still prefill
+        fully on their admission tick)."""
         self.scheduler.drain_into(self._queue)
         for adm in self.scheduler.admissions():
-            req, slot, cached = adm.request, adm.slot, adm.cached_len
-            t_pf0 = time.perf_counter()
-            try:
-                suffix = req.prompt_ids[cached:]
-                padded = np.zeros((1, adm.bucket), np.int32)
-                padded[0, :len(suffix)] = suffix
-                logits, self.cache = self.loop.prefill(
-                    self.params, self.cache, self._put(padded),
-                    self._put(np.int32(slot)),
-                    self._put(np.int32(cached)))
-                # First generated token: from the LAST REAL prompt pos.
-                # One counted sync per admission — the prefill logits
-                # row IS the first token (np.asarray on the device
-                # logits here was the jax-lint rule's first in-tree
-                # catch: an uncounted implicit sync).
-                idx = self.loop.first_token_index(len(req.prompt_ids),
-                                                  cached)
+            self._prefilling.append(_PrefillJob(adm, pos=adm.cached_len))
+
+    def _prefill_tick(self) -> None:
+        """Advance EVERY in-progress prefill by one chunk. Intermediate
+        chunks are dispatch-only (no host fetch — their logits are
+        never needed); the decode tick that follows interleaves with
+        their device execution, which is what keeps co-batched TPOT
+        flat while a long prompt materializes."""
+        for job in list(self._prefilling):
+            if self._advance_prefill(job):
+                self._prefilling.remove(job)
+
+    def _advance_prefill(self, job: "_PrefillJob") -> bool:
+        """Dispatch one prefill chunk; returns True when the job is
+        finished (activated into the decode roster, or aborted)."""
+        req, slot = job.adm.request, job.adm.slot
+        cached = job.adm.cached_len
+        n, bucket = job.adm.chunks[job.idx]
+        final = job.idx == len(job.adm.chunks) - 1
+        if job.idx == 0:
+            job.t_pf0 = time.perf_counter()
+        traced = req.trace_ctx is not None
+        t0w = time.time() if traced else 0.0
+        try:
+            suffix = req.prompt_ids[job.pos:job.pos + n]
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = suffix
+            logits, self.cache = self.loop.prefill(
+                self.params, self.cache, self._put(padded),
+                self._put(np.int32(slot)),
+                self._put(np.int32(job.pos)))
+            # Per-chunk prefix commit: block occupancy and the slot's
+            # resident chain track the materialized prefix as chunks
+            # land, not the whole prompt up-front.
+            self.kv.commit_prefill(slot, req.prompt_ids[:job.pos + n])
+            if final:
+                # First generated token: from the LAST REAL prompt pos
+                # (row n-1 of the final chunk). The ONE counted prefill
+                # sync per admission — intermediate chunks fetch
+                # nothing (np.asarray on the device logits here was the
+                # jax-lint rule's first in-tree catch: an uncounted
+                # implicit sync).
                 first = int(np.argmax(
-                    self._fetch(logits, tag="prefill")[0, idx]))
-            except BaseException as e:  # noqa: BLE001 — one bad request
-                # must not kill the engine thread (every later request
-                # would hang on a dead engine).
-                self.scheduler.abort_admission(req)
-                if not req.future.done():
-                    req.future.set_exception(e)
-                if req.stream_queue is not None:
-                    req.stream_queue.put(("error", e))
-                continue
-            req.first_token_t = time.perf_counter()
-            queue_s = max(0.0, t_pf0 - req.arrival_t)
-            prefill_s = max(0.0, req.first_token_t - t_pf0)
-            SERVE_TTFT_BREAKDOWN_MS.observe(queue_s * 1e3,
-                                            labels={"component": "queue"})
-            SERVE_TTFT_BREAKDOWN_MS.observe(prefill_s * 1e3,
-                                            labels={"component": "prefill"})
-            if req.trace_ctx is not None:
-                # Wall-clock span boundaries reconstructed from the
-                # perf_counter intervals measured above.
-                now_w = time.time()
-                _tracing.emit_span(
-                    "engine.queued", now_w - prefill_s - queue_s,
-                    now_w - prefill_s, parent=req.trace_ctx,
-                    attrs={"prompt_len": len(req.prompt_ids)})
-                _tracing.emit_span(
-                    "engine.prefill", now_w - prefill_s, now_w,
-                    parent=req.trace_ctx,
-                    attrs={"prefill_tokens": len(suffix),
-                           "cached_tokens": cached,
-                           "bucket": adm.bucket, "slot": slot})
-            self.metrics.record_admit(req.first_token_t - req.arrival_t,
-                                      len(suffix), cached)
-            req.generated.append(first)
+                    self._fetch(logits, tag="prefill")[0, n - 1]))
+        except BaseException as e:  # noqa: BLE001 — one bad request
+            # must not kill the engine thread (every later request
+            # would hang on a dead engine). Seed only the PRE-ACQUIRE
+            # reused prefix: rows this job dispatched are unconfirmed.
+            self.scheduler.abort_admission(
+                req, resident=req.prompt_ids[:cached])
+            if not req.future.done():
+                req.future.set_exception(e)
             if req.stream_queue is not None:
-                req.stream_queue.put(("token", first))
-            self.scheduler.activate(req)
-            self._maybe_finish(req, first)
+                req.stream_queue.put(("error", e))
+            return True
+        if traced:
+            # One span per CHUNK (chunk/chunks attrs), so TTFT
+            # decomposition stays accurate under chunked prefill — the
+            # gaps between chunk spans are the interleaved decode ticks.
+            _tracing.emit_span(
+                "engine.prefill", t0w, time.time(),
+                parent=req.trace_ctx,
+                attrs={"prefill_tokens": n, "cached_tokens": cached,
+                       "bucket": bucket, "slot": slot,
+                       "chunk": job.idx, "chunks": len(job.adm.chunks)})
+        job.idx += 1
+        job.pos += n
+        if not final:
+            return False
+        req.first_token_t = time.perf_counter()
+        queue_s = max(0.0, job.t_pf0 - req.arrival_t)
+        prefill_s = max(0.0, req.first_token_t - job.t_pf0)
+        SERVE_TTFT_BREAKDOWN_MS.observe(queue_s * 1e3,
+                                        labels={"component": "queue"})
+        SERVE_TTFT_BREAKDOWN_MS.observe(prefill_s * 1e3,
+                                        labels={"component": "prefill"})
+        if traced:
+            # Wall-clock span boundaries reconstructed from the
+            # perf_counter intervals measured above (prefill spans
+            # first-chunk dispatch -> first-token fetch, covering any
+            # interleaved decode ticks).
+            now_w = time.time()
+            _tracing.emit_span(
+                "engine.queued", now_w - prefill_s - queue_s,
+                now_w - prefill_s, parent=req.trace_ctx,
+                attrs={"prompt_len": len(req.prompt_ids)})
+        self.metrics.record_admit(req.first_token_t - req.arrival_t,
+                                  len(req.prompt_ids) - cached, cached)
+        req.generated.append(first)
+        if req.stream_queue is not None:
+            req.stream_queue.put(("token", first))
+        self.scheduler.activate(req)
+        self._maybe_finish(req, first)
+        return True
 
     def _maybe_finish(self, req: EngineRequest, last_tok: int) -> bool:
         done = self.scheduler.is_finished(req, last_tok)
@@ -384,51 +510,153 @@ class InferenceEngine:
         least one draft dispatch the multi-token verify program; ticks
         with nothing to verify fall through to the plain chunk — so a
         workload on which lookup never bites costs nothing over
-        speculation-off.
+        speculation-off. Multi-step double-buffering applies only to
+        the drafter-free engine: drafts are proposed from host-visible
+        tokens, which an in-flight chunk would lag by one dispatch.
         """
         if self.drafter is not None:
             drafts = self._draft_for_roster()
             if drafts:
                 self._spec_tick(drafts)
                 return
-        self._plain_tick()
+            self._plain_tick()
+            return
+        if self.multi_step:
+            self._pipelined_tick()
+        else:
+            self._plain_tick()
 
     def _plain_tick(self) -> None:
+        """Dispatch one chunk and fetch it in the same tick (the
+        pre-multi-step schedule; also the spec engine's zero-draft
+        path)."""
+        rec = self._dispatch_chunk()
+        if rec is not None:
+            self._retire_chunk(rec)
+
+    def _pipelined_tick(self) -> None:
+        """Multi-step schedule: with an unchanged roster, enqueue chunk
+        N+1 from chunk N's device-carried state BEFORE fetching chunk
+        N — the one host sync per tick then overlaps chunk N+1's device
+        execution instead of serializing ahead of it. Roster churn
+        (admissions, finishes discovered at the last fetch) falls back
+        to fetch-then-dispatch for that tick; device-side freezing
+        keeps an in-flight chunk correct across finishes either way
+        (a slot the host retires was already done on device — its
+        carried mask emits nothing, so the trailing chunk of a burst
+        delivers zero tokens and is dropped unfetched)."""
+        prev = self._inflight
+        nxt = None
+        if (prev is not None and prev["roster"] == self._roster_key()
+                and self._roster_outlives_chunk()):
+            nxt = self._dispatch_chunk(carry=prev)
+        if prev is not None:
+            self._inflight = None
+            if not self._retire_chunk(prev):
+                return  # device failure: roster failed, nxt is doomed
+        if nxt is None and self.scheduler.active:
+            nxt = self._dispatch_chunk()
+        self._inflight = nxt
+
+    def _roster_key(self):
+        return tuple((id(r), r.slot) for r in self.scheduler.active)
+
+    def _roster_outlives_chunk(self) -> bool:
+        """True when some active request can still be live AFTER the
+        in-flight chunk lands (its budget and row cap — both known
+        host-side — survive another ``chunk`` tokens). When nobody can,
+        the speculative next chunk would be all-frozen by construction:
+        skip it instead of burning a whole wasted dispatch per burst
+        (short generations — budget <= chunk — would otherwise pay ~2x
+        decode compute for zero tokens). EOS is the one early stop the
+        host can't predict; an EOS-ended burst still wastes at most one
+        trailing chunk."""
+        k = self.loop.chunk
+        return any(r.remaining() > k and r.length + k + 1 < self.max_len
+                   for r in self.scheduler.active)
+
+    def _dispatch_chunk(self, carry: Optional[Dict[str, Any]] = None):
+        """Enqueue one decode chunk (no host sync). ``carry`` pipelines
+        the previous chunk's device-carried state (tokens/lengths/
+        remaining/done stay on device; eos never changes for a fixed
+        roster); without it the inputs are rebuilt host-side from the
+        roster. Returns the in-flight record _retire_chunk consumes, or
+        None on a dispatch failure (roster failed)."""
         active = self.scheduler.active
         # Chunk-span wall boundaries: computed ONLY when some roster
         # member is traced — the tracing-off tick is byte-identical (no
         # extra clock reads, no span dicts).
         traced_tick = (_tracing.enabled()
                        and any(r.trace_ctx is not None for r in active))
-        tokens, lengths, remaining, eos_ids, done = \
-            self._roster_arrays(active)
+        if carry is not None:
+            tok_d, len_d, rem_d, eos_d, done_d = carry["carry"]
+        else:
+            tokens, lengths, remaining, eos_ids, done = \
+                self._roster_arrays(active)
+            tok_d, len_d, rem_d, eos_d, done_d = (
+                self._put(tokens), self._put(lengths),
+                self._put(remaining), self._put(eos_ids),
+                self._put(done))
         t0w = time.time() if traced_tick else 0.0
         t0 = time.perf_counter()
         try:
-            toks_d, n_valid_d, _len_d, _done_d, self.cache = \
-                self.loop.decode_chunk(
-                    self.params, self.cache, self._put(tokens),
-                    self._put(lengths), self._put(remaining),
-                    self._put(eos_ids), self._put(done))
-            # device_get returns host ndarrays: [B, K] ids + [B] valid.
-            chunk_ids, n_valid = self._fetch((toks_d, n_valid_d))
+            toks_d, n_valid_d, ntok_d, nlen_d, nrem_d, ndone_d, \
+                self.cache = self.loop.decode_chunk(
+                    self.params, self.cache, tok_d, len_d, rem_d,
+                    eos_d, done_d)
         except BaseException as e:  # noqa: BLE001 — fail all waiters
             self._fail_roster(e)
-            return
-        elapsed = time.perf_counter() - t0
-        t1w = time.time() if traced_tick else 0.0
-        # Device utilization denominator: every slot live at dispatch is
-        # scanned for the full chunk (static shapes) whether or not it
-        # freezes mid-chunk — delivered/live_steps < 1.0 shows the
-        # frozen-overshoot waste instead of the old always-1.0 readout.
-        live_steps = len(active) * self.loop.chunk
+            return None
+        return {"outs": (toks_d, n_valid_d),
+                "carry": (ntok_d, nlen_d, nrem_d, eos_d, ndone_d),
+                "roster": self._roster_key(),
+                # Strong refs pin the roster's request objects while
+                # this record lives: the key above compares id()s, and
+                # a finished request's id could otherwise be recycled
+                # for a newly admitted one in the same slot — a false
+                # "unchanged roster" that would pipeline the new
+                # request against a carry that has its slot frozen.
+                "reqs": list(active),
+                # Device utilization denominator: every slot live at
+                # dispatch is scanned for the full chunk (static
+                # shapes) whether or not it freezes mid-chunk —
+                # delivered/live_steps < 1.0 shows the frozen-overshoot
+                # waste instead of the old always-1.0 readout.
+                "live_steps": len(active) * self.loop.chunk,
+                "t0": t0, "t0w": t0w, "traced": traced_tick}
+
+    def _retire_chunk(self, rec: Dict[str, Any]) -> bool:
+        """The tick's ONE host fetch: land the chunk's tokens, deliver
+        to whoever is still active (a slot whose request finished —
+        or was recycled — since dispatch reports n_valid 0: the device
+        carried its done mask), retire finishes. False on device
+        failure."""
+        try:
+            # device_get returns host ndarrays: [B, K] ids + [B] valid.
+            chunk_ids, n_valid = self._fetch(rec["outs"])
+        except BaseException as e:  # noqa: BLE001 — fail all waiters
+            self._fail_roster(e)
+            return False
+        now = time.perf_counter()
+        # TPOT window: a PIPELINED chunk was dispatched one tick ago, so
+        # dispatch->fetch would fold the whole intervening host tick
+        # (which overlapped device compute) into per-token latency — an
+        # apparent regression exactly when latency improved. Measure the
+        # steady-state cadence instead: time since the LAST fetch
+        # completed. Serial ticks reduce to dispatch->fetch (the
+        # previous retire ended just before this record's dispatch).
+        elapsed = now - max(rec["t0"], self._last_retire_t)
+        self._last_retire_t = now
+        t1w = time.time() if rec["traced"] else 0.0
+        active = self.scheduler.active
         delivered = 0
+        n_act = len(active)
         for req in list(active):
             n = int(n_valid[req.slot])
             delivered += n
             if req.trace_ctx is not None and n:
                 _tracing.emit_span(
-                    "engine.decode_chunk", t0w, t1w,
+                    "engine.decode_chunk", rec["t0w"], t1w,
                     parent=req.trace_ctx,
                     attrs={"tokens": n, "slot": req.slot})
             for j in range(n):
@@ -440,8 +668,9 @@ class InferenceEngine:
                     req.stream_queue.put(("token", tok))
                 if self._maybe_finish(req, tok):
                     break  # device froze the slot here; rest are repeats
-        self.metrics.record_chunk(delivered, live_steps, elapsed)
-        _flight.record("engine_tick", tok=delivered, act=len(active))
+        self.metrics.record_chunk(delivered, rec["live_steps"], elapsed)
+        _flight.record("engine_tick", tok=delivered, act=n_act)
+        return True
 
     # -------------------------------------------------------- speculation
 
@@ -598,10 +827,19 @@ class InferenceEngine:
             # inputs go through the explicit _put/_fetch pair).
             with jax_debug.tick_guard():
                 self._admit()
+                self._prefill_tick()
             self.metrics.record_depths(self.scheduler.queue_depth(),
                                        len(self.scheduler.active),
                                        self.kv.hit_rate())
             if not self.scheduler.active:
+                if self._prefilling:
+                    continue  # keep chunked prefills advancing
+                # A burst just drained: the multi-step trailing chunk
+                # (dispatched while every member was already frozen on
+                # device) delivers nothing by construction — drop it
+                # unfetched. Its cache output already landed at
+                # dispatch time.
+                self._inflight = None
                 try:
                     # Straight into the waiting line (re-putting to the
                     # mailbox would reorder it behind later arrivals and

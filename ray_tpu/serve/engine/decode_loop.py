@@ -172,9 +172,16 @@ class DecodeLoop:
             remaining [B] (token budget), eos_ids [B] (-1 = none),
             done [B] bool (True = slot inactive / already finished).
 
-            Returns (chunk_tokens [B, K], n_valid [B], new_lengths [B],
-            done [B], cache). chunk_tokens[b, j] for j >= n_valid[b] are
-            frozen repeats of the slot's final token — discard them.
+            Returns (chunk_tokens [B, K], n_valid [B], next_tokens
+            [B, 1], new_lengths [B], new_remaining [B], done [B],
+            cache). chunk_tokens[b, j] for j >= n_valid[b] are frozen
+            repeats of the slot's final token — discard them. The
+            trailing carry (next_tokens/lengths/remaining/done) is the
+            EXACT input state of the next chunk for an unchanged
+            roster: the engine's multi-step tick feeds it straight back
+            as device arrays (same shapes/dtypes — one program either
+            way), enqueueing chunk N+1 before fetching chunk N's
+            tokens so the host sync overlaps the next chunk's compute.
             """
 
             def body(carry, _):
@@ -191,12 +198,12 @@ class DecodeLoop:
                 new_dn = dn | fin
                 return (cache, emit[:, None], ln, rem, new_dn), (emit, dn)
 
-            (cache, _t, lengths, remaining, done), (toks, was_done) = \
+            (cache, tok, lengths, remaining, done), (toks, was_done) = \
                 jax.lax.scan(body, (cache, tokens, lengths, remaining,
                                     done), None, length=self.chunk)
             n_valid = self.chunk - jnp.sum(was_done.astype(jnp.int32),
                                            axis=0)
-            return toks.T, n_valid, lengths, done, cache
+            return toks.T, n_valid, tok, lengths, remaining, done, cache
 
         self.decode_chunk = jax.jit(decode_chunk)
         # Exposed for the equivalence tests: the same single step the
@@ -308,9 +315,3 @@ class DecodeLoop:
 
         self.verify_chunk = jax.jit(verify_chunk)
 
-    # ------------------------------------------------------------ helpers
-
-    def first_token_index(self, prompt_len: int, cached_len: int) -> int:
-        """Row of the prefill logits holding the first generated token:
-        the LAST REAL (unpadded, uncached) prompt position."""
-        return prompt_len - cached_len - 1

@@ -170,6 +170,13 @@ class KVCacheManager:
         self._unindex(slot)
         info = self._slots[slot]
         info.in_use = True
+        # Occupancy counts the WHOLE prompt from admission: the chunk
+        # plan is committed even while a chunked prefill is still
+        # materializing rows, and the serve router's KV-pressure term
+        # reads used_blocks — under-counting for the length of a long
+        # prefill would steer MORE long prompts at the replica that is
+        # already busiest materializing KV. (commit_prefill tracks the
+        # materialized prefix separately, via resident/chain.)
         info.length = len(prompt_ids)
         # Rows beyond cached_len are about to be overwritten: resident
         # content is only trustworthy up to the reused prefix until the
@@ -182,6 +189,40 @@ class KVCacheManager:
     def grow(self, slot: int, n: int = 1) -> None:
         """Account ``n`` more rows written to an in-use slot (decode)."""
         self._slots[slot].length += n
+
+    def commit_prefill(self, slot: int, tokens: Sequence[int]) -> None:
+        """Commit one landed prefill chunk: the prompt prefix ``tokens``
+        is materialized in the slot's rows [0, len(tokens)) — called
+        once per chunk with the cumulative prefix, so the slot's
+        resident chain tracks the chunked prefill as it progresses.
+        (Block OCCUPANCY is committed in full at acquire — the plan is
+        spoken for — so the router's KV-pressure signal never
+        under-counts a long in-flight prefill.) The chain is NOT
+        indexed while the slot is in use (release does that);
+        committing here keeps the materialized-prefix view honest.
+        Dispatch-time optimism is safe: a device failure surfaces at
+        the next fetch and that abort path releases the slot seeding
+        only the PRE-ACQUIRE reused prefix, never these rows."""
+        info = self._slots[slot]
+        if not info.in_use:
+            raise ValueError(f"slot {slot} is not in use")
+        tokens = tuple(tokens)
+        bs = self.block_size
+        if tokens[:len(info.resident)] == info.resident:
+            # The common path — each commit extends the previous one —
+            # hashes only the NEW complete blocks (the chain links them
+            # to the old hashes), keeping per-admission hashing linear
+            # in prompt length across a many-chunk prefill instead of
+            # quadratic.
+            chain = list(info.chain)
+            h = chain[-1] if chain else 0
+            for i in range(len(chain), len(tokens) // bs):
+                h = hash((h, tokens[i * bs:(i + 1) * bs]))
+                chain.append(h)
+            info.chain = tuple(chain)
+        else:
+            info.chain = tuple(self._chain(tokens))
+        info.resident = tokens
 
     # ------------------------------------------------------- speculation
 

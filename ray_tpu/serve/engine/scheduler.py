@@ -66,11 +66,17 @@ class EngineRequest:
 @dataclasses.dataclass
 class Admission:
     """One admission decision: prefill ``request.prompt_ids[cached_len:]``
-    padded to ``bucket`` into ``slot`` at row offset ``cached_len``."""
+    into ``slot`` starting at row offset ``cached_len``, as the
+    ``chunks`` plan — a list of (real_tokens, padded_bucket) pieces the
+    engine dispatches one per tick (Sarathi-style chunked prefill;
+    a single entry when chunking is off or the suffix fits one chunk).
+    ``bucket`` remains the one-shot bucket for the whole suffix
+    (back-compat surface for callers that predate chunking)."""
     request: EngineRequest
     slot: int
     cached_len: int
     bucket: int
+    chunks: List[tuple] = dataclasses.field(default_factory=list)
 
 
 def bucket_for(n: int, buckets: List[int]) -> int:
@@ -87,11 +93,24 @@ class Scheduler:
     """FIFO admission over a slot pool with prefix-aware placement."""
 
     def __init__(self, kv: KVCacheManager, *, max_len: int,
-                 prompt_buckets: List[int]):
+                 prompt_buckets: List[int], prefill_chunk: int = 0):
         self.kv = kv
         self.max_len = max_len
         self.buckets = sorted(set(
             [b for b in prompt_buckets if b <= max_len] + [max_len]))
+        # Chunked prefill (0 = off): long suffixes split into pieces of
+        # this many REAL tokens, dispatched one per engine tick so a
+        # long prompt stops stalling the whole roster's TPOT. Snapped
+        # DOWN to the largest configured bucket <= the request (up to
+        # the smallest bucket when none is) so intermediate chunks are
+        # unpadded (one static prefill shape, no new programs) —
+        # snapping up would let sparse buckets balloon the chunk back
+        # into the one-shot stall the knob exists to bound.
+        if prefill_chunk:
+            le = [b for b in self.buckets if b <= prefill_chunk]
+            self.prefill_chunk = le[-1] if le else self.buckets[0]
+        else:
+            self.prefill_chunk = 0
         self._waiting: Deque[EngineRequest] = collections.deque()
         self.active: List[EngineRequest] = []
         self.peak_active = 0
@@ -116,6 +135,31 @@ class Scheduler:
 
     # ---------------------------------------------------------- admission
 
+    def prefill_plan(self, suffix: int) -> List[tuple]:
+        """Split a ``suffix``-token prefill into (real_tokens, bucket)
+        chunks. Chunking off (or suffix within one chunk): a single
+        bucket-padded piece — today's behavior exactly. On: full
+        ``prefill_chunk``-token pieces (bucket == length, unpadded)
+        with a bucketed tail; ONLY the final chunk's logits carry the
+        first generated token, so intermediate chunks are dispatched
+        without a host fetch."""
+        c = self.prefill_chunk
+        if not c or suffix <= c:
+            return [(suffix, bucket_for(suffix, self.buckets))]
+        out: List[tuple] = []
+        rest = suffix
+        while rest > c:
+            out.append((c, c))
+            rest -= c
+        out.append((rest, bucket_for(rest, self.buckets)))
+        return out
+
+    def _prefill_rows(self, suffix: int) -> int:
+        """Cache rows a suffix prefill writes: real tokens for every
+        full chunk plus the final chunk's padded bucket."""
+        plan = self.prefill_plan(suffix)
+        return sum(n for n, _ in plan[:-1]) + plan[-1][1]
+
     def admissions(self) -> Iterator[Admission]:
         """Match waiting requests to free slots, FIFO. Stops at slot
         exhaustion — later arrivals wait for a recycled slot (admitted
@@ -126,10 +170,12 @@ class Scheduler:
             # Reuse depths whose bucket-padded suffix prefill would write
             # past max_len are vetoed: the padded chunk lands at rows
             # [cached, cached + bucket), and a clamped device write would
-            # silently shift the suffix KV onto the wrong rows.
+            # silently shift the suffix KV onto the wrong rows. (Chunked
+            # prefill pads only the FINAL chunk, so its row bound is
+            # usually tighter than the one-shot bucket.)
             got = self.kv.acquire(
                 req.prompt_ids,
-                fit=lambda c: (c + bucket_for(plen - c, self.buckets)
+                fit=lambda c: (c + self._prefill_rows(plen - c)
                                <= self.max_len))
             if got is None:  # raced to exhaustion
                 self._waiting.appendleft(req)
@@ -138,7 +184,8 @@ class Scheduler:
             req.slot, req.cached_len = slot, cached_len
             suffix = plen - cached_len
             yield Admission(req, slot, cached_len,
-                            bucket_for(suffix, self.buckets))
+                            bucket_for(suffix, self.buckets),
+                            chunks=self.prefill_plan(suffix))
 
     def activate(self, req: EngineRequest) -> None:
         """Prefill succeeded: request joins the decode roster."""
@@ -146,10 +193,15 @@ class Scheduler:
         self.active.append(req)
         self.peak_active = max(self.peak_active, len(self.active))
 
-    def abort_admission(self, req: EngineRequest) -> None:
-        """Prefill failed: recycle the slot without seeding the prefix
-        cache (its rows are in an unknown state)."""
-        self.kv.release(req.slot, resident_tokens=())
+    def abort_admission(self, req: EngineRequest,
+                        resident=()) -> None:
+        """Prefill failed: recycle the slot. ``resident`` may carry the
+        PRE-ACQUIRE reused prefix (rows a previous, confirmed
+        generation wrote and this request's prefill never touched —
+        writes start at cached_len) so an abort doesn't evict a still-
+        valid hot prefix; rows this request dispatched are in an
+        unknown state and are never seeded."""
+        self.kv.release(req.slot, resident_tokens=resident)
         req.slot = -1
 
     # ------------------------------------------------------------- decode

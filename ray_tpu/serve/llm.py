@@ -49,7 +49,10 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
 
     ``engine_kwargs`` flow straight into the ``LLMEngine`` constructor —
     including the speculative-decoding knobs (``spec_draft_len``,
-    ``spec_ngram_max``, ``spec_adaptive``) and ``quantize="int8"``."""
+    ``spec_ngram_max``, ``spec_adaptive``), ``quantize="int8"``,
+    ``prefill_chunk`` (chunked prefill), ``paged_decode`` (block-table
+    decode attention) and ``multi_step`` (double-buffered decode
+    dispatch)."""
     from ray_tpu.serve import api as serve_api
 
     engine_kwargs = engine_kwargs or {}

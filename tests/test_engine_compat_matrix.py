@@ -1,0 +1,413 @@
+"""Engine feature compatibility matrix + chunked-prefill/multi-step
+behavior.
+
+The engine's compounding performance knobs — speculative decoding
+(PR 3), weight-only int8 (PR 6), chunked prefill, the paged decode
+kernel, and multi-step double-buffered ticks — all share ONE
+correctness contract: greedy output is token-identical to the plain
+engine (int8 compares within the same quantized weights, since
+quantization itself legitimately changes logits). The fast tier runs
+the highest-interaction corners; the full 16-way sweep is
+``@pytest.mark.slow``.
+"""
+
+import concurrent.futures as cf
+import threading
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+PROMPTS = [
+    [7] * 12,                 # repetitive: prompt lookup drafts
+    list(range(2, 32)),       # 30 tokens: chunks under prefill_chunk=8
+    [9, 8, 7] * 6,            # mid-length repetitive
+    [1, 2, 3],                # short
+    list(range(2, 32)),       # repeat: exercises prefix reuse mid-run
+]
+N_NEW = 16  # long enough for prompt lookup to latch onto repetition
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from ray_tpu.models import llama
+
+    cfg = llama.tiny_config(max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def _run(tiny_model, **kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_buckets", [8, 16])
+    kw.setdefault("prefix_block", 8)
+    eng = LLMEngine(cfg, params, **kw)
+    try:
+        outs = [eng.generate(p, max_new_tokens=N_NEW)["token_ids"]
+                for p in PROMPTS]
+        stats = eng.stats()
+    finally:
+        eng.close()
+    return outs, stats
+
+
+@pytest.fixture(scope="module")
+def baselines(tiny_model):
+    """Plain-engine greedy outputs per quantization level (multi-step
+    off: the pre-PR schedule is the ground truth the new knobs must
+    reproduce)."""
+    return {
+        None: _run(tiny_model, multi_step=False)[0],
+        "int8": _run(tiny_model, multi_step=False, quantize="int8")[0],
+    }
+
+
+def _combo_kw(spec, quant, chunked, paged):
+    kw = {}
+    if spec:
+        kw.update(spec_draft_len=spec, spec_chunk=2)
+    if quant:
+        kw.update(quantize=quant)
+    if chunked:
+        kw.update(prefill_chunk=chunked)
+    if paged:
+        kw.update(paged_decode=True)
+    return kw
+
+
+# Fast tier: the all-on composite per quantization level, plus each new
+# knob alone against the shared baseline.
+FAST_COMBOS = [
+    (2, None, 8, True),       # spec + chunked + paged, f32
+    (2, "int8", 8, True),     # everything on at once
+    (0, None, 8, False),      # chunked alone
+    (0, None, 0, True),       # paged alone
+]
+
+FULL_COMBOS = [(s, q, c, p)
+               for s in (0, 2) for q in (None, "int8")
+               for c in (0, 8) for p in (False, True)]
+
+
+@pytest.mark.parametrize("spec,quant,chunked,paged", FAST_COMBOS)
+def test_feature_combo_token_identity_fast(tiny_model, baselines, spec,
+                                           quant, chunked, paged):
+    outs, stats = _run(tiny_model,
+                       **_combo_kw(spec, quant, chunked, paged))
+    assert outs == baselines[quant], (spec, quant, chunked, paged)
+    if spec:
+        assert stats["spec_chunks"] > 0   # the verify path really ran
+    if chunked:
+        # 30-token prompt, chunk 8: intermediate chunks dispatched
+        # without a fetch — prefill syncs stay one per admission, so
+        # prefill token counts are the only chunking trace here.
+        assert stats["prefill_tokens"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec,quant,chunked,paged", FULL_COMBOS)
+def test_feature_combo_token_identity_full(tiny_model, baselines, spec,
+                                           quant, chunked, paged):
+    outs, _ = _run(tiny_model, **_combo_kw(spec, quant, chunked, paged))
+    assert outs == baselines[quant], (spec, quant, chunked, paged)
+
+
+def test_cfg_level_paged_decode_pads_cache(tiny_model, baselines):
+    """LlamaConfig.paged_decode=True (no engine kwarg) must also pad
+    the cache allocation to a page multiple — its docstring promises
+    the engine pads, and an unpadded cache dies on the kernel's
+    page-multiple check at the first decode tick."""
+    import dataclasses
+
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    pcfg = dataclasses.replace(cfg, paged_decode=True, decode_page=24)
+    eng = LLMEngine(pcfg, params, max_batch=2, max_len=64,
+                    prompt_buckets=[8, 16], prefix_block=8)
+    try:
+        assert eng.cache["k"].shape[3] % 24 == 0  # 64 -> 72 rows
+        outs = [eng.generate(p, max_new_tokens=N_NEW)["token_ids"]
+                for p in PROMPTS]
+    finally:
+        eng.close()
+    assert outs == baselines[None]
+
+
+# --------------------------------------------------------- multi-step
+
+
+def test_multi_step_token_identity_and_sync_parity(tiny_model,
+                                                   baselines):
+    """The double-buffered schedule delivers identical tokens with the
+    identical host-sync count (the witness invariant: one sync per
+    FETCHED chunk — pipelining moves the sync, never adds one)."""
+    outs_on, stats_on = _run(tiny_model, multi_step=True)
+    _, stats_off = _run(tiny_model, multi_step=False)
+    assert outs_on == baselines[None]
+    assert (stats_on["decode_host_syncs"]
+            == stats_off["decode_host_syncs"])
+
+
+def test_multi_step_pipelines_dispatch_ahead_of_fetch(tiny_model):
+    """Steady-state decode must dispatch chunk N+1 BEFORE fetching
+    chunk N (the observable double-buffer), with the SAME dispatch and
+    fetch counts as the serial schedule: a budget-bound burst wastes
+    nothing, because the engine skips the speculative dispatch once no
+    request's remaining budget can outlive the in-flight chunk."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    events = {}
+    for multi_step in (True, False):
+        eng = LLMEngine(cfg, params, max_batch=1, max_len=64,
+                        prompt_buckets=[8], decode_chunk=4,
+                        multi_step=multi_step)
+        log = events.setdefault(multi_step, [])
+        inner_dispatch = eng.loop.decode_chunk
+        inner_fetch = eng._fetch
+
+        def dispatch(*a, _i=inner_dispatch, _log=log, **kw):
+            _log.append("d")
+            return _i(*a, **kw)
+
+        def fetch(tree, tag="decode", _i=inner_fetch, _log=log):
+            if tag == "decode":
+                _log.append("f")
+            return _i(tree, tag)
+
+        eng.loop.decode_chunk = dispatch
+        eng._fetch = fetch
+        try:
+            out = eng.generate([1, 2, 3], max_new_tokens=13)
+        finally:
+            eng.close()
+        assert out["num_generated"] == 13
+    # Identical work: 3 dispatches, 3 fetches (ceil(12/4)) both ways …
+    assert sorted(events[True]) == sorted(events[False]) == \
+        ["d", "d", "d", "f", "f", "f"]
+    # … but multi-step enqueues the second chunk BEFORE fetching the
+    # first, while the serial schedule strictly alternates.
+    assert events[True] == ["d", "d", "f", "d", "f", "f"]
+    assert events[False] == ["d", "f", "d", "f", "d", "f"]
+
+
+def test_multi_step_roster_churn_under_concurrency(tiny_model):
+    """Requests joining and finishing mid-burst (slot recycling, prefix
+    reuse, staggered lengths) must not lose or duplicate tokens when
+    chunks are retired one behind dispatch."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    want = {}
+    for ms in (False, True):
+        eng = LLMEngine(cfg, params, max_batch=2, max_len=64,
+                        prompt_buckets=[8, 16], decode_chunk=4,
+                        multi_step=ms)
+        lens = [5, 9, 13, 7, 11, 6]
+        prompts = [[i + 1] * 3 for i in range(6)]
+        try:
+            with cf.ThreadPoolExecutor(6) as pool:
+                futs = [pool.submit(eng.generate, p, n)
+                        for p, n in zip(prompts, lens)]
+                outs = [f.result(timeout=300)["token_ids"]
+                        for f in futs]
+        finally:
+            eng.close()
+        want[ms] = outs
+        for n, o in zip(lens, outs):
+            assert len(o) == n
+    assert want[True] == want[False]
+
+
+# ----------------------------------------------------- chunked prefill
+
+
+def test_prefill_plan_shapes():
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+    from ray_tpu.serve.engine.scheduler import Scheduler
+
+    kv = KVCacheManager(num_slots=2, max_len=64, block_size=8)
+    s = Scheduler(kv, max_len=64, prompt_buckets=[8, 16, 32],
+                  prefill_chunk=8)
+    assert s.prefill_plan(5) == [(5, 8)]          # within one chunk
+    assert s.prefill_plan(8) == [(8, 8)]
+    assert s.prefill_plan(20) == [(8, 8), (8, 8), (4, 8)]
+    assert s.prefill_plan(16) == [(8, 8), (8, 8)]  # exact multiple
+    # Padded rows: full chunks are unpadded, only the tail buckets.
+    assert s._prefill_rows(20) == 8 + 8 + 8
+    # Chunking off: one bucket-padded piece.
+    s0 = Scheduler(kv, max_len=64, prompt_buckets=[8, 16, 32])
+    assert s0.prefill_plan(20) == [(20, 32)]
+    assert s0._prefill_rows(20) == 32
+    # prefill_chunk snaps DOWN to a configured bucket (static shapes;
+    # snapping up would balloon the chunk between sparse buckets and
+    # reintroduce the one-shot stall) — up only when nothing smaller.
+    s7 = Scheduler(kv, max_len=64, prompt_buckets=[8, 16, 32],
+                   prefill_chunk=7)
+    assert s7.prefill_chunk == 8
+    s20 = Scheduler(kv, max_len=64, prompt_buckets=[8, 16, 32],
+                    prefill_chunk=20)
+    assert s20.prefill_chunk == 16
+    s_sparse = Scheduler(kv, max_len=256, prompt_buckets=[32, 224],
+                         prefill_chunk=64)
+    assert s_sparse.prefill_chunk == 32  # NOT 224
+
+
+def test_chunked_fit_admits_deeper_prefix_reuse():
+    """The chunked row bound (full chunks unpadded, only the tail
+    bucketed) is tighter than the one-shot bucket, so reuse depths the
+    unchunked fit must veto survive: a 16-token resident hit on a
+    39-token prompt at max_len 40 keeps all 16 rows chunked
+    (16 + 8+8+8 = 40) but shrinks to 8 unchunked (16 + 32 = 48)."""
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+    from ray_tpu.serve.engine.scheduler import (EngineRequest,
+                                                Scheduler)
+
+    prompt = list(range(2, 41))  # 39 tokens
+    for chunk, want_cached in ((0, 8), (8, 16)):
+        kv = KVCacheManager(num_slots=1, max_len=40, block_size=8)
+        s = Scheduler(kv, max_len=40, prompt_buckets=[8, 32],
+                      prefill_chunk=chunk)
+        slot, _ = kv.acquire(prompt)
+        kv.release(slot, resident_tokens=prompt[:16])  # 2-block hit
+        req = EngineRequest(prompt_ids=list(prompt), max_new_tokens=1)
+        s.submit(req)
+        (adm,) = list(s.admissions())
+        assert adm.cached_len == want_cached, (chunk, adm.cached_len)
+
+
+def test_kv_commit_prefill_tracks_materialized_prefix():
+    """Occupancy is committed in FULL at acquire (the chunk plan is
+    spoken for — the router's KV-pressure term must not under-count a
+    long in-flight prefill), while resident/chain track the
+    MATERIALIZED prefix chunk by chunk, hashed incrementally (the new
+    blocks chain onto the old hashes — same chain as a one-shot
+    hash)."""
+    from ray_tpu.serve.engine.kv_manager import (KVCacheManager,
+                                                 chain_hashes)
+
+    kv = KVCacheManager(num_slots=1, max_len=32, block_size=4)
+    prompt = list(range(40, 60))  # 20 tokens
+    slot, cached = kv.acquire(prompt)
+    assert cached == 0 and kv.used_blocks() == 5  # whole plan, up-front
+    kv.commit_prefill(slot, prompt[:8])
+    assert kv._slots[slot].resident == tuple(prompt[:8])
+    assert len(kv._slots[slot].chain) == 2
+    kv.commit_prefill(slot, prompt[:14])  # mid-block tail: 3 complete
+    assert len(kv._slots[slot].chain) == 3
+    kv.commit_prefill(slot, prompt[:20])
+    assert (list(kv._slots[slot].chain)
+            == chain_hashes(prompt, 4))   # incremental == one-shot
+    assert kv.used_blocks() == 5          # unchanged by materialization
+    kv.release(slot, resident_tokens=prompt)
+    assert kv.used_blocks() == 0
+
+
+def test_abort_seeds_only_preacquire_prefix():
+    """A failed admission releases the slot seeding the PRE-ACQUIRE
+    reused prefix (rows a confirmed earlier generation wrote), never
+    the aborted request's own unconfirmed rows."""
+    from ray_tpu.serve.engine.kv_manager import KVCacheManager
+    from ray_tpu.serve.engine.scheduler import (EngineRequest,
+                                                Scheduler)
+
+    kv = KVCacheManager(num_slots=1, max_len=32, block_size=4)
+    s = Scheduler(kv, max_len=32, prompt_buckets=[8, 16],
+                  prefill_chunk=4)
+    seed = list(range(70, 78))
+    slot, _ = kv.acquire(seed)
+    kv.release(slot, resident_tokens=seed)
+    prompt = seed + list(range(80, 88))
+    req = EngineRequest(prompt_ids=prompt, max_new_tokens=4)
+    s.submit(req)
+    (adm,) = list(s.admissions())
+    assert adm.cached_len == 8
+    kv.commit_prefill(adm.slot, prompt[:12])  # one chunk landed …
+    s.abort_admission(req, resident=prompt[:adm.cached_len])  # … fails
+    # The old 8-token prefix still serves hits; the aborted rows don't.
+    s2, cached = kv.acquire(seed + [99])
+    assert cached == 8
+    kv.release(s2, resident_tokens=())
+    s3, cached = kv.acquire(prompt)
+    assert cached == 0  # the 12-token commit never reached the index
+
+
+def test_chunked_prefill_engine_prefix_reuse_and_streaming(tiny_model):
+    """Chunked engine end-to-end: warm repeat reuses the prefix cache
+    and streams identical tokens; a long prompt co-batched with an
+    active decode stream doesn't change either output."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = tiny_model
+    eng = LLMEngine(cfg, params, max_batch=2, max_len=64,
+                    prompt_buckets=[8, 16], prefix_block=8,
+                    prefill_chunk=8, decode_chunk=4)
+    long_prompt = list(range(2, 32))
+    try:
+        cold = eng.generate(long_prompt, max_new_tokens=8)
+        assert cold["cached_prefix_len"] == 0
+        warm = eng.generate(long_prompt, max_new_tokens=8)
+        assert warm["cached_prefix_len"] == 24  # 3 of 30//8 blocks
+        assert warm["token_ids"] == cold["token_ids"]
+        got = {}
+
+        def consume(name, prompt, n):
+            got[name] = list(eng.generate_stream(prompt,
+                                                 max_new_tokens=n))
+
+        t1 = threading.Thread(target=consume, args=("decode",
+                                                    [5, 4, 3], 20))
+        t1.start()
+        deadline = time.monotonic() + 120
+        while eng.metrics.requests < 3 and time.monotonic() < deadline:
+            time.sleep(0.001)  # decode stream admitted (monotonic
+            #                    signal — roster emptiness races)
+        assert eng.metrics.requests >= 3, "stream never admitted"
+        consume("long", list(range(32, 60)), 6)
+        t1.join(timeout=300)
+    finally:
+        eng.close()
+    assert len(got["decode"]) == 20
+    assert len(got["long"]) == 6
+
+
+def test_chunked_prefill_emits_per_chunk_spans(tiny_model):
+    """TTFT decomposition under chunked prefill: one engine.prefill
+    span PER CHUNK with chunk/chunks attrs (a whole 30-token prompt
+    attributed to one span would hide where the prefill time went)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as gcfg
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.util import tracing
+
+    cfg, params = tiny_model
+    spans = []
+    old = gcfg.get("tracing_enabled")
+    gcfg.set("tracing_enabled", True)
+    tracing.set_sink(spans.extend)
+    eng = LLMEngine(cfg, params, max_batch=1, max_len=64,
+                    prompt_buckets=[8, 16], prefill_chunk=8,
+                    decode_chunk=4)
+    try:
+        with tracing.trace("matrix-root"):
+            out = eng.generate(list(range(2, 32)), max_new_tokens=4)
+        tracing.flush()
+    finally:
+        eng.close()
+        tracing.set_sink(None)
+        gcfg.set("tracing_enabled", old)
+    assert out["num_generated"] == 4
+    pf = sorted((s for s in spans if s["name"] == "engine.prefill"),
+                key=lambda s: s["attrs"]["chunk"])
+    # 30-token suffix, chunk 8 -> (8, 8, 8, 6): four chunk spans.
+    assert [s["attrs"]["chunk"] for s in pf] == [0, 1, 2, 3]
+    assert all(s["attrs"]["chunks"] == 4 for s in pf)
+    assert [s["attrs"]["prefill_tokens"] for s in pf] == [8, 8, 8, 6]
+    assert pf[-1]["attrs"]["bucket"] == 8
+    queued = [s for s in spans if s["name"] == "engine.queued"]
+    assert len(queued) == 1

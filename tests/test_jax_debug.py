@@ -186,6 +186,47 @@ def test_steady_state_decode_programs_and_sync_cadence(debug_jax,
         eng.close()
 
 
+def test_chunked_paged_engine_declared_schedule(debug_jax):
+    """The chunked-prefill + paged-decode + multi-step engine keeps the
+    SAME declared budgets: one decode program (paged dispatch is a
+    static config branch inside it), prefill programs within the
+    per-bucket budget even though a long prompt now dispatches MANY
+    chunks (intermediate chunks reuse bucket shapes and fetch nothing),
+    exactly one counted prefill sync per ADMISSION (the final chunk's
+    first-token fetch), and decode witness syncs == the per-chunk
+    metric (multi-step moves the fetch one chunk behind dispatch, it
+    never adds or drops one)."""
+    eng = _engine(prefill_chunk=16, paged_decode=True, prefix_block=16,
+                  multi_step=True)
+    try:
+        # 40-token prompt -> chunks (16, 16, 8); short prompt -> one.
+        out = eng.generate([3] * 40, max_new_tokens=12)
+        assert out["num_generated"] == 12
+        assert eng.generate([9, 8, 7], max_new_tokens=9)[
+            "num_generated"] == 9
+        first = eng.loop.program_counts()
+        eng.generate([3] * 40, max_new_tokens=4)  # steady: no growth
+        programs = eng.loop.program_counts()
+        assert programs == first
+        assert programs["decode_chunk"] == 1
+        # Chunking NARROWS the prefill shape set: every full chunk is
+        # the 16-token bucket and every tail (<= chunk) buckets back
+        # into it — one program, under the 2-bucket budget.
+        assert programs["prefill"] == 1
+        assert jax_debug.over_budget_reports() == []
+        stats = eng.stats()
+        syncs = jax_debug.host_sync_counts()
+        assert syncs.get("engine.decode", 0) == \
+            stats["decode_host_syncs"]
+        assert syncs.get("engine.prefill", 0) == stats["requests"] == 3
+        # Chunked accounting: 40+3+40 real suffix tokens prefilled
+        # (minus any warm prefix reuse on the repeat).
+        assert stats["prefill_tokens"] + stats[
+            "prefix_tokens_reused"] == 83
+    finally:
+        eng.close()
+
+
 def test_transfer_guard_clean_engine_tick(debug_jax, monkeypatch):
     """Under RTPU_DEBUG_JAX_TRANSFER_GUARD=disallow every tick runs
     inside jax.transfer_guard: all device traffic must go through the

@@ -1,0 +1,189 @@
+"""Paged decode-attention kernel vs references (interpret mode on CPU —
+the decode_attention.py test idiom): the block-table read must be
+bit-equal to the contiguous read for identity tables, exact against the
+gather reference for scattered tables, and the llama/engine dispatch
+glue must reproduce the unpaged model path."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.ops.decode_attention import decode_attention_reference  # noqa: E402
+from ray_tpu.ops.paged_decode import (paged_decode_attention,  # noqa: E402
+                                      paged_decode_attention_reference)
+
+
+def _inputs(b=2, h=8, kh=4, s=64, d=16, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (b, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, kh, s, d), dtype)
+    v = jax.random.normal(ks[2], (b, kh, s, d), dtype)
+    lengths = jnp.asarray(
+        jax.random.randint(ks[3], (b,), 1, s + 1), jnp.int32)
+    return q, k, v, lengths
+
+
+def _identity_table(b, s, page):
+    np_row = s // page
+    return jnp.arange(b * np_row, dtype=jnp.int32).reshape(b, np_row)
+
+
+def _scatter_pages(k, v, page, seed=0):
+    """Shuffle every (seq, page) into a random physical page of an
+    equally-sized pool; returns (pool_k, pool_v, table)."""
+    b, kh, s, d = k.shape
+    np_row = s // page
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(b * np_row)
+    kp = np.asarray(k).reshape(b, kh, np_row, page, d)
+    vp = np.asarray(v).reshape(b, kh, np_row, page, d)
+    pool_k = np.zeros_like(kp)
+    pool_v = np.zeros_like(vp)
+    table = np.zeros((b, np_row), np.int32)
+    for bi in range(b):
+        for pi in range(np_row):
+            t = int(perm[bi * np_row + pi])
+            table[bi, pi] = t
+            pool_k[t // np_row, :, t % np_row] = kp[bi, :, pi]
+            pool_v[t // np_row, :, t % np_row] = vp[bi, :, pi]
+    return (jnp.asarray(pool_k.reshape(b, kh, s, d)),
+            jnp.asarray(pool_v.reshape(b, kh, s, d)),
+            jnp.asarray(table))
+
+
+def test_identity_table_bit_equal_to_contiguous_reference():
+    """A slot-identity table (the engine's table) reads the exact same
+    rows in the exact same order — the paged reference must be
+    BIT-equal to the contiguous decode reference on live rows."""
+    q, k, v, lengths = _inputs()
+    table = _identity_table(2, 64, 8)
+    ref = decode_attention_reference(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), lengths)
+    got = paged_decode_attention_reference(q, k, v, table, lengths, 8)
+    assert jnp.array_equal(ref, got)
+
+
+@pytest.mark.parametrize("shape", [
+    dict(b=2, h=8, kh=4, s=64, d=16),     # GQA
+    dict(b=1, h=4, kh=4, s=96, d=32),     # MHA, 12 pages
+    dict(b=3, h=16, kh=2, s=64, d=16),    # deep GQA groups
+])
+def test_kernel_matches_reference_identity(shape):
+    q, k, v, lengths = _inputs(**shape)
+    page = 8
+    table = _identity_table(shape["b"], shape["s"], page)
+    expect = paged_decode_attention_reference(q, k, v, table, lengths,
+                                              page)
+    got = paged_decode_attention(q, k, v, table, lengths,
+                                 page_size=page, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_scattered_table_reads_in_place():
+    """Pages scattered across the pool: the kernel must follow the
+    table (no contiguity assumption) and still match the un-scattered
+    contiguous computation exactly."""
+    q, k, v, lengths = _inputs(b=2, h=8, kh=4, s=64, d=16)
+    page = 8
+    pool_k, pool_v, table = _scatter_pages(k, v, page)
+    ref = decode_attention_reference(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), lengths)
+    got_ref = paged_decode_attention_reference(q, pool_k, pool_v, table,
+                                               lengths, page)
+    assert jnp.array_equal(ref, got_ref)  # gather undoes the scatter
+    got = paged_decode_attention(q, pool_k, pool_v, table, lengths,
+                                 page_size=page, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pages_past_length_never_contribute():
+    """Poison every row at or past each sequence's length — including
+    WHOLE pages the index map never streams — and check invariance."""
+    q, k, v, _ = _inputs(b=2, h=4, kh=4, s=64, d=16)
+    page = 8
+    lengths = jnp.asarray([3, 41], jnp.int32)  # partial first/last pages
+    table = _identity_table(2, 64, page)
+    expect = paged_decode_attention_reference(q, k, v, table, lengths,
+                                              page)
+    k_p = k.at[0, :, 3:].set(100.0).at[1, :, 41:].set(100.0)
+    v_p = v.at[0, :, 3:].set(-77.0).at[1, :, 41:].set(-77.0)
+    got = paged_decode_attention(q, k_p, v_p, table, lengths,
+                                 page_size=page, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zero_length_slot_attends_nothing():
+    """A freed/empty slot (length 0) outputs ~0 — never the mean of
+    whatever physical page the parked index map landed on."""
+    q, k, v, _ = _inputs(b=2, h=4, kh=4, s=64, d=16)
+    lengths = jnp.asarray([0, 64], jnp.int32)
+    table = _identity_table(2, 64, 8)
+    got = paged_decode_attention(q, k, v, table, lengths,
+                                 page_size=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(got)[0], 0.0, atol=1e-6)
+    expect = paged_decode_attention_reference(q, k, v, table, lengths, 8)
+    np.testing.assert_allclose(np.asarray(got)[1],
+                               np.asarray(expect)[1],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_non_multiple_cache_rows_rejected():
+    q, k, v, lengths = _inputs(b=1, h=4, kh=4, s=60, d=16)
+    table = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention(q, k, v, table, lengths, page_size=8)
+
+
+def test_bfloat16_inputs():
+    q, k, v, lengths = _inputs(b=1, h=4, kh=2, s=64, d=16,
+                               dtype=jnp.bfloat16)
+    table = _identity_table(1, 64, 8)
+    expect = paged_decode_attention_reference(q, k, v, table, lengths, 8)
+    got = paged_decode_attention(q, k, v, table, lengths,
+                                 page_size=8, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(expect, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_llama_paged_dispatch_glue():
+    """The MODEL-side integration (llama._block's identity table /
+    lengths / page-size plumbing) against the unpaged path — both the
+    gather-reference dispatch (paged_decode=True off-TPU) and the
+    interpret-mode kernel."""
+    from ray_tpu.models import llama
+
+    base = llama.tiny_config(max_seq_len=64)
+    cfg_r = dataclasses.replace(base, paged_decode=True, decode_page=8)
+    cfg_i = dataclasses.replace(base, paged_decode="interpret",
+                                decode_page=8)
+    cfg_x = dataclasses.replace(base, use_decode_kernel=False)
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+    caches = {n: llama.init_kv_cache(base, 2, 64) for n in "rix"}
+    cfgs = {"r": cfg_r, "i": cfg_i, "x": cfg_x}
+    prompt = jnp.asarray([[5, 9, 3, 7], [2, 8, 1, 4]], jnp.int32)
+    outs = {}
+    for n in "rix":  # prefill is the same unpaged path everywhere
+        outs[n], caches[n] = llama.forward_with_cache(
+            params, prompt, caches[n], 0, cfgs[n])
+    np.testing.assert_allclose(np.asarray(outs["r"]),
+                               np.asarray(outs["x"]), rtol=2e-4,
+                               atol=2e-4)
+    tok = jnp.argmax(outs["x"][:, -1], -1)[:, None].astype(jnp.int32)
+    for step in range(3):
+        for n in "rix":
+            outs[n], caches[n] = llama.forward_with_cache(
+                params, tok, caches[n], 4 + step, cfgs[n])
+        for n in "ri":
+            np.testing.assert_allclose(
+                np.asarray(outs[n]), np.asarray(outs["x"]),
+                rtol=2e-3, atol=2e-3)
+        tok = jnp.argmax(outs["x"][:, -1], -1)[:, None].astype(jnp.int32)
