@@ -106,7 +106,8 @@ SERVE_ROUTED_TIMEOUT_S = 600  # whole 8-phase sweep child (2 replicas, CPU)
 PROBE_TIMEOUT_S = 180      # backend init probe (axon can HANG, not fail)
 LOCALITY_TIMEOUT_S = 420   # per locality child (boots a 4-node cluster)
 DATAPLANE_TIMEOUT_S = 420  # dataplane child (store bench + 2-node cluster)
-CHAOS_TIMEOUT_S = 420      # chaos child (kill head + kill node + recover)
+CHAOS_TIMEOUT_S = 600      # chaos child (kill head/node + upgrade + recover)
+SCALE_TIMEOUT_S = 300      # scale child (100 simulated nodes, head hot paths)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -1655,6 +1656,36 @@ def chaos_child_main() -> None:
     object_reconstruction_s = time.perf_counter() - t0
     assert got[0] == 0 and got[-1] == n - 1
 
+    # --- head_upgrade_s: rolling head upgrade (drain -> sqlite
+    # checkpoint -> port handover to a NEW incarnation) under continuous
+    # task + actor-call load. Acceptance is ZERO failed client requests
+    # (latency may spike while requests ride retries across the gap) —
+    # asserted here, so a row with head_upgrade_s implies it held.
+    from ray_tpu.devtools import chaos as _chaos_mod
+
+    @rt.remote(max_restarts=1, max_task_retries=-1)
+    class UpgradeEcho:
+        def hit(self, i):
+            return i
+
+    echo = UpgradeEcho.remote()
+    assert rt.get(echo.hit.remote(-1), timeout=60) == -1
+
+    def _upgrade_request(i):
+        if i % 2:
+            assert rt.get(ping.remote(i), timeout=120) == i
+        else:
+            assert rt.get(echo.hit.remote(i), timeout=120) == i
+
+    up = _chaos_mod.run_rolling_upgrade(runtime, _upgrade_request,
+                                        clients=2)
+    assert up["request_failures"] == [], \
+        f"requests failed during rolling upgrade: {up['request_failures']}"
+    assert up["new_incarnation"] != up["old_incarnation"]
+    head_upgrade_s = up["upgrade_s"]
+    upgrade_requests_ok = up["requests_ok"]
+    rt.kill(echo)
+
     # --- leak check: after the workload drains, the cluster-wide lease
     # census must be empty (every fault path returned its lease). A
     # census with an unreachable node is NOT leak-free — it is
@@ -1675,6 +1706,8 @@ def chaos_child_main() -> None:
         "metric": "chaos_recovery",
         "head_recovery_s": round(head_recovery_s, 2),
         "object_reconstruction_s": round(object_reconstruction_s, 2),
+        "head_upgrade_s": round(head_upgrade_s, 2),
+        "upgrade_requests_ok": upgrade_requests_ok,
         "leaked_leases": len(leaked) if leaked is not None else -1,
         "object_bytes": n * 8, "nodes": 2,
     }
@@ -1871,6 +1904,7 @@ def _merge_chaos_rows(rows: list) -> dict:
         merged["error"] = row["error"]
     else:
         for k in ("head_recovery_s", "object_reconstruction_s",
+                  "head_upgrade_s", "upgrade_requests_ok",
                   "leaked_leases", "census_error", "rpc_witness_clean",
                   "rpc_witness_violations", "rpc_witness_log_lines",
                   "rpc_dup_audits", "leaked_resources",
@@ -1879,6 +1913,128 @@ def _merge_chaos_rows(rows: list) -> dict:
             if row.get(k) is not None:
                 merged[k] = row[k]
     return merged
+
+
+# --------------------------------------------------------------------------
+# scale suite (--scale): head hot paths at 100 simulated nodes
+# --------------------------------------------------------------------------
+
+def scale_child_main() -> int:
+    """Boot ONE head + N simulated in-process node managers (stubbed
+    stores, real control plane: registration, versioned heartbeat sync,
+    directory mirrors, lease census) and measure the head's hot paths at
+    production node counts: RPC dispatch (pick_node with locality
+    hints), object-directory lookups, the node-death/drain directory
+    scrub, and the cluster-wide lease census. Prints one JSON row."""
+    import hashlib
+    import random as _random
+
+    from ray_tpu.cluster import protocol as _protocol
+    from ray_tpu.core.cluster_runtime import SimulatedCluster
+
+    n = int(os.environ.get("RTPU_SCALE_NODES", "100"))
+    n_objects = int(os.environ.get("RTPU_SCALE_OBJECTS", "20000"))
+    t0 = time.perf_counter()
+    sim = SimulatedCluster(n)
+    sim.wait_registered(60)
+    boot_s = time.perf_counter() - t0
+    rng = _random.Random(0)
+    node_ids = [nd.node_id for nd in sim.nodes]
+
+    def pctl(vals: list, p: float) -> float:
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+    # Seed the object directory: n_objects objects, 1-3 holders each,
+    # shipped as one object_batch frame per node (the production wire
+    # shape). Gives directory lookups + the drain scrub real work.
+    oids = [hashlib.sha224(b"scale-obj-%d" % i).digest()
+            for i in range(n_objects)]
+    per_node: dict = {nid: [] for nid in node_ids}
+    for oid in oids:
+        for nid in rng.sample(node_ids, rng.randint(1, 3)):
+            per_node[nid].append(("add", oid, 1 << 20))
+    for nid, entries in per_node.items():
+        sim.client.call("object_batch", nid, entries, timeout=30)
+
+    # Head RPC dispatch: pick_node, alternating bare and locality-hinted
+    # picks (the dispatch shape owners send), p99 over 2000 calls.
+    lat_pick = []
+    for i in range(2000):
+        hints = ([oids[rng.randrange(n_objects)] for _ in range(4)]
+                 if i % 2 else None)
+        t = time.perf_counter()
+        picked = sim.client.call("pick_node", {"CPU": 1.0}, None, None,
+                                 f"scale-k{i % 64}", hints, timeout=30)
+        lat_pick.append((time.perf_counter() - t) * 1e6)
+        assert picked is not None
+    # Directory lookups: object_locations p99 over 2000 random objects.
+    lat_loc = []
+    for i in range(2000):
+        t = time.perf_counter()
+        sim.client.call("object_locations",
+                        oids[rng.randrange(n_objects)],
+                        node_ids[rng.randrange(n)], timeout=30)
+        lat_loc.append((time.perf_counter() - t) * 1e6)
+    # Cluster-wide lease census (fan-out to all N nodes).
+    t = time.perf_counter()
+    census = sim.client.call("cluster_leases", timeout=60)
+    census_ms = (time.perf_counter() - t) * 1e3
+    census_errors = sum(1 for v in census.values()
+                        if isinstance(v, dict) and "error" in v)
+    # Node drain: the directory scrub that also runs per dead node.
+    t = time.perf_counter()
+    sim.client.call("drain_node", node_ids[-1], timeout=60)
+    drain_ms = (time.perf_counter() - t) * 1e3
+    # Heartbeat fan-in: the in-process head's per-handler stats cover
+    # every beat the N nodes sent since boot.
+    hb = _protocol.get_event_stats().get("heartbeat", {})
+    hb_count = int(hb.get("count", 0))
+    row = {
+        "metric": "head_scale",
+        "nodes": n,
+        "objects": n_objects,
+        "boot_s": round(boot_s, 2),
+        "head_dispatch_us_p50": round(pctl(lat_pick, 0.50), 1),
+        "head_dispatch_us_p99": round(pctl(lat_pick, 0.99), 1),
+        "head_object_locations_us_p99": round(pctl(lat_loc, 0.99), 1),
+        "head_census_ms": round(census_ms, 1),
+        "head_census_errors": census_errors,
+        "head_drain_scrub_ms": round(drain_ms, 1),
+        "heartbeats_processed": hb_count,
+        "head_heartbeat_handler_us_avg": round(
+            hb.get("total_s", 0.0) / hb_count * 1e6, 1) if hb_count else None,
+        "head_heartbeat_handler_ms_max": round(
+            hb.get("max_s", 0.0) * 1e3, 2) if hb_count else None,
+    }
+    print(json.dumps(row), flush=True)
+    sim.shutdown()
+    return 0
+
+
+def _scale_rows() -> list:
+    try:
+        proc = _run(["--scale-child"], SCALE_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "head_scale",
+                 "error": f"timeout {SCALE_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "head_scale",
+                "error": "rc=%d: %s" % (proc.returncode,
+                                        " | ".join(tail))})
+    return out
+
+
+def scale_main() -> int:
+    rows = _scale_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return 0 if all("error" not in r for r in rows) else 1
 
 
 # --------------------------------------------------------------------------
@@ -2071,13 +2227,24 @@ def main() -> int:
         print(json.dumps(r), flush=True)
 
     # Phase 6: chaos-recovery suite on CPU (kill head / kill holder,
-    # recovery times + lease-leak census). Tracked from this PR.
+    # rolling upgrade, recovery times + lease-leak census). Tracked
+    # from this PR.
     chaos_rows: list = []
     try:
         chaos_rows = _chaos_rows()
     except Exception as e:  # noqa: BLE001 — never blocks the bench
         chaos_rows = [{"metric": "chaos_recovery", "error": repr(e)[:200]}]
     for r in chaos_rows:
+        print(json.dumps(r), flush=True)
+
+    # Phase 7: head scale suite on CPU (100 simulated nodes, head
+    # dispatch/directory/census hot paths). Tracked from this PR.
+    scale_rows: list = []
+    try:
+        scale_rows = _scale_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        scale_rows = [{"metric": "head_scale", "error": repr(e)[:200]}]
+    for r in scale_rows:
         print(json.dumps(r), flush=True)
 
     # Final merged line (the driver parses the tail line): headline is the
@@ -2177,11 +2344,20 @@ def main() -> int:
         merged["dataplane_error"] = dp_merged["error"]
     ch_merged = _merge_chaos_rows(chaos_rows)
     for k in ("head_recovery_s", "object_reconstruction_s",
-              "leaked_leases", "leaked_resources"):
+              "head_upgrade_s", "leaked_leases", "leaked_resources"):
         if ch_merged.get(k) is not None:
             merged[k] = ch_merged[k]
     if "error" in ch_merged:
         merged["chaos_error"] = ch_merged["error"]
+    sc = next((r for r in scale_rows if r.get("metric") == "head_scale"),
+              {})
+    if "error" not in sc and sc.get("head_dispatch_us_p99") is not None:
+        suffix = f"{sc.get('nodes', 0)}node"
+        merged[f"head_dispatch_us_p99_{suffix}"] = \
+            sc["head_dispatch_us_p99"]
+        merged[f"head_census_ms_{suffix}"] = sc.get("head_census_ms")
+    elif sc:
+        merged["scale_error"] = sc["error"]
     print(json.dumps(merged))
     return 0
 
@@ -2211,6 +2387,10 @@ if __name__ == "__main__":
         sys.exit(chaos_child_main())
     if "--chaos" in sys.argv:
         sys.exit(chaos_main())
+    if "--scale-child" in sys.argv:
+        sys.exit(scale_child_main())
+    if "--scale" in sys.argv:
+        sys.exit(scale_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

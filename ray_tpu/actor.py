@@ -122,6 +122,7 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             concurrency_groups=opts.get("concurrency_groups"),
             max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
             resources=resources,
             lifetime=opts.get("lifetime"),
             scheduling_strategy=opts.get("scheduling_strategy"),

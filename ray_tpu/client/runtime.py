@@ -207,7 +207,8 @@ class ClientRuntime:
 
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "default", max_concurrency: int = 1,
-                     max_restarts: int = 0, resources=None, lifetime=None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
                      concurrency_groups: Optional[Dict[str, int]] = None,
@@ -219,6 +220,7 @@ class ClientRuntime:
             "max_concurrency": max_concurrency,
             "concurrency_groups": concurrency_groups,
             "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
             "resources": resources.to_dict() if resources is not None else None,
             "lifetime": lifetime,
             "scheduling_strategy": scheduling_strategy,

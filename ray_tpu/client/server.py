@@ -213,6 +213,7 @@ class ClientGateway:
             max_concurrency=opts.get("max_concurrency", 1),
             concurrency_groups=opts.get("concurrency_groups"),
             max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
             resources=ResourceSet.from_dict(resources) if resources else None,
             lifetime=opts.get("lifetime"),
             scheduling_strategy=opts.get("scheduling_strategy"),

@@ -72,6 +72,19 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.sync_version = -1  # versioned resource view (delta sync)
+        # Cached max-fraction-used utilization, recomputed whenever
+        # `available` changes (heartbeats — O(nodes) writes per second)
+        # instead of per scheduling pass (O(nodes * resources) reads per
+        # PICK: at 100 nodes the recomputation inside every
+        # _score_nodes_ex scan was the head's hottest loop and its
+        # longest _lock hold — bench.py --scale measures it).
+        self.util = 0.0
+        self.recompute_util()
+
+    def recompute_util(self) -> None:
+        us = [1 - self.available.get(k, 0) / t
+              for k, t in self.total.items() if t > 0]
+        self.util = max(us) if us else 0.0
 
     def view(self) -> Dict[str, Any]:
         return {"node_id": self.node_id, "address": self.address,
@@ -82,12 +95,18 @@ class NodeInfo:
 
 class ActorInfo:
     def __init__(self, actor_id: bytes, name: Optional[str], namespace: str,
-                 spec_blob: bytes, max_restarts: int, resources: Dict[str, float]):
+                 spec_blob: bytes, max_restarts: int, resources: Dict[str, float],
+                 max_task_retries: int = 0):
         self.actor_id = actor_id
         self.name = name
         self.namespace = namespace
         self.spec_blob = spec_blob  # serialized (cls, args, kwargs, opts)
         self.max_restarts = max_restarts
+        # Replay policy: != 0 opts this actor's CALLS into at-least-once
+        # delivery — submitters replay unacked calls against a restarted
+        # incarnation instead of failing them (reference semantics:
+        # max_task_retries on actor methods). 0 = fail-fast (default).
+        self.max_task_retries = max_task_retries
         self.restart_count = 0
         self.resources = resources
         self.state = PENDING
@@ -119,6 +138,12 @@ class HeadServer:
         self._named: Dict[Tuple[str, str], bytes] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         self._object_dir: Dict[bytes, Set[str]] = {}
+        # Reverse index node -> its resident oids: node death and drain
+        # scrub ONLY that node's entries instead of walking the whole
+        # directory under the scheduler lock (O(all objects) per death —
+        # at 100 nodes x 20k objects the full-table walk was a
+        # triple-digit-ms lock hold; bench.py --scale measures it).
+        self._node_objects: Dict[str, Set[bytes]] = {}
         # Sealed sizes alongside the holder sets: the scheduler scores
         # candidate nodes by locally-resident input BYTES, not object
         # counts (reference: the GCS object directory the raylet's
@@ -156,6 +181,16 @@ class HeadServer:
         self._task_events = _collections.deque(
             maxlen=int(cfg.task_events_buffer_size))
         self._pool = ClientPool()
+        # Bounded executor for node fan-outs (lease census), built on
+        # first use under self._lock (see _fanout_pool).
+        self._census_pool = None
+        # actor_id -> re-register deadline for actors recovered ALIVE
+        # from the durable tables (see _sweep_alive_watch).
+        self._alive_watch: Dict[bytes, float] = {}
+        # True while a rolling upgrade drains this head (prepare_upgrade):
+        # health sweeps stop declaring nodes dead — the successor, not
+        # this era, owns liveness decisions from here on.
+        self._draining = False
         # Durable tables (reference: gcs_table_storage.h). None = memory
         # only. Loaded BEFORE serving so a restarted head answers from the
         # recovered state; nodes re-register on their first heartbeat NACK.
@@ -181,7 +216,8 @@ class HeadServer:
         for actor_id, st in self._store.load_actors():
             info = ActorInfo(actor_id, st["name"], st["namespace"],
                              st["spec_blob"], st["max_restarts"],
-                             st["resources"])
+                             st["resources"],
+                             max_task_retries=st.get("max_task_retries", 0))
             info.strategy = st.get("strategy")
             info.runtime_env = st.get("runtime_env")
             info.restart_count = st.get("restart_count", 0)
@@ -197,9 +233,46 @@ class HeadServer:
             # actually landed before the crash just re-registers ALIVE).
             if info.state in (PENDING, RESTARTING):
                 to_recover.append(info)
+            elif info.state == ALIVE and info.node_id is not None:
+                # Recovered-ALIVE watch: the host node may have died WITH
+                # the old head (no worker_dead_at report will ever
+                # arrive, and the health loop can't flag a node it never
+                # knew). If the node doesn't re-register within the
+                # grace window, the actor is declared dead and re-driven
+                # through its max_restarts policy — the all-holders-dead
+                # recovery path.
+                self._alive_watch[actor_id] = (
+                    time.monotonic() + cfg.head_restart_actor_grace_s)
         for info in to_recover:
             threading.Thread(target=self._restart_actor, args=(info,),
                              daemon=True).start()
+
+    def _sweep_alive_watch(self) -> None:
+        """Health-loop pass over actors recovered ALIVE from sqlite: an
+        actor whose host node re-registered is confirmed (dropped from
+        the watch); one whose node never came back within the grace
+        window died with the old era — re-drive it."""
+        if not self._alive_watch:
+            return
+        now = time.monotonic()
+        victims: List[ActorInfo] = []
+        with self._lock:
+            for actor_id, deadline in list(self._alive_watch.items()):
+                info = self._actors.get(actor_id)
+                if info is None or info.state != ALIVE:
+                    self._alive_watch.pop(actor_id, None)
+                    continue
+                n = self._nodes.get(info.node_id)
+                if n is not None and n.alive:
+                    self._alive_watch.pop(actor_id, None)
+                    continue
+                if now >= deadline:
+                    self._alive_watch.pop(actor_id, None)
+                    victims.append(info)
+        for info in victims:
+            self._actor_died(
+                info, "host node never re-registered after head restart",
+                try_restart=True)
 
     def _persist_actor(self, info: ActorInfo) -> None:
         if self._store is None:
@@ -207,6 +280,7 @@ class HeadServer:
         self._store.save_actor(info.actor_id, {
             "name": info.name, "namespace": info.namespace,
             "spec_blob": info.spec_blob, "max_restarts": info.max_restarts,
+            "max_task_retries": info.max_task_retries,
             "restart_count": info.restart_count,
             "resources": info.resources,
             "state": info.state, "worker_addr": info.worker_addr,
@@ -220,6 +294,8 @@ class HeadServer:
         # _stop wakes the health loop's wait(): join so no sweep runs
         # against a server/store that is being torn down below.
         self._health_thread.join(timeout=2.0)
+        if self._census_pool is not None:
+            self._census_pool.shutdown(wait=False)
         self._server.stop()
         self._pool.close_all()
         if self._store is not None:
@@ -290,9 +366,12 @@ class HeadServer:
             if is_delta:
                 if version is None or version != n.sync_version + 1:
                     return "resync"
-                n.available.update(available)
+                if available:
+                    n.available.update(available)
+                    n.recompute_util()
             else:
                 n.available = dict(available)
+                n.recompute_util()
             if version is not None:
                 n.sync_version = version
             if not n.alive:
@@ -384,14 +463,23 @@ class HeadServer:
             # Its object copies leave with it: scrub directory entries
             # (same cleanup as node death) so pullers don't dial a
             # drained node and the locality scorer doesn't credit it.
-            for oid, nodes in list(self._object_dir.items()):
-                nodes.discard(node_id)
-                if not nodes:
-                    del self._object_dir[oid]
-                    self._object_sizes.pop(oid, None)
+            self._scrub_node_objects(node_id)
         if n is not None:
             self._publish("NODE", {"event": "removed", "node_id": node_id})
         return True
+
+    def _scrub_node_objects(self, node_id: str) -> None:
+        """Drop one node's directory entries via the reverse index —
+        O(objects on that node), never a full-table walk. Caller holds
+        self._lock."""
+        for oid in self._node_objects.pop(node_id, ()):
+            locs = self._object_dir.get(oid)
+            if locs is None:
+                continue
+            locs.discard(node_id)
+            if not locs:
+                del self._object_dir[oid]
+                self._object_sizes.pop(oid, None)
 
     def rpc_list_nodes(self, conn):
         with self._lock:
@@ -414,6 +502,8 @@ class HeadServer:
         period = cfg.health_check_period_ms / 1000.0
         threshold = cfg.health_check_failure_threshold * period
         while not self._stop.wait(period):
+            if self._draining:
+                continue  # upgrade handover: the successor judges liveness
             now = time.monotonic()
             dead_nodes = []
             with self._lock:
@@ -425,6 +515,7 @@ class HeadServer:
                 _flight.record("node_dead", node=node_id[:12])
                 self._publish("NODE", {"event": "dead", "node_id": node_id})
                 self._on_node_dead(node_id)
+            self._sweep_alive_watch()
 
     def _on_node_dead(self, node_id: str) -> None:
         with self._lock:
@@ -433,11 +524,7 @@ class HeadServer:
             # Object copies died with the node: a stale directory entry
             # would make owners believe lost objects are still available
             # (blocking lineage recovery) and make pullers dial a corpse.
-            for oid, nodes in list(self._object_dir.items()):
-                nodes.discard(node_id)
-                if not nodes:
-                    del self._object_dir[oid]
-                    self._object_sizes.pop(oid, None)
+            self._scrub_node_objects(node_id)
         for a in victims:
             self._actor_died(a, f"node {node_id} died", try_restart=True)
 
@@ -481,23 +568,17 @@ class HeadServer:
                             if n.alive and n.node_id not in exclude
                             and all(n.total.get(k, 0) >= v
                                     for k, v in resources.items() if v > 0)]
-                by_total.sort(key=lambda n: (self._util(n), n.node_id))
+                by_total.sort(key=lambda n: (n.util, n.node_id))
                 return by_total, True
 
             thresh = cfg.scheduler_spread_threshold
-            below = [n for n in feasible if self._util(n) < thresh]
+            below = [n for n in feasible if n.util < thresh]
             if below:
                 # Pack: highest-utilization node still under threshold.
-                below.sort(key=lambda n: (-self._util(n), n.node_id))
+                below.sort(key=lambda n: (-n.util, n.node_id))
                 return below, False
-            feasible.sort(key=lambda n: (self._util(n), n.node_id))
+            feasible.sort(key=lambda n: (n.util, n.node_id))
             return feasible, False
-
-    @staticmethod
-    def _util(n: NodeInfo) -> float:
-        us = [1 - n.available.get(k, 0) / t
-              for k, t in n.total.items() if t > 0]
-        return max(us) if us else 0.0
 
     def rpc_pick_node(self, conn, resources: Dict[str, float],
                       strategy: Optional[Dict[str, Any]] = None,
@@ -676,7 +757,7 @@ class HeadServer:
         if (best is not ranked[0] and not relax_spill
                 and any(n.node_id == best.node_id
                         for n in self._feasible_nodes(resources, exclude))
-                and self._util(best)
+                and best.util
                 >= cfg.scheduler_locality_spill_threshold):
             # Spillback: the holder has capacity RIGHT NOW yet is loaded
             # past the threshold; keep the hybrid choice. A view-full
@@ -697,7 +778,8 @@ class HeadServer:
                            resources: Dict[str, float],
                            get_if_exists: bool = False,
                            strategy: Optional[Dict[str, Any]] = None,
-                           runtime_env: Optional[Dict[str, Any]] = None):
+                           runtime_env: Optional[Dict[str, Any]] = None,
+                           max_task_retries: int = 0):
         """Register + schedule + create. Returns ("created", None) /
         ("exists", actor_id) / raises on name conflict or placement failure.
         Idempotent on actor_id: a retried registration (lost reply) must not
@@ -714,7 +796,8 @@ class HeadServer:
                     raise ValueError(f"actor name '{name}' already taken")
                 self._named[(namespace, name)] = actor_id
             info = ActorInfo(actor_id, name, namespace, spec_blob,
-                             max_restarts, resources)
+                             max_restarts, resources,
+                             max_task_retries=max_task_retries)
             info.strategy = strategy
             info.runtime_env = runtime_env
             self._actors[actor_id] = info
@@ -926,8 +1009,20 @@ class HeadServer:
         info = self._actors.get(actor_id)
         if info is None:
             return None
+        # at_least_once: submitters consult this at conn-loss time — a
+        # restartable actor whose calls opted in (max_task_retries != 0)
+        # gets its unacked calls REPLAYED against the next incarnation
+        # instead of failed. BOTH knobs gate it: max_restarts alone must
+        # keep the legacy fail-fast call semantics (a poison call would
+        # kill every incarnation), and max_task_retries without restarts
+        # has no incarnation to replay against. restarts doubles as the
+        # incarnation number the replay targets.
         return {"state": info.state, "address": info.worker_addr,
                 "name": info.name, "restarts": info.restart_count,
+                "max_restarts": info.max_restarts,
+                "max_task_retries": info.max_task_retries,
+                "at_least_once": (info.max_restarts > 0
+                                  and info.max_task_retries != 0),
                 "reason": info.death_reason}
 
     def rpc_list_actors(self, conn):
@@ -950,6 +1045,7 @@ class HeadServer:
                          size: Optional[int] = None):
         with self._lock:
             self._object_dir.setdefault(oid, set()).add(node_id)
+            self._node_objects.setdefault(node_id, set()).add(oid)
             if size:
                 self._object_sizes[oid] = int(size)
         return True
@@ -962,6 +1058,9 @@ class HeadServer:
                 if not locs:
                     del self._object_dir[oid]
                     self._object_sizes.pop(oid, None)
+            no = self._node_objects.get(node_id)
+            if no is not None:
+                no.discard(oid)
         return True
 
     def rpc_object_batch(self, conn, node_id: str, entries):
@@ -975,9 +1074,11 @@ class HeadServer:
             # in order (strips the sequence stamp).
             entries = _rpcdbg.check_outbox("head", entries)
         with self._lock:
+            node_set = self._node_objects.setdefault(node_id, set())
             for kind, oid, size in entries:
                 if kind == "add":
                     self._object_dir.setdefault(oid, set()).add(node_id)
+                    node_set.add(oid)
                     if size:
                         self._object_sizes[oid] = int(size)
                 else:
@@ -987,6 +1088,7 @@ class HeadServer:
                         if not locs:
                             del self._object_dir[oid]
                             self._object_sizes.pop(oid, None)
+                    node_set.discard(oid)
         return True
 
     def rpc_object_locations(self, conn, oid: bytes,
@@ -1023,15 +1125,33 @@ class HeadServer:
                     "object_bytes_tracked": sum(self._object_sizes.values()),
                     "head_incarnation": self.incarnation}
 
+    def _fanout_pool(self):
+        """Lazily-built bounded executor for node fan-outs (census).
+        One thread PER NODE per census call scaled as O(N) thread
+        creations per leak check — at 100 nodes that alone dominated
+        census wall time; a persistent pool amortizes it. Flat 32
+        workers (ThreadPoolExecutor only spawns threads on demand, so
+        a small cluster pays for what it uses and a grown one is not
+        frozen at its boot-time size); built under self._lock so
+        concurrent first censuses can't each build — and leak — one."""
+        with self._lock:
+            pool = self._census_pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._census_pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="head-fanout")
+        return pool
+
     @blocking_rpc
     def rpc_cluster_leases(self, conn):
         """Cluster-wide open-lease census: fan out to every alive node's
         list_leases (the chaos bench's leak detector — after a scenario
         drains, every lease must be returned and every node's available
-        must equal its total). The per-node calls run CONCURRENTLY so
-        total census time is one control-RPC timeout, not N of them — a
-        serial loop over a few mid-death nodes would outrun the caller's
-        own deadline on every attempt."""
+        must equal its total). The per-node calls run CONCURRENTLY on
+        the persistent fan-out pool so total census time is bounded by
+        one control-RPC timeout (not N of them) without paying N thread
+        creations per census."""
         with self._lock:
             nodes = [(n.node_id, n.address) for n in self._nodes.values()
                      if n.alive]
@@ -1048,17 +1168,17 @@ class HeadServer:
             with results_lock:
                 results[node_id] = entry
 
-        threads = [threading.Thread(target=census_one, args=na,
-                                    daemon=True, name="lease-census")
-                   for na in nodes]
-        for t in threads:
-            t.start()
+        pool = self._fanout_pool()
+        futures = [pool.submit(census_one, *na) for na in nodes]
         deadline = time.monotonic() + cfg.rpc_control_timeout_s + 2.0
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
-        # Snapshot under the lock: a straggler thread may still write
-        # results after the join timeout, and the reply must not be
-        # mutated while it serializes.
+        for f in futures:
+            try:
+                f.result(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:  # rtpu-lint: disable=swallowed-exception — census_one recorded its own outcome; this is only the deadline wait
+                pass
+        # Snapshot under the lock: a straggler may still write results
+        # after the deadline, and the reply must not be mutated while it
+        # serializes.
         with results_lock:
             out = dict(results)
         for node_id, _addr in nodes:
@@ -1298,6 +1418,48 @@ class HeadServer:
         if self._store is not None:
             self._store.set_meta("job_counter", n)
         return n
+
+    # ---------------------------------------------------------- upgrade
+
+    @blocking_rpc
+    def rpc_prepare_upgrade(self, conn):
+        """Rolling-upgrade drain + snapshot flush (step 1 of the handover
+        scenario in devtools/chaos.py): stop this era's health verdicts
+        (the successor owns liveness from here), wait out in-flight actor
+        creations so no creation spec is mid-push when the port changes
+        hands, then checkpoint the sqlite WAL so the successor's first
+        read sees every durable row without replaying the log.
+
+        Idempotent: a re-delivered prepare re-checkpoints and returns the
+        same summary — draining twice is draining."""
+        self._draining = True
+        deadline = time.monotonic() + cfg.head_upgrade_drain_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                in_flight = [a for a in self._actors.values()
+                             if a.state in (PENDING, RESTARTING)]
+            if not in_flight:
+                break
+            time.sleep(0.1)
+        flushed = False
+        if self._store is not None:
+            self._store.checkpoint()
+            flushed = True
+        with self._lock:
+            summary = {"incarnation": self.incarnation,
+                       "actors": len(self._actors),
+                       "nodes": len(self._nodes),
+                       "pgs": len(self._pgs),
+                       "kv_keys": len(self._kv),
+                       "flushed": flushed}
+        _flight.record("head_drain", **{k: v for k, v in summary.items()
+                                        if k != "incarnation"})
+        return summary
+
+    def rpc_resume_serving(self, conn):
+        """Abort a drain (upgrade rolled back): re-enable health sweeps."""
+        self._draining = False
+        return True
 
     def rpc_ping(self, conn):
         return "pong"

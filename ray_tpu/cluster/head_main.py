@@ -34,6 +34,21 @@ def main() -> None:
     args = p.parse_args()
     head = HeadServer(args.host, args.port, persist_path=args.persist)
     print(f"ADDRESS {head.address}", flush=True)
+
+    def _graceful_term(signum, frame):
+        # Rolling-upgrade handover (or supervisor teardown): stop the
+        # server FIRST — that severs every parked peer connection so
+        # heartbeats fail over to the successor immediately — then close
+        # the durable store cleanly and release the port by exiting.
+        print("RTPU_HEAD: SIGTERM — releasing port", flush=True)
+        import os as _os
+
+        try:
+            head.shutdown()
+        finally:
+            _os._exit(0)
+
+    signal.signal(signal.SIGTERM, _graceful_term)
     try:
         while True:
             time.sleep(3600)

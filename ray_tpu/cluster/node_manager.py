@@ -160,21 +160,50 @@ class Lease:
         self.blocked = 0
 
 
+class _SimStore:
+    """Store stub for simulated scale-mode nodes: the control-plane
+    surfaces (heartbeats, directory mirror reconciliation, clock-sync
+    eviction polls) call it, the data plane never does — a 100-node
+    in-process cluster must not map 100 shm arenas."""
+
+    def contains(self, oid) -> bool:
+        return False
+
+    def stats(self) -> Tuple[int, int, int, int]:
+        return (0, 0, 0, 0)  # used, capacity, objects, evictions
+
+    def close(self) -> None:
+        pass
+
+
 class NodeManager:
     chaos_role = "node"  # fault-injection scope (devtools/chaos.py)
 
     def __init__(self, head_addr: str, node_id: str,
                  resources: Dict[str, float], labels: Dict[str, str],
-                 object_store_bytes: int, host: str = "127.0.0.1"):
+                 object_store_bytes: int, host: str = "127.0.0.1",
+                 simulated: bool = False):
         self.node_id = node_id
         self.head_addr = head_addr
+        # Simulated scale mode (bench.py --scale): a full control-plane
+        # node — registration, heartbeat delta sync, directory mirror,
+        # lease census — with the store stubbed and NO worker machinery
+        # (spawner/reaper/zygote/metrics threads), so hundreds of
+        # NodeManager instances fit in one process to profile the HEAD's
+        # hot paths at production node counts.
+        self.simulated = simulated
         _flight.set_role("node", node_id=node_id)
         self.total = dict(resources)
         self.available = dict(resources)
         self.labels = labels
-        self.store_name = f"/rtpu_store_{node_id[:12]}"
-        self.store = ShmStore.create(self.store_name, object_store_bytes,
-                                     prefault=cfg.object_store_prefault)
+        if simulated:
+            self.store_name = f"/rtpu_sim_{node_id[:12]}"
+            self.store = _SimStore()
+        else:
+            self.store_name = f"/rtpu_store_{node_id[:12]}"
+            self.store = ShmStore.create(
+                self.store_name, object_store_bytes,
+                prefault=cfg.object_store_prefault)
         self._lock = make_rlock("node_manager._lock")
         self._idle_cv = threading.Condition(self._lock)
         # Signalled whenever resources are credited back (lease return,
@@ -262,7 +291,7 @@ class NodeManager:
         # the process registry + live node gauges; the port is advertised
         # as a node label for scrape-config discovery.
         self._metrics_exporter = None
-        if cfg.metrics_export_port >= 0:
+        if cfg.metrics_export_port >= 0 and not simulated:
             try:
                 from ray_tpu.util.metrics_agent import start_exporter
 
@@ -315,14 +344,16 @@ class NodeManager:
         # zygote_spawn_timeout_s) cannot wedge them.
         self._zygote_lock = make_lock("node_manager._zygote_lock")
         self._zygote_io_lock = make_lock("node_manager._zygote_io_lock")
-        threading.Thread(target=self._spawner_loop, daemon=True,
-                         name=f"node-spawner-{node_id[:8]}").start()
+        if not simulated:
+            threading.Thread(target=self._spawner_loop, daemon=True,
+                             name=f"node-spawner-{node_id[:8]}").start()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name=f"node-hb-{node_id[:8]}").start()
-        threading.Thread(target=self._reap_loop, daemon=True,
-                         name=f"node-reap-{node_id[:8]}").start()
+        if not simulated:
+            threading.Thread(target=self._reap_loop, daemon=True,
+                             name=f"node-reap-{node_id[:8]}").start()
         if (cfg.memory_monitor_refresh_ms > 0
-                and cfg.memory_usage_threshold < 1.0):
+                and cfg.memory_usage_threshold < 1.0 and not simulated):
             from ray_tpu.cluster.memory_monitor import MemoryMonitor
 
             self.memory_monitor = MemoryMonitor(

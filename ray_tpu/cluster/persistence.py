@@ -54,6 +54,16 @@ class HeadStore:
             except Exception:
                 pass
 
+    def checkpoint(self) -> None:
+        """Flush the WAL into the main database file (rolling-upgrade
+        snapshot step): the successor head — possibly a NEWER build
+        opening the file fresh — reads a fully-merged db instead of
+        replaying this era's write-ahead log. TRUNCATE also resets the
+        -wal file so the handover copies no stale log frames."""
+        with self._lock:
+            self._db.commit()
+            self._db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+
     # ------------------------------------------------------------------ kv
 
     def kv_put(self, ns: str, key: bytes, value: bytes) -> None:

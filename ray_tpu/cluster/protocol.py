@@ -324,6 +324,10 @@ IDEMPOTENT_RPCS = frozenset({
     "push_tasks", "push_actor_batch", "pull_object", "pull_direct",
     "push_object", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "drain_node",
+    # rolling-upgrade handover: draining twice is draining (the
+    # checkpoint re-runs, the summary re-reads), and resume just clears
+    # the flag — both safe to retry or re-deliver
+    "prepare_upgrade", "resume_serving",
 })
 
 #: Caller-side acked-retry loops with explicit loss handling; a
@@ -894,34 +898,61 @@ class _Waiter:
 
 
 class ClientPool:
-    """Caches one RpcClient per address (process-wide)."""
+    """Caches one RpcClient per address (process-wide).
+
+    Connection CREATION runs under a per-address lock, not the pool
+    lock: a fan-out over N fresh peers (the head's lease census at 100
+    nodes) otherwise serializes N TCP connects behind one global lock —
+    the pool's own bookkeeping is microseconds, the connects are not."""
 
     def __init__(self):
         self._clients: Dict[str, RpcClient] = {}
+        self._creating: Dict[str, threading.Lock] = {}
         self._lock = make_lock("protocol.client_pool._lock")
+
+    @staticmethod
+    def _upgrade(c: RpcClient, on_push: Optional[Callable],
+                 on_close: Optional[Callable]) -> RpcClient:
+        # Upgrade: a later caller may care about conn-loss or push
+        # frames on a connection first opened by a caller that
+        # didn't. Without the on_push half, a cached client created
+        # push-less silently DROPPED every later caller's server
+        # pushes for the life of the connection.
+        if on_close is not None and c._on_close is None:
+            c._on_close = on_close
+        if on_push is not None and c._on_push is None:
+            c._on_push = on_push
+        return c
 
     def get(self, address: str, on_push: Optional[Callable] = None,
             on_close: Optional[Callable] = None) -> RpcClient:
         with self._lock:
             c = self._clients.get(address)
-            if c is None or c._closed or not c._alive:
-                # A client whose socket died (reader exited) must not be
-                # handed out again: replace it with a fresh connection.
-                c = RpcClient(address, on_push=on_push, on_close=on_close)
+            if c is not None and not c._closed and c._alive:
+                return self._upgrade(c, on_push, on_close)
+            mk = self._creating.setdefault(address, threading.Lock())
+        with mk:
+            with self._lock:
+                c = self._clients.get(address)
+                if c is not None and not c._closed and c._alive:
+                    return self._upgrade(c, on_push, on_close)
+            # A client whose socket died (reader exited) must not be
+            # handed out again: replace it with a fresh connection —
+            # dialed WITHOUT the pool lock (two addresses connect
+            # concurrently; the per-address lock stops a thundering
+            # herd on one address).
+            c = RpcClient(address, on_push=on_push, on_close=on_close)
+            with self._lock:
                 self._clients[address] = c
-            else:
-                # Upgrade: a later caller may care about conn-loss or push
-                # frames on a connection first opened by a caller that
-                # didn't. Without the on_push half, a cached client created
-                # push-less silently DROPPED every later caller's server
-                # pushes for the life of the connection.
-                if on_close is not None and c._on_close is None:
-                    c._on_close = on_close
-                if on_push is not None and c._on_push is None:
-                    c._on_push = on_push
             return c
 
     def invalidate(self, address: str) -> None:
+        # _creating entries are deliberately NEVER popped: a dial may be
+        # in flight under that lock right now, and replacing the lock
+        # would let a second dial race it — the loser's client would be
+        # overwritten in _clients and leak its socket + reader thread.
+        # One tiny Lock per distinct address ever dialed is bounded by
+        # the same set that bounds _clients itself.
         with self._lock:
             c = self._clients.pop(address, None)
         if c is not None:

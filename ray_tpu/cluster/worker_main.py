@@ -46,13 +46,25 @@ class _OrderState:
     """Per-(actor, submitter) in-order delivery: buffers out-of-order seqs
     (a chaos-dropped push retried late must not execute after its successor)
     and dedups retries. Parity: the reference's ActorSchedulingQueue +
-    sequence_no/client_processed_up_to (task_receiver.cc:36)."""
+    sequence_no/client_processed_up_to (task_receiver.cc:36).
 
-    __slots__ = ("expected", "buf")
+    ``done`` is the at-least-once reply memo: completed calls' result
+    batches keyed by seq, LRU-bounded by ``actor_reply_memo_max``. A
+    duplicate delivery of an already-executed seq (lost push ack, a
+    replay racing the original's completion) re-ships the memoized
+    results instead of re-executing — owner-side completion handlers
+    are first-write-wins, so a double delivery of RESULTS is free while
+    a double EXECUTION of a mutating method is not. Entries below the
+    submitter's min_pending horizon are pruned (the submitter settled
+    those seqs; no retry can ever ask for them again)."""
+
+    __slots__ = ("expected", "buf", "done")
 
     def __init__(self):
         self.expected: Optional[int] = None
         self.buf: Dict[int, Any] = {}
+        self.done: "collections.OrderedDict[int, tuple]" = \
+            collections.OrderedDict()
 
 
 class _HostedActor:
@@ -90,7 +102,14 @@ class _HostedActor:
             if g is not None:
                 self._method_groups[mname] = g
         self.loop = None
-        self.order: Dict[str, _OrderState] = {}  # owner_addr -> state
+        # owner_addr -> per-caller stream state, LRU-bounded
+        # (actor_order_states_max): a service actor called by thousands
+        # of short-lived drivers must not pin one state per caller ever
+        # seen — least-recently-active streams are evicted (their memo
+        # goes with them; a retry after THAT long re-executes, which is
+        # the documented at-least-once floor).
+        self.order: "collections.OrderedDict[str, _OrderState]" = \
+            collections.OrderedDict()
         self.order_lock = make_lock("worker_main.actor.order_lock")
         self.dead = False
 
@@ -479,9 +498,29 @@ class WorkerRuntime(ClusterCore):
             actor_id_bytes, seq = actor_ctx
             entry = ("actor", (actor_id_bytes, seq, task_id.binary(),
                                results, span))
+            # Reply memo: a duplicate delivery of this seq (lost ack /
+            # replay racing completion) answers with THESE results
+            # instead of re-executing (see _OrderState.done).
+            self._memoize_actor_reply(owner, actor_id_bytes, seq, entry)
         else:
             entry = ("task", (task_id.binary(), results, span))
         self._enqueue_done(owner, entry)
+
+    def _memoize_actor_reply(self, owner: str, actor_id_bytes: bytes,
+                             seq: int, entry: tuple) -> None:
+        with self._hosted_lock:
+            hosted = self._hosted.get(ActorID(actor_id_bytes))
+        if hosted is None:
+            return  # killed mid-call / "not hosted" error reply: no memo
+        with hosted.order_lock:
+            st = hosted.order.get(owner)
+            if st is None:
+                return  # caller stream evicted (or pre-registration path)
+            st.done[seq] = entry
+            st.done.move_to_end(seq)
+            cap = int(cfg.actor_reply_memo_max)
+            while len(st.done) > cap:
+                st.done.popitem(last=False)
 
     def _enqueue_done(self, owner: str, entry) -> None:
         """Routes a completion to the owner's dedicated flusher thread
@@ -642,14 +681,22 @@ class WorkerRuntime(ClusterCore):
                     actor_ctx=(spec["actor_id"], seq))
             return True
         owner = specs[0][1]["owner_addr"]
+        dup_replies: List[tuple] = []
         with hosted.order_lock:
             st = hosted.order.get(owner)
             if st is None:
                 st = hosted.order[owner] = _OrderState()
+            hosted.order.move_to_end(owner)
+            while len(hosted.order) > int(cfg.actor_order_states_max):
+                hosted.order.popitem(last=False)  # LRU caller stream
             if st.expected is None:
                 st.expected = min_pending
             else:
                 st.expected = max(st.expected, min_pending)
+            # Reply-memo hygiene: seqs the submitter settled can never be
+            # retried — drop their memoized results.
+            for s in [s for s in st.done if s < min_pending]:
+                del st.done[s]
             if hosted.out_of_order:
                 # Dedup via the horizon + the buffered-seen set, but run
                 # immediately: buf marks "already dispatched" seqs (pruned
@@ -659,6 +706,10 @@ class WorkerRuntime(ClusterCore):
                 runnable = []
                 for seq, spec in specs:
                     if seq < st.expected or seq in st.buf:
+                        entry = st.done.get(seq)
+                        if entry is not None:  # executed: re-ship results
+                            st.done.move_to_end(seq)
+                            dup_replies.append(entry)
                         continue
                     st.buf[seq] = True
                     runnable.append((spec, seq))
@@ -670,13 +721,24 @@ class WorkerRuntime(ClusterCore):
                     del st.buf[s]
                 for seq, spec in specs:
                     if seq < st.expected or seq in st.buf:
-                        continue  # duplicate of an executed/buffered push
+                        # Duplicate of an executed/buffered push: an
+                        # already-executed seq answers from the reply
+                        # memo (its results frame may have been the
+                        # thing that was lost); an in-flight one stays
+                        # silent — its results flow when it completes.
+                        entry = st.done.get(seq)
+                        if entry is not None:
+                            st.done.move_to_end(seq)
+                            dup_replies.append(entry)
+                        continue
                     st.buf[seq] = spec
                 runnable = []
                 while st.expected in st.buf:
                     s = st.expected
                     runnable.append((st.buf.pop(s), s))
                     st.expected += 1
+        for entry in dup_replies:
+            self._enqueue_done(owner, entry)
         if hosted.is_async and hosted.loop is not None:
             # Async actors: schedule the runnable burst onto the actor's
             # event loop in ONE threadsafe hop (pool.submit +

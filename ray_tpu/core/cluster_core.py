@@ -25,6 +25,7 @@ ownership model of reference_count.h). Re-designed over the framed RPC plane:
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 import uuid
@@ -49,6 +50,8 @@ from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError, TaskError,
                                 WorkerCrashedError)
 from ray_tpu.core.lineage import LineageRecord as _LineageRecord
 from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 
 
 class _SubmitTemplate:
@@ -230,7 +233,8 @@ class _ActorConn:
     frame order on the socket IS execution-submission order on the worker."""
 
     __slots__ = ("actor_id", "address", "next_seq", "outbound", "unacked",
-                 "pending", "lock", "sender_running", "dead", "death_reason")
+                 "pending", "lock", "sender_running", "dead", "death_reason",
+                 "loss_handling", "incarnation", "replays")
 
     def __init__(self, actor_id: ActorID):
         import collections
@@ -245,6 +249,18 @@ class _ActorConn:
         self.sender_running = False
         self.dead = False
         self.death_reason = ""
+        # True while ONE conn-loss handler owns this conn's recovery
+        # (concurrent loss reports — sender inline + pool on_close
+        # threads — must not double-replay or double-fail).
+        self.loss_handling = False
+        # Last head-reported restart count this submitter replayed
+        # against; purely observational (the worker's per-caller seq
+        # horizon is what makes a cross-incarnation replay safe).
+        self.incarnation = 0
+        # seq -> cross-incarnation replay count (entries leave with
+        # pending): a poison call stops after max_task_retries replays
+        # instead of riding every future incarnation.
+        self.replays: Dict[int, int] = {}
 
     def min_pending(self) -> int:
         """Smallest seq still awaiting completion — the ordered-execution
@@ -2422,7 +2438,8 @@ class ClusterCore:
 
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "default", max_concurrency: int = 1,
-                     max_restarts: int = 0, resources=None, lifetime=None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
                      concurrency_groups: Optional[Dict[str, int]] = None,
@@ -2458,6 +2475,7 @@ class ClusterCore:
                 "register_actor", actor_id.binary(), name, namespace,
                 spec_blob, max_restarts, resources, get_if_exists,
                 _strategy_dict(scheduling_strategy), runtime_env,
+                max_task_retries,
                 timeout=cfg.actor_connect_timeout_s)
         except BaseException:
             self._release_submitted_args(b"actor-args:" + actor_id.binary())
@@ -2503,16 +2521,26 @@ class ClusterCore:
                 memo.popitem(last=False)
 
     def _resolve_actor_address(self, conn: _ActorConn,
-                               timeout: float = 60.0) -> Optional[str]:
+                               timeout: Optional[float] = None
+                               ) -> Optional[str]:
+        """Blocks until the head reports the actor ALIVE (the restart-
+        pending QUEUE window: callers park here while a max_restarts
+        re-creation is in flight, bounded by
+        actor_restart_queue_timeout_s)."""
         if conn.address is not None:
             return conn.address
+        if timeout is None:
+            timeout = cfg.actor_restart_queue_timeout_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            # Short long-poll rounds (read-only, retry-safe under chaos).
+            # Short long-poll rounds (read-only, retry-safe under chaos);
+            # round length clipped to the remaining window so a short
+            # restart-pending timeout is honored at ~its own granularity.
+            poll = max(0.5, min(10.0, deadline - time.monotonic()))
             try:
                 state, payload = self.head.call(
-                    "wait_actor_address", conn.actor_id.binary(), 10.0,
-                    timeout=15)
+                    "wait_actor_address", conn.actor_id.binary(), poll,
+                    timeout=poll + 5)
             except ConnectionLost:
                 time.sleep(0.2)  # dead socket fails instantly: no hot spin
                 try:
@@ -2609,15 +2637,27 @@ class ClusterCore:
                 if batch:
                     self._send_actor_batch(conn, batch, 0)
                     # Opportunistically reap acked heads to bound unacked.
-                    while conn.unacked and conn.unacked[0][1]._event.is_set():
-                        self._settle_actor_ack(conn, conn.unacked.popleft())
+                    # Pops ride conn.lock (and never span the settle,
+                    # which may resend = block): a replay handler
+                    # snapshots this deque from another thread, and a
+                    # bare mutation mid-snapshot raises RuntimeError in
+                    # exactly the recovery path that must not die.
+                    while True:
+                        with conn.lock:
+                            if not (conn.unacked
+                                    and conn.unacked[0][1]._event.is_set()):
+                                break
+                            entry = conn.unacked.popleft()
+                        self._settle_actor_ack(conn, entry)
                     continue
                 entry = conn.unacked[0]
                 if entry[1]._event.wait(0.05):
-                    conn.unacked.popleft()
+                    with conn.lock:
+                        conn.unacked.popleft()
                     self._settle_actor_ack(conn, entry)
                 elif time.monotonic() > entry[3]:
-                    conn.unacked.popleft()
+                    with conn.lock:
+                        conn.unacked.popleft()
                     self._resend_actor_batch(conn, entry)
             except BaseException:  # noqa: BLE001 — keep the sender alive
                 for it in batch:
@@ -2636,8 +2676,11 @@ class ClusterCore:
         except Exception:
             addr = None
         if addr is None:
+            reason = (None if conn.dead else
+                      "actor restart still pending after "
+                      f"{cfg.actor_restart_queue_timeout_s:.0f}s")
             for it in items:
-                self._fail_actor_call(conn, it[0])
+                self._fail_actor_call(conn, it[0], reason=reason)
             return
         with conn.lock:
             live = [it for it in items if it[0] in conn.pending]
@@ -2655,8 +2698,9 @@ class ClusterCore:
                     [(it[0], it[2]) for it in live], conn.min_pending())
             # 2s resend deadline: worker-side dedup makes resends free, and
             # a chaos-dropped frame must not stall the whole batch 10s.
-            conn.unacked.append([live, waiter, tries,
-                                 time.monotonic() + 2.0])
+            with conn.lock:
+                conn.unacked.append([live, waiter, tries,
+                                     time.monotonic() + 2.0])
         except (ConnectionLost, OSError):
             self._handle_actor_conn_lost(conn)
 
@@ -2678,16 +2722,19 @@ class ClusterCore:
             return
         self._send_actor_batch(conn, live, tries + 1)
 
-    def _fail_actor_call(self, conn: _ActorConn, seq: int) -> None:
+    def _fail_actor_call(self, conn: _ActorConn, seq: int,
+                         reason: Optional[str] = None) -> None:
         with conn.lock:
             entry = conn.pending.pop(seq, None)
+            conn.replays.pop(seq, None)
         if entry is None:
             return
         task_id_bytes, _, return_ids = entry
         with self._inflight_lock:
             self._inflight.pop(task_id_bytes, None)
         self._release_submitted_args(task_id_bytes)
-        err = ActorDiedError(conn.actor_id, conn.death_reason or "actor died")
+        err = ActorDiedError(conn.actor_id,
+                             reason or conn.death_reason or "actor died")
         for oid in return_ids:
             self.memory_store.put(oid, err, is_exception=True)
 
@@ -2698,18 +2745,59 @@ class ClusterCore:
         aconn = self._actor_conn(ActorID(actor_id_bytes))
         with aconn.lock:
             aconn.pending.pop(seq, None)
+            aconn.replays.pop(seq, None)
         return self.rpc_task_done(conn_ctx, task_id_bytes, results, span)
 
     def _handle_actor_conn_lost(self, conn: _ActorConn) -> None:
-        """Connection to the actor's worker died: consult the head."""
-        stale_addr = conn.address
-        conn.address = None
-        deadline = time.monotonic() + 120.0
+        """Connection to the actor's worker died: consult the head.
+
+        Two policies, switched by the actor's ``max_restarts`` (the head
+        reports it as ``at_least_once``):
+
+        - max_restarts == 0 (default): in-flight calls FAIL — a call
+          that may already have executed is never replayed (reference
+          semantics, max_task_retries=0).
+        - max_restarts > 0: the actor is declared restartable, so its
+          callers opted into at-least-once calls — every still-pending
+          seq REPLAYS against the restarted incarnation, in seq order,
+          through the same sender machinery. The worker-side
+          (caller, seq) horizon + reply memo turn the at-least-once
+          wire into exactly-once execution per incarnation; only calls
+          whose execution-and-results were lost WITH the old
+          incarnation run again.
+
+        Restart-pending windows QUEUE, not fail: while the head reports
+        PENDING/RESTARTING this handler keeps waiting (and new submits
+        keep queueing in outbound) until actor_restart_queue_timeout_s.
+        """
+        with conn.lock:
+            if conn.loss_handling:
+                return  # another thread owns this conn's recovery
+            conn.loss_handling = True
+            stale_addr = conn.address
+            conn.address = None
+        try:
+            self._handle_actor_conn_lost_inner(conn, stale_addr)
+        finally:
+            with conn.lock:
+                conn.loss_handling = False
+
+    def _handle_actor_conn_lost_inner(self, conn: _ActorConn,
+                                      stale_addr: Optional[str]) -> None:
+        # Same window as the sibling loss path (_send_actor_batch ->
+        # _resolve_actor_address): both must honor the configured
+        # restart-pending queueing timeout EXACTLY, or the two paths
+        # fail identical calls at different times with a reason naming
+        # a wait that never happened.
+        deadline = time.monotonic() + cfg.actor_restart_queue_timeout_s
         while time.monotonic() < deadline:
             try:
                 info = self.head.retrying_call("get_actor_info",
                                                conn.actor_id.binary(), timeout=10)
-            except Exception:
+            except Exception as e:
+                # Head unreachable (mid-restart/upgrade): keep polling
+                # until our own deadline — the restart-pending window.
+                logger.debug("actor info poll failed (head down?): %r", e)
                 time.sleep(0.5)
                 continue
             if info is None:
@@ -2724,11 +2812,11 @@ class ClusterCore:
                     time.sleep(0.2)
                     continue
                 conn.address = info["address"]
-                # Reference semantics: actor-task retries default to 0 —
-                # calls that may already have executed are FAILED, not
-                # replayed against the restarted instance (a poison call
-                # would kill every incarnation). New calls go to the new
-                # address.
+                if info.get("at_least_once"):
+                    conn.incarnation = int(info.get("restarts", 0))
+                    self._replay_actor_calls(
+                        conn, int(info.get("max_task_retries", 0)))
+                    return
                 conn.death_reason = ("actor restarted; in-flight calls "
                                      "failed (max_task_retries=0)")
                 with conn.lock:
@@ -2742,13 +2830,72 @@ class ClusterCore:
                 self._release_submitted_args(
                     b"actor-args:" + conn.actor_id.binary())
                 break
-            time.sleep(0.2)  # PENDING/RESTARTING: wait
+            time.sleep(0.2)  # PENDING/RESTARTING: wait (queued callers)
         with conn.lock:
             seqs = list(conn.pending)
         for seq in seqs:
-            self._fail_actor_call(conn, seq)
+            self._fail_actor_call(
+                conn, seq,
+                reason=None if conn.dead else
+                "actor restart still pending after "
+                f"{cfg.actor_restart_queue_timeout_s:.0f}s")
         if conn.dead:
             self._retire_actor_conn(conn)
+
+    def _replay_actor_calls(self, conn: _ActorConn,
+                            max_task_retries: int = -1) -> None:
+        """Re-enqueue every still-pending call for the actor's new
+        incarnation. Seqs already queued in outbound (new submits that
+        parked during the restart) merge in — the rebuilt outbound is
+        sorted so the wire carries one ascending stream. Seqs riding an
+        unacked batch are NOT re-enqueued here: their resend deadline
+        re-drives them through _send_actor_batch against the new
+        address, and a duplicate send is dedup'd by the worker's
+        (caller, seq) horizon anyway. Each seq replays at most
+        max_task_retries times across incarnations (<0 = unlimited) —
+        the poison-call bound."""
+        exhausted: List[int] = []
+        with conn.lock:
+            # Snapshot under the lock the sender's unacked mutations
+            # also hold: a bare deque iteration racing an append/pop
+            # raises RuntimeError in exactly this recovery path.
+            inflight: set = set()
+            for entry in conn.unacked:
+                for it in entry[0]:
+                    inflight.add(it[0])
+            items = {it[0]: it for it in conn.outbound}
+            for seq, (tid, blob, rids) in conn.pending.items():
+                if seq in items or seq in inflight:
+                    continue
+                n = conn.replays.get(seq, 0) + 1
+                if max_task_retries >= 0 and n > max_task_retries:
+                    exhausted.append(seq)
+                    continue
+                conn.replays[seq] = n
+                items[seq] = (seq, tid, blob, rids)
+            conn.outbound.clear()
+            for seq in sorted(items):
+                conn.outbound.append(items[seq])
+            replayed = len(items)
+            start = (not conn.sender_running
+                     and bool(conn.outbound or conn.unacked))
+            if start:
+                conn.sender_running = True
+        for seq in exhausted:
+            self._fail_actor_call(
+                conn, seq,
+                reason=f"call replayed {max_task_retries}x across actor "
+                       "restarts without completing (max_task_retries)")
+        if replayed or inflight:
+            from ray_tpu.util import flight_recorder as _fl
+
+            _fl.record("actor_replay", actor=conn.actor_id.hex()[:12],
+                       queued=replayed, inflight=len(inflight),
+                       incarnation=conn.incarnation)
+        if start:
+            threading.Thread(
+                target=self._actor_sender_loop, args=(conn,), daemon=True,
+                name=f"actor-send-{conn.actor_id.hex()[:8]}").start()
 
     def get_actor(self, name: str, namespace: str = "default") -> ActorID:
         found = self.head.retrying_call("get_named_actor", name, namespace, timeout=10)

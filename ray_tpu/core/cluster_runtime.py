@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -95,6 +96,58 @@ def start_node_process(head_addr: str, resources: Optional[Dict[str, float]],
     proc = _spawn(args, f"node-{int(time.time()*1000)%100000}.log")
     info = _read_tagged_line(proc, "ADDRESS", timeout)
     return NodeProc(proc, info["ADDRESS"], info["NODE"], info["STORE"])
+
+
+class SimulatedCluster:
+    """Scale-mode harness (bench.py --scale): ONE in-process HeadServer
+    plus N in-process ``NodeManager(simulated=True)`` instances with
+    stubbed stores. Everything control-plane is real — registration,
+    versioned heartbeat delta sync, holder-set mirrors, the lease
+    census — so head RPC dispatch, heartbeat fan-in, and directory
+    lookups can be profiled at 100+ node counts on one machine."""
+
+    def __init__(self, n_nodes: int, resources: Optional[Dict[str, float]]
+                 = None, zones: int = 4):
+        import uuid as _uuid
+
+        from ray_tpu.cluster.head import HeadServer
+        from ray_tpu.cluster.node_manager import NodeManager
+        from ray_tpu.cluster.protocol import RpcClient
+
+        self.head = HeadServer()
+        self.nodes: List[Any] = []
+        res = dict(resources or {"CPU": 8.0})
+        for i in range(n_nodes):
+            node_id = _uuid.uuid4().hex
+            self.nodes.append(NodeManager(
+                self.head.address, node_id, dict(res),
+                {"zone": f"z{i % max(1, zones)}"}, 0, simulated=True))
+        self.client = RpcClient(self.head.address)
+
+    def wait_registered(self, timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        want = len(self.nodes)
+        while time.monotonic() < deadline:
+            views = self.client.call("list_nodes", timeout=10)
+            if sum(1 for v in views if v["alive"]) >= want:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"only {len(self.client.call('list_nodes'))} "
+                           f"of {want} simulated nodes registered")
+
+    def shutdown(self) -> None:
+        try:
+            self.client.close()
+        except Exception as e:
+            logging.getLogger(__name__).debug(
+                "sim client close failed: %r", e)
+        for n in self.nodes:
+            try:
+                n.shutdown()
+            except Exception as e:
+                logging.getLogger(__name__).debug(
+                    "sim node shutdown failed: %r", e)
+        self.head.shutdown()
 
 
 class ClusterRuntime(ClusterCore):
@@ -185,12 +238,18 @@ class ClusterRuntime(ClusterCore):
         valid; reconnects happen inside retrying_call."""
         port = self._head_addr_str.rsplit(":", 1)[1]
         while not getattr(self, "_shutdown_flag", False):
+            if getattr(self, "_upgrading", False):
+                # Rolling upgrade owns the head process handover: the
+                # supervisor racing it would double-bind the port.
+                time.sleep(cfg.head_supervisor_poll_s)
+                continue
             proc = self._head_proc
             if proc.poll() is None:
                 time.sleep(cfg.head_supervisor_poll_s)
                 continue
-            if getattr(self, "_shutdown_flag", False):
-                return
+            if getattr(self, "_shutdown_flag", False) or getattr(
+                    self, "_upgrading", False):
+                continue
             try:
                 new_proc = _spawn(
                     [sys.executable, "-m", "ray_tpu.cluster.head_main",
@@ -202,6 +261,68 @@ class ClusterRuntime(ClusterCore):
                 self._procs.append(new_proc)
             except Exception:
                 time.sleep(1.0)  # port may linger in TIME_WAIT; retry
+
+    def rolling_head_upgrade(self) -> Dict[str, Any]:
+        """Zero-request-failure head swap (ROADMAP item 3's rolling
+        upgrade): drain + WAL-checkpoint the serving head, SIGTERM it
+        (graceful stop severs parked peer conns and releases the port),
+        bind a NEW head process — a new incarnation — on the SAME port
+        with the SAME durable tables, and let the cluster re-converge:
+        clients ride retrying_call across the gap, nodes re-register on
+        their first heartbeat NACK and republish holder sets (the PR 8
+        path), and recovered-ALIVE actors are confirmed as their nodes
+        come back. Returns the step timings; the chaos scenario driver
+        (devtools.chaos.run_rolling_upgrade) asserts zero failed client
+        requests around it."""
+        if not getattr(self, "_owns_cluster", False):
+            raise RuntimeError("rolling_head_upgrade needs the driver "
+                               "that owns the head process")
+        port = self._head_addr_str.rsplit(":", 1)[1]
+        report: Dict[str, Any] = {}
+        t0 = time.monotonic()
+        self._upgrading = True
+        try:
+            summary = self.head.retrying_call(
+                "prepare_upgrade",
+                timeout=cfg.head_upgrade_drain_timeout_s + 10)
+            report["old_incarnation"] = summary.get("incarnation")
+            report["drain_s"] = round(time.monotonic() - t0, 3)
+            old = self._head_proc
+            old.terminate()
+            try:
+                old.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                old.kill()
+                old.wait(timeout=5)
+            t_swap = time.monotonic()
+            report["handover_at_s"] = round(t_swap - t0, 3)
+            # Port may linger a beat after process exit: retry the bind.
+            deadline = time.monotonic() + cfg.node_boot_timeout_s
+            new_proc = None
+            while new_proc is None:
+                try:
+                    new_proc = _spawn(
+                        [sys.executable, "-m", "ray_tpu.cluster.head_main",
+                         "--port", port, "--persist", self._head_persist],
+                        "head.log")
+                    _read_tagged_line(new_proc, "ADDRESS",
+                                      cfg.node_boot_timeout_s)
+                except Exception:
+                    if new_proc is not None and new_proc.poll() is None:
+                        new_proc.kill()
+                    new_proc = None
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.5)
+            self._head_proc = new_proc
+            self._procs.append(new_proc)
+        finally:
+            self._upgrading = False
+        # The swap is done when the successor answers on the old port.
+        stats = self.head.retrying_call("scheduler_stats", timeout=30)
+        report["new_incarnation"] = stats.get("head_incarnation")
+        report["upgrade_s"] = round(time.monotonic() - t0, 3)
+        return report
 
     # --------------------------------------------------------------- kv
 

@@ -354,6 +354,31 @@ _d("node_boot_timeout_s", float, 30.0,
 _d("head_supervisor_poll_s", float, 0.5,
    "driver-side head supervisor liveness poll period")
 
+# --- durable control plane (at-least-once actor calls, rolling head
+# upgrades, restart recovery) ---
+_d("actor_restart_queue_timeout_s", float, 60.0,
+   "how long queued actor calls wait for a PENDING/RESTARTING actor to "
+   "come back before failing with ActorDiedError (the restart-pending "
+   "queueing window: callers park, they don't error, while a "
+   "max_restarts recreation is in flight)")
+_d("actor_reply_memo_max", int, 1024,
+   "per-(actor, caller) LRU memo of executed calls' result batches: a "
+   "retried call whose results were already computed is answered from "
+   "the memo instead of re-executing (the at-least-once dedup half)")
+_d("actor_order_states_max", int, 4096,
+   "distinct caller streams tracked per hosted actor (seq horizon + "
+   "reply memo); least-recently-active streams beyond the cap are "
+   "evicted — a dead driver's stream must not pin memo state forever")
+_d("head_restart_actor_grace_s", float, 10.0,
+   "after a head restart, how long a recovered-ALIVE actor's host node "
+   "gets to re-register before the actor is declared dead and re-driven "
+   "through its max_restarts policy (covers the all-holders-dead case: "
+   "host node and head died together, so no worker_dead_at report ever "
+   "arrives)")
+_d("head_upgrade_drain_timeout_s", float, 15.0,
+   "rolling head upgrade: max wait for in-flight creations to settle "
+   "during prepare_upgrade before the snapshot flush proceeds anyway")
+
 # --- compiled DAGs ---
 _d("dag_channel_capacity", int, 8,
    "compiled-DAG channel slots: executions pipeline up to this depth "

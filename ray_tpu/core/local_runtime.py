@@ -290,7 +290,8 @@ class LocalRuntime:
 
     def create_actor(self, cls, args, kwargs, *, name: Optional[str] = None,
                      namespace: str = "default", max_concurrency: int = 1,
-                     max_restarts: int = 0, resources=None, lifetime=None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     resources=None, lifetime=None,
                      scheduling_strategy=None, get_if_exists: bool = False,
                      runtime_env=None, release_resources: bool = False,
                      concurrency_groups=None,

@@ -330,3 +330,68 @@ def _peer_of(conn) -> str:
         return f"{host}:{port}"
     except OSError:
         return ""
+
+
+# --------------------------------------------------------------- scenarios
+#
+# Scripted multi-step failure scenarios that need ORCHESTRATION, not just
+# an injected fault: the rule grammar above breaks one RPC at one point;
+# a rolling upgrade is a planned sequence (drain -> snapshot -> port
+# handover -> re-converge) whose acceptance criterion is measured on the
+# CLIENT side. Drivers live here so tests and bench.py run the identical
+# scenario.
+
+
+def run_rolling_upgrade(runtime, request_fn, clients: int = 2,
+                        pre_s: float = 0.5, settle_s: float = 1.0) -> dict:
+    """Rolling head-upgrade scenario: continuous client load across a
+    drain -> sqlite-checkpoint -> old head releases the port -> new
+    incarnation binds and serves handover
+    (ClusterRuntime.rolling_head_upgrade).
+
+    ``request_fn(i)`` is one client request returning a result or
+    raising; it runs in ``clients`` threads before, during, and after
+    the swap. Acceptance is ZERO raised requests — elevated latency is
+    expected (requests issued in the gap ride their retry loops), a
+    failure is not. Returns the upgrade report plus
+    requests_ok / request_failures / max_request_s."""
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"ok": 0, "failures": [], "max_s": 0.0}
+
+    def client_loop(ci: int) -> None:
+        i = 0
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                request_fn(ci * 1_000_000 + i)
+                with lock:
+                    stats["ok"] += 1
+                    stats["max_s"] = max(stats["max_s"],
+                                         time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001 — the scenario verdict
+                with lock:
+                    stats["failures"].append(repr(e)[:200])
+            i += 1
+
+    threads = [threading.Thread(target=client_loop, args=(ci,),
+                                daemon=True, name=f"upgrade-load-{ci}")
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(pre_s)  # load established before the swap begins
+        report = dict(runtime.rolling_head_upgrade())
+        time.sleep(settle_s)  # catch straggler failures after the swap
+    finally:
+        # A failed swap must still stop the load threads: left running
+        # they hammer the (possibly torn-down) runtime forever and grow
+        # stats['failures'] without bound.
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    with lock:
+        report["requests_ok"] = stats["ok"]
+        report["request_failures"] = list(stats["failures"])
+        report["max_request_s"] = round(stats["max_s"], 3)
+    return report

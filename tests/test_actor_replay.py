@@ -1,0 +1,352 @@
+"""At-least-once actor calls: the worker-side reply memo, the
+submitter-side replay machinery, and the restart-pending queueing
+window — unit tier (no cluster, no store; tier-1 everywhere).
+
+The memo contract under test is the one the durable control plane
+leans on: a retried delivery of a call that already EXECUTED must not
+execute again (exactly-once per incarnation), and when its results
+frame was the thing that got lost, the memo re-ships them.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import pytest
+
+from ray_tpu.cluster.worker_main import WorkerRuntime, _HostedActor
+from ray_tpu.core.cluster_core import ClusterCore, _ActorConn
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.serialization import SERIALIZER
+from ray_tpu.devtools.lock_debug import make_lock
+
+
+class _Instance:
+    """Mutating method: duplicate execution is observable."""
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+class _MemoHarness(WorkerRuntime):
+    """WorkerRuntime's actor-execution surface with the cluster plumbing
+    replaced: completions land in .sent instead of an owner RPC."""
+
+    def __init__(self):  # deliberately NOT calling super().__init__
+        self._hosted = {}
+        self._hosted_lock = make_lock("test._hosted_lock")
+        self._seen_tasks = set()
+        self._seen_order = collections.deque()
+        self._seen_lock = make_lock("test._seen_lock")
+        self._cancelled = set()
+        self._executing = set()
+        self.sent = []
+        self.sent_cv = threading.Condition()
+
+    def _enqueue_done(self, owner: str, entry) -> None:
+        with self.sent_cv:
+            self.sent.append((owner, entry))
+            self.sent_cv.notify_all()
+
+    def wait_sent(self, n: int, timeout: float = 10.0) -> list:
+        deadline = time.monotonic() + timeout
+        with self.sent_cv:
+            while len(self.sent) < n:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, \
+                    f"only {len(self.sent)}/{n} completions arrived"
+                self.sent_cv.wait(remaining)
+            return list(self.sent)
+
+
+def _host(harness: _MemoHarness, out_of_order: bool = False):
+    actor_id = ActorID.of(JobID.from_int(7))
+    hosted = _HostedActor(actor_id, _Instance(), 1, False,
+                          out_of_order=out_of_order)
+    harness._hosted[actor_id] = hosted
+    return actor_id, hosted
+
+
+def _entry(actor_id: ActorID, seq: int, owner: str = "owner-A",
+           method: str = "inc"):
+    task_id = TaskID.for_task(actor_id)
+    oid = ObjectID.for_task_return(task_id, 0)
+    blob = SERIALIZER.encode((task_id.binary(), actor_id.binary(), method,
+                              (), {}, [oid.binary()], owner))
+    return (seq, blob)
+
+
+def test_duplicate_push_executes_once_and_reships_results():
+    """The (caller, seq) memo: a duplicate delivery of an EXECUTED call
+    re-ships the memoized results instead of re-running the mutating
+    method — the at-least-once wire, exactly-once effect contract."""
+    h = _MemoHarness()
+    actor_id, hosted = _host(h)
+    e0 = _entry(actor_id, 0)
+    assert h.rpc_push_actor_batch(None, [e0], 0) is True
+    first = h.wait_sent(1)
+    assert hosted.instance.n == 1
+    # Same seq re-delivered (lost ack shape): NO re-execution, and the
+    # memoized results are re-enqueued to the owner verbatim.
+    assert h.rpc_push_actor_batch(None, [e0], 0) is True
+    both = h.wait_sent(2)
+    assert hosted.instance.n == 1, "duplicate delivery re-executed"
+    assert both[1] == first[0]
+    # A third delivery keeps answering from the memo.
+    assert h.rpc_push_actor_batch(None, [e0], 0) is True
+    assert h.wait_sent(3)[2] == first[0]
+    assert hosted.instance.n == 1
+
+
+def test_duplicate_push_out_of_order_actor_also_memoized():
+    h = _MemoHarness()
+    actor_id, hosted = _host(h, out_of_order=True)
+    e0 = _entry(actor_id, 0)
+    h.rpc_push_actor_batch(None, [e0], 0)
+    h.wait_sent(1)
+    h.rpc_push_actor_batch(None, [e0], 0)
+    h.wait_sent(2)
+    assert hosted.instance.n == 1
+
+
+def test_inflight_duplicate_stays_silent_until_completion():
+    """A duplicate of a DISPATCHED-but-unfinished seq must neither
+    re-execute nor fabricate results: the single execution's completion
+    is the only reply."""
+    h = _MemoHarness()
+    actor_id, hosted = _host(h)
+    gate = threading.Event()
+    started = threading.Event()
+
+    class _Slow:
+        def __init__(self):
+            self.calls = 0
+
+        def inc(self):
+            self.calls += 1
+            started.set()
+            gate.wait(10)
+            return self.calls
+
+    hosted.instance = _Slow()
+    e0 = _entry(actor_id, 0)
+    h.rpc_push_actor_batch(None, [e0], 0)
+    assert started.wait(5)
+    h.rpc_push_actor_batch(None, [e0], 0)  # in-flight duplicate
+    time.sleep(0.1)
+    assert h.sent == []  # no fabricated reply
+    gate.set()
+    h.wait_sent(1)
+    time.sleep(0.2)
+    assert hosted.instance.calls == 1
+    assert len(h.sent) == 1
+
+
+def test_memo_pruned_below_min_pending_horizon():
+    """Seqs the submitter settled can never be retried: their memo
+    entries drop the moment a push advances min_pending past them."""
+    h = _MemoHarness()
+    actor_id, hosted = _host(h)
+    h.rpc_push_actor_batch(None, [_entry(actor_id, 0),
+                                  _entry(actor_id, 1)], 0)
+    h.wait_sent(2)
+    owner_state = hosted.order["owner-A"]
+    assert set(owner_state.done) == {0, 1}
+    # Next push says min_pending=2: both settled at the submitter.
+    h.rpc_push_actor_batch(None, [_entry(actor_id, 2)], 2)
+    h.wait_sent(3)
+    assert set(owner_state.done) == {2}
+
+
+def test_reply_memo_lru_bound():
+    old = cfg.actor_reply_memo_max
+    cfg.set("actor_reply_memo_max", 8)
+    try:
+        h = _MemoHarness()
+        actor_id, hosted = _host(h)
+        for s in range(20):
+            h.rpc_push_actor_batch(None, [_entry(actor_id, s)], 0)
+        h.wait_sent(20)
+        st = hosted.order["owner-A"]
+        assert len(st.done) <= 8
+        assert max(st.done) == 19  # newest kept, oldest evicted
+    finally:
+        cfg.set("actor_reply_memo_max", old)
+
+
+def test_order_state_eviction_under_4096_plus_distinct_callers():
+    """A hosted service actor called by 4096+ distinct (short-lived)
+    callers must not pin one stream state per caller forever: the LRU
+    cap holds and the survivors are the most recent callers."""
+    h = _MemoHarness()
+    actor_id, hosted = _host(h)
+    n_callers = int(cfg.actor_order_states_max) + 104
+    for i in range(n_callers):
+        h.rpc_push_actor_batch(
+            None, [_entry(actor_id, 0, owner=f"owner-{i}")], 0)
+    h.wait_sent(n_callers, timeout=120.0)
+    assert len(hosted.order) == int(cfg.actor_order_states_max)
+    # Oldest callers evicted, newest retained.
+    assert "owner-0" not in hosted.order
+    assert f"owner-{n_callers - 1}" in hosted.order
+
+
+def test_dup_injected_push_actor_batch_executes_once(monkeypatch):
+    """The RTPU_DEBUG_RPC duplicate-delivery audit drives
+    push_actor_batch (a classified-idempotent mutating RPC) TWICE
+    through a real server dispatch: the mutating method must run once
+    and both deliveries must ack identically — the memo dedup asserted
+    under dup injection."""
+    from ray_tpu.cluster.protocol import RpcClient, RpcServer
+    from ray_tpu.devtools import rpc_debug
+
+    monkeypatch.setenv("RTPU_DEBUG_RPC", "1")
+    monkeypatch.setenv("RTPU_DEBUG_RPC_DUP_NTH", "1")
+    rpc_debug.reset()
+    h = _MemoHarness()
+    h.chaos_role = "worker"
+    actor_id, hosted = _host(h)
+    server = RpcServer(h).start()
+    client = RpcClient(server.address)
+    try:
+        for s in range(4):
+            assert client.call("push_actor_batch", [_entry(actor_id, s)],
+                               0, timeout=15) is True
+        h.wait_sent(4)
+        time.sleep(0.3)  # let any duplicate-triggered execution surface
+        assert hosted.instance.n == 4, \
+            "dup-injected delivery re-executed a mutating call"
+        assert rpc_debug.violations() == []
+        assert rpc_debug.dup_audit_counts().get("push_actor_batch", 0) > 0
+    finally:
+        client.close()
+        server.stop()
+        rpc_debug.reset()
+
+
+# ---------------------------------------------------------------- submitter
+
+
+class _ReplayHarness(ClusterCore):
+    """ClusterCore's replay surface with the wire replaced: failed calls
+    land in .failed, started senders in .senders."""
+
+    def __init__(self):  # deliberately NOT calling super().__init__
+        self.failed = []
+        self._inflight = {}
+        self._inflight_lock = make_lock("test._inflight_lock")
+
+    def _fail_actor_call(self, conn, seq, reason=None):
+        with conn.lock:
+            conn.pending.pop(seq, None)
+            conn.replays.pop(seq, None)
+        self.failed.append((seq, reason))
+
+    def _actor_sender_loop(self, conn):  # replay starts one; inert here
+        return
+
+
+def _conn_with_pending(seqs, actor_id=None):
+    conn = _ActorConn(actor_id or ActorID.of(JobID.from_int(9)))
+    for s in seqs:
+        conn.pending[s] = (b"tid%d" % s, b"blob", [])
+    conn.next_seq = max(seqs) + 1 if seqs else 0
+    return conn
+
+
+def test_replay_rebuilds_outbound_sorted_and_skips_inflight():
+    h = _ReplayHarness()
+    conn = _conn_with_pending([0, 1, 2, 3])
+    # seq 1 rides an unacked batch (will be re-driven by its resend
+    # deadline); seq 3 is already queued outbound (parked new submit).
+    conn.unacked.append([[(1, b"tid1", b"blob", [])], None, 0, 0.0])
+    conn.outbound.append((3, b"tid3", b"blob", []))
+    h._replay_actor_calls(conn, max_task_retries=-1)
+    assert [it[0] for it in conn.outbound] == [0, 2, 3]
+    assert conn.replays == {0: 1, 2: 1}  # outbound-parked seq 3 not a replay
+    assert h.failed == []
+    assert conn.sender_running  # replay started a sender
+
+
+def test_replay_against_newer_incarnation_than_the_acked_one():
+    """A batch ACKED by incarnation 1 (receipt ack — the worker died
+    before completing it) replays when the conn re-resolves to
+    incarnation 2, and AGAIN to incarnation 3: the replay machinery
+    must not treat a receipt-acked seq as settled, and the replay
+    count must ride across incarnations."""
+    h = _ReplayHarness()
+    conn = _conn_with_pending([5])
+    conn.incarnation = 1
+    h._replay_actor_calls(conn, max_task_retries=-1)  # -> incarnation 2
+    assert [it[0] for it in conn.outbound] == [5]
+    conn.outbound.clear()  # "sent" (and receipt-acked) to incarnation 2
+    conn.incarnation = 2
+    h._replay_actor_calls(conn, max_task_retries=-1)  # -> incarnation 3
+    assert [it[0] for it in conn.outbound] == [5]
+    assert conn.replays[5] == 2
+    assert h.failed == []
+
+
+def test_replay_bounded_by_max_task_retries():
+    h = _ReplayHarness()
+    conn = _conn_with_pending([0])
+    h._replay_actor_calls(conn, max_task_retries=2)
+    conn.outbound.clear()
+    h._replay_actor_calls(conn, max_task_retries=2)
+    conn.outbound.clear()
+    assert h.failed == []
+    # Third replay exceeds the bound: the poison call fails instead of
+    # riding every future incarnation.
+    h._replay_actor_calls(conn, max_task_retries=2)
+    assert conn.outbound == collections.deque()
+    assert len(h.failed) == 1
+    seq, reason = h.failed[0]
+    assert seq == 0 and "max_task_retries" in reason
+    assert 0 not in conn.pending and 0 not in conn.replays
+
+
+def test_restart_pending_queueing_timeout():
+    """Calls queued for a PENDING/RESTARTING actor park for
+    actor_restart_queue_timeout_s, then fail with a restart-pending
+    reason (never a silent hang, never an instant failure)."""
+    from ray_tpu.cluster.head import HeadServer, ActorInfo, PENDING
+    from ray_tpu.cluster.protocol import RpcClient
+
+    head = HeadServer()
+    old = cfg.actor_restart_queue_timeout_s
+    cfg.set("actor_restart_queue_timeout_s", 1.5)
+    try:
+        actor_id = ActorID.of(JobID.from_int(3))
+        info = ActorInfo(actor_id.binary(), None, "default", b"", 1, {},
+                         max_task_retries=-1)
+        info.state = PENDING  # restart in flight, forever
+        head._actors[actor_id.binary()] = info
+
+        h = _ReplayHarness()
+        h.head = RpcClient(head.address)
+        conn = _conn_with_pending([0], actor_id=actor_id)
+        t0 = time.monotonic()
+        addr = h._resolve_actor_address(conn)
+        waited = time.monotonic() - t0
+        assert addr is None
+        assert 1.0 <= waited < 10.0, waited  # parked ~the window, not 60s
+        # _send_actor_batch's addr-None arm fails queued calls with the
+        # restart-pending reason.
+        items = [(0, b"tid0", b"blob", [])]
+        h._send_actor_batch(conn, items, 0)
+        assert len(h.failed) == 1
+        assert "restart still pending" in h.failed[0][1]
+    finally:
+        cfg.set("actor_restart_queue_timeout_s", old)
+        try:
+            h.head.close()
+        except Exception:
+            pass
+        head.shutdown()

@@ -614,6 +614,210 @@ def test_scenario_head_restart_with_inflight_pg_and_queued_leases(
     _assert_leases_drain(runtime, allowed_actor_hosts=0)
 
 
+@pytest.fixture
+def plain_cluster():
+    """Subprocess cluster with NO chaos plan: scenarios drive real
+    SIGKILLs from the test body (the all-holders-dead shapes kill two
+    processes at once, which the one-process-kills-itself plan grammar
+    cannot express)."""
+    import ray_tpu
+
+    def boot(num_cpus=2):
+        return ray_tpu.init(num_cpus=num_cpus)
+
+    yield boot
+    import ray_tpu
+
+    ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_scenario_all_holders_dead_actor(plain_cluster):
+    """A registered actor's host NODE and the head die TOGETHER. No
+    worker_dead_at report can ever arrive (its target died too), and
+    the respawned head recovers the actor ALIVE from sqlite pointing at
+    a node that will never re-register. The recovered-ALIVE watch must
+    declare it dead after the grace window and re-drive it through
+    max_restarts; the caller's queued calls replay onto the new
+    incarnation (at-least-once) — PR 8's harness could not pass this
+    because the head had no durable actor table and no zombie-ALIVE
+    sweep."""
+    import os
+    import signal
+
+    import ray_tpu as rt
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    runtime = plain_cluster()
+    node_b = runtime.add_node(num_cpus=2)
+    time.sleep(1.5)
+
+    @rt.remote(max_restarts=2, max_task_retries=-1,
+               scheduling_strategy=NodeAffinitySchedulingStrategy(
+                   node_id=node_b.node_id, soft=True))
+    class Svc:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    a = Svc.remote()
+    assert rt.get(a.inc.remote(), timeout=60) == 1
+    info = runtime.head.retrying_call("get_actor_info",
+                                      a._actor_id.binary(), timeout=15)
+    assert info["state"] == "ALIVE"  # placed on node_b (soft affinity)
+    head_pid = runtime._head_proc.pid
+    # Kill BOTH: the actor's host node first (so its death report has no
+    # live head to land on), then the head before its health sweep can
+    # notice the node.
+    node_b.proc.kill()
+    os.kill(head_pid, signal.SIGKILL)
+    # Queued during the outage: must park (restart-pending queueing),
+    # then replay against the re-created incarnation on node A.
+    refs = [a.inc.remote() for _ in range(4)]
+    vals = rt.get(refs, timeout=180)
+    # Fresh incarnation: counter restarts from 0; exactly-once per
+    # incarnation means the four replayed calls count 1..4.
+    assert vals == [1, 2, 3, 4], vals
+    assert runtime._head_proc.pid != head_pid, "head did not respawn"
+    info = runtime.head.retrying_call("get_actor_info",
+                                      a._actor_id.binary(), timeout=15)
+    assert info["state"] == "ALIVE"
+    assert info["restarts"] >= 1
+    _assert_leases_drain(runtime, allowed_actor_hosts=1)
+
+
+@pytest.mark.slow
+def test_scenario_all_holders_dead_object_while_head_down(plain_cluster):
+    """Every holder of an object dies WHILE the head is down. The
+    respawned head's directory rehydrates only from surviving nodes —
+    none has a copy — so the owner's get() must fall through to lineage
+    re-execution (sqlite brings the control plane back; lineage brings
+    the data back)."""
+    import os
+    import signal
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    runtime = plain_cluster()
+    node_b = runtime.add_node(num_cpus=2)
+    time.sleep(1.5)
+    n = 500_000
+
+    @rt.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=node_b.node_id, soft=True))
+    def produce():
+        return np.arange(n)
+
+    ref = produce.remote()
+    ready, _ = rt.wait([ref], num_returns=1, timeout=90, fetch_local=False)
+    assert ready
+    head_pid = runtime._head_proc.pid
+    os.kill(head_pid, signal.SIGKILL)  # head down first...
+    node_b.proc.kill()                 # ...then the only holder dies
+    got = rt.get(ref, timeout=180)     # recovers via lineage post-respawn
+    assert got[0] == 0 and got[-1] == n - 1
+    assert runtime._head_proc.pid != head_pid, "head did not respawn"
+    _assert_leases_drain(runtime, allowed_actor_hosts=0)
+
+
+@pytest.mark.slow
+def test_scenario_node_death_recreates_actor_and_replays_calls(
+        plain_cluster):
+    """The one-continuous-story scenario: host node dies (head alive),
+    head's health sweep restarts the actor on another node via
+    max_restarts, and the caller's unacked calls replay there."""
+    import ray_tpu as rt
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    runtime = plain_cluster()
+    node_b = runtime.add_node(num_cpus=2)
+    time.sleep(1.5)
+
+    @rt.remote(max_restarts=1, max_task_retries=-1,
+               scheduling_strategy=NodeAffinitySchedulingStrategy(
+                   node_id=node_b.node_id, soft=True))
+    class Svc:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    a = Svc.remote()
+    assert rt.get(a.inc.remote(), timeout=60) == 1
+    refs = [a.inc.remote() for _ in range(6)]
+    runtime.kill_node(node_b)
+    vals = rt.get(refs, timeout=180)
+    # Some of the 6 may have executed on the dying incarnation with
+    # results delivered (those keep their old-incarnation values); the
+    # rest replay in order onto the fresh one. NONE may fail, and every
+    # replayed run must be exactly-once (strictly increasing counter
+    # runs — a duplicate execution would repeat or skip a value).
+    assert len(vals) == 6
+    assert all(isinstance(v, int) for v in vals), vals
+    runs = [vals[i] for i in range(len(vals))
+            if i == 0 or vals[i] != vals[i - 1] + 1]
+    assert len(runs) <= 2, f"more than one incarnation boundary: {vals}"
+    # The restarted incarnation answers fresh calls.
+    assert rt.get(a.inc.remote(), timeout=60) >= 1
+    _wait_until(
+        lambda: runtime.head.retrying_call(
+            "get_actor_info", a._actor_id.binary(),
+            timeout=15)["restarts"] >= 1,
+        60, "actor never restarted after node death")
+    _assert_leases_drain(runtime, allowed_actor_hosts=1)
+
+
+@pytest.mark.slow
+def test_scenario_rolling_head_upgrade_zero_failures(plain_cluster):
+    """The rolling-upgrade scenario (devtools.chaos.run_rolling_upgrade):
+    drain -> sqlite checkpoint -> old head releases the port -> new
+    incarnation serves, under continuous task + actor-call load.
+    Acceptance: ZERO failed client requests — latency may spike while
+    requests ride their retry loops across the gap, failures fail."""
+    import ray_tpu as rt
+
+    runtime = plain_cluster()
+
+    @rt.remote
+    def ping(i):
+        return i
+
+    @rt.remote(max_restarts=1, max_task_retries=-1)
+    class Echo:
+        def hit(self, i):
+            return i
+
+    e = Echo.remote()
+    assert rt.get(e.hit.remote(-1), timeout=60) == -1
+
+    def request(i):
+        if i % 2:
+            assert rt.get(ping.remote(i), timeout=120) == i
+        else:
+            assert rt.get(e.hit.remote(i), timeout=120) == i
+
+    report = chaos.run_rolling_upgrade(runtime, request, clients=2)
+    assert report["request_failures"] == [], report["request_failures"]
+    assert report["requests_ok"] > 0
+    assert report["new_incarnation"] != report["old_incarnation"]
+    # The upgraded head serves fresh work and the actor survived.
+    assert rt.get(e.hit.remote(99), timeout=60) == 99
+    assert rt.get([ping.remote(i) for i in range(4)],
+                  timeout=90) == list(range(4))
+    _assert_leases_drain(runtime, allowed_actor_hosts=1)
+
+
 def _assert_leases_drain(runtime, allowed_actor_hosts: int,
                          timeout_s: float = 45.0) -> None:
     """Post-scenario invariant: once the workload drains, every

@@ -1,0 +1,213 @@
+"""Simulated-N-node scale mode + the head's indexed hot-path
+structures (node->objects reverse index, cached per-node utilization)
+— in-process, no store, tier-1 everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu.cluster.head import HeadServer
+from ray_tpu.core.cluster_runtime import SimulatedCluster
+from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+
+def test_simulated_cluster_control_plane_end_to_end():
+    """8 simulated nodes register, heartbeat, serve picks/locations/
+    census/drain — the full control-plane surface bench.py --scale
+    profiles at 100."""
+    sim = SimulatedCluster(8, resources={"CPU": 4.0})
+    try:
+        sim.wait_registered(30)
+        views = sim.client.call("list_nodes", timeout=10)
+        assert sum(1 for v in views if v["alive"]) == 8
+        # Scheduling works against simulated nodes.
+        picked = sim.client.call("pick_node", {"CPU": 1.0}, None, None,
+                                 "sim-k", timeout=10)
+        assert picked is not None
+        # Directory: seed via the batched wire shape, look up, drain.
+        nid = sim.nodes[0].node_id
+        oid = b"x" * 28
+        sim.client.call("object_batch", nid, [("add", oid, 123)],
+                        timeout=10)
+        locs = sim.client.call("object_locations", oid, None, timeout=10)
+        assert [l[0] for l in locs] == [nid]
+        census = sim.client.call("cluster_leases", timeout=30)
+        assert len(census) == 8
+        assert all("error" not in v for v in census.values()
+                   if isinstance(v, dict))
+        sim.client.call("drain_node", nid, timeout=10)
+        assert sim.client.call("object_locations", oid, None,
+                               timeout=10) == []
+    finally:
+        sim.shutdown()
+
+
+def test_simulated_node_spawns_no_worker_machinery():
+    sim = SimulatedCluster(1)
+    try:
+        sim.wait_registered(15)
+        n = sim.nodes[0]
+        assert n.simulated
+        assert n._workers == {}
+        assert n._zygote is None
+        assert n._metrics_exporter is None
+        # The stubbed store serves the control-plane calls it needs.
+        assert n.store.contains(object()) is False
+        assert n.store.stats() == (0, 0, 0, 0)
+    finally:
+        sim.shutdown()
+
+
+def test_head_reverse_index_tracks_adds_removes_and_death():
+    """The node->objects reverse index must stay consistent with the
+    holder-set directory through every mutation path — it is what node
+    death/drain scrubs instead of walking the full table."""
+    head = HeadServer()
+    try:
+        head.rpc_register_node(None, "nA", "127.0.0.1:1", {"CPU": 1}, {},
+                               "sA")
+        head.rpc_register_node(None, "nB", "127.0.0.1:2", {"CPU": 1}, {},
+                               "sB")
+        o1, o2 = b"a" * 28, b"b" * 28
+        head.rpc_object_added(None, o1, "nA", 10)
+        head.rpc_object_batch(None, "nB", [("add", o1, 10),
+                                           ("add", o2, 20)])
+        assert head._node_objects["nA"] == {o1}
+        assert head._node_objects["nB"] == {o1, o2}
+        # Removal via both wire shapes.
+        head.rpc_object_removed(None, o1, "nA")
+        assert head._node_objects["nA"] == set()
+        assert head._object_dir[o1] == {"nB"}
+        # Death scrub drops ONLY the dead node's entries.
+        head._on_node_dead("nB")
+        assert "nB" not in head._node_objects
+        assert o1 not in head._object_dir
+        assert o2 not in head._object_dir
+        assert head._object_sizes == {}
+    finally:
+        head.shutdown()
+
+
+def test_node_util_cache_tracks_heartbeats():
+    """pick scoring reads the cached util; heartbeats (full and delta)
+    must keep it fresh."""
+    head = HeadServer()
+    try:
+        head.rpc_register_node(None, "nA", "127.0.0.1:1",
+                               {"CPU": 4.0, "TPU": 2.0}, {}, "sA")
+        n = head._nodes["nA"]
+        assert n.util == 0.0
+        assert head.rpc_heartbeat(None, "nA", {"CPU": 2.0, "TPU": 2.0},
+                                  version=1, is_delta=False) is True
+        assert n.util == 0.5
+        # Delta carrying only the changed resource.
+        assert head.rpc_heartbeat(None, "nA", {"TPU": 0.0},
+                                  version=2, is_delta=True) is True
+        assert n.util == 1.0
+        # Empty delta (nothing changed): cheap, util untouched.
+        assert head.rpc_heartbeat(None, "nA", {}, version=3,
+                                  is_delta=True) is True
+        assert n.util == 1.0
+        # The pick path consumes the cache: a fully-used node loses to
+        # an idle one.
+        head.rpc_register_node(None, "nB", "127.0.0.1:2",
+                               {"CPU": 4.0, "TPU": 2.0}, {}, "sB")
+        picked = head.rpc_pick_node(None, {"CPU": 1.0})
+        assert picked[0] == "nB"
+    finally:
+        head.shutdown()
+
+
+def test_prepare_upgrade_drains_and_reports():
+    head = HeadServer()
+    try:
+        head.rpc_register_node(None, "nA", "127.0.0.1:1", {"CPU": 1}, {},
+                               "sA")
+        summary = head.rpc_prepare_upgrade(None)
+        assert summary["incarnation"] == head.incarnation
+        assert summary["nodes"] == 1
+        assert summary["flushed"] is False  # memory-only head
+        assert head._draining
+        # Draining head stops issuing death verdicts: a node with an
+        # ancient heartbeat survives the sweep.
+        head._nodes["nA"].last_heartbeat = time.monotonic() - 3600
+        head._sweep_alive_watch()  # no-op either way; the health loop
+        # itself is gated on _draining (exercised via the flag).
+        assert head.rpc_resume_serving(None) is True
+        assert not head._draining
+    finally:
+        head.shutdown()
+
+
+def test_recovered_alive_actor_watch_grace(tmp_path):
+    """A head restarted from sqlite with an ALIVE actor whose node never
+    re-registers must declare it dead after the grace window and
+    re-drive it (the all-holders-dead shape, unit tier)."""
+    from ray_tpu.cluster.head import ALIVE, RESTARTING, DEAD, ActorInfo
+
+    db = str(tmp_path / "head.db")
+    head = HeadServer(persist_path=db)
+    aid = b"actor-000"
+    try:
+        info = ActorInfo(aid, None, "default", b"\x80\x04N.", 1, {},
+                         max_task_retries=-1)
+        info.state = ALIVE
+        info.node_id = "gone-node"
+        info.worker_addr = "127.0.0.1:9"
+        head._actors[aid] = info
+        head._persist_actor(info)
+    finally:
+        head.shutdown()
+    old = cfg.head_restart_actor_grace_s
+    cfg.set("head_restart_actor_grace_s", 0.5)
+    try:
+        head2 = HeadServer(persist_path=db)
+        try:
+            assert aid in head2._alive_watch
+            info2 = head2._actors[aid]
+            assert info2.state == ALIVE
+            assert info2.max_task_retries == -1  # persisted policy
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and aid in head2._alive_watch:
+                time.sleep(0.1)
+            # Grace expired with no node: re-driven through max_restarts
+            # (no node to land on here, so it parks RESTARTING and then
+            # fails -> DEAD; the point is it LEFT the zombie-ALIVE state).
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and \
+                    head2._actors[aid].state == ALIVE:
+                time.sleep(0.1)
+            assert head2._actors[aid].state in (RESTARTING, DEAD)
+        finally:
+            head2.shutdown()
+    finally:
+        cfg.set("head_restart_actor_grace_s", old)
+
+
+def test_recovered_alive_actor_confirmed_when_node_returns(tmp_path):
+    """The inverse: the host node re-registers inside the grace window
+    and the actor is confirmed, never killed."""
+    from ray_tpu.cluster.head import ALIVE, ActorInfo
+
+    db = str(tmp_path / "head.db")
+    head = HeadServer(persist_path=db)
+    aid = b"actor-001"
+    try:
+        info = ActorInfo(aid, None, "default", b"\x80\x04N.", 1, {})
+        info.state = ALIVE
+        info.node_id = "node-back"
+        head._actors[aid] = info
+        head._persist_actor(info)
+    finally:
+        head.shutdown()
+    head2 = HeadServer(persist_path=db)
+    try:
+        assert aid in head2._alive_watch
+        head2.rpc_register_node(None, "node-back", "127.0.0.1:3",
+                                {"CPU": 1}, {}, "s")
+        head2._sweep_alive_watch()
+        assert aid not in head2._alive_watch
+        assert head2._actors[aid].state == ALIVE
+    finally:
+        head2.shutdown()

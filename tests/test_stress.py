@@ -153,13 +153,24 @@ class TestRound4Chaos:
             assert got == list(range(80))
 
     def test_cross_node_dag_exact_under_chaos(self, chaos_cluster):
-        """Pushed channel messages + cumulative acks survive chaos: 40
-        windowed rounds through a 2-node pipeline stay exact."""
+        """Pushed channel messages + cumulative acks survive chaos: 24
+        windowed rounds through a 2-node pipeline stay exact.
+
+        Bounded-retry-window idiom (the PR 6/PR 8 de-flake pattern): a
+        cross-node hop is a push RPC per message, and chaos-lengthened
+        push laps (each retry lap is seconds of backoff) can
+        legitimately outrun one round's channel timeout on a loaded
+        box. A ChannelTimeoutError therefore gets a FRESH dag and a
+        retry — up to 3 measurement attempts, pass on the first exact
+        run. Correctness still has no escape hatch: any attempt that
+        COMPLETES must be exact, and broken channel plumbing times out
+        (or mis-orders) on all three attempts."""
         import collections
         import time as _time
 
         from ray_tpu.core.task_spec import NodeAffinitySchedulingStrategy
         from ray_tpu.dag import InputNode
+        from ray_tpu.dag.channel import ChannelTimeoutError
 
         rt = chaos_cluster
         node = rt.add_node(num_cpus=2)
@@ -179,16 +190,28 @@ class TestRound4Chaos:
         b = Stage.options(
             scheduling_strategy=NodeAffinitySchedulingStrategy(
                 node_id=node.node_id, soft=False)).remote()
-        with InputNode() as inp:
-            out = b.f.bind(a.f.bind(inp))
-        dag = out.experimental_compile()
-        w = collections.deque()
-        got = []
-        for i in range(24):
-            w.append(dag.execute(i))
-            if len(w) >= 4:
-                got.append(w.popleft().get(timeout=120))
-        while w:
-            got.append(w.popleft().get(timeout=120))
-        assert got == [i * 9 for i in range(24)]
-        dag.teardown()
+
+        last_timeout = None
+        for attempt in range(3):
+            with InputNode() as inp:
+                out = b.f.bind(a.f.bind(inp))
+            dag = out.experimental_compile()
+            w = collections.deque()
+            got = []
+            try:
+                for i in range(24):
+                    w.append(dag.execute(i))
+                    if len(w) >= 4:
+                        got.append(w.popleft().get(timeout=120))
+                while w:
+                    got.append(w.popleft().get(timeout=120))
+            except ChannelTimeoutError as e:
+                last_timeout = e
+                dag.teardown()
+                continue
+            assert got == [i * 9 for i in range(24)]
+            dag.teardown()
+            return
+        raise AssertionError(
+            f"channel pipeline timed out on all 3 attempts: "
+            f"{last_timeout!r}")
