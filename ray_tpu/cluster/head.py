@@ -173,6 +173,15 @@ class HeadServer:
         self._trace_lock = make_lock("head._trace_lock")
         self._trace_ring = _collections.deque()
         self._trace_ring_bytes = 0
+        # Compiled-DAG channel registry: channel_id -> {addr, owner,
+        # alive, ts}. The ONE-TIME negotiation point for cross-node
+        # channel edges (reader registers its endpoint, writer looks it
+        # up once); steady-state channel traffic never comes back here.
+        # Entries for a dead owner flip alive=False (writers blocked on
+        # the edge read that as peer death) and are reaped by the
+        # register-time cap below.
+        self._channels: "_collections.OrderedDict[bytes, dict]" = \
+            _collections.OrderedDict()
         # submitter id -> (monotonic, [(resources, count)]) backlog reports
         self._backlogs: Dict[str, Tuple[float, list]] = {}
         # Cluster-wide task-event ring (reference: GcsTaskManager,
@@ -464,6 +473,7 @@ class HeadServer:
             # (same cleanup as node death) so pullers don't dial a
             # drained node and the locality scorer doesn't credit it.
             self._scrub_node_objects(node_id)
+            self._scrub_channels(node_id=node_id)
         if n is not None:
             self._publish("NODE", {"event": "removed", "node_id": node_id})
         return True
@@ -525,6 +535,9 @@ class HeadServer:
             # would make owners believe lost objects are still available
             # (blocking lineage recovery) and make pullers dial a corpse.
             self._scrub_node_objects(node_id)
+            # Channel endpoints hosted on the node died with it: flip
+            # them so blocked writers see peer death, not a blind stall.
+            self._scrub_channels(node_id=node_id)
         for a in victims:
             self._actor_died(a, f"node {node_id} died", try_restart=True)
 
@@ -974,9 +987,61 @@ class HeadServer:
         with self._lock:
             victims = [a for a in self._actors.values()
                        if a.worker_addr == worker_addr and a.state == ALIVE]
+            self._scrub_channels(owner=worker_addr)
         for a in victims:
             self._actor_died(a, "worker process died", try_restart=True)
         return True
+
+    # ------------------------------------------------------ channel registry
+
+    _CHANNELS_MAX = 8192
+
+    def rpc_channel_register(self, conn, channel_id: bytes, addr: str,
+                             owner: str = "", node_id: str = "") -> bool:
+        """Compiled-DAG channel negotiation: the READER endpoint of a
+        cross-node edge registers its dialable address once; writers
+        resolve it via channel_lookup and then never come back.
+        Idempotent: re-registering the same channel overwrites (a
+        respawned reader re-announces itself)."""
+        with self._lock:
+            self._channels[channel_id] = {
+                "addr": addr, "owner": owner, "node_id": node_id,
+                "alive": True, "ts": time.time()}
+            self._channels.move_to_end(channel_id)
+            while len(self._channels) > self._CHANNELS_MAX:
+                self._channels.popitem(last=False)
+        _flight.record("channel_register", ch=channel_id.hex()[:12],
+                       addr=addr)
+        return True
+
+    def rpc_channel_lookup(self, conn, channel_id: bytes):
+        """Endpoint + liveness for one channel (None = never
+        registered / unregistered). ``alive=False`` means the owning
+        worker died with the registration still standing — a blocked
+        writer should treat the edge as closed, not slow."""
+        with self._lock:
+            ent = self._channels.get(channel_id)
+            return dict(ent) if ent is not None else None
+
+    def rpc_channel_unregister(self, conn, channel_id: bytes) -> bool:
+        """Graceful reader teardown. Idempotent — unregistering an
+        unknown channel is True (the state 'not registered' holds)."""
+        with self._lock:
+            self._channels.pop(channel_id, None)
+        return True
+
+    def _scrub_channels(self, owner: Optional[str] = None,
+                        node_id: Optional[str] = None) -> None:
+        """Death-report integration (callers hold self._lock): flip
+        registrations owned by a dead worker/node to alive=False so
+        writers blocked mid-transfer learn the peer died instead of
+        timing out blind. Entries stay (bounded by the register cap)
+        so lookup can still ANSWER with the death verdict."""
+        for ent in self._channels.values():
+            if owner is not None and ent.get("owner", "") == owner:
+                ent["alive"] = False
+            elif node_id is not None and ent.get("node_id") == node_id:
+                ent["alive"] = False
 
     @blocking_rpc
     def rpc_kill_actor(self, conn, actor_id: bytes, no_restart: bool = True):

@@ -306,6 +306,9 @@ READONLY_RPCS = frozenset({
     "clock_probe", "dump_flight", "pick_node", "pick_nodes",
     "object_locations", "scheduler_stats", "pg_table", "pg_ready",
     "kv_get", "kv_keys", "get_demand", "has_object", "store_stats",
+    # channel negotiation: endpoint + liveness read (writers poll it
+    # during the one-time negotiation and on timeout liveness probes)
+    "channel_lookup",
     "pull_stats", "wait_object", "wait_objects", "get_object",
     "stream_consumed", "wait_actor_address",
     # chunk serving is a pure read of a sealed object (the pull
@@ -328,6 +331,10 @@ IDEMPOTENT_RPCS = frozenset({
     # checkpoint re-runs, the summary re-reads), and resume just clears
     # the flag — both safe to retry or re-deliver
     "prepare_upgrade", "resume_serving",
+    # channel negotiation: register overwrites with the same entry
+    # (re-delivery is a no-op returning True), unregister of an
+    # already-gone channel is True — the state "not registered" holds
+    "channel_register", "channel_unregister",
 })
 
 #: Caller-side acked-retry loops with explicit loss handling; a

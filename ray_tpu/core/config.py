@@ -304,6 +304,16 @@ _d("serve_router_queue_weight", float, 1.0,
 _d("serve_router_kv_weight", float, 0.5,
    "scored routing: weight of the KV-pressure penalty (1 - free/total "
    "cache blocks on the candidate)")
+_d("serve_router_ttft_weight", float, 0.0,
+   "scored routing: weight of the replica's EWMA TTFT (seconds) as a "
+   "pressure term — 0 (default) keeps scores byte-identical to the "
+   "pre-disagg router; the disaggregated prefill pool sets it so "
+   "admission pressure on a slow-prefilling replica steers arrivals "
+   "away before the SLO gate has to shed them")
+_d("serve_disagg_max_redirects", int, 2,
+   "disaggregated serving: how many times a prefill replica re-routes "
+   "one request's KV handoff after a decode-replica death before "
+   "failing the request")
 _d("serve_snapshot_ttl_s", float, 5.0,
    "replica load snapshots older than this are treated as absent "
    "(scored routing falls back to pow-2 rather than trust a dead "
@@ -385,6 +395,23 @@ _d("dag_channel_capacity", int, 8,
    "before the driver's next execute() blocks")
 _d("dag_teardown_timeout_s", float, 10.0,
    "teardown handshake: wait for each loop to consume its stop sentinel")
+_d("dag_ring_bytes", int, 1 << 20,
+   "same-node compiled-DAG channel ring size (data bytes of the shm "
+   "mmap ring each edge maps); records bigger than dag_ring_spill_bytes "
+   "spill to a side file so one huge payload never has to fit")
+_d("dag_ring_spill_bytes", int, 1 << 18,
+   "ring records larger than this many payload bytes spill to a side "
+   "file next to the ring (the ring carries the reference); the writer "
+   "pins the spill until the reader consumes it and reclaims it on "
+   "teardown — a reader death can never leak the payload")
+_d("dag_channel_dir", str, "",
+   "directory for same-node channel rings/spills ('' = /dev/shm when "
+   "present, else the system temp dir). Both endpoints of an edge must "
+   "resolve the same directory — it IS the rendezvous namespace")
+_d("dag_negotiate_timeout_s", float, 30.0,
+   "one-time channel negotiation budget: ring-file rendezvous attach "
+   "and head-mediated cross-node endpoint lookup both give up (with "
+   "peer-liveness context in the error) after this long")
 _d("dag_overlap_comm", bool, False,
    "compiled DAGs: run channel writes on a dedicated sender thread so "
    "compute for step n+1 overlaps the send of step n (reference: "

@@ -1,13 +1,20 @@
 """ray_tpu.dag: compiled multi-actor execution graphs (aDAG equivalent).
 
-Parity target: the reference's Compiled Graphs surface (python/ray/dag/ —
+Parity target: the reference's Compiled Graphs surface (python/ray/dag —
 InputNode/MultiOutputNode/.bind()/experimental_compile) re-designed for
-this runtime: schedules execute over shm channels with condvar wakeups
-instead of per-call RPC (see compiled_dag.py).
+this runtime: compile turns the bound graph into per-actor schedules
+over PRE-NEGOTIATED per-edge channels — shm ring buffers for same-node
+edges (ring.py), persistent peer sockets carrying scatter frames for
+cross-node edges (peer.py) — so a steady-state hop never touches the
+head, the scheduler, or a lease. The disaggregated prefill/decode
+serving tier (serve/llm.py) streams KV pages over the same channels.
 """
 
-from ray_tpu.dag.channel import (ChannelClosedError, ChannelTimeoutError,
-                                 ShmChannel)
+from ray_tpu.dag.channel import (ChannelClosedError, ChannelEndpoint,
+                                 ChannelError, ChannelReader,
+                                 ChannelTimeoutError, ChannelWriter,
+                                 CrossNodeChannel, RingChannel, ShmChannel,
+                                 endpoint_violations)
 from ray_tpu.dag.collective_node import (CollectiveOutputNode, allreduce)
 from ray_tpu.dag.communicator import (Communicator, CpuCommunicator,
                                       JaxHostCommunicator)
@@ -16,8 +23,10 @@ from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
                                   MultiOutputNode)
 
 __all__ = [
-    "ChannelClosedError", "ChannelTimeoutError", "ClassMethodNode",
-    "CollectiveOutputNode", "Communicator", "CompiledDAG", "CompiledDAGRef",
-    "CpuCommunicator", "DAGNode", "InputNode", "JaxHostCommunicator",
-    "MultiOutputNode", "ShmChannel", "allreduce",
+    "ChannelClosedError", "ChannelEndpoint", "ChannelError",
+    "ChannelReader", "ChannelTimeoutError", "ChannelWriter",
+    "ClassMethodNode", "CollectiveOutputNode", "Communicator",
+    "CompiledDAG", "CompiledDAGRef", "CpuCommunicator", "CrossNodeChannel",
+    "DAGNode", "InputNode", "JaxHostCommunicator", "MultiOutputNode",
+    "RingChannel", "ShmChannel", "allreduce", "endpoint_violations",
 ]

@@ -1,336 +1,103 @@
-"""Shm-backed channels: the compiled DAG's data plane.
+"""Compiled-DAG channels: the data plane the compiled graph runs on.
 
-Parity target: reference python/ray/experimental/channel/
-shared_memory_channel.py:151 (Channel over mutable plasma objects).
-Re-designed over this runtime's object plane: each (channel, seq) message
-is one immutable store object with a DETERMINISTIC id
-(sha224(channel_id || seq) — exactly the store's 28-byte key size), so
-writer and reader processes rendezvous with no coordination service.
-Consumption is deletion (the ack), and backpressure is the writer waiting
-for the message `capacity` slots back to be consumed. Wakeups ride the
-store's process-shared seal condvar — a compiled-DAG hop costs a shm write
-+ condvar broadcast, not an RPC through the scheduler.
+Two transports, selected at compile time once actor placement is known
+(``compiled_dag._resolve_channel_kinds``):
+
+- :class:`ShmChannel` (``ring.RingChannel``) — same-node edges ride an
+  SPSC shm ring buffer (one mmap in /dev/shm per edge): a hop is a
+  memcpy + an 8-byte cursor publish. See ``ring.py``.
+- :class:`CrossNodeChannel` (``peer.CrossNodeChannel``) — cross-node
+  edges ride a persistent peer socket carrying pickle-5 scatter frames
+  with credit-based backpressure, negotiated ONCE through the head's
+  channel registry. See ``peer.py``.
+
+Both implement the same surface the compiled DAG drives::
+
+    write(value, seq) / write_error(exc, seq) / write_stop(seq)
+    read(seq, timeout)            # ordered; consumption is the ack
+    wait_consumed(seq, timeout)   # teardown handshake
+    drain(from_seq) / close()
+
+``ChannelWriter`` / ``ChannelReader`` wrap an endpoint with a running
+seq counter for long-lived streams (the disaggregated-serving KV mesh)
+where callers want ``send()``/``recv()`` instead of explicit seqs.
 """
 
 from __future__ import annotations
 
-import hashlib
-import pickle
-import time
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Optional
 
-from ray_tpu.core.ids import ObjectID
+from ray_tpu.dag.errors import (ChannelClosedError, ChannelError,
+                                ChannelTimeoutError)
+from ray_tpu.dag.peer import (ChannelEndpoint, CrossNodeChannel,
+                              endpoint_violations, get_endpoint)
+from ray_tpu.dag.ring import RingChannel, channel_dir
 
+#: Same-node transport under its historical name (the compiled DAG and
+#: its tests type-check channel kinds by these two class names).
+ShmChannel = RingChannel
 
-class ChannelTimeoutError(TimeoutError):
-    pass
-
-
-class ChannelClosedError(RuntimeError):
-    pass
-
-
-_STOP = b"\x00__rtpu_channel_stop__"
-
-
-def _msg_oid(channel_id: bytes, seq: int) -> ObjectID:
-    return ObjectID(hashlib.sha224(
-        channel_id + seq.to_bytes(8, "little")).digest())
+__all__ = [
+    "ChannelClosedError", "ChannelEndpoint", "ChannelError",
+    "ChannelReader", "ChannelTimeoutError", "ChannelWriter",
+    "CrossNodeChannel", "RingChannel", "ShmChannel", "channel_dir",
+    "endpoint_violations", "get_endpoint",
+]
 
 
-class ShmChannel:
-    """Single-writer single-reader ordered message channel.
+class ChannelWriter:
+    """Thread-safe auto-seq facade over a channel's writer end: many
+    producer threads, ONE ordered stream (the channel stays
+    single-writer — the lock serializes, the counter orders)."""
 
-    Both ends construct it from the (serializable) channel_id; the store
-    handle comes from the hosting process's runtime. Same-node only — the
-    compiled DAG scheduler co-locates or falls back to the RPC path.
-    """
+    def __init__(self, channel):
+        self.channel = channel
+        self._seq = 0
+        self._lock = threading.Lock()
 
-    def __init__(self, channel_id: bytes, capacity: int = 8):
-        self.channel_id = channel_id
-        self.capacity = capacity
-        self._store = None
+    def send(self, value: Any, timeout: Optional[float] = None) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self.channel.write(value, seq, timeout=timeout)
+            return seq
 
-    def _ensure_store(self):
-        if self._store is None:
-            from ray_tpu.core.runtime_context import require_runtime
+    def send_stop(self) -> None:
+        with self._lock:
+            try:
+                self.channel.write_stop(self._seq)
+                self._seq += 1
+            except (ChannelError, ChannelTimeoutError, OSError):
+                pass
 
-            self._store = require_runtime().store
-        return self._store
+    def close(self) -> None:
+        close = getattr(self.channel, "close", None)
+        if close is not None:
+            close()
 
-    # ------------------------------------------------------------ writer
 
-    def write(self, value: Any, seq: int, timeout: Optional[float] = None,
-              _raw: Optional[bytes] = None) -> None:
-        store = self._ensure_store()
-        payload = _raw if _raw is not None else pickle.dumps(
-            ("ok", value), protocol=5)
-        # Backpressure: the slot `capacity` behind must have been consumed.
-        # Exponential backoff (0.5ms -> 10ms): contains() may stat the
-        # spill dir, and a tight poll would be a syscall storm per stalled
-        # writer.
-        if seq >= self.capacity:
-            old = _msg_oid(self.channel_id, seq - self.capacity)
-            deadline = None if timeout is None else time.monotonic() + timeout
-            pause = 0.0005
-            while store.contains(old):
-                if deadline is not None and time.monotonic() > deadline:
-                    raise ChannelTimeoutError(
-                        f"reader {self.capacity} messages behind")
-                time.sleep(pause)
-                pause = min(pause * 2, 0.01)
-        store.put_bytes(_msg_oid(self.channel_id, seq), payload)
+class ChannelReader:
+    """Auto-seq facade over a channel's reader end (single consumer)."""
 
-    def write_error(self, exc: BaseException, seq: int) -> None:
-        self.write(None, seq, _raw=pickle.dumps(("err", exc), protocol=5))
+    def __init__(self, channel):
+        self.channel = channel
+        self._seq = 0
 
-    def write_stop(self, seq: int) -> None:
-        self.write(None, seq, _raw=pickle.dumps(("stop", None), protocol=5))
+    def prepare(self) -> None:
+        prep = getattr(self.channel, "prepare_read", None)
+        if prep is not None:
+            prep()
 
-    # ------------------------------------------------------------ reader
-
-    def read(self, seq: int, timeout: Optional[float] = None) -> Any:
-        """Blocking read of message `seq`; consumed (deleted) on return.
-        Raises the carried exception for error messages and
-        ChannelClosedError for stop sentinels."""
-        store = self._ensure_store()
-        oid = _msg_oid(self.channel_id, seq)
-        ms = -1 if timeout is None else max(1, int(timeout * 1000))
-        buf = store.get(oid, timeout_ms=ms)
-        if buf is None:
-            raise ChannelTimeoutError(
-                f"channel read timed out (seq={seq})")
-        try:
-            kind, value = pickle.loads(bytes(buf.buffer))
-        finally:
-            buf.release()
-        store.delete(oid)  # consumption ack: frees the writer's slot
-        if kind == "err":
-            raise value
-        if kind == "stop":
-            raise ChannelClosedError("channel closed")
+    def recv(self, timeout: Optional[float] = None) -> Any:
+        value = self.channel.read(self._seq, timeout=timeout)
+        self._seq += 1
         return value
 
-    def wait_consumed(self, seq: int, timeout: float = 10.0) -> bool:
-        """Block until message `seq` has been consumed (teardown
-        handshake). True if consumed within the timeout."""
-        store = self._ensure_store()
-        oid = _msg_oid(self.channel_id, seq)
-        deadline = time.monotonic() + timeout
-        pause = 0.001
-        while store.contains(oid):
-            if time.monotonic() > deadline:
-                return False
-            time.sleep(pause)
-            pause = min(pause * 2, 0.05)
-        return True
-
-    def drain(self, from_seq: int, span: int = 64) -> None:
-        """Best-effort cleanup of unconsumed messages (teardown)."""
-        store = self._ensure_store()
-        for seq in range(max(0, from_seq - span), from_seq + span):
+    def close(self) -> None:
+        close = getattr(self.channel, "close", None)
+        if close is not None:
             try:
-                store.delete(_msg_oid(self.channel_id, seq))
-            except Exception:
-                pass
-
-    def __reduce__(self):
-        return (ShmChannel, (self.channel_id, self.capacity))
-
-
-class CrossNodeChannel:
-    """Single-writer single-reader ordered channel ACROSS nodes.
-
-    Parity target: the reference's cross-node mutable-object channels
-    (reference: RegisterMutableObject/PushMutableObject,
-    node_manager.proto:444-446) re-designed over this runtime's push
-    transfer: the writer seals each message into its LOCAL store and
-    pushes it to the reader's node (rpc_push_object — receiver-driven
-    chunk protocol); the reader consumes from its local store and pushes
-    a tiny ACK object back. Backpressure: the writer admits seq only
-    after ack(seq - capacity) arrived (then deletes it), so at most
-    `capacity` messages are in flight node-to-node."""
-
-    def __init__(self, channel_id: bytes, writer_node_addr: str,
-                 reader_node_addr: str, capacity: int = 8):
-        self.channel_id = channel_id
-        self.writer_node_addr = writer_node_addr
-        self.reader_node_addr = reader_node_addr
-        self.capacity = capacity
-        self._rt = None
-        self._acked_through = -1  # writer-side cumulative consumption mark
-
-    def _runtime(self):
-        if self._rt is None:
-            from ray_tpu.core.runtime_context import require_runtime
-
-            self._rt = require_runtime()
-        return self._rt
-
-    def _ack_oid(self, seq: int) -> ObjectID:
-        return _msg_oid(self.channel_id + b"#ack", seq)
-
-    def _delete_unregistered(self, store, oid: ObjectID) -> None:
-        """Delete + drop the head's directory entry: pushed copies were
-        registered object_added on arrival, and a raw store delete would
-        leak one directory row per message forever. The removal rides the
-        runtime's BATCHED notify outbox — a direct head.notify here could
-        overtake a same-process put's still-queued object_added and leave
-        the head holding a permanently stale add."""
-        store.delete(oid)
-        rt = self._runtime()
-        try:
-            rt._queue_object_notify("rm", oid.binary())
-        except Exception:
-            pass
-
-    # ------------------------------------------------------------ writer
-
-    def _observe_acks(self, store, upto_seq: int) -> None:
-        """Advance the cumulative consumption mark: the reader consumes IN
-        ORDER, so ack(m) present implies everything <= m was consumed —
-        one LOST ack therefore costs nothing once a later one lands
-        (per-seq waits would deadlock on a single dropped ack push)."""
-        for s in range(self._acked_through + 1, upto_seq + 1):
-            ack = self._ack_oid(s)
-            if store.contains(ack):
-                self._acked_through = max(self._acked_through, s)
-        # Ring-clean observed acks (including ghosts re-pushed by retries).
-        for s in range(max(0, self._acked_through - 2 * self.capacity),
-                       self._acked_through + 1):
-            try:
-                self._delete_unregistered(store, self._ack_oid(s))
-            except Exception:
-                pass
-
-    def write(self, value: Any, seq: int, timeout: Optional[float] = None,
-              _raw: Optional[bytes] = None) -> None:
-        rt = self._runtime()
-        store = rt.store
-        payload = _raw if _raw is not None else pickle.dumps(
-            ("ok", value), protocol=5)
-        if seq >= self.capacity:
-            needed = seq - self.capacity
-            deadline = (None if timeout is None
-                        else time.monotonic() + timeout)
-            pause = 0.0005
-            while self._acked_through < needed:
-                self._observe_acks(store, seq - 1)
-                if self._acked_through >= needed:
-                    break
-                if deadline is not None and time.monotonic() > deadline:
-                    raise ChannelTimeoutError(
-                        f"reader {self.capacity} messages behind")
-                time.sleep(pause)
-                pause = min(pause * 2, 0.01)
-        oid = _msg_oid(self.channel_id, seq)
-        store.put_bytes(oid, payload)
-        # A False reply may be one dropped inner transfer RPC (chaos, a
-        # transient peer hiccup), not a dead reader: retry before
-        # declaring the channel closed. Double-pushes are safe — the
-        # reader consumes each seq once and ring-cleans ghosts. The outer
-        # per-try window EXCEEDS the handler's internal wait
-        # (timeout_ms/1000 + 5) so slow-but-succeeding transfers are not
-        # spuriously retried; transport exceptions become the same
-        # ChannelClosedError as exhausted retries, and the local copy is
-        # dropped on EVERY exit (leaks otherwise).
-        ok = False
-        try:
-            for attempt in range(3):
-                try:
-                    ok = rt.node.retrying_call(
-                        "push_object", oid.binary(),
-                        self.reader_node_addr, 10000, timeout=18)
-                except Exception:
-                    ok = False
-                if ok:
-                    break
-                if attempt < 2:
-                    time.sleep(0.2 * (attempt + 1))
-        finally:
-            # Local copy served its purpose once pushed; drop it so
-            # channels never accumulate in the writer's store.
-            store.delete(oid)
-        if not ok:
-            raise ChannelClosedError(
-                f"push to {self.reader_node_addr} failed (seq={seq})")
-
-    def write_error(self, exc: BaseException, seq: int) -> None:
-        self.write(None, seq, _raw=pickle.dumps(("err", exc), protocol=5))
-
-    def write_stop(self, seq: int) -> None:
-        self.write(None, seq, _raw=pickle.dumps(("stop", None), protocol=5))
-
-    # ------------------------------------------------------------ reader
-
-    def read(self, seq: int, timeout: Optional[float] = None) -> Any:
-        rt = self._runtime()
-        store = rt.store
-        oid = _msg_oid(self.channel_id, seq)
-        ms = -1 if timeout is None else max(1, int(timeout * 1000))
-        buf = store.get(oid, timeout_ms=ms)
-        if buf is None:
-            raise ChannelTimeoutError(
-                f"cross-node channel read timed out (seq={seq})")
-        try:
-            kind, value = pickle.loads(bytes(buf.buffer))
-        finally:
-            buf.release()
-        self._delete_unregistered(store, oid)
-        # Ring-clean a long-consumed slot: a retried push may have
-        # RESURRECTED an already-consumed message (push is not
-        # idempotent); nothing else would ever delete the ghost.
-        if seq >= 2 * self.capacity:
-            try:
-                self._delete_unregistered(
-                    store, _msg_oid(self.channel_id,
-                                    seq - 2 * self.capacity))
-            except Exception:
-                pass
-        # Ack: a 1-byte object pushed back to the writer's node. Lost acks
-        # are tolerated — the writer's consumption mark advances on ANY
-        # later ack (ordered consumption implies the earlier ones).
-        ack = self._ack_oid(seq)
-        try:
-            store.put_bytes(ack, b"\x01")
-            rt.node.retrying_call("push_object", ack.binary(),
-                                  self.writer_node_addr, 5000, timeout=12)
-            store.delete(ack)
-        except Exception:
-            pass
-        if kind == "err":
-            raise value
-        if kind == "stop":
-            raise ChannelClosedError("channel closed")
-        return value
-
-    def wait_consumed(self, seq: int, timeout: float = 10.0) -> bool:
-        """Writer-side teardown handshake: consumed == its ack arrived
-        (or the cumulative mark already passed it)."""
-        rt = self._runtime()
-        store = rt.store
-        ack = self._ack_oid(seq)
-        deadline = time.monotonic() + timeout
-        pause = 0.001
-        while self._acked_through < seq and not store.contains(ack):
-            if time.monotonic() > deadline:
-                return False
-            time.sleep(pause)
-            pause = min(pause * 2, 0.05)
-        return True
-
-    def drain(self, from_seq: int, span: int = 64) -> None:
-        rt = self._runtime()
-        store = rt.store
-        for seq in range(max(0, from_seq - span), from_seq + span):
-            for oid in (_msg_oid(self.channel_id, seq),
-                        self._ack_oid(seq)):
-                try:
-                    store.delete(oid)
-                except Exception:
-                    pass
-
-    def __reduce__(self):
-        return (CrossNodeChannel,
-                (self.channel_id, self.writer_node_addr,
-                 self.reader_node_addr, self.capacity))
+                close(unlink=True)
+            except TypeError:
+                close()

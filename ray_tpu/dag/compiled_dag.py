@@ -18,6 +18,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.channel import ChannelClosedError, ShmChannel
+from ray_tpu.dag.errors import ChannelError
 from ray_tpu.dag.collective_node import CollectiveOutputNode, reduce_fn
 from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, InputNode,
                                   MultiOutputNode)
@@ -225,6 +226,20 @@ class CompiledDAG:
         replacements = self._resolve_channel_kinds(chan_ends)
         if replacements:
             self._rewrite_channels(per_actor, replacements)
+        # Pre-negotiate the driver's READER ends now (cross-node output
+        # channels register their endpoint with the head before any
+        # actor writer looks them up) and label every edge for error
+        # context.
+        def _label(ep) -> str:
+            return "driver" if ep == "driver" else ep.hex()[:8]
+
+        for ends in chan_ends.values():
+            ch = replacements.get(id(ends[0]), ends[0])
+            ch.edge = f"{_label(ends[1])}->{_label(ends[2])}"
+        for ch in self._output_channels:
+            prep = getattr(ch, "prepare_read", None)
+            if prep is not None:
+                prep()
 
         # Ship each actor its schedule; the worker runs a dedicated loop
         # thread (special method intercepted in worker_main).
@@ -507,6 +522,53 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
     send_q: "_q.Queue" = _q.Queue(maxsize=32)
     send_failed: List[BaseException] = []
 
+    def _sched_channels():
+        """Every channel object in the schedule, tagged by this actor's
+        role on it ("r" = this loop reads it, "w" = writes)."""
+        for op in schedule:
+            for kind, v in list(op["args"]) + list(op["kwargs"].values()):
+                if kind == "chan":
+                    yield "r", v
+            for out in op["outputs"]:
+                yield "w", out
+            if op.get("kind") == "allreduce":
+                for ch in op["up_children"]:
+                    yield "r", ch
+                if op["down_parent"] is not None:
+                    yield "r", op["down_parent"]
+                if op["up_parent"] is not None:
+                    yield "w", op["up_parent"]
+                for ch in op["down_children"]:
+                    yield "w", ch
+
+    # One-time negotiation, BEFORE the first execute round: reader ends
+    # register their endpoint (cross-node writers look it up through
+    # the head exactly once); steady-state hops then never touch the
+    # head again.
+    for role, ch in _sched_channels():
+        if role == "r":
+            prep = getattr(ch, "prepare_read", None)
+            if prep is not None:
+                prep()
+
+    def _close_channels():
+        for role, ch in _sched_channels():
+            close = getattr(ch, "close", None)
+            if close is None:
+                continue
+            try:
+                if role == "r":
+                    try:
+                        close(unlink=True)
+                    except TypeError:
+                        close()
+                else:
+                    close()
+            except Exception:  # noqa: BLE001 — teardown is best-effort:
+                # every peer also closes its own ends, and the ring/sock
+                # res witness reports anything that truly leaked
+                continue
+
     def _sender():
         while True:
             item = send_q.get()
@@ -547,6 +609,7 @@ def run_actor_dag_loop(instance, schedule: List[Dict[str, Any]],
         if sender_thread is not None:
             send_q.put(None)
             sender_thread.join(timeout=30)
+        _close_channels()
 
     seq = 0
     while not stop_event.is_set():

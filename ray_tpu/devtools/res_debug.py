@@ -51,8 +51,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: these feed the bench's cluster-wide ``leaked_resources`` verdict.
 #: "thread" is deliberately absent (daemon loops are legitimately alive
 #: mid-run; owners assert them at close) and the store gauges are
-#: informational only.
-LEAK_KINDS = ("buffer_lease", "lease", "kv_spec")
+#: informational only. The channel kinds (dag/ring.py, dag/peer.py)
+#: count mapped ring files, spilled payload side-files, and peer
+#: sockets: a compiled DAG or disaggregated-serving mesh torn down
+#: without releasing them is a leak the chaos bench fails on.
+LEAK_KINDS = ("buffer_lease", "lease", "kv_spec",
+              "channel_ring", "channel_spill", "channel_sock")
 
 
 def enabled() -> bool:

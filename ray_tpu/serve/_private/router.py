@@ -40,13 +40,18 @@ logger = logging.getLogger(__name__)
 
 class Router:
     def __init__(self, controller, deployment: str,
-                 refresh_interval_s: Optional[float] = None):
+                 refresh_interval_s: Optional[float] = None,
+                 score_weights: Optional[Dict[str, float]] = None):
         if refresh_interval_s is None:
             from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
             refresh_interval_s = cfg.serve_router_refresh_s
         self._controller = controller
         self._deployment = deployment
+        # Per-pool scoring profile (disaggregated serving): overrides
+        # for the config weights, keys prefix/queue/kv/ttft. None =
+        # config weights exactly (the default, byte-identical scores).
+        self._weights = dict(score_weights) if score_weights else None
         from ray_tpu.devtools.lock_debug import make_lock
 
         self._lock = make_lock("serve.router._lock")
@@ -243,9 +248,22 @@ class Router:
         total_blocks = snap.get("kv_total_blocks", 0)
         if total_blocks:
             kv = 1.0 - snap.get("kv_free_blocks", 0) / total_blocks
-        return (cfg.serve_router_prefix_weight * affinity
-                - cfg.serve_router_queue_weight * queue / slots
-                - cfg.serve_router_kv_weight * kv), depth
+        # getattr: unit fixtures (and pre-upgrade pickles) build Routers
+        # via __new__ without the profile field.
+        w = getattr(self, "_weights", None) or {}
+        w_prefix = w.get("prefix", cfg.serve_router_prefix_weight)
+        w_queue = w.get("queue", cfg.serve_router_queue_weight)
+        w_kv = w.get("kv", cfg.serve_router_kv_weight)
+        # TTFT pressure (disagg prefill pools): a replica whose EWMA
+        # TTFT is climbing is prefill-saturated even when its queue
+        # momentarily looks short. Weight 0 (the default) keeps the
+        # score arithmetic byte-identical to the pre-disagg router.
+        w_ttft = w.get("ttft", cfg.serve_router_ttft_weight)
+        score = (w_prefix * affinity - w_queue * queue / slots
+                 - w_kv * kv)
+        if w_ttft:
+            score -= w_ttft * snap.get("ewma_ttft_ms", 0.0) / 1e3
+        return score, depth
 
     def _choose_scored(self, loads: Dict[Any, Dict[str, Any]],
                        prefix_tokens: Optional[Sequence[int]],

@@ -129,12 +129,16 @@ class InferenceEngine:
                  prefill_chunk: int = 0,
                  multi_step: bool = True,
                  paged_decode: Any = False,
+                 role: str = "colocated",
                  seed: int = 0,
                  name: Optional[str] = None):
         import jax
 
         from ray_tpu.models import llama
 
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
         self._jax = jax
         self.cfg = cfg or llama.tiny_config(max_seq_len=max_len)
         if paged_decode:
@@ -175,7 +179,9 @@ class InferenceEngine:
                                chunk=self.decode_chunk,
                                spec_window=self.spec_draft_len + 1,
                                spec_chunk=spec_chunk,
-                               prefill_budget=len(self.buckets))
+                               prefill_budget=len(self.buckets),
+                               kv_page=(prefix_block
+                                        if role != "colocated" else 0))
         # Verify windows span spec_draft_len+1 rows; the scratch strip
         # past max_len absorbs parked/overrun writes so they can never
         # clamp back onto resident rows (decode_loop docstring). Row
@@ -187,6 +193,13 @@ class InferenceEngine:
             # scratch strip — never written, masked out by lengths).
             page = self.cfg.decode_page
             cache_rows = -(-cache_rows // page) * page
+        if role != "colocated":
+            # KV-page export/install moves whole pages: pad the
+            # allocation so the tail page of a max-length prompt never
+            # needs the transfer programs' defensive clamp (a clamped
+            # start on ONE side of a prefill→decode pair whose scratch
+            # strips differ would land rows at the wrong offset).
+            cache_rows = -(-cache_rows // prefix_block) * prefix_block
         self.cache = llama.init_kv_cache(self.cfg, max_batch, cache_rows)
 
         self.kv = KVCacheManager(max_batch, self.max_len,
@@ -207,6 +220,12 @@ class InferenceEngine:
         self._inflight: Optional[Dict[str, Any]] = None
         self._last_retire_t = 0.0  # TPOT cadence anchor (see _retire_chunk)
         self._queue: "queue.Queue[EngineRequest]" = queue.Queue()
+        # Decode role: KV-page install jobs handed over from prefill
+        # replicas. Device work happens on the engine thread (installs
+        # run under the tick transfer guard like every other dispatch);
+        # jobs that race slot exhaustion wait in FIFO order.
+        self._install_queue: "queue.Queue" = queue.Queue()
+        self._install_waiting: List[tuple] = []
         self._shutdown = False
         self._thread = _resdbg.track_thread(
             threading.Thread(target=self._engine_loop, daemon=True,
@@ -243,11 +262,54 @@ class InferenceEngine:
             else:
                 raise val
 
+    def prefill_remote(self, prompt_ids: List[int],
+                       max_new_tokens: int = 32,
+                       eos_id: Optional[int] = None,
+                       timeout: float = 300.0) -> Dict[str, Any]:
+        """Prefill-role entry (disaggregated serving): run admission +
+        (chunked) prefill for ``prompt_ids`` and return a KV HANDOFF
+        payload — the slot's hash-chained KV pages plus the first
+        generated token — instead of decoding. The caller streams the
+        payload over a DAG channel to a decode-role engine's
+        ``install_remote``. A request that FINISHES at its first token
+        (budget 1 / immediate EOS) returns a completed result with no
+        handoff (``kv_handoff`` absent)."""
+        if self.role != "prefill":
+            raise RuntimeError("prefill_remote requires role='prefill'")
+        req = self._make_request(prompt_ids, max_new_tokens, eos_id,
+                                 handoff=True)
+        self._queue.put(req)
+        return req.future.result(timeout=timeout)
+
+    def install_async(self, payload: Dict[str, Any]) -> EngineRequest:
+        """Decode-role entry: queue one prefill handoff for
+        installation. Returns the EngineRequest; its future resolves
+        with the standard generation result once decode finishes."""
+        if self.role != "decode":
+            raise RuntimeError("install_async requires role='decode'")
+        if payload.get("page") != self.kv.block_size:
+            raise ValueError(
+                f"KV page size mismatch: payload {payload.get('page')} "
+                f"vs engine block {self.kv.block_size}")
+        req = self._make_request(payload["prompt_ids"],
+                                 payload["max_new_tokens"],
+                                 payload.get("eos_id"))
+        req.generated.append(int(payload["first_token"]))
+        self._install_queue.put((req, payload))
+        return req
+
+    def install_remote(self, payload: Dict[str, Any],
+                       timeout: float = 300.0) -> Dict[str, Any]:
+        """Blocking install + decode of one prefill handoff."""
+        return self.install_async(payload).future.result(timeout=timeout)
+
     def _make_request(self, prompt_ids, max_new_tokens, eos_id,
-                      stream: bool = False) -> EngineRequest:
+                      stream: bool = False,
+                      handoff: bool = False) -> EngineRequest:
         req = EngineRequest(list(prompt_ids), max_new_tokens, eos_id,
                             stream_queue=queue.Queue() if stream else None,
-                            arrival_t=time.perf_counter())
+                            arrival_t=time.perf_counter(),
+                            handoff=handoff)
         if _tracing.enabled():
             # Captured on the CALLER's thread (replica request context /
             # driver span); the engine thread parents its queued/prefill/
@@ -277,7 +339,9 @@ class InferenceEngine:
         out = {"active": len(self.scheduler.active),
                "free_slots": self.kv.free_slots(),
                "quantize": self.quantize,
+               "role": self.role,
                "prefilling": len(self._prefilling),
+               "installs_waiting": len(self._install_waiting),
                "waiting": (self._queue.qsize()
                            + self.scheduler.queue_depth())}
         if self.quantize is not None:
@@ -299,7 +363,10 @@ class InferenceEngine:
 
         m = self.metrics.snapshot()
         return {
-            "waiting": self._queue.qsize() + self.scheduler.queue_depth(),
+            "role": self.role,
+            "waiting": (self._queue.qsize() + self.scheduler.queue_depth()
+                        + len(self._install_waiting)
+                        + self._install_queue.qsize()),
             "active": len(self.scheduler.active),
             # Admitted but still materializing their prompt (chunked
             # prefill): they hold slots and will decode — surfaced
@@ -451,9 +518,147 @@ class InferenceEngine:
         req.generated.append(first)
         if req.stream_queue is not None:
             req.stream_queue.put(("token", first))
+        if req.handoff:
+            self._finish_handoff(req)
+            return True
         self.scheduler.activate(req)
         self._maybe_finish(req, first)
         return True
+
+    def _finish_handoff(self, req: EngineRequest) -> None:
+        """Prefill role: resolve the request with a KV handoff payload
+        (or a completed result when the first token already ends it)
+        and recycle the slot — seeding the prefill-side prefix cache
+        with the full prompt, so repeat-prefix traffic keeps its reuse
+        win on the prefill pool."""
+        slot = req.slot
+        plen = len(req.prompt_ids)
+        first = req.generated[-1]
+        done = (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and first == req.eos_id)
+                or plen + 1 >= self.max_len)
+        result: Dict[str, Any]
+        if done:
+            result = {"token_ids": list(req.generated),
+                      "num_generated": len(req.generated),
+                      "cached_prefix_len": req.cached_len}
+        else:
+            P = self.kv.block_size
+            pages_dev = []
+            for p in range(-(-plen // P)):
+                pages_dev.append(self.loop.export_page(
+                    self.cache, self._put(np.int32(slot)),
+                    self._put(np.int32(p * P))))
+            # ONE host sync lands every page of the slot (tagged so the
+            # RTPU_DEBUG_JAX witness attributes it separately from the
+            # counted prefill sync).
+            pages = self._fetch(pages_dev, tag="kv_export")
+            pages_k = [np.ascontiguousarray(k) for k, _v in pages]
+            pages_v = [np.ascontiguousarray(v) for _k, v in pages]
+            import zlib
+
+            result = {
+                "kv_handoff": True,
+                "prompt_ids": list(req.prompt_ids),
+                "first_token": int(first),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "page": P,
+                "rows": plen,
+                "pages_k": pages_k,
+                "pages_v": pages_v,
+                # Content integrity: the chain hashes cover TOKEN
+                # identity (both sides derive them from prompt_ids);
+                # these cover the page BYTES, so a transport/export bug
+                # that mangles KV data fails the install instead of
+                # decoding garbage.
+                "page_crc": [zlib.crc32(k.tobytes())
+                             ^ zlib.crc32(v.tobytes())
+                             for k, v in zip(pages_k, pages_v)],
+                "chain": list(self.kv.slot_chain(slot)),
+                "cached_prefix_len": req.cached_len,
+            }
+        self.kv.release(slot, resident_tokens=req.prompt_ids)
+        req.slot = -1
+        if not req.future.done():
+            req.future.set_result(result)
+        if req.stream_queue is not None and done:
+            req.stream_queue.put(("done", None))
+        if req.trace_ctx is not None:
+            _tracing.flush()
+
+    def _install_tick(self) -> None:
+        """Decode role: install queued KV handoffs into free slots,
+        FIFO. A job that races slot exhaustion waits (installs never
+        jump the line — later handoffs can't acquire either)."""
+        while True:
+            try:
+                self._install_waiting.append(
+                    self._install_queue.get_nowait())
+            except queue.Empty:
+                break
+        pending = self._install_waiting
+        self._install_waiting = []
+        for i, (req, payload) in enumerate(pending):
+            if not self.kv.free_slots():
+                self._install_waiting.extend(pending[i:])
+                return
+            try:
+                self._install_one(req, payload)
+            except BaseException as e:  # noqa: BLE001 — one bad handoff
+                # must not kill the engine thread
+                if not req.future.done():
+                    req.future.set_exception(e)
+                if req.stream_queue is not None:
+                    req.stream_queue.put(("error", e))
+
+    def _install_one(self, req: EngineRequest,
+                     payload: Dict[str, Any]) -> None:
+        # fit vetoes every reuse depth: the handoff's pages OVERWRITE
+        # the slot's rows wholesale, so counting a resident-prefix
+        # "hit" here would pollute the prefix-cache stats with reuse
+        # that never happens.
+        got = self.kv.acquire(req.prompt_ids, fit=lambda c: False)
+        if got is None:
+            raise RuntimeError("no free slot for KV install")
+        slot, _cached = got
+        P = int(payload["page"])
+        try:
+            crcs = payload.get("page_crc")
+            for i, (kp, vp) in enumerate(zip(payload["pages_k"],
+                                             payload["pages_v"])):
+                if crcs is not None:
+                    import zlib
+
+                    got_crc = (zlib.crc32(np.ascontiguousarray(kp)
+                                          .tobytes())
+                               ^ zlib.crc32(np.ascontiguousarray(vp)
+                                            .tobytes()))
+                    if got_crc != crcs[i]:
+                        raise RuntimeError(
+                            f"KV page {i} checksum mismatch: the page "
+                            "bytes were corrupted in transit")
+                self.cache = self.loop.install_page(
+                    self.cache, self._put(kp), self._put(vp),
+                    self._put(np.int32(slot)),
+                    self._put(np.int32(i * P)))
+            self.kv.commit_prefill(slot, req.prompt_ids)
+            # Chain equality covers TOKEN/protocol identity (same
+            # prompt, same block algorithm/size); the per-page CRCs
+            # above cover the KV BYTES themselves.
+            chain = list(self.kv.slot_chain(slot))
+            want = payload.get("chain")
+            if want is not None and chain != list(want):
+                raise RuntimeError(
+                    "KV chain mismatch after install: the decode side's "
+                    "block hashes disagree with the prefill side's")
+        except BaseException:
+            self.kv.release(slot, resident_tokens=())
+            raise
+        req.slot = slot
+        req.first_token_t = time.perf_counter()
+        self.scheduler.activate(req)
+        self._maybe_finish(req, req.generated[-1])
 
     def _maybe_finish(self, req: EngineRequest, last_tok: int) -> bool:
         done = self.scheduler.is_finished(req, last_tok)
@@ -827,19 +1032,24 @@ class InferenceEngine:
             # inputs go through the explicit _put/_fetch pair).
             with jax_debug.tick_guard():
                 self._admit()
+                if self.role == "decode":
+                    self._install_tick()
                 self._prefill_tick()
             self.metrics.record_depths(self.scheduler.queue_depth(),
                                        len(self.scheduler.active),
                                        self.kv.hit_rate())
             if not self.scheduler.active:
-                if self._prefilling:
-                    continue  # keep chunked prefills advancing
+                if self._prefilling or self._install_waiting:
+                    continue  # keep chunked prefills / installs advancing
                 # A burst just drained: the multi-step trailing chunk
                 # (dispatched while every member was already frozen on
                 # device) delivers nothing by construction — drop it
                 # unfetched. Its cache output already landed at
                 # dispatch time.
                 self._inflight = None
+                if (self.role == "decode"
+                        and not self._install_queue.empty()):
+                    continue  # a handoff just arrived: install it now
                 try:
                     # Straight into the waiting line (re-putting to the
                     # mailbox would reorder it behind later arrivals and

@@ -54,7 +54,7 @@ class DecodeLoop:
 
     def __init__(self, cfg, *, max_len: int, chunk: int = 8,
                  spec_window: int = 1, spec_chunk: int = 0,
-                 prefill_budget: int = 0):
+                 prefill_budget: int = 0, kv_page: int = 0):
         import jax
 
         self.cfg = cfg
@@ -62,6 +62,7 @@ class DecodeLoop:
         self.chunk = max(1, int(chunk))
         self.spec_window = max(1, int(spec_window))
         self.prefill_budget = max(0, int(prefill_budget))
+        self.kv_page = max(0, int(kv_page))
         # Verify iterations per dispatch. The default keeps the token
         # POSITIONS scanned per dispatch comparable to the plain chunk
         # (chunk // window): each verify iteration forwards a whole
@@ -76,6 +77,8 @@ class DecodeLoop:
         self._build()
         if self.spec_window > 1:
             self._build_verify()
+        if self.kv_page:
+            self._build_kv_transfer()
         self._witness()
 
     def _witness(self) -> None:
@@ -98,6 +101,11 @@ class DecodeLoop:
         if self.spec_window > 1:
             self.verify_chunk = jax_debug.wrap_jit(
                 self.verify_chunk, "decode_loop.verify_chunk", budget=1)
+        if self.kv_page:
+            self.export_page = jax_debug.wrap_jit(
+                self.export_page, "decode_loop.export_page", budget=1)
+            self.install_page = jax_debug.wrap_jit(
+                self.install_page, "decode_loop.install_page", budget=1)
 
     def program_counts(self) -> dict:
         """{program name: distinct compiled signatures} when the
@@ -106,7 +114,7 @@ class DecodeLoop:
 
         out = {}
         for name in ("prefill", "decode_chunk", "decode_step",
-                     "verify_chunk"):
+                     "verify_chunk", "export_page", "install_page"):
             fn = getattr(self, name, None)
             if isinstance(fn, JitWitness):
                 out[name] = fn.program_count
@@ -314,4 +322,48 @@ class DecodeLoop:
                     done, cache)
 
         self.verify_chunk = jax.jit(verify_chunk)
+
+    def _build_kv_transfer(self) -> None:
+        """KV-page export/install for disaggregated prefill/decode: the
+        prefill engine slices one ``kv_page``-row page of a slot's KV
+        out of the cache (ONE program, any page index — the host loops
+        pages and fetches them in a single sync), the decode engine
+        installs received pages into its own cache at the same rows.
+        Page size == the KV manager's block size, so a "page" here is
+        exactly the block the hash chain and the paged-decode kernel
+        already agree on."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self.kv_page
+
+        def export_page(cache, slot, start):
+            """-> (k_page [L, KH, P, D], v_page) for rows
+            [start, start+P) of ``slot``."""
+            L, _B, KH, S, D = cache["k"].shape
+            start = jnp.clip(start, 0, S - P)
+            out = []
+            for key in ("k", "v"):
+                page = jax.lax.dynamic_slice(
+                    cache[key], (0, slot, 0, start, 0),
+                    (L, 1, KH, P, D))
+                out.append(page[:, 0])
+            return tuple(out)
+
+        def install_page(cache, k_page, v_page, slot, start):
+            """Write one exported page into this cache's ``slot`` at
+            rows [start, start+P)."""
+            S = cache["k"].shape[3]
+            start = jnp.clip(start, 0, S - P)
+            new = {}
+            for key, page in (("k", k_page), ("v", v_page)):
+                # slot is bounded by contract (scheduler admits into
+                # slots < max_batch) and start is jnp.clip-ed above.
+                new[key] = jax.lax.dynamic_update_slice(  # rtpu-lint: disable=unclamped-dynamic-update-slice
+                    cache[key], page[:, None].astype(cache[key].dtype),
+                    (0, slot, 0, start, 0))
+            return new
+
+        self.export_page = jax.jit(export_page)
+        self.install_page = jax.jit(install_page)
 

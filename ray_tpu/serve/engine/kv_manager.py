@@ -289,6 +289,13 @@ class KVCacheManager:
                 if not s:
                     self._index.pop(h, None)
 
+    def slot_chain(self, slot: int) -> Tuple[int, ...]:
+        """The committed block-chain hashes of a slot's materialized
+        prefix (disaggregated serving compares the decode side's chain
+        against the prefill side's after a KV-page install — equal
+        chains == the installed rows hold the same tokens' KV)."""
+        return tuple(self._slots[slot].chain)
+
     # ------------------------------------------------------------- stats
 
     def free_blocks(self) -> int:

@@ -57,6 +57,11 @@ class EngineRequest:
     # span emit gates on this, so the untraced decode path allocates no
     # span state.
     trace_ctx: Optional[Any] = None
+    # Disaggregated serving: True on a PREFILL-role engine's requests —
+    # after the final prefill chunk the engine exports the slot's KV
+    # pages and resolves the future with a handoff payload instead of
+    # joining the decode roster (core._advance_prefill).
+    handoff: bool = False
 
     def remaining(self) -> int:
         """Token budget left (per-request accounting)."""

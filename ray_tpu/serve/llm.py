@@ -23,7 +23,10 @@ routed, autoscaled replicas.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
 
 from ray_tpu.serve.engine.core import InferenceEngine
 from ray_tpu.serve.engine.scheduler import (EngineRequest as
@@ -31,6 +34,17 @@ from ray_tpu.serve.engine.scheduler import (EngineRequest as
 from ray_tpu.serve.engine.scheduler import bucket_for
 
 __all__ = ["GenerationRequest", "LLMEngine", "build_llm_deployment"]
+
+#: Decode-pool routing profile: KV headroom dominates (the decode
+#: replica's scarce resource is cache blocks), queue pressure second,
+#: prefix affinity zero (installed pages overwrite the slot wholesale —
+#: residency buys a decode replica nothing at admission time).
+DECODE_POOL_WEIGHTS = {"prefix": 0.0, "queue": 0.5, "kv": 2.0,
+                       "ttft": 0.0}
+
+
+class DecodeReplicaDied(RuntimeError):
+    """A KV handoff's decode edge died mid-flight (channel torn down)."""
 
 
 class LLMEngine(InferenceEngine):
@@ -43,8 +57,383 @@ def _bucket(n: int, buckets) -> int:
     return bucket_for(n, list(buckets))
 
 
+class DecodeLLMServer:
+    """Decode-role replica: installs KV handoffs streamed over a DAG
+    channel and runs multi-step decode. One channel PAIR per prefill
+    peer (kv: prefill→decode, results: decode→prefill), negotiated once
+    via :meth:`open_kv_channel`; every steady-state handoff after that
+    is a channel write — no actor RPC, no head."""
+
+    def __init__(self, **kw):
+        kw.setdefault("role", "decode")
+        self.engine = LLMEngine(**kw)
+        self._edges: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+
+    def open_kv_channel(self, writer_tag: str,
+                        writer_node: str) -> Dict[str, Any]:
+        """One-time edge negotiation (idempotent per ``writer_tag``):
+        create the kv/result channel pair for one prefill peer and
+        start the install loop. Same-node peers get shm rings,
+        cross-node peers get peer-socket channels (the kv reader's
+        endpoint address rides back; the result channel resolves
+        through the head's channel registry)."""
+        with self._lock:
+            e = self._edges.get(writer_tag)
+            if e is not None:
+                return e["info"]
+        import queue as _q
+
+        from ray_tpu.core.runtime_context import get_runtime
+        from ray_tpu.dag.channel import (ChannelReader, CrossNodeChannel,
+                                         RingChannel)
+
+        rt = get_runtime()
+        my_node = str(getattr(rt, "node_id", "") or "")
+        same = (not my_node) or (writer_node == my_node)
+        kv_id, res_id = uuid.uuid4().bytes, uuid.uuid4().bytes
+        info: Dict[str, Any] = {"transport": "ring" if same else "peer",
+                                "kv_id": kv_id, "res_id": res_id,
+                                "node_id": my_node}
+        tag8 = writer_tag[:8]
+        if same:
+            kv_reader = ChannelReader(RingChannel(
+                kv_id, capacity=8, edge=f"kv:{tag8}"))
+        else:
+            ch = CrossNodeChannel(kv_id, capacity=8, edge=f"kv:{tag8}")
+            info["kv_addr"] = ch.prepare_read()
+            kv_reader = ChannelReader(ch)
+        outbox: "_q.Queue" = _q.Queue()
+        edge = {"info": info, "kv_reader": kv_reader, "outbox": outbox,
+                "same": same, "res_id": res_id, "tag": tag8,
+                "writer_tag": writer_tag}
+        with self._lock:
+            self._edges[writer_tag] = edge
+        threading.Thread(target=self._install_loop, args=(edge,),
+                         daemon=True,
+                         name=f"disagg-install-{tag8}").start()
+        threading.Thread(target=self._respond_loop, args=(edge,),
+                         daemon=True,
+                         name=f"disagg-respond-{tag8}").start()
+        return info
+
+    def _install_loop(self, edge: Dict[str, Any]) -> None:
+        from ray_tpu.dag.errors import (ChannelClosedError,
+                                        ChannelTimeoutError)
+
+        reader = edge["kv_reader"]
+        while not self._stopped.is_set():
+            try:
+                msg = reader.recv(timeout=1.0)
+            except ChannelTimeoutError:
+                continue
+            except ChannelClosedError:
+                break
+            req_id, payload = msg
+            try:
+                req = self.engine.install_async(payload)
+            except BaseException as e:  # noqa: BLE001 — reported to peer
+                edge["outbox"].put((req_id, False, e))
+                continue
+            outbox = edge["outbox"]
+
+            def _deliver(fut, _rid=req_id, _out=outbox):
+                try:
+                    _out.put((_rid, True, fut.result()))
+                except BaseException as e:  # noqa: BLE001 — shipped back
+                    _out.put((_rid, False, e))
+
+            req.future.add_done_callback(_deliver)
+        reader.close()
+        edge["outbox"].put(None)
+        # Retire the edge record: a prefill peer that died (or
+        # re-negotiated under a new epoch) must not accumulate entries
+        # for the life of the replica.
+        with self._lock:
+            self._edges.pop(edge["writer_tag"], None)
+
+    def _respond_loop(self, edge: Dict[str, Any]) -> None:
+        import queue as _q
+
+        from ray_tpu.dag.channel import (ChannelWriter, CrossNodeChannel,
+                                         RingChannel)
+
+        if edge["same"]:
+            writer = ChannelWriter(RingChannel(
+                edge["res_id"], capacity=8, edge=f"res:{edge['tag']}"))
+        else:
+            writer = ChannelWriter(CrossNodeChannel(
+                edge["res_id"], capacity=8, edge=f"res:{edge['tag']}"))
+        try:
+            while not self._stopped.is_set():
+                try:
+                    item = edge["outbox"].get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                if item is None:
+                    return
+                writer.send(item, timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — prefill peer gone: its
+            # dispatcher re-routes the in-flight request on edge death
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "disagg result channel closed: %r", e)
+        finally:
+            writer.close()
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Direct KV-handoff install (actor-RPC fallback path; the
+        channel mesh is the fast path)."""
+        return self.engine.install_remote(request)
+
+    def stats(self):
+        return self.engine.stats()
+
+    def load_snapshot(self):
+        return self.engine.load_snapshot()
+
+
+class PrefillLLMServer:
+    """Prefill-role replica: admission + (chunked) prefill only.
+    Finished KV pages stream over a per-edge DAG channel to a decode
+    replica chosen by a KV-headroom-weighted router; on a decode death
+    mid-flight the edge is torn down (releasing the pinned spill
+    payloads) and the request re-routes to a live decode replica."""
+
+    def __init__(self, decode_handle, **kw):
+        kw.setdefault("role", "prefill")
+        self.engine = LLMEngine(**kw)
+        self._decode_name = decode_handle._name
+        self._tag = uuid.uuid4().hex[:12]
+        self._epoch = 0
+        self._edges: Dict[Any, Dict[str, Any]] = {}
+        # Per-replica negotiation locks: two concurrent requests to the
+        # same decode replica must not both negotiate (the loser's
+        # channel pair + decode-side loops would leak unclosed).
+        self._edge_locks: Dict[Any, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        from ray_tpu.serve import api as serve_api
+        from ray_tpu.serve._private.router import Router
+
+        self._router = Router(serve_api._get_or_start_controller(),
+                              self._decode_name,
+                              score_weights=DECODE_POOL_WEIGHTS)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        h = self.engine.prefill_remote(
+            request["prompt_ids"],
+            max_new_tokens=request.get("max_new_tokens", 32),
+            eos_id=request.get("eos_id"))
+        if not h.get("kv_handoff"):
+            return h  # finished at the first token: no decode needed
+        return self._dispatch(h)
+
+    # ------------------------------------------------------------ edges
+
+    def _edge_for(self, replica) -> Dict[str, Any]:
+        with self._lock:
+            e = self._edges.get(replica)
+            if e is not None and not e["dead"]:
+                return e
+            nlock = self._edge_locks.setdefault(replica,
+                                                threading.Lock())
+        with nlock:
+            # Re-check under the negotiation lock: the race's loser
+            # reuses the winner's edge instead of leaking a second
+            # channel pair.
+            with self._lock:
+                e = self._edges.get(replica)
+                if e is not None and not e["dead"]:
+                    return e
+                self._epoch += 1
+                epoch = self._epoch
+            return self._negotiate_edge(replica, epoch)
+
+    def _negotiate_edge(self, replica, epoch: int) -> Dict[str, Any]:
+        import ray_tpu
+        from ray_tpu.core.runtime_context import get_runtime
+        from ray_tpu.dag.channel import (ChannelReader, ChannelWriter,
+                                         CrossNodeChannel, RingChannel)
+
+        my_node = str(getattr(get_runtime(), "node_id", "") or "")
+        # Replica actors front the user callable with handle_request;
+        # this is the edge's ONE actor-plane RPC (negotiation) — every
+        # handoff after it rides the channel.
+        info = ray_tpu.get(replica.handle_request.remote(
+            "open_kv_channel", (f"{self._tag}:{epoch}", my_node), {}),
+            timeout=60)
+        if info["transport"] == "ring":
+            writer = ChannelWriter(RingChannel(
+                info["kv_id"], capacity=8, edge=f"kv:{self._tag[:8]}"))
+            res_ch = RingChannel(info["res_id"], capacity=8,
+                                 edge=f"res:{self._tag[:8]}")
+        else:
+            writer = ChannelWriter(CrossNodeChannel(
+                info["kv_id"], capacity=8, edge=f"kv:{self._tag[:8]}",
+                addr=info.get("kv_addr")))
+            res_ch = CrossNodeChannel(info["res_id"], capacity=8,
+                                      edge=f"res:{self._tag[:8]}")
+        reader = ChannelReader(res_ch)
+        reader.prepare()
+        edge = {"writer": writer, "reader": reader, "dead": False,
+                "pending": {}, "lock": threading.Lock()}
+        with self._lock:
+            self._edges[replica] = edge
+        threading.Thread(target=self._collect_loop,
+                         args=(replica, edge), daemon=True,
+                         name=f"disagg-collect-{self._tag[:8]}").start()
+        return edge
+
+    def _collect_loop(self, replica, edge: Dict[str, Any]) -> None:
+        from ray_tpu.dag.errors import (ChannelClosedError,
+                                        ChannelTimeoutError)
+
+        while not self._stopped.is_set() and not edge["dead"]:
+            try:
+                req_id, ok, result = edge["reader"].recv(timeout=1.0)
+            except ChannelTimeoutError:
+                continue
+            except ChannelClosedError:
+                break
+            except Exception:  # noqa: BLE001 — edge is failed below
+                break
+            with edge["lock"]:
+                fut = edge["pending"].pop(req_id, None)
+            if fut is None:
+                continue
+            if ok:
+                fut.set_result(result)
+            else:
+                fut.set_exception(result)
+        self._kill_edge(replica, edge)
+
+    def _kill_edge(self, replica, edge: Dict[str, Any]) -> None:
+        """Decode-replica death / channel teardown: close BOTH ends
+        (the channel close reclaims any pinned spill payloads — the
+        res-lint acquire-without-release shape) and fail the edge's
+        in-flight futures with a typed error the dispatcher re-routes
+        on."""
+        with self._lock:
+            if edge["dead"]:
+                return
+            edge["dead"] = True
+            if self._edges.get(replica) is edge:
+                self._edges.pop(replica, None)
+        edge["writer"].close()
+        edge["reader"].close()
+        with edge["lock"]:
+            pending, edge["pending"] = dict(edge["pending"]), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(DecodeReplicaDied(
+                    "decode edge torn down mid-flight"))
+
+    # --------------------------------------------------------- dispatch
+
+    def _await_result(self, replica, edge: Dict[str, Any],
+                      fut: Future, deadline: float) -> Dict[str, Any]:
+        """Wait for the decode side's result, probing the replica's
+        liveness over the actor plane while parked: a SIGKILLed decode
+        replica cannot close its ring side, so without the probe a
+        handoff into a dead ring would wait out the full handle
+        timeout instead of re-routing."""
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        import ray_tpu
+
+        while True:
+            try:
+                return fut.result(timeout=3.0)
+            except _FutTimeout:
+                if _time.monotonic() > deadline:
+                    raise
+                try:
+                    ray_tpu.get(replica.health_check.remote(),
+                                timeout=15)
+                except Exception as e:  # noqa: BLE001 — any probe
+                    # failure = treat the replica as gone and re-route
+                    raise DecodeReplicaDied(
+                        f"decode replica unreachable: {e!r}") from e
+
+    def _dispatch(self, handoff: Dict[str, Any]) -> Dict[str, Any]:
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.dag.errors import ChannelError, ChannelTimeoutError
+
+        deadline = _time.monotonic() + cfg.serve_handle_timeout_s
+        last_err: Optional[BaseException] = None
+        for _attempt in range(cfg.serve_disagg_max_redirects + 1):
+            replica = self._router.choose()
+            edge = None
+            try:
+                try:
+                    edge = self._edge_for(replica)
+                except Exception as e:  # noqa: BLE001 — NEGOTIATION
+                    # failure (e.g. the chosen replica died before
+                    # open_kv_channel): re-route like a transfer failure
+                    last_err = e
+                    self._router.invalidate()
+                    if _time.monotonic() > deadline:
+                        break
+                    continue
+                req_id = uuid.uuid4().hex
+                fut: Future = Future()
+                with edge["lock"]:
+                    edge["pending"][req_id] = fut
+                try:
+                    edge["writer"].send((req_id, handoff), timeout=60.0)
+                    # Genuine request errors (the decode engine failed
+                    # THIS request) propagate from here untouched —
+                    # only edge/transport deaths re-route.
+                    return self._await_result(replica, edge, fut,
+                                              deadline)
+                except _FutTimeout as e:
+                    # Overall deadline expired with the decode replica
+                    # HEALTHY (the liveness probe passed): fail only
+                    # THIS request — tearing the shared edge down here
+                    # would kill every healthy sibling in flight on it.
+                    last_err = e
+                    with edge["lock"]:
+                        edge["pending"].pop(req_id, None)
+                    break
+                except (DecodeReplicaDied, ChannelError,
+                        ChannelTimeoutError, OSError) as e:
+                    # The handoff payload is still in hand: tear the
+                    # edge down (releasing its pinned spill payloads)
+                    # and re-route the SAME request to another decode
+                    # replica.
+                    last_err = e
+                    self._kill_edge(replica, edge)
+                    self._router.invalidate()
+                    if _time.monotonic() > deadline:
+                        break
+            finally:
+                self._router.done(replica)
+        raise RuntimeError(
+            f"disaggregated dispatch failed after "
+            f"{cfg.serve_disagg_max_redirects + 1} attempts: "
+            f"{last_err!r}")
+
+    def stats(self):
+        out = self.engine.stats()
+        out["router"] = self._router.stats()
+        return out
+
+    def load_snapshot(self):
+        return self.engine.load_snapshot()
+
+
 def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
-                         use_tpu: bool = False, engine_kwargs=None):
+                         use_tpu: bool = False, engine_kwargs=None,
+                         disaggregated: bool = False,
+                         num_prefill_replicas: int = 1,
+                         num_decode_replicas: int = 1):
     """A ready-to-run @serve.deployment wrapping LLMEngine.
 
     ``engine_kwargs`` flow straight into the ``LLMEngine`` constructor —
@@ -52,10 +441,37 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
     ``spec_ngram_max``, ``spec_adaptive``), ``quantize="int8"``,
     ``prefill_chunk`` (chunked prefill), ``paged_decode`` (block-table
     decode attention) and ``multi_step`` (double-buffered decode
-    dispatch)."""
+    dispatch).
+
+    ``disaggregated=True`` deploys TWO pools instead of one:
+    ``<name>`` (prefill-role replicas — admission + chunked prefill
+    only) and ``<name>-decode`` (decode-role replicas). Finished KV
+    pages stream prefill→decode over compiled-DAG channels (shm rings
+    same-node, peer sockets cross-node) negotiated once per edge; the
+    router scores the prefill pool by queue/TTFT and the decode pool by
+    KV headroom. Greedy output is token-identical to the colocated
+    deployment. Requests route exactly as before —
+    ``handle.remote({"prompt_ids": ...})`` — streaming is colocated-only
+    for now."""
     from ray_tpu.serve import api as serve_api
 
     engine_kwargs = engine_kwargs or {}
+    opts: Dict[str, Any] = {}
+    if use_tpu:
+        opts["resources"] = {"TPU": 1.0}
+    if disaggregated:
+        decode_dep = serve_api.deployment(
+            DecodeLLMServer, name=f"{name}-decode",
+            num_replicas=num_decode_replicas,
+            max_ongoing_requests=32,
+            ray_actor_options=dict(opts)).bind(**engine_kwargs)
+        prefill_dep = serve_api.deployment(
+            PrefillLLMServer, name=name,
+            num_replicas=num_prefill_replicas,
+            max_ongoing_requests=16,
+            ray_actor_options=dict(opts)).bind(decode_dep,
+                                               **engine_kwargs)
+        return prefill_dep
 
     class LLMServer:
         def __init__(self, **kw):
@@ -83,9 +499,6 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
             router and the autoscaling policy."""
             return self.engine.load_snapshot()
 
-    opts: Dict[str, Any] = {}
-    if use_tpu:
-        opts["resources"] = {"TPU": 1.0}
     dep = serve_api.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         max_ongoing_requests=16, ray_actor_options=opts)

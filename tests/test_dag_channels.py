@@ -1,0 +1,349 @@
+"""Channel-subsystem unit tier: shm ring + peer-socket transports.
+
+Store-free by design — rings ride an mmap file and peer channels ride
+plain sockets, so every rendezvous/backpressure/teardown/death invariant
+runs in tier-1 without the native store lib. (The compiled-DAG
+integration over a real cluster lives in test_dag*.py; the chaos path in
+test_stress.py.)
+"""
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu.dag.channel import ChannelReader, ChannelWriter
+from ray_tpu.dag.errors import ChannelClosedError, ChannelTimeoutError
+from ray_tpu.dag.peer import (_HELLO, ChannelEndpoint,
+                              CrossNodeChannel)
+from ray_tpu.dag.ring import RingChannel, channel_dir
+
+
+def _pair(capacity=4, ring_bytes=8192):
+    cid = uuid.uuid4().bytes
+    return (RingChannel(cid, capacity=capacity, ring_bytes=ring_bytes),
+            RingChannel(cid, capacity=capacity, ring_bytes=ring_bytes))
+
+
+# ----------------------------------------------------------------- ring
+
+
+def test_ring_roundtrip_and_wraparound():
+    w, r = _pair(ring_bytes=4096)
+    try:
+        # Far more bytes than the ring holds: every record wraps the
+        # cursor many times over and each read must be byte-faithful.
+        for i in range(200):
+            w.write({"i": i, "pad": bytes([i % 256]) * 333}, i, timeout=10)
+            got = r.read(i, timeout=10)
+            assert got["i"] == i and got["pad"][:1] == bytes([i % 256])
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_rendezvous_either_order():
+    """Whichever endpoint touches the channel first creates the file;
+    the other attaches — no coordination service involved."""
+    cid = uuid.uuid4().bytes
+    r = RingChannel(cid, capacity=4)
+    w = RingChannel(cid, capacity=4)
+    try:
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(r.read(0, timeout=10)))
+        t.start()  # reader first: blocks on an empty (created) ring
+        time.sleep(0.05)
+        w.write("hello", 0)
+        t.join(timeout=10)
+        assert got == ["hello"]
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_backpressure_blocks_and_unblocks():
+    w, r = _pair(capacity=3)
+    try:
+        for i in range(3):
+            w.write(i, i)  # fills the message window
+        state = {"unblocked_at": None}
+
+        def drain():
+            time.sleep(0.3)
+            for i in range(3, 8):
+                r.read(i - 3, timeout=10)
+
+        t = threading.Thread(target=drain)
+        t.start()
+        t0 = time.monotonic()
+        w.write(3, 3, timeout=10)  # must BLOCK until the reader drains
+        state["unblocked_at"] = time.monotonic() - t0
+        for i in range(4, 8):
+            w.write(i, i, timeout=10)
+        t.join(timeout=10)
+        assert state["unblocked_at"] > 0.2, state
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_timeout_carries_context():
+    cid = uuid.uuid4().bytes
+    w = RingChannel(cid, capacity=2, ring_bytes=2048, edge="a->b")
+    r = RingChannel(cid, capacity=2, ring_bytes=2048, edge="a->b")
+    try:
+        w.write(b"x" * 100, 0)
+        w.write(b"x" * 100, 1)
+        with pytest.raises(ChannelTimeoutError) as ei:
+            w.write(b"x" * 100, 2, timeout=0.2)
+        e = ei.value
+        assert e.edge == "a->b" and e.seq == 2
+        assert e.bytes_in_flight and e.peer_alive is True
+        for f in ("edge=a->b", "seq=2", "bytes_in_flight=",
+                  "peer_alive=True"):
+            assert f in str(e), str(e)
+        # Reader-side timeout context too.
+        with pytest.raises(ChannelTimeoutError) as ei2:
+            empty_w, empty_r = _pair()
+            try:
+                empty_r.read(0, timeout=0.2)
+            finally:
+                empty_w.close()
+                empty_r.close(unlink=True)
+        assert ei2.value.seq == 0
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_reader_death_fails_writer():
+    w, r = _pair(capacity=2)
+    w.write(0, 0)
+    r.read(0, timeout=5)
+    r.close(unlink=True)  # reader dies
+    with pytest.raises(ChannelClosedError):
+        for i in range(1, 10):
+            w.write(i, i, timeout=5)
+    w.close()
+
+
+def test_ring_spill_large_payload_and_reclaim(monkeypatch):
+    """Payloads past the spill threshold ride a side file; the writer
+    reclaims unconsumed spills at close (reader-death must not leak
+    them), witnessed by RTPU_DEBUG_RES."""
+    monkeypatch.setenv("RTPU_DEBUG_RES", "1")
+    from ray_tpu.devtools import res_debug
+
+    res_debug.reset()
+    big = os.urandom(1 << 19)  # 512 KiB > dag_ring_spill_bytes default
+    w, r = _pair(capacity=4)
+    w.write(big, 0)
+    assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 1
+    assert r.read(0, timeout=10) == big
+    # A consumed spill settles once the writer observes the cursor.
+    w.write(b"small", 1)
+    assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 0
+    # Unconsumed spill + writer close => reclaimed, not leaked.
+    w.write(big, 2)
+    assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 1
+    w.close()
+    assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 0
+    assert res_debug.outstanding("channel_ring").get("channel_ring", 0) == 1  # reader still open
+    r.close(unlink=True)
+    assert res_debug.outstanding("channel_ring").get("channel_ring", 0) == 0
+    res_debug.reset()
+
+
+def test_ring_stop_sentinel_and_error_forwarding():
+    w, r = _pair()
+    try:
+        w.write_error(ValueError("boom"), 0)
+        with pytest.raises(ValueError, match="boom"):
+            r.read(0, timeout=5)
+        w.write_stop(1)
+        assert w.wait_consumed(0, timeout=5)
+        with pytest.raises(ChannelClosedError):
+            r.read(1, timeout=5)
+        assert w.wait_consumed(1, timeout=5)
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_seq_mismatch_is_loud():
+    w, r = _pair()
+    try:
+        w.write("a", 0)
+        with pytest.raises(ChannelClosedError, match="seq inversion"):
+            r.read(5, timeout=5)
+    finally:
+        w.close()
+        r.close(unlink=True)
+
+
+def test_ring_file_lives_in_channel_dir():
+    w, r = _pair()
+    try:
+        w.write(1, 0)
+        path = w._path
+        assert path and path.startswith(channel_dir())
+        assert os.path.exists(path)
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert not os.path.exists(path)  # reader unlink cleaned it up
+
+
+# ----------------------------------------------------------------- peer
+
+
+def _peer_pair(capacity=4):
+    cid = uuid.uuid4().bytes
+    rd = CrossNodeChannel(cid, capacity=capacity, edge="w->r")
+    addr = rd.prepare_read()
+    wr = CrossNodeChannel(cid, capacity=capacity, edge="w->r", addr=addr)
+    return wr, rd
+
+
+def test_peer_scatter_byte_identity():
+    """Multi-MB numpy payload crosses the socket as pickle-5 scatter
+    frames and arrives byte-identical."""
+    wr, rd = _peer_pair()
+    try:
+        payload = np.random.default_rng(0).integers(
+            0, 255, size=(1 << 20,), dtype=np.uint8)
+        wr.write(payload, 0)
+        out = rd.read(0, timeout=30)
+        assert isinstance(out, np.ndarray)
+        assert np.array_equal(out, payload)
+    finally:
+        wr.close()
+        rd.close()
+
+
+def test_peer_credit_window_backpressure():
+    wr, rd = _peer_pair(capacity=3)
+    try:
+        blocked = {}
+
+        def drain():
+            time.sleep(0.3)
+            for i in range(12):
+                rd.read(i, timeout=10)
+
+        t = threading.Thread(target=drain)
+        t.start()
+        t0 = time.monotonic()
+        for i in range(12):
+            wr.write(i, i, timeout=10)
+        blocked["dt"] = time.monotonic() - t0
+        t.join(timeout=10)
+        assert blocked["dt"] > 0.2, blocked  # window forced a wait
+        assert wr.wait_consumed(11, timeout=5)
+    finally:
+        wr.close()
+        rd.close()
+
+
+def test_peer_reader_death_rejects_writer():
+    wr, rd = _peer_pair()
+    wr.write("x", 0)
+    assert rd.read(0, timeout=10) == "x"
+    rd.close()  # teardown: endpoint now actively rejects the channel
+    with pytest.raises((ChannelClosedError, ChannelTimeoutError)):
+        for i in range(1, 20):
+            wr.write(i, i, timeout=2)
+    wr.close()
+
+
+def test_peer_seq_monotonicity_witness():
+    """Out-of-order / duplicate frames are recorded as violations (the
+    channel analog of the RPC witness's outbox ordering checks) and
+    duplicates are dropped, not delivered twice."""
+    cid = uuid.uuid4().bytes
+    rd = CrossNodeChannel(cid, capacity=8)
+    addr = rd.prepare_read()
+    host, port = addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)))
+    try:
+        s.sendall(struct.pack("<II", _HELLO, len(cid)) + cid)
+
+        def frame(seq):
+            body = pickle.dumps(("ok", seq), protocol=5)
+            return (struct.pack("<IBQI", len(body), 0, seq, 1)
+                    + struct.pack("<I", len(body)) + body)
+
+        s.sendall(frame(0) + frame(2) + frame(1))  # gap, then inversion
+        assert rd.read(0, timeout=5) == 0
+        assert rd.read(2, timeout=5) == 2  # gap flagged but delivered
+        deadline = time.monotonic() + 5
+        from ray_tpu.dag.peer import get_endpoint
+
+        while time.monotonic() < deadline:
+            kinds = [v["kind"] for v in get_endpoint().violations()]
+            if ("channel-seq-gap" in kinds
+                    and "channel-seq-inversion" in kinds):
+                break
+            time.sleep(0.05)
+        assert "channel-seq-gap" in kinds, kinds
+        assert "channel-seq-inversion" in kinds, kinds
+    finally:
+        s.close()
+        rd.close()
+
+
+def test_peer_sockets_res_witnessed(monkeypatch):
+    monkeypatch.setenv("RTPU_DEBUG_RES", "1")
+    from ray_tpu.devtools import res_debug
+
+    res_debug.reset()
+    wr, rd = _peer_pair()
+    wr.write("x", 0)
+    assert rd.read(0, timeout=10) == "x"
+    assert res_debug.outstanding("channel_sock").get("channel_sock", 0) >= 1
+    wr.close()
+    rd.close()
+    deadline = time.monotonic() + 5
+    while (res_debug.outstanding("channel_sock").get("channel_sock", 0)
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert res_debug.outstanding("channel_sock").get("channel_sock", 0) == 0
+    res_debug.reset()
+
+
+def test_private_endpoint_isolated_stop():
+    """A dedicated endpoint stops cleanly and rejects later dials."""
+    ep = ChannelEndpoint()
+    port = ep.port
+    ep.stop()
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=1)
+
+
+# ------------------------------------------------------ writer/reader API
+
+
+def test_channel_writer_reader_facade():
+    cid = uuid.uuid4().bytes
+    # Window > messages sent: the facade test exercises ordering, not
+    # backpressure (test_ring_backpressure covers blocking).
+    w = ChannelWriter(RingChannel(cid, capacity=16))
+    r = ChannelReader(RingChannel(cid, capacity=16))
+    try:
+        for i in range(10):
+            w.send({"n": i})
+        for i in range(10):
+            assert r.recv(timeout=5)["n"] == i
+        w.send_stop()
+        with pytest.raises(ChannelClosedError):
+            r.recv(timeout=5)
+    finally:
+        w.close()
+        r.close()
