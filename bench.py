@@ -69,6 +69,16 @@ Rows:
   (``actor_args_nn_per_s``). Needs a loadable native store lib
   (RTPU_SHM_STORE_SO on containers whose glibc rejects the checked-in
   .so).
+- data — streaming Dataset executor suite (``--data`` standalone):
+  same-window alternating A/B of ``random_shuffle`` with the exchange
+  on the channel mesh vs per-task RPC (``data_shuffle_gbps_channel`` /
+  ``_task`` / ``data_shuffle_channel_speedup``), and a synthetic train
+  loop over ``iter_batches(device_put=...)`` with the double-buffered
+  loader vs inline transfers (``data_ingest_steps_per_s_buffered`` /
+  ``_inline`` / ``data_ingest_overlap_speedup``) plus a pre-staged
+  roofline (``data_ingest_efficiency``; ``cpu_cores`` on the row —
+  overlap > 1 needs host cores for the loader thread). Needs the
+  native store lib, like dataplane.
 
 Structure: measurements run in CHILD subprocesses; the parent supervises
 with retry + backoff. A TPU backend init failure is cached for the life
@@ -109,6 +119,7 @@ DATAPLANE_TIMEOUT_S = 420  # dataplane child (store bench + 2-node cluster)
 CHAOS_TIMEOUT_S = 600      # chaos child (kill head/node + upgrade + recover)
 SCALE_TIMEOUT_S = 300      # scale child (100 simulated nodes, head hot paths)
 DAG_TIMEOUT_S = 420        # dag child (2-actor cluster, channel vs RPC hops)
+DATA_TIMEOUT_S = 420       # data child (channel-vs-task shuffle + ingest A/B)
 DISAGG_TIMEOUT_S = 900     # disagg serve sweep (colocated vs disagg TTFT)
 
 
@@ -2168,6 +2179,167 @@ def dag_bench_main() -> int:
 
 
 # --------------------------------------------------------------------------
+# data suite (--data): channel-vs-task shuffle GB/s + ingest overlap A/B
+# --------------------------------------------------------------------------
+
+def data_child_main() -> int:
+    """Streaming Dataset executor A/Bs, same window, alternating arms:
+
+    - shuffle: ``random_shuffle`` of the same dataset with the exchange
+      on the channel mesh vs the per-task-RPC pipeline (both transports
+      share the partition/merge kernels, so the work per row is
+      identical — the delta is pure transport).
+    - ingest: a synthetic train loop over ``iter_batches(device_put=)``
+      with the double-buffered background loader vs inline per-batch
+      ``device_put`` on the consumer thread (the pre-executor path).
+    """
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.data as rdata
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    row = {"metric": "data_executor", "config": "same-node"}
+    ray_tpu.init(num_cpus=4)
+    try:
+        # ---------------- shuffle GB/s, alternating A/B -----------------
+        import ray_tpu.data._exchange as _ex
+
+        # Many small blocks: steady-state per-piece cost is what the
+        # transports differ on (the partition/merge kernels are shared),
+        # and a 48-block exchange moves 48x48 pieces per pass.
+        n_rows, width = 96_000, 16  # ~13 MB of float64 per pass
+        ds = rdata.range(n_rows, parallelism=48).map_batches(
+            lambda b: {"id": b["id"],
+                       "x": np.tile(b["id"][:, None].astype(np.float64),
+                                    (1, width))})
+        nbytes = n_rows * (width + 1) * 8
+        counts = {"channel": 0}
+        orig = _ex._channel_exchange
+
+        def counting(*a, **k):
+            counts["channel"] += 1
+            return orig(*a, **k)
+
+        _ex._channel_exchange = counting
+        ds.materialize()  # warm read path + compile nothing later
+        times = {"channel": [], "task": []}
+        reps = 3
+        for rep in range(reps):
+            for arm in ("channel", "task"):  # alternate inside the window
+                cfg.data_exchange_transport = arm
+                t0 = time.perf_counter()
+                out = ds.random_shuffle(seed=rep).materialize()
+                assert out.count() == n_rows
+                times[arm].append(time.perf_counter() - t0)
+        cfg.data_exchange_transport = "channel"
+        gbps = {arm: round(nbytes / min(ts) / 1e9, 3)
+                for arm, ts in times.items()}
+        row["data_shuffle_gbps_channel"] = gbps["channel"]
+        row["data_shuffle_gbps_task"] = gbps["task"]
+        row["data_shuffle_channel_speedup"] = round(
+            gbps["channel"] / gbps["task"], 2)
+        # Honesty check: 0 here means every "channel" arm silently fell
+        # back to tasks and the A/B measured nothing.
+        row["data_channel_exchanges"] = counts["channel"]
+
+        # ---------------- ingest overlap A/B ----------------------------
+        import jax
+        import jax.numpy as jnp
+
+        dev = jax.devices()[0]
+        d = 256
+        bs = 4096
+        ing = rdata.range(65_536, parallelism=16).map_batches(
+            lambda b: {"x": np.tile(b["id"][:, None].astype(np.float32),
+                                    (1, d))})
+        w = jnp.ones((d, d), jnp.float32)
+
+        @jax.jit
+        def step(x, w_):
+            y = x @ w_
+            y = jnp.tanh(y) @ w_
+            return (y @ w_).sum()
+
+        step(jnp.ones((bs, d), jnp.float32), w).block_until_ready()
+
+        def run_buffered():
+            n = 0
+            for b in ing.iter_batches(batch_size=bs, device_put=dev):
+                step(b["x"], w).block_until_ready()
+                n += 1
+            return n
+
+        def run_inline():
+            n = 0
+            for hb in ing.iter_batches(batch_size=bs):
+                b = {k: jax.device_put(v, dev) for k, v in hb.items()}
+                step(b["x"], w).block_until_ready()
+                n += 1
+            return n
+
+        run_buffered()  # warm both pipelines once
+        t_buf, t_inl = [], []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            n_steps = run_buffered()
+            t_buf.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            assert run_inline() == n_steps
+            t_inl.append(time.perf_counter() - t0)
+        # Roofline: the same step count on a pre-staged device batch —
+        # what steps/s looks like with ZERO ingest cost. buffered/
+        # roofline is the "ingest stopped bottlenecking" ratio (needs
+        # host cores for the loader thread to overlap into; on a 1-core
+        # container both A/B arms are core-bound and converge instead).
+        xb = jax.device_put(np.ones((bs, d), np.float32), dev)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            step(xb, w).block_until_ready()
+        t_roof = time.perf_counter() - t0
+        row["data_ingest_steps_per_s_buffered"] = round(
+            n_steps / min(t_buf), 2)
+        row["data_ingest_steps_per_s_inline"] = round(
+            n_steps / min(t_inl), 2)
+        row["data_ingest_steps_per_s_roofline"] = round(
+            n_steps / t_roof, 2)
+        row["data_ingest_overlap_speedup"] = round(
+            min(t_inl) / min(t_buf), 2)
+        row["data_ingest_efficiency"] = round(
+            t_roof / min(t_buf), 2)
+        row["cpu_cores"] = len(os.sched_getaffinity(0))
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+def _data_rows() -> list:
+    try:
+        proc = _run(["--data-child"], DATA_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "data_executor",
+                 "error": f"timeout {DATA_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "data_executor",
+                "error": "rc=%d: %s" % (proc.returncode,
+                                        " | ".join(tail))})
+    return out
+
+
+def data_bench_main() -> int:
+    rows = _data_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+# --------------------------------------------------------------------------
 # disagg serve sweep: colocated vs disaggregated p99 TTFT, mixed load
 # --------------------------------------------------------------------------
 
@@ -2556,6 +2728,16 @@ def main() -> int:
     for r in disagg_rows:
         print(json.dumps(r), flush=True)
 
+    # Phase 10: streaming-data suite on CPU (channel-vs-task shuffle
+    # GB/s + double-buffered ingest A/B). Tracked from this PR.
+    data_rows: list = []
+    try:
+        data_rows = _data_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        data_rows = [{"metric": "data_executor", "error": repr(e)[:200]}]
+    for r in data_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -2687,6 +2869,20 @@ def main() -> int:
                 merged[k] = dis_merged[k]
     else:
         merged["serve_disagg_error"] = dis_merged["error"]
+    da = next((r for r in data_rows
+               if r.get("metric") == "data_executor"), {})
+    if "error" not in da and da.get("data_shuffle_gbps_channel") is not None:
+        for k in ("data_shuffle_gbps_channel", "data_shuffle_gbps_task",
+                  "data_shuffle_channel_speedup",
+                  "data_ingest_steps_per_s_buffered",
+                  "data_ingest_steps_per_s_inline",
+                  "data_ingest_steps_per_s_roofline",
+                  "data_ingest_overlap_speedup",
+                  "data_ingest_efficiency"):
+            if da.get(k) is not None:
+                merged[k] = da[k]
+    elif da:
+        merged["data_error"] = da["error"]
     print(json.dumps(merged))
     return 0
 
@@ -2724,6 +2920,10 @@ if __name__ == "__main__":
         sys.exit(dag_child_main())
     if "--dag" in sys.argv:
         sys.exit(dag_bench_main())
+    if "--data-child" in sys.argv:
+        sys.exit(data_child_main())
+    if "--data" in sys.argv:
+        sys.exit(data_bench_main())
     if "--serve-disagg-child" in sys.argv:
         sys.exit(serve_disagg_child_main())
     if "--serve-disagg" in sys.argv:

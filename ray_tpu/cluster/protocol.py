@@ -307,7 +307,11 @@ READONLY_RPCS = frozenset({
     "object_locations", "scheduler_stats", "pg_table", "pg_ready",
     "kv_get", "kv_keys", "get_demand", "has_object", "store_stats",
     # channel negotiation: endpoint + liveness read (writers poll it
-    # during the one-time negotiation and on timeout liveness probes)
+    # during the one-time negotiation and on timeout liveness probes).
+    # The streaming Dataset executor's inter-operator edges and the
+    # channel shuffle mesh (data/_executor.py, data/_exchange.py) ride
+    # these same three channel RPCs — the data plane adds NO new
+    # handlers to classify.
     "channel_lookup",
     "pull_stats", "wait_object", "wait_objects", "get_object",
     "stream_consumed", "wait_actor_address",

@@ -726,7 +726,13 @@ class ClusterCore:
 
     # ------------------------------------------------------------------ put/get
 
-    def put(self, value: Any, _owner=None) -> ObjectRef:
+    def put(self, value: Any, _owner=None, inline_ok: bool = True
+            ) -> ObjectRef:
+        """``inline_ok=False`` forces the shm store even for small
+        values: inlined objects live in the OWNER's memory store and die
+        with it, while store-backed objects survive on the node — the
+        contract long-lived data-plane producers (streaming Dataset
+        operator actors) need so their outputs outlive the actor."""
         oid = ObjectID.for_put(self.current_task_id(), next(self._put_counter))
         self.refcount.add_owned_object(oid)
         if isinstance(value, TaskError):
@@ -734,7 +740,7 @@ class ClusterCore:
             return ObjectRef(oid, self.owner_addr)
         header, buffers = SERIALIZER.serialize(value)
         total = SERIALIZER.encode_total_size(header, buffers)
-        if total <= cfg.object_store_inline_max_bytes:
+        if inline_ok and total <= cfg.object_store_inline_max_bytes:
             self.memory_store.put(oid, value)
         else:
             self._put_plasma(oid, header, buffers)

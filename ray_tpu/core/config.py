@@ -265,6 +265,28 @@ _d("data_memory_budget_bytes", int, 512 * 1024**2,
 _d("data_block_size_estimate", int, 8 * 1024**2,
    "assumed block size before the first real block lands (seeds the "
    "memory-budget admission until running averages exist)")
+_d("data_executor", str, "streaming",
+   "physical executor: 'streaming' runs map stages on long-lived operator "
+   "actors connected by bounded channel queues (falls back to 'pull' off a "
+   "cluster runtime or inside worker processes); 'pull' forces the "
+   "task-per-block generator chain")
+_d("data_streaming_lanes", int, 2,
+   "lanes (operator-actor replicas) per task-pool map stage under the "
+   "streaming executor; actor-pool stages use their own pool bounds")
+_d("data_queue_capacity", int, 8,
+   "bounded inter-operator queue depth in FRAMES per lane edge (rides "
+   "dag ring/peer channel backpressure; blocks stay in the object store, "
+   "frames carry refs)")
+_d("data_exchange_transport", str, "channel",
+   "shuffle partition traffic: 'channel' streams partition pieces over "
+   "mapper->reducer channel meshes (falls back to 'tasks' off-cluster, on "
+   "failure, or when the exchange would exceed the in-memory working-set "
+   "bound); 'tasks' forces the per-task-RPC two-stage exchange")
+_d("data_exchange_mappers", int, 2,
+   "mapper actors in a channel-backed exchange")
+_d("data_exchange_reducers", int, 2,
+   "reducer actors in a channel-backed exchange (each owns "
+   "num_outputs/reducers partitions)")
 
 # --- TPU / accelerator ---
 _d("tpu_chips_per_host", int, 4, "chips per TPU VM host (v5e/v5p default 4)")

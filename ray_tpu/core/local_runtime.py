@@ -152,7 +152,10 @@ class LocalRuntime:
     def current_resources(self) -> Dict[str, float]:
         return getattr(_task_local, "resources", {})
 
-    def put(self, value: Any, _owner=None) -> ObjectRef:
+    def put(self, value: Any, _owner=None, inline_ok: bool = True
+            ) -> ObjectRef:
+        # inline_ok is interface parity with ClusterCore.put: one process
+        # means the memory store IS the object's lifetime either way.
         oid = ObjectID.for_put(self.current_task_id(), next(self._put_counter))
         self.refcount.add_owned_object(oid)
         if isinstance(value, TaskError):

@@ -42,8 +42,35 @@ __all__ = [
     "ChannelClosedError", "ChannelEndpoint", "ChannelError",
     "ChannelReader", "ChannelTimeoutError", "ChannelWriter",
     "CrossNodeChannel", "RingChannel", "ShmChannel", "channel_dir",
-    "endpoint_violations", "get_endpoint",
+    "endpoint_violations", "get_endpoint", "open_edge",
 ]
+
+
+def open_edge(channel_id: bytes, *, writer_node: Optional[str],
+              reader_node: Optional[str],
+              writer_addr: Optional[str] = None,
+              reader_addr: Optional[str] = None,
+              capacity: int = 8, ring_bytes: Optional[int] = None,
+              edge: str = ""):
+    """Placement-aware channel construction for data-plane edges OUTSIDE
+    compiled DAGs (the streaming Dataset executor, exchange meshes): the
+    same ring-vs-peer decision ``compiled_dag._resolve_channel_kinds``
+    makes at compile time, packaged for callers that already know both
+    endpoints' nodes. Same node (or unknown placement, e.g. a
+    single-process runtime) -> shm SPSC ring; different nodes -> peer
+    socket with credit backpressure (both node ADDRESSES required)."""
+    if (writer_node is None or reader_node is None
+            or writer_node == reader_node):
+        return RingChannel(channel_id, capacity=capacity,
+                           ring_bytes=ring_bytes, edge=edge)
+    if not writer_addr or not reader_addr:
+        raise ValueError(
+            f"cross-node edge {edge or channel_id.hex()[:8]} needs both "
+            f"node addresses ({writer_node!r} -> {reader_node!r})")
+    ch = CrossNodeChannel(channel_id, writer_addr, reader_addr,
+                          capacity=capacity)
+    ch.edge = edge
+    return ch
 
 
 class ChannelWriter:

@@ -4,20 +4,36 @@ Parity target: the reference's exchange planner
 (reference: python/ray/data/_internal/planner/exchange/
 exchange_task_scheduler.py, sort_task_spec.py, shuffle_task_spec.py,
 push_based_shuffle_task_scheduler.py) re-designed small: one generic
-two-stage exchange over the object plane —
+two-stage exchange with TWO transports under one seam —
+
+**channel transport** (default on a cluster, ``data_exchange_transport``):
+long-lived mapper and reducer actors wired into an M x R mesh of bounded
+channel queues (``dag/ring.py`` shm rings same-node, ``dag/peer.py``
+peer sockets cross-node). Steady-state partition traffic is channel
+scatter frames — a mapper splits each block and streams piece
+``(block_index, partition, rows)`` frames straight to the owning
+reducer, no per-piece task RPC, no driver involvement. Reducers merge +
+finalize, and hand results back as actor-task returns so output blocks
+are driver-owned. The push-based-shuffle role, on PR 15's data plane.
+
+**task transport** (fallback): the original wave-admitted task pipeline —
 
     map stage:    one task per input block -> N partition blocks
                   (num_returns=N; partitions stay in the shm store, rows
                   ride zero-copy numpy buffers)
     reduce stage: one task per output partition, merging its N pieces
 
-The driver only moves REFS; block bytes flow worker->store->worker, and
-spilling makes the exchange out-of-core (a sort of 2x store memory walks
-through disk transparently).
+The task path stays the OUT-OF-CORE path: its wave admission sizes work
+to live store capacity and spilling walks a 2x-store sort through disk.
+The channel path bounds itself to in-memory working sets and falls back
+to tasks beyond that (or on any mid-exchange failure — both transports
+produce row-identical output for the same seed, so the fallback is
+invisible to results).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,14 +45,14 @@ from ray_tpu.data.block import (Block, BlockAccessor, BlockMetadata,
                                 col_unique_inverse, is_arrow_col)
 
 # --------------------------------------------------------------------------
-# Remote stage functions (module-level: pickled by reference, tiny specs)
+# Pure stage kernels (shared verbatim by both transports: row identity
+# between channel and task exchanges is BY CONSTRUCTION)
 # --------------------------------------------------------------------------
 
 
-@ray_tpu.remote(max_retries=3, retry_exceptions=True)
-def _partition_block(block: Block, assignment_fn_blob, n: int,
-                     block_index: int = 0):
-    """Map stage: split `block` into n partition blocks by row assignment.
+def partition_rows(block: Block, assignment_fn_blob, n: int,
+                   block_index: int = 0):
+    """Split `block` into n partition blocks by row assignment.
     assignment_fn_blob: callable (block, block_index) -> [num_rows] int
     partition ids (the index gives shuffles a distinct deterministic
     stream per block — content-derived seeds collapse for equal blocks)."""
@@ -53,17 +69,34 @@ def _partition_block(block: Block, assignment_fn_blob, n: int,
     return tuple(out) if n > 1 else out[0]
 
 
-@ray_tpu.remote(max_retries=3, retry_exceptions=True)
-def _merge_blocks(finalize_fn_blob, *pieces: Block):
-    """Reduce stage: concat this partition's pieces + finalize (sort the
-    partition, local shuffle, aggregate, ...). Returns (block, metadata):
-    the block lands in the store, the metadata rides the completion push
-    inline so the driver never fetches block bytes for bookkeeping."""
+def merge_pieces(pieces: Sequence[Block], finalize_fn_blob) -> Block:
+    """Concat one partition's pieces (in block-index order) + finalize
+    (sort the partition, local shuffle, aggregate, ...)."""
     merged = BlockAccessor.concat(list(pieces))
     if not merged and pieces:
         merged = {k: col_slice(v, 0, 0) for k, v in pieces[0].items()}
     if finalize_fn_blob:
         merged = finalize_fn_blob(merged)
+    return merged
+
+
+# --------------------------------------------------------------------------
+# Remote stage functions (module-level: pickled by reference, tiny specs)
+# --------------------------------------------------------------------------
+
+
+@ray_tpu.remote(max_retries=3, retry_exceptions=True)
+def _partition_block(block: Block, assignment_fn_blob, n: int,
+                     block_index: int = 0):
+    return partition_rows(block, assignment_fn_blob, n, block_index)
+
+
+@ray_tpu.remote(max_retries=3, retry_exceptions=True)
+def _merge_blocks(finalize_fn_blob, *pieces: Block):
+    """Returns (block, metadata): the block lands in the store, the
+    metadata rides the completion push inline so the driver never
+    fetches block bytes for bookkeeping."""
+    merged = merge_pieces(pieces, finalize_fn_blob)
     return merged, BlockMetadata.of(merged)
 
 
@@ -85,9 +118,54 @@ def exchange(bundles: List[Tuple[Any, BlockMetadata]],
              finalize_fn: Optional[Callable[[Block], Block]] = None,
              ) -> List[Tuple[Any, BlockMetadata]]:
     """Runs the two-stage exchange; returns the output bundles in
-    partition order. Refs only — no block bytes touch the driver."""
+    partition order. Refs only — no block bytes touch the driver.
+
+    Transport dispatch: the channel mesh when configured, on a cluster,
+    and within the in-memory working-set bound; the task pipeline
+    otherwise (out-of-core sizes, non-cluster runtimes, worker-hosted
+    pipelines) and as the fallback when a channel exchange fails
+    mid-flight (both transports share the partition/merge kernels, so a
+    fallback rerun is row-identical)."""
     if not bundles:
         return []
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+    if _cfg.data_exchange_transport == "channel":
+        from ray_tpu.data._executor import streaming_available
+
+        if streaming_available() and _within_memory_bound(bundles):
+            try:
+                return _channel_exchange(bundles, assignment_fn,
+                                         num_outputs, finalize_fn)
+            except Exception as e:
+                print(f"RTPU_DATA: channel exchange failed ({e!r}); "
+                      "falling back to task exchange", flush=True)
+    return _task_exchange(bundles, assignment_fn, num_outputs,
+                          finalize_fn)
+
+
+def _within_memory_bound(bundles) -> bool:
+    """The channel exchange accumulates partition pieces in reducer
+    heaps — in-memory by design. Exchanges bigger than a third of live
+    store capacity keep the task path, whose wave admission + store
+    spilling is the out-of-core story."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+    from ray_tpu.core.runtime_context import require_runtime
+
+    total = sum(m.size_bytes for _r, m in bundles if m)
+    try:
+        _used, store_bytes, _n, _e = require_runtime().store.stats()
+    except Exception:  # rtpu-lint: disable=swallowed-exception — config-default fallback when the store has no stats endpoint
+        store_bytes = _cfg.object_store_memory_bytes
+    return total <= store_bytes // 3
+
+
+def _task_exchange(bundles: List[Tuple[Any, BlockMetadata]],
+                   assignment_fn: Callable[[Block], np.ndarray],
+                   num_outputs: int,
+                   finalize_fn: Optional[Callable[[Block], Block]] = None,
+                   ) -> List[Tuple[Any, BlockMetadata]]:
+    """The wave-admitted per-task-RPC pipeline (out-of-core capable)."""
     # Memory admission control for BOTH stages (reference: pull admission
     # in pull_manager.h + the push-based shuffle's staged merges): a task
     # pins its inputs and creates outputs (~2-3x block bytes of store
@@ -102,7 +180,7 @@ def exchange(bundles: List[Tuple[Any, BlockMetadata]],
     part_bytes = max(1, total_bytes // num_outputs)
     try:  # the LIVE store capacity (init's object_store_memory argument)
         _used, store_bytes, _n, _e = require_runtime().store.stats()
-    except Exception:
+    except Exception:  # rtpu-lint: disable=swallowed-exception — config-default fallback when the store has no stats endpoint
         store_bytes = _cfg.object_store_memory_bytes
 
     map_wave = int(max(1, min(len(bundles),
@@ -131,6 +209,221 @@ def exchange(bundles: List[Tuple[Any, BlockMetadata]],
             wave_meta_refs.append(m_ref)
         metas.extend(ray_tpu.get(wave_meta_refs))
     return list(zip(block_refs, metas))
+
+
+# --------------------------------------------------------------------------
+# The channel transport: an M x R mapper/reducer mesh
+# --------------------------------------------------------------------------
+
+
+class _ExchangeMapper:
+    """Map side of the channel exchange: splits assigned blocks with the
+    shared ``partition_rows`` kernel and streams each piece to the
+    reducer owning its partition as one channel frame
+    ``(block_index, partition, piece)``. Empty pieces ship too — the
+    reducer needs every (block, partition) cell to reconstruct the task
+    transport's exact concat order (and a schema for empty outputs)."""
+
+    def __init__(self):
+        self._queues = None
+
+    def whereami(self):
+        try:
+            return ray_tpu.get_runtime_context().node_id
+        except Exception:  # rtpu-lint: disable=swallowed-exception — placement is a hint; None means same-node
+            return None
+
+    def attach(self, out_queues, payload) -> bool:
+        self._queues = out_queues  # reducer r reads queue r
+        self._assign = payload["assignment_fn"]
+        self._n = payload["num_outputs"]
+        self._trace_ctx = payload.get("trace_ctx")
+        return True
+
+    def run(self, assigned) -> int:
+        """assigned: [(block_index, ref)] — refs resolved here (nested
+        refs stay refs across the actor call; the borrow registration
+        keeps them alive in flight)."""
+        from ray_tpu.util import tracing
+
+        sent = 0
+        t0 = time.time()
+        try:
+            for block_index, ref in assigned:
+                block = ray_tpu.get(ref)
+                parts = partition_rows(block, self._assign, self._n,
+                                       block_index)
+                if self._n == 1:
+                    parts = (parts,)
+                for j, piece in enumerate(parts):
+                    self._queues[j % len(self._queues)].put(
+                        (block_index, j, piece), timeout=600.0)
+                    sent += 1
+        finally:
+            for q in self._queues:
+                try:
+                    q.put_stop()
+                except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort EOS on an already-failed stream
+                    pass
+            if tracing.enabled():
+                tracing.emit_span("data.op.exchange", t0, time.time(),
+                                  parent=self._trace_ctx,
+                                  attrs={"phase": "exec",
+                                         "role": "map", "pieces": sent})
+                tracing.flush()
+        return sent
+
+
+class _ExchangeReducer:
+    """Reduce side: drains all M mapper streams (round-robin polling —
+    a reducer pinned to one silent mapper while others' rings fill is
+    the classic mesh deadlock), then merges + finalizes each owned
+    partition with the shared kernel. Results return via per-partition
+    actor-task returns so output blocks are DRIVER-owned — they outlive
+    the mesh teardown."""
+
+    def __init__(self):
+        self._pieces: Dict[int, Dict[int, Block]] = {}
+
+    def whereami(self):
+        try:
+            return ray_tpu.get_runtime_context().node_id
+        except Exception:  # rtpu-lint: disable=swallowed-exception — placement is a hint; None means same-node
+            return None
+
+    def attach(self, in_queues, payload) -> bool:
+        self._queues = list(in_queues)
+        self._finalize = payload["finalize_fn"]
+        self._trace_ctx = payload.get("trace_ctx")
+        for q in self._queues:
+            q.prepare_read()
+        return True
+
+    def run(self) -> int:
+        from ray_tpu.data._queues import QueueStopped
+        from ray_tpu.util import tracing
+
+        t0 = time.time()
+        live = list(self._queues)
+        got = 0
+        deadline = time.monotonic() + 600.0
+        while live:
+            progressed = False
+            for q in list(live):
+                try:
+                    block_index, j, piece = q.get(timeout=0.05)
+                except TimeoutError:
+                    continue
+                except QueueStopped:
+                    live.remove(q)
+                    progressed = True
+                    continue
+                self._pieces.setdefault(j, {})[block_index] = piece
+                got += 1
+                progressed = True
+            if progressed:
+                deadline = time.monotonic() + 600.0
+            elif time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"exchange reducer: no frames for 600s "
+                    f"({len(live)} mapper streams still open)")
+        if tracing.enabled():
+            tracing.emit_span("data.op.exchange", t0, time.time(),
+                              parent=self._trace_ctx,
+                              attrs={"phase": "exec", "role": "reduce",
+                                     "pieces": got})
+            tracing.flush()
+        return got
+
+    def finish(self, j: int):
+        """Merge + finalize partition j. num_returns=2 at the call site:
+        the block ref is a task return (driver-owned), the metadata
+        rides the completion push."""
+        cells = self._pieces.pop(j, {})
+        pieces = [cells[i] for i in sorted(cells)]
+        merged = merge_pieces(pieces, self._finalize)
+        return merged, BlockMetadata.of(merged)
+
+
+def _channel_exchange(bundles, assignment_fn, num_outputs: int,
+                      finalize_fn) -> List[Tuple[Any, BlockMetadata]]:
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+    from ray_tpu.core.runtime_context import require_runtime
+    from ray_tpu.dag.channel import open_edge
+    from ray_tpu.data._queues import ChannelQueue
+    from ray_tpu.devtools import res_debug
+    from ray_tpu.util import tracing
+
+    rt = require_runtime()
+    node_addr = {n["node_id"]: n["address"] for n in rt.nodes()}
+    n_map = max(1, min(_cfg.data_exchange_mappers, len(bundles)))
+    n_red = max(1, min(_cfg.data_exchange_reducers, num_outputs))
+    trace_ctx = tracing.current() if tracing.enabled() else None
+
+    import uuid as _uuid
+
+    mapper_cls = ray_tpu.remote(_ExchangeMapper)
+    reducer_cls = ray_tpu.remote(_ExchangeReducer)
+    mappers = [mapper_cls.options(num_cpus=0).remote()
+               for _ in range(n_map)]
+    reducers = [reducer_cls.options(num_cpus=0).remote()
+                for _ in range(n_red)]
+    actors = mappers + reducers
+    res_keys = [res_debug.note_acquire("data_operator", owner=a,
+                                       note="exchange")
+                for a in actors]
+    map_nodes = ray_tpu.get([m.whereami.remote() for m in mappers],
+                            timeout=60.0)
+    red_nodes = ray_tpu.get([r.whereami.remote() for r in reducers],
+                            timeout=60.0)
+
+    # The M x R mesh: queue[m][r], SPSC per edge (one mapper writer, one
+    # reducer reader), bounded by the channel's own backpressure.
+    cap = _cfg.data_queue_capacity
+    mesh = [[ChannelQueue(open_edge(
+        _uuid.uuid4().bytes[:12], writer_node=map_nodes[m],
+        reader_node=red_nodes[r],
+        writer_addr=node_addr.get(map_nodes[m]),
+        reader_addr=node_addr.get(red_nodes[r]),
+        capacity=cap, edge=f"xchg.m{m}->r{r}"),
+        name=f"xchg.m{m}.r{r}") for r in range(n_red)]
+        for m in range(n_map)]
+    try:
+        # Reducers attach first (reader rendezvous before any writer).
+        ray_tpu.get([reducers[r].attach.remote(
+            [mesh[m][r] for m in range(n_map)],
+            {"finalize_fn": finalize_fn, "trace_ctx": trace_ctx})
+            for r in range(n_red)], timeout=60.0)
+        ray_tpu.get([mappers[m].attach.remote(
+            mesh[m], {"assignment_fn": assignment_fn,
+                      "num_outputs": num_outputs,
+                      "trace_ctx": trace_ctx})
+            for m in range(n_map)], timeout=60.0)
+        red_runs = [r.run.remote() for r in reducers]
+        map_runs = [mappers[m].run.remote(
+            [(i, ref) for i, (ref, _meta) in enumerate(bundles)
+             if i % n_map == m]) for m in range(n_map)]
+        ray_tpu.get(map_runs, timeout=600.0)
+        ray_tpu.get(red_runs, timeout=600.0)
+        out: List[Tuple[Any, BlockMetadata]] = []
+        for start in range(0, num_outputs, 16):
+            js = range(start, min(start + 16, num_outputs))
+            pairs = [reducers[j % n_red].finish.options(
+                num_returns=2).remote(j) for j in js]
+            metas = ray_tpu.get([m for _b, m in pairs], timeout=600.0)
+            out.extend((b, meta)
+                       for (b, _m), meta in zip(pairs, metas))
+        return out
+    finally:
+        for a, key in zip(actors, res_keys):
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort teardown
+                pass
+            res_debug.note_release("data_operator", key)
+        for row in mesh:
+            for q in row:
+                q.shutdown(unlink=True)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,109 @@
+"""Double-buffered device ingest: overlap host loading with device steps.
+
+The train-loop seam ``iter_batches(device_put=...)`` used to issue
+``jax.device_put`` inline on the consumer thread: every batch paid block
+fetch + concat + re-chunk + H2D transfer INSIDE the train step's gap, so
+host loading serialized with device compute.
+
+:func:`device_batches` moves the whole host pipeline onto a background
+loader thread that feeds a bounded :class:`~ray_tpu.data._queues.LocalQueue`
+of already-transferred ``jax.Array`` batches. ``jax.device_put`` is
+asynchronous — the loader can have ``depth`` transfers in flight while
+the consumer runs the current step, so at steady state the device never
+waits on the host unless loading is genuinely slower than compute. The
+queue bound is the device-memory bound: at most ``depth + 1`` batches of
+activations-in-waiting exist at once, and a slow consumer blocks the
+loader (backpressure, not unbounded device allocation).
+
+Early close (``break`` out of the train loop) shuts the queue down,
+which unblocks and ends the loader thread; the generator's ``close()``
+propagates up the host pipeline so the streaming executor's finalizers
+run too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from ray_tpu.data._queues import LocalQueue, QueueStopped
+from ray_tpu.util import tracing
+
+__all__ = ["device_batches"]
+
+_BATCH, _ERR = 0, 1
+
+
+def device_batches(host_batches: Iterator[Dict[str, Any]],
+                   device_put: Any,
+                   depth: int,
+                   trace_ctx: Optional[Dict[str, str]] = None,
+                   ) -> Iterator[Dict[str, Any]]:
+    """Yield ``host_batches`` as device arrays, ``depth``-deep
+    double-buffered: a loader thread pulls host batches and issues
+    ``jax.device_put`` ahead of the consumer."""
+    import jax
+
+    depth = max(1, int(depth))
+    q = LocalQueue(depth, name="device_ingest")
+    stop = threading.Event()
+
+    def load():
+        t_wait = 0.0
+        n = 0
+        t0 = time.time()
+        try:
+            for hb in host_batches:
+                if stop.is_set():
+                    break
+                dev = {k: jax.device_put(v, device_put)
+                       for k, v in hb.items()}
+                t1 = time.time()
+                q.put((_BATCH, dev))
+                t_wait += time.time() - t1
+                n += 1
+        except Exception as e:  # surfaced on the consumer thread
+            try:
+                q.put((_ERR, e), timeout=60.0)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort error forwarding to a possibly-gone consumer
+                pass
+        finally:
+            q.put_stop()
+            # Close the host generator from THIS thread (the one
+            # iterating it) so upstream finalizers run on early break.
+            close = getattr(host_batches, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort generator close in teardown
+                    pass
+            if tracing.enabled():
+                tracing.emit_span("data.op.ingest", t0, time.time(),
+                                  parent=trace_ctx,
+                                  attrs={"phase": "exec", "batches": n,
+                                         "queue_full_s": round(t_wait, 4)})
+
+    loader = threading.Thread(target=load, name="rtpu-data-ingest",
+                              daemon=True)
+    loader.start()
+    try:
+        while True:
+            t0 = time.time()
+            try:
+                kind, item = q.get(timeout=600.0)
+            except QueueStopped:
+                return
+            if tracing.enabled():
+                tracing.emit_span("data.op.ingest", t0, time.time(),
+                                  parent=trace_ctx,
+                                  attrs={"phase": "queue_wait"})
+            if kind == _ERR:
+                raise item
+            yield item
+    finally:
+        # Consumer gone (exhaustion or early break): stop + unblock the
+        # loader; it closes the host generator on its way out.
+        stop.set()
+        q.shutdown()
+        loader.join(timeout=30.0)

@@ -110,6 +110,28 @@ class ExecContext:
         self.budget = MemoryBudget(
             cfg.data_memory_budget_bytes if memory_budget_bytes is None
             else memory_budget_bytes)
+        #: Teardown hooks that must outlive individual operator
+        #: generators: a streaming map stage's lane actors own blocks
+        #: that DOWNSTREAM stages still read after the stage's own
+        #: generator exhausts, so lanes die at pipeline close, not at
+        #: stage close (execute_plan runs these on exhaustion, error, or
+        #: consumer abandonment).
+        self._finalizers: List[Callable[[], None]] = []
+        #: Wire context of the consumer's root span (e.g. one
+        #: ``iter_batches`` call) — operator spans parent to it so the
+        #: whole pipeline renders as ONE timeline.
+        self.trace_ctx: Optional[Dict[str, str]] = None
+
+    def add_finalizer(self, fn: Callable[[], None]) -> None:
+        self._finalizers.append(fn)
+
+    def run_finalizers(self) -> None:
+        fns, self._finalizers = self._finalizers[::-1], []
+        for fn in fns:
+            try:
+                fn()
+            except Exception:  # rtpu-lint: disable=swallowed-exception — finalizers are teardown; one failing must not mask others
+                pass
 
 
 class Operator:
@@ -461,7 +483,19 @@ class DriverOperator(Operator):
 
     def execute(self, upstream: Iterator[RefBundle],
                 ctx: Optional[ExecContext] = None) -> Iterator[RefBundle]:
-        return self._gen(upstream)
+        tctx = ctx.trace_ctx if ctx is not None else None
+        if tctx is None:
+            return self._gen(upstream)
+        from ray_tpu.util import tracing
+
+        def traced() -> Iterator[RefBundle]:
+            # Driver-side work (e.g. an exchange) runs while this
+            # generator is being advanced: attach the consumer's root
+            # context so its spans join the pipeline's timeline.
+            with tracing.attach(tctx):
+                yield from self._gen(upstream)
+
+        return traced()
 
 
 class LimitOperator(Operator):
@@ -531,13 +565,40 @@ def optimize_plan(ops: List[Operator]) -> List[Operator]:
 
 def execute_plan(input_op: InputOperator,
                  operators: List[Operator],
-                 memory_budget_bytes: Optional[int] = None
+                 memory_budget_bytes: Optional[int] = None,
+                 trace_ctx: Optional[Dict[str, str]] = None,
                  ) -> Iterator[RefBundle]:
+    """Run the optimized plan. Two physical executors share this seam:
+
+    - **streaming** (default on a cluster runtime): map stages run on
+      long-lived operator actors connected by bounded channel queues
+      (``_executor.py``) — per-block steady-state cost is a channel hop
+      plus a store get/put instead of a task RPC;
+    - **pull** (``data_executor='pull'``, non-cluster runtimes, or
+      worker-hosted pipelines): the original task-per-block generator
+      chain below.
+
+    Both produce row-identical output for the same plan: the streaming
+    executor dispatches and gathers blocks in global index order.
+    """
     ctx = ExecContext(memory_budget_bytes)
+    ctx.trace_ctx = trace_ctx
+    ops = optimize_plan(operators)
+    from ray_tpu.data._executor import adapt_plan, streaming_available
+
+    if streaming_available():
+        ops = adapt_plan(ops)
     stream = input_op.execute(None, ctx)
-    for op in optimize_plan(operators):
+    for op in ops:
         stream = op.execute(stream, ctx)
-    return stream
+
+    def _with_finalizers():
+        try:
+            yield from stream
+        finally:
+            ctx.run_finalizers()
+
+    return _with_finalizers()
 
 
 def explain_plan(input_op: InputOperator,

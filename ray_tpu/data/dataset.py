@@ -252,11 +252,12 @@ class Dataset:
 
     # ------------------------------------------------------------ execution
 
-    def _stream(self) -> Iterator[RefBundle]:
+    def _stream(self, trace_ctx: Optional[Dict[str, str]] = None
+                ) -> Iterator[RefBundle]:
         return execute_plan(
             InputOperator(self._read_tasks,
                           parallelism=self._read_parallelism),
-            self._ops)
+            self._ops, trace_ctx=trace_ctx)
 
     def iter_block_refs(self) -> Iterator[RefBundle]:
         return self._stream()
@@ -269,15 +270,21 @@ class Dataset:
         """Stream batches, re-chunking blocks to exactly ``batch_size`` rows.
 
         ``device_put``: a jax.sharding.Sharding/device — batches become
-        jax.Arrays, with ``prefetch_depth`` (default: config
-        `device_prefetch_depth`) transfers issued ahead of the consumer.
+        jax.Arrays, double-buffered through a background loader thread:
+        ``prefetch_depth`` (default: config `device_prefetch_depth`)
+        async transfers are issued ahead of the consumer, so host block
+        loading overlaps device steps (see ``_ingest.py``).
         """
-        import collections
+        from ray_tpu.util import tracing
+
+        root = tracing.start_span("data.iter_batches") if (
+            tracing.enabled()) else None
+        trace_ctx = tracing.ctx_of(root)
 
         def host_batches() -> Iterator[Block]:
             buf: List[Block] = []
             buffered = 0
-            for ref, _meta in self._stream():
+            for ref, _meta in self._stream(trace_ctx=trace_ctx):
                 block = ray_tpu.get(ref)
                 n = BlockAccessor(block).num_rows()
                 if n == 0:
@@ -300,21 +307,18 @@ class Dataset:
                 if BlockAccessor(tail).num_rows() and not drop_last:
                     yield tail
 
-        if device_put is None:
-            yield from host_batches()
-            return
+        try:
+            if device_put is None:
+                yield from host_batches()
+            else:
+                from ray_tpu.data._ingest import device_batches
 
-        import jax
-
-        depth = prefetch_depth or cfg.device_prefetch_depth
-        window: "collections.deque" = collections.deque()
-        for hb in host_batches():
-            window.append({k: jax.device_put(v, device_put)
-                           for k, v in hb.items()})
-            if len(window) > depth:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
+                yield from device_batches(
+                    host_batches(), device_put,
+                    prefetch_depth or cfg.device_prefetch_depth,
+                    trace_ctx=trace_ctx)
+        finally:
+            tracing.end_span(root)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         yield from _rows_of(self._stream())
@@ -632,7 +636,9 @@ class StreamSplitIterator:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      drop_last: bool = False,
-                     device_put: Optional[Any] = None) -> Iterator[Block]:
+                     device_put: Optional[Any] = None,
+                     prefetch_depth: Optional[int] = None,
+                     ) -> Iterator[Block]:
         def blocks() -> Iterator[Block]:
             while True:
                 out = ray_tpu.get(
@@ -642,38 +648,42 @@ class StreamSplitIterator:
                 ref, _n = out
                 yield ray_tpu.get(ref)
 
-        buf: List[Block] = []
-        buffered = 0
-        if device_put is not None:
-            import jax  # noqa: F401 — only device consumers need jax
+        def host_batches() -> Iterator[Block]:
+            buf: List[Block] = []
+            buffered = 0
+            for block in blocks():
+                n = BlockAccessor(block).num_rows()
+                if n == 0:
+                    continue
+                if batch_size is None:
+                    yield block
+                    continue
+                buf.append(block)
+                buffered += n
+                while buffered >= batch_size:
+                    merged = BlockAccessor.concat(buf)
+                    out = BlockAccessor(merged).slice(0, batch_size)
+                    rest = BlockAccessor(merged).slice(
+                        batch_size, BlockAccessor(merged).num_rows())
+                    buf = [rest] if BlockAccessor(rest).num_rows() else []
+                    buffered -= batch_size
+                    yield out
+            if buf and not drop_last:
+                tail = BlockAccessor.concat(buf)
+                if BlockAccessor(tail).num_rows():
+                    yield tail
 
-        for block in blocks():
-            n = BlockAccessor(block).num_rows()
-            if n == 0:
-                continue
-            if batch_size is None:
-                yield block
-                continue
-            buf.append(block)
-            buffered += n
-            while buffered >= batch_size:
-                merged = BlockAccessor.concat(buf)
-                out = BlockAccessor(merged).slice(0, batch_size)
-                rest = BlockAccessor(merged).slice(
-                    batch_size, BlockAccessor(merged).num_rows())
-                buf = [rest] if BlockAccessor(rest).num_rows() else []
-                buffered -= batch_size
-                if device_put is not None:
-                    out = {k: jax.device_put(v, device_put)
-                           for k, v in out.items()}
-                yield out
-        if buf and not drop_last:
-            tail = BlockAccessor.concat(buf)
-            if BlockAccessor(tail).num_rows():
-                if device_put is not None:
-                    tail = {k: jax.device_put(v, device_put)
-                            for k, v in tail.items()}
-                yield tail
+        if device_put is None:
+            yield from host_batches()
+            return
+        from ray_tpu.data._ingest import device_batches
+
+        # Same double-buffered feed as Dataset.iter_batches: each train
+        # worker's split overlaps its coordinator pulls + H2D transfers
+        # with its own device steps.
+        yield from device_batches(
+            host_batches(), device_put,
+            prefetch_depth or cfg.device_prefetch_depth)
 
 
 # ---------------------------------------------------------------- read API

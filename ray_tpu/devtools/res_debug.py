@@ -55,8 +55,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: count mapped ring files, spilled payload side-files, and peer
 #: sockets: a compiled DAG or disaggregated-serving mesh torn down
 #: without releasing them is a leak the chaos bench fails on.
+#: ``data_queue`` / ``data_operator`` (data/_queues.py, data/_executor.py)
+#: count the streaming Dataset executor's bounded inter-operator queues
+#: and long-lived operator actors: a pipeline torn down without closing
+#: its edges or killing its lanes is a leak.
 LEAK_KINDS = ("buffer_lease", "lease", "kv_spec",
-              "channel_ring", "channel_spill", "channel_sock")
+              "channel_ring", "channel_spill", "channel_sock",
+              "data_queue", "data_operator")
 
 
 def enabled() -> bool:

@@ -13,7 +13,8 @@ from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.session import (get_checkpoint, get_context,
-                                   get_dataset_shard, report)
+                                   get_dataset_shard, iter_device_batches,
+                                   report)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.huggingface import (RayTrainReportCallback,
@@ -27,6 +28,6 @@ __all__ = [
     "RayTrainReportCallback", "Result", "RunConfig", "ScalingConfig",
     "TorchTrainer", "TrainWorkerError", "TransformersTrainer",
     "WorkerGroup", "prepare_trainer",
-    "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
-    "report", "save_pytree",
+    "get_checkpoint", "get_context", "get_dataset_shard",
+    "iter_device_batches", "load_pytree", "report", "save_pytree",
 ]

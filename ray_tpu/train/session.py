@@ -139,6 +139,32 @@ class TrainSession:
                 f"(datasets={list(self._dataset_shards)})")
         return shard
 
+    def iter_device_batches(self, name: str = "train", *,
+                            batch_size: Optional[int] = 256,
+                            device=None, prefetch_depth: Optional[int] = None):
+        """Double-buffered device ingest for a train loop.
+
+        Yields batches from the named dataset shard already placed on
+        ``device`` (default: this worker's first jax device): a
+        background loader overlaps host block loading + transfer with
+        the caller's device steps (``data/_ingest.py``), so the step
+        loop never waits on ingest once the pipeline is warm. The shard
+        must come from a streaming-capable dataset
+        (``Dataset.streaming_split``); plain-sequence shards have no
+        batch iterator and raise ``TypeError``.
+        """
+        shard = self.get_dataset_shard(name)
+        if not hasattr(shard, "iter_batches"):
+            raise TypeError(
+                f"dataset shard {name!r} ({type(shard).__name__}) has no "
+                "iter_batches; pass a ray_tpu.data Dataset to the trainer "
+                "for device ingest")
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        return shard.iter_batches(batch_size=batch_size, device_put=device,
+                                  prefetch_depth=prefetch_depth)
+
 
 # Module-level accessors (the public API surface inside a train loop).
 _session: Optional[TrainSession] = None
@@ -167,3 +193,11 @@ def get_context() -> TrainContext:
 
 def get_dataset_shard(name: str = "train"):
     return _require_session().get_dataset_shard(name)
+
+
+def iter_device_batches(name: str = "train", *,
+                        batch_size: Optional[int] = 256,
+                        device=None, prefetch_depth: Optional[int] = None):
+    return _require_session().iter_device_batches(
+        name, batch_size=batch_size, device=device,
+        prefetch_depth=prefetch_depth)
