@@ -96,6 +96,7 @@ import os
 import subprocess
 import sys
 import time
+import uuid
 
 # Peak dense bf16 FLOP/s per chip by device kind substring.
 PEAK_FLOPS = [
@@ -1944,9 +1945,15 @@ def scale_child_main() -> int:
 
     from ray_tpu.cluster import protocol as _protocol
     from ray_tpu.core.cluster_runtime import SimulatedCluster
+    from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
 
     n = int(os.environ.get("RTPU_SCALE_NODES", "100"))
     n_objects = int(os.environ.get("RTPU_SCALE_OBJECTS", "20000"))
+    if n >= 500:
+        # 1000 nodes at one beat/s would make the run a heartbeat fan-in
+        # bench; stretch the beat (and the death threshold with it) so
+        # the storm below measures dispatch, not backpressure.
+        _cfg.set("health_check_period_ms", 5000)
     t0 = time.perf_counter()
     sim = SimulatedCluster(n)
     sim.wait_registered(60)
@@ -1989,6 +1996,90 @@ def scale_child_main() -> int:
                         oids[rng.randrange(n_objects)],
                         node_ids[rng.randrange(n)], timeout=30)
         lat_loc.append((time.perf_counter() - t) * 1e6)
+    # Task storm: sustained owner-side dispatch against a few hot
+    # scheduling keys, A/B in the same window — per-task head pick +
+    # lease (the pre-block path) vs owner-routed lease blocks (grant
+    # once per block, then node-direct request_lease until exhaustion).
+    from ray_tpu.cluster.protocol import ClientPool as _ClientPool
+
+    storm_tasks = int(os.environ.get("RTPU_SCALE_STORM_TASKS", "2000"))
+    storm_keys = int(os.environ.get("RTPU_SCALE_STORM_KEYS", "8"))
+    pool = _ClientPool()
+    res = {"CPU": 1.0}
+
+    def storm(use_blocks: bool) -> dict:
+        head_rpcs = 0
+        direct = 0
+        done = 0
+        blocks: dict = {}
+        t0 = time.perf_counter()
+        for i in range(storm_tasks):
+            key = f"storm-k{i % storm_keys}"
+            granted = None
+            addr = None
+            used_head = False
+            if use_blocks:
+                blk = blocks.get(key)
+                if blk is not None and blk[2] > 0:
+                    bid, addr, remaining = blk
+                    granted = pool.get(addr).call(
+                        "request_lease", res, True, None,
+                        uuid.uuid4().hex, "bench-owner", None, None,
+                        bid, timeout=30)
+                    if granted is None or isinstance(granted, dict):
+                        granted = None
+                        blocks.pop(key, None)
+                    else:
+                        blocks[key] = (bid, addr, remaining - 1)
+                if granted is None:
+                    # First touch / exhausted: one head grant renews a
+                    # whole block of node-direct admissions.
+                    used_head = True
+                    head_rpcs += 1
+                    bid = uuid.uuid4().hex
+                    got = sim.client.call("lease_block_grant", bid,
+                                          "bench-owner", res, None,
+                                          None, timeout=30)
+                    if got is None:
+                        continue
+                    _nid, addr, size, _ttl = got
+                    granted = pool.get(addr).call(
+                        "request_lease", res, True, None,
+                        uuid.uuid4().hex, "bench-owner", None, None,
+                        bid, timeout=30)
+                    if granted is None or isinstance(granted, dict):
+                        continue
+                    blocks[key] = (bid, addr, size - 1)
+            else:
+                used_head = True
+                head_rpcs += 1
+                picked = sim.client.call("pick_node", res, None, None,
+                                         key, None, timeout=30)
+                if picked is None:
+                    continue
+                addr = picked[1]
+                granted = pool.get(addr).call(
+                    "request_lease", res, True, None, uuid.uuid4().hex,
+                    "bench-owner", None, None, None, timeout=30)
+                if granted is None or isinstance(granted, dict):
+                    continue
+            pool.get(addr).call("return_lease", granted[1], timeout=30)
+            done += 1
+            if not used_head:
+                direct += 1
+        dt = time.perf_counter() - t0
+        for bid, _addr, _rem in blocks.values():
+            sim.client.call("lease_block_revoke", bid, timeout=30)
+        return {"tasks_per_s": round(done / dt, 1) if dt else None,
+                "bypass_rate": round(direct / done, 4) if done else None,
+                "head_rpcs_per_task": round(head_rpcs / done, 4)
+                if done else None,
+                "completed": done}
+
+    head_path = storm(use_blocks=False)
+    block_path = storm(use_blocks=True)
+    pool.close_all()
+
     # Cluster-wide lease census (fan-out to all N nodes).
     t = time.perf_counter()
     census = sim.client.call("cluster_leases", timeout=60)
@@ -2014,6 +2105,12 @@ def scale_child_main() -> int:
         "head_census_ms": round(census_ms, 1),
         "head_census_errors": census_errors,
         "head_drain_scrub_ms": round(drain_ms, 1),
+        "storm_tasks_per_s": block_path["tasks_per_s"],
+        "storm_tasks_per_s_headpath": head_path["tasks_per_s"],
+        "head_dispatch_bypass_rate": block_path["bypass_rate"],
+        "head_rpcs_per_task": block_path["head_rpcs_per_task"],
+        "head_rpcs_per_task_headpath": head_path["head_rpcs_per_task"],
+        "storm_tasks_completed": block_path["completed"],
         "heartbeats_processed": hb_count,
         "head_heartbeat_handler_us_avg": round(
             hb.get("total_s", 0.0) / hb_count * 1e6, 1) if hb_count else None,
@@ -2026,12 +2123,17 @@ def scale_child_main() -> int:
 
 
 def _scale_rows() -> list:
+    # 1000-node runs (RTPU_SCALE_NODES=1000) boot 10x the node threads
+    # and heartbeat fan-in: give the child a proportionally wider window.
+    timeout_s = SCALE_TIMEOUT_S
+    if int(os.environ.get("RTPU_SCALE_NODES", "100")) >= 500:
+        timeout_s = SCALE_TIMEOUT_S * 4
     try:
-        proc = _run(["--scale-child"], SCALE_TIMEOUT_S,
+        proc = _run(["--scale-child"], timeout_s,
                     env_extra={"JAX_PLATFORMS": "cpu"})
     except subprocess.TimeoutExpired:
         return [{"metric": "head_scale",
-                 "error": f"timeout {SCALE_TIMEOUT_S}s"}]
+                 "error": f"timeout {timeout_s}s"}]
     lines = _json_lines(proc.stdout)
     if lines and proc.returncode == 0:
         return lines
@@ -2847,6 +2949,11 @@ def main() -> int:
         merged[f"head_dispatch_us_p99_{suffix}"] = \
             sc["head_dispatch_us_p99"]
         merged[f"head_census_ms_{suffix}"] = sc.get("head_census_ms")
+        for k in ("head_dispatch_bypass_rate", "storm_tasks_per_s",
+                  "storm_tasks_per_s_headpath", "head_rpcs_per_task",
+                  "head_rpcs_per_task_headpath"):
+            if sc.get(k) is not None:
+                merged[k] = sc[k]
     elif sc:
         merged["scale_error"] = sc["error"]
     dg = next((r for r in dag_rows if r.get("metric") == "dag_channel"),

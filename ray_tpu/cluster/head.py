@@ -53,6 +53,24 @@ class _TransientReservationFailure(Exception):
     """A node rejected a bundle after local re-check; retry placement."""
 
 
+class _DirShard:
+    """One oid-hash partition of the head object directory. Each shard
+    carries its OWN lock: directory churn (object_batch frames from every
+    node/owner) contends on shard locks, never on the scheduler-critical
+    head lock — and two frames touching different shards apply fully in
+    parallel."""
+
+    __slots__ = ("lock", "object_dir", "node_objects", "object_sizes")
+
+    def __init__(self, idx: int):
+        self.lock = make_lock(f"head._dir_shard{idx}")
+        self.object_dir: Dict[bytes, Set[str]] = {}
+        # node -> resident oids WITHIN this shard (drain/death scrub
+        # walks only this node's entries per shard, O(touched)).
+        self.node_objects: Dict[str, Set[bytes]] = {}
+        self.object_sizes: Dict[bytes, int] = {}
+
+
 # Actor states (reference: src/ray/design_docs/actor_states.rst)
 PENDING = "PENDING_CREATION"
 ALIVE = "ALIVE"
@@ -79,6 +97,10 @@ class NodeInfo:
         # _score_nodes_ex scan was the head's hottest loop and its
         # longest _lock hold — bench.py --scale measures it).
         self.util = 0.0
+        # Position in the head's utilization-bucket index (-1 = not
+        # indexed: dead, or replaced by a re-registration). Managed by
+        # HeadServer._rebucket under the head lock.
+        self.util_bucket = -1
         self.recompute_util()
 
     def recompute_util(self) -> None:
@@ -137,20 +159,37 @@ class HeadServer:
         self._actors: Dict[bytes, ActorInfo] = {}
         self._named: Dict[Tuple[str, str], bytes] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
-        self._object_dir: Dict[bytes, Set[str]] = {}
-        # Reverse index node -> its resident oids: node death and drain
-        # scrub ONLY that node's entries instead of walking the whole
-        # directory under the scheduler lock (O(all objects) per death —
-        # at 100 nodes x 20k objects the full-table walk was a
-        # triple-digit-ms lock hold; bench.py --scale measures it).
-        self._node_objects: Dict[str, Set[bytes]] = {}
-        # Sealed sizes alongside the holder sets: the scheduler scores
-        # candidate nodes by locally-resident input BYTES, not object
-        # counts (reference: the GCS object directory the raylet's
-        # locality-aware lease policy reads).
-        self._object_sizes: Dict[bytes, int] = {}
+        # Object directory, sharded by oid hash (_DirShard): holder sets,
+        # per-node reverse index, and sealed sizes (the scheduler scores
+        # candidate nodes by locally-resident input BYTES — reference:
+        # the GCS object directory the raylet's locality-aware lease
+        # policy reads). Directory traffic takes ONLY the touched shards'
+        # locks; the merged `_object_dir`/`_node_objects`/`_object_sizes`
+        # PROPERTIES below exist for introspection/tests and are O(all
+        # objects) per read — never use them on a hot path.
+        self._dir_shards = [
+            _DirShard(i) for i in range(max(1, int(cfg.object_dir_shards)))]
+        # Per-node directory sync cursor: the highest journal seq this
+        # head has APPLIED from each node's object_batch stream. The
+        # heartbeat compares it against the node's dir_seq and NACKs
+        # ("dir_resync", cursor) on a gap, so a node republishes only
+        # the journal tail the head actually missed — O(touched), not
+        # O(objects on node). Own lock: cursor updates ride the
+        # object_batch path, which must not take the scheduler lock.
+        self._dir_cursors: Dict[str, int] = {}
+        self._dir_cursor_lock = make_lock("head._dir_cursor_lock")
         self._locality_hits = 0
         self._locality_misses = 0
+        # Utilization-bucket index over ALIVE nodes (guarded by _lock):
+        # bucket i holds nodes with util in [i/NB, (i+1)/NB). The pick
+        # hot path walks buckets (descending for pack, ascending for
+        # spread) and stops at the FIRST feasible node instead of
+        # filter+sort over every node per pick — O(nodes examined), not
+        # O(N log N). Maintenance is O(1) per heartbeat (_rebucket);
+        # the READ path is gated on cfg.head_index_min_nodes so small
+        # clusters keep the byte-identical _score_nodes_ex ranking.
+        self._util_buckets: List[Dict[str, NodeInfo]] = [
+            {} for _ in range(32)]
         self._pgs: Dict[bytes, Dict[str, Any]] = {}
         self._subscribers: Dict[str, List[Any]] = {}  # channel -> [conn]
         self._job_counter = 1
@@ -182,6 +221,25 @@ class HeadServer:
         # register-time cap below.
         self._channels: "_collections.OrderedDict[bytes, dict]" = \
             _collections.OrderedDict()
+        # Reverse channel indexes (owner addr / host node -> channel
+        # ids): the death/drain scrub flips only the dead entity's
+        # registrations instead of walking all _CHANNELS_MAX entries
+        # per report. Maintained by register/unregister/evict under
+        # _lock; exact-equivalent to the full walk.
+        self._channels_by_owner: Dict[str, Set[bytes]] = {}
+        self._channels_by_node: Dict[str, Set[bytes]] = {}
+        # Owner-routed lease blocks (steady-state head bypass): after the
+        # first head-mediated pick for a scheduling key the owner gets a
+        # pre-negotiated block (node, count, TTL) and dispatches repeat
+        # leases node-direct. The head keeps PLACEMENT POLICY — it picks
+        # the node, sets the size/TTL, and revokes on drain/death — while
+        # the node keeps ADMISSION (it decrements the block per lease).
+        # block_id -> {owner, node_id, node_addr, resources, size,
+        # ttl_ms, expires_at}; the two reverse indexes make drain/death
+        # revocation O(blocks on that node / owner), never a full walk.
+        self._lease_blocks: Dict[str, dict] = {}
+        self._node_blocks: Dict[str, Set[str]] = {}
+        self._owner_blocks: Dict[str, Set[str]] = {}
         # submitter id -> (monotonic, [(resources, count)]) backlog reports
         self._backlogs: Dict[str, Tuple[float, list]] = {}
         # Cluster-wide task-event ring (reference: GcsTaskManager,
@@ -347,12 +405,42 @@ class HeadServer:
 
     # ------------------------------------------------------------- nodes
 
+    def _rebucket(self, n: NodeInfo) -> None:
+        """Move a node to the util bucket matching its current state
+        (dead -> out of the index entirely). Caller holds self._lock.
+        O(1): two dict ops when the bucket changed, none when it
+        didn't — heartbeats mostly oscillate within one bucket."""
+        nb = len(self._util_buckets)
+        want = min(nb - 1, int(n.util * nb)) if n.alive else -1
+        if want == n.util_bucket:
+            return
+        if n.util_bucket >= 0:
+            self._util_buckets[n.util_bucket].pop(n.node_id, None)
+        if want >= 0:
+            self._util_buckets[want][n.node_id] = n
+        n.util_bucket = want
+
     def rpc_register_node(self, conn, node_id: str, address: str,
                           resources: Dict[str, float], labels: Dict[str, str],
                           store_name: str):
         with self._lock:
+            old = self._nodes.get(node_id)
+            if old is not None:
+                # Re-registration replaces the NodeInfo object: the old
+                # one must leave the bucket index or picks would keep
+                # scoring a phantom.
+                old.alive = False
+                self._rebucket(old)
             self._nodes[node_id] = NodeInfo(node_id, address, resources,
                                             labels, store_name)
+            self._rebucket(self._nodes[node_id])
+        # Fresh registration starts the directory sync from cursor 0: a
+        # node re-registering after a HEAD restart sees the gap on its
+        # next heartbeat ("dir_resync", 0) and republishes; a node
+        # PROCESS restart (dir_seq reset to 0) must not inherit the old
+        # process's cursor and skip its rehydration.
+        with self._dir_cursor_lock:
+            self._dir_cursors.pop(node_id, None)
         self._publish("NODE", {"event": "added", "node_id": node_id})
         # Truthy for legacy callers; nodes compare it across re-registers
         # to detect a head restart (era change -> republish holder sets,
@@ -361,12 +449,22 @@ class HeadServer:
 
     def rpc_heartbeat(self, conn, node_id: str, available: Dict[str, float],
                       version: Optional[int] = None,
-                      is_delta: bool = False):
+                      is_delta: bool = False,
+                      dir_seq: Optional[int] = None):
         """Versioned resource sync (reference: ray_syncer's versioned
         NodeState views, common/ray_syncer/ray_syncer.h:83): a delta
         carries only the resources whose availability CHANGED since the
         last acked version. Version gaps (head restart, lost beat) NACK
-        with "resync" and the node's next beat is a full snapshot."""
+        with "resync" and the node's next beat is a full snapshot.
+
+        ``dir_seq`` piggybacks the node's directory-journal position: a
+        gap against this head's applied cursor acks
+        ("dir_resync", cursor) — the node replays only the journal tail
+        past the cursor (or a full snapshot if its journal no longer
+        reaches back that far). The ack still counts as True for the
+        resource versioning above; replayed entries are idempotent, so a
+        beat racing in-flight object_batch frames costs a redundant
+        tail, never a wrong directory."""
         with self._lock:
             n = self._nodes.get(node_id)
             if n is None:
@@ -385,6 +483,12 @@ class HeadServer:
                 n.sync_version = version
             if not n.alive:
                 n.alive = True  # node recovered
+            self._rebucket(n)
+        if dir_seq is not None:
+            with self._dir_cursor_lock:
+                cur = self._dir_cursors.get(node_id, 0)
+            if cur < dir_seq:
+                return ("dir_resync", cur)
         return True
 
     @staticmethod
@@ -469,27 +573,73 @@ class HeadServer:
         """Graceful removal (autoscaler downscale)."""
         with self._lock:
             n = self._nodes.pop(node_id, None)
+            if n is not None:
+                n.alive = False
+                self._rebucket(n)
             # Its object copies leave with it: scrub directory entries
             # (same cleanup as node death) so pullers don't dial a
             # drained node and the locality scorer doesn't credit it.
             self._scrub_node_objects(node_id)
             self._scrub_channels(node_id=node_id)
+            doomed = self._pop_blocks(node_id=node_id)
+        # Notify the node: a draining node is still alive and would
+        # otherwise keep admitting owner-direct leases against its blocks
+        # until TTL — owners must fall back to a head pick immediately.
+        self._notify_blocks_revoked(doomed)
         if n is not None:
             self._publish("NODE", {"event": "removed", "node_id": node_id})
         return True
 
+    def _shard_for(self, oid: bytes) -> _DirShard:
+        import zlib
+
+        return self._dir_shards[zlib.crc32(oid) % len(self._dir_shards)]
+
+    # Merged directory views (introspection / tests / state API): one
+    # materialized dict per read, O(all objects). Production paths go
+    # through _shard_for and touch only the implicated shards.
+    @property
+    def _object_dir(self) -> Dict[bytes, Set[str]]:
+        out: Dict[bytes, Set[str]] = {}
+        for sh in self._dir_shards:
+            with sh.lock:
+                out.update(sh.object_dir)
+        return out
+
+    @property
+    def _node_objects(self) -> Dict[str, Set[bytes]]:
+        out: Dict[str, Set[bytes]] = {}
+        for sh in self._dir_shards:
+            with sh.lock:
+                for nid, oids in sh.node_objects.items():
+                    out.setdefault(nid, set()).update(oids)
+        return out
+
+    @property
+    def _object_sizes(self) -> Dict[bytes, int]:
+        out: Dict[bytes, int] = {}
+        for sh in self._dir_shards:
+            with sh.lock:
+                out.update(sh.object_sizes)
+        return out
+
     def _scrub_node_objects(self, node_id: str) -> None:
-        """Drop one node's directory entries via the reverse index —
-        O(objects on that node), never a full-table walk. Caller holds
-        self._lock."""
-        for oid in self._node_objects.pop(node_id, ()):
-            locs = self._object_dir.get(oid)
-            if locs is None:
-                continue
-            locs.discard(node_id)
-            if not locs:
-                del self._object_dir[oid]
-                self._object_sizes.pop(oid, None)
+        """Drop one node's directory entries via the per-shard reverse
+        index — O(shards + objects on that node), never a full-table
+        walk. Takes only shard locks (safe with or without self._lock:
+        shard locks are leaves)."""
+        for sh in self._dir_shards:
+            with sh.lock:
+                for oid in sh.node_objects.pop(node_id, ()):
+                    locs = sh.object_dir.get(oid)
+                    if locs is None:
+                        continue
+                    locs.discard(node_id)
+                    if not locs:
+                        del sh.object_dir[oid]
+                        sh.object_sizes.pop(oid, None)
+        with self._dir_cursor_lock:
+            self._dir_cursors.pop(node_id, None)
 
     def rpc_list_nodes(self, conn):
         with self._lock:
@@ -520,12 +670,14 @@ class HeadServer:
                 for n in self._nodes.values():
                     if n.alive and now - n.last_heartbeat > threshold:
                         n.alive = False
+                        self._rebucket(n)
                         dead_nodes.append(n.node_id)
             for node_id in dead_nodes:
                 _flight.record("node_dead", node=node_id[:12])
                 self._publish("NODE", {"event": "dead", "node_id": node_id})
                 self._on_node_dead(node_id)
             self._sweep_alive_watch()
+            self._sweep_expired_blocks()
 
     def _on_node_dead(self, node_id: str) -> None:
         with self._lock:
@@ -538,6 +690,10 @@ class HeadServer:
             # Channel endpoints hosted on the node died with it: flip
             # them so blocked writers see peer death, not a blind stall.
             self._scrub_channels(node_id=node_id)
+            # Its lease blocks died with it too — scrub, no notify (there
+            # is nothing to dial). Owners dispatching against the dead
+            # block hit ConnectionLost and fall back to a head pick.
+            self._pop_blocks(node_id=node_id)
         for a in victims:
             self._actor_died(a, f"node {node_id} died", try_restart=True)
 
@@ -592,6 +748,49 @@ class HeadServer:
                 return below, False
             feasible.sort(key=lambda n: (n.util, n.node_id))
             return feasible, False
+
+    def _pick_first_fit(self, resources: Dict[str, float],
+                        exclude: Set[str]):
+        """Indexed pick for the default (no-strategy) path: walk the
+        util buckets in the hybrid policy's preference order and stop at
+        the FIRST feasible node — highest-feasible-under-threshold
+        bucket (pack), lowest-feasible bucket (spread), lowest
+        total-fit bucket (saturated fallback). Preference is resolved at
+        BUCKET granularity (1/nb util): within a bucket, insertion
+        order wins rather than an exact util sort — all members are
+        within one bucket width of each other, and a per-pick
+        sorted(bucket) at 1000 idle nodes (everyone in bucket 0) was
+        itself the O(N) scan this index exists to remove. The pack
+        dynamics are preserved: the picked node's util rises, the
+        heartbeat rebuckets it upward, and the higher bucket stays
+        preferred. Caller holds self._lock. Returns
+        (node_or_None, saturated)."""
+        def fits(n, pool):
+            return (n.node_id not in exclude
+                    and all(pool(n).get(k, 0) >= v
+                            for k, v in resources.items() if v > 0))
+
+        thresh = cfg.scheduler_spread_threshold
+        nb = len(self._util_buckets)
+        # Pack: feasible node in the highest bucket with util < thresh.
+        for bi in range(min(nb - 1, int(thresh * nb)), -1, -1):
+            for n in self._util_buckets[bi].values():
+                if n.util < thresh and fits(n, lambda n: n.available):
+                    return n, False
+        # Spread: least-util feasible (every feasible node is >= thresh
+        # here, or pack would have returned it).
+        for bucket in self._util_buckets:
+            for n in bucket.values():
+                if fits(n, lambda n: n.available):
+                    return n, False
+        # Saturated: lowest-bucket node whose TOTAL capacity fits, so
+        # the lease request queues there instead of the submitter
+        # churning.
+        for bucket in self._util_buckets:
+            for n in bucket.values():
+                if fits(n, lambda n: n.total):
+                    return n, True
+        return None, False
 
     def rpc_pick_node(self, conn, resources: Dict[str, float],
                       strategy: Optional[Dict[str, Any]] = None,
@@ -696,15 +895,35 @@ class HeadServer:
                     self._spread_rr += 1
                     return n.node_id, n.address, n.store_name
                 return None
-        ranked, saturated = self._score_nodes_ex(resources, exclude_set)
-        if not ranked:
-            self._unmet_demand.append(
-                (time.monotonic(), dict(resources), demand_key))
-            return None
-        if saturated:
-            # Demand exceeds current capacity (autoscaler signal).
-            self._unmet_demand.append(
-                (time.monotonic(), dict(resources), demand_key))
+        ranked = None
+        with self._lock:
+            if len(self._nodes) >= cfg.head_index_min_nodes:
+                # Large cluster: the bucket index answers the hybrid
+                # choice without ranking every node; a hinted pick then
+                # re-ranks only the HOLDER set in _apply_locality, so
+                # the whole pick is O(buckets + holders), not O(N).
+                n, saturated = self._pick_first_fit(resources,
+                                                    exclude_set)
+                if n is None or saturated:
+                    self._unmet_demand.append(
+                        (time.monotonic(), dict(resources),
+                         demand_key))
+                if n is None:
+                    return None
+                if not input_objects:
+                    return n.node_id, n.address, n.store_name
+                ranked = [n]
+        if ranked is None:
+            ranked, saturated = self._score_nodes_ex(resources,
+                                                     exclude_set)
+            if not ranked:
+                self._unmet_demand.append(
+                    (time.monotonic(), dict(resources), demand_key))
+                return None
+            if saturated:
+                # Demand exceeds current capacity (autoscaler signal).
+                self._unmet_demand.append(
+                    (time.monotonic(), dict(resources), demand_key))
         n = ranked[0]
         if input_objects:
             # In the saturated fallback the lease QUEUES at the picked
@@ -737,39 +956,66 @@ class HeadServer:
         still the right pick — the lease request QUEUES there for
         `scheduler_locality_wait_ms` and only then spills back (waiting
         out one task beats migrating the input bytes)."""
-        candidates = list(ranked)
-        seen = {n.node_id for n in candidates}
-        with self._lock:
-            for n in self._nodes.values():
-                if (n.node_id not in seen and n.alive
-                        and n.node_id not in exclude
-                        and all(n.total.get(k, 0) >= v
-                                for k, v in resources.items() if v > 0)):
-                    candidates.append(n)
-        if len(candidates) < 2:
-            return ranked[0]
-        with self._lock:
-            local_bytes: Dict[str, int] = {}
-            for oid in input_objects:
-                holders = self._object_dir.get(oid)
+        local_bytes: Dict[str, int] = {}
+        for oid in input_objects:
+            sh = self._shard_for(oid)
+            with sh.lock:
+                holders = sh.object_dir.get(oid)
                 if not holders:
                     continue
-                size = self._object_sizes.get(oid, 1)
+                size = sh.object_sizes.get(oid, 1)
                 for nid in holders:
                     local_bytes[nid] = local_bytes.get(nid, 0) + size
         if not local_bytes:
             return ranked[0]
-        order = {n.node_id: i for i, n in enumerate(candidates)}
-        best = max(candidates, key=lambda n: (local_bytes.get(n.node_id, 0),
-                                              -order[n.node_id]))
-        if local_bytes.get(best.node_id, 0) <= 0:
-            return ranked[0]
+        with self._lock:
+            indexed = len(self._nodes) >= cfg.head_index_min_nodes
+            if indexed:
+                # O(holders) fast path: only a node that actually HOLDS
+                # input bytes can beat ranked[0], so the candidate scan
+                # is the holder set, not the whole cluster.
+                order = {n.node_id: i for i, n in enumerate(ranked)}
+                far = len(ranked)
+                candidates = [
+                    n for n in (self._nodes.get(nid)
+                                for nid in local_bytes)
+                    if n is not None and n.alive
+                    and n.node_id not in exclude
+                    and all(n.total.get(k, 0) >= v
+                            for k, v in resources.items() if v > 0)]
+                if not candidates:
+                    return ranked[0]
+                best = max(candidates,
+                           key=lambda n: (local_bytes[n.node_id],
+                                          -order.get(n.node_id, far),
+                                          n.node_id))
+            else:
+                candidates = list(ranked)
+                seen = {n.node_id for n in candidates}
+                for n in self._nodes.values():
+                    if (n.node_id not in seen and n.alive
+                            and n.node_id not in exclude
+                            and all(n.total.get(k, 0) >= v
+                                    for k, v in resources.items()
+                                    if v > 0)):
+                        candidates.append(n)
+        if not indexed:
+            if len(candidates) < 2:
+                return ranked[0]
+            order = {n.node_id: i for i, n in enumerate(candidates)}
+            best = max(candidates,
+                       key=lambda n: (local_bytes.get(n.node_id, 0),
+                                      -order[n.node_id]))
+            if local_bytes.get(best.node_id, 0) <= 0:
+                return ranked[0]
         # Lazy: the feasibility probe is only needed for the spill check
-        # (most hinted picks return before here; the head is single-
-        # threaded for scheduling — don't scan nodes twice per pick).
+        # (most hinted picks return before here). `best` is already
+        # alive and not excluded (candidate filters above), so probing
+        # ITS availability directly replaces the full _feasible_nodes
+        # scan — O(resources), not O(N), per pick.
         if (best is not ranked[0] and not relax_spill
-                and any(n.node_id == best.node_id
-                        for n in self._feasible_nodes(resources, exclude))
+                and all(best.available.get(k, 0) >= v
+                        for k, v in resources.items() if v > 0)
                 and best.util
                 >= cfg.scheduler_locality_spill_threshold):
             # Spillback: the holder has capacity RIGHT NOW yet is loaded
@@ -782,6 +1028,192 @@ class HeadServer:
         with self._lock:
             self._locality_hits += 1
         return best
+
+    # -------------------------------------------------------- lease blocks
+
+    def _grant_block(self, block_id: str, owner_addr: str,
+                     resources: Dict[str, float],
+                     strategy: Optional[Dict[str, Any]],
+                     locality_hint: Optional[List[bytes]],
+                     prefer_node: Optional[str]):
+        """Pick a node, install the block THERE first (the admitting side
+        must hold it before the owner dispatches against it), then record
+        it in the head tables. Idempotent on block_id: a retried grant
+        returns the SAME (node_id, node_addr, size, ttl_ms) tuple —
+        double-granting would double the admission budget."""
+        if not cfg.lease_block_enabled:
+            return None
+        with self._lock:
+            ent = self._lease_blocks.get(block_id)
+            if ent is not None:
+                return (ent["node_id"], ent["node_addr"],
+                        ent["size"], ent["ttl_ms"])
+        picked = None
+        if prefer_node:
+            # Renewal affinity: keep the key's tasks on the node that
+            # already hosts its leases/workers if it still fits by TOTAL
+            # capacity (a momentarily-busy node still admits — the lease
+            # queues there like any saturated pick).
+            with self._lock:
+                n = self._nodes.get(prefer_node)
+                if (n is not None and n.alive
+                        and all(n.total.get(k, 0) >= v
+                                for k, v in resources.items() if v > 0)):
+                    picked = (n.node_id, n.address, n.store_name)
+        if picked is None:
+            picked = self.rpc_pick_node(None, resources, strategy, None,
+                                        ("lease_block", owner_addr),
+                                        locality_hint)
+        if picked is None:
+            return None
+        node_id, node_addr, _store = picked
+        size = int(cfg.lease_block_size)
+        ttl_ms = int(cfg.lease_block_ttl_ms)
+        try:
+            ok = self._pool.get(node_addr).retrying_call(
+                "lease_block_install", block_id, owner_addr,
+                dict(resources), size, ttl_ms,
+                timeout=cfg.rpc_control_timeout_s)
+        except Exception as e:
+            logger.debug("lease block %s install at %s failed: %r",
+                         block_id[:12], node_addr, e)
+            ok = False
+        if not ok:
+            return None
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is None or not n.alive:
+                # Node died/drained between pick and install: the install
+                # either never landed or will die with the node — don't
+                # record a block the death path can no longer see.
+                node_gone = True
+            else:
+                node_gone = False
+                self._lease_blocks[block_id] = {
+                    "owner": owner_addr, "node_id": node_id,
+                    "node_addr": node_addr, "resources": dict(resources),
+                    "size": size, "ttl_ms": ttl_ms,
+                    "expires_at": time.monotonic() + ttl_ms / 1000.0}
+                self._node_blocks.setdefault(node_id, set()).add(block_id)
+                self._owner_blocks.setdefault(owner_addr, set()).add(block_id)
+        if node_gone:
+            try:
+                self._pool.get(node_addr).retrying_call(
+                    "lease_block_revoke", block_id, timeout=2)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort: the node is dead or dying; its TTL sweep releases the block
+                pass
+            return None
+        _flight.record("lease_block_grant", block=block_id[:12],
+                       node=node_id[:12])
+        return (node_id, node_addr, size, ttl_ms)
+
+    def rpc_lease_block_grant(self, conn, block_id: str, owner_addr: str,
+                              resources: Dict[str, float],
+                              strategy: Optional[Dict[str, Any]] = None,
+                              locality_hint: Optional[List[bytes]] = None):
+        """First grant for a scheduling key. Returns (node_id, node_addr,
+        size, ttl_ms) or None (infeasible / blocks disabled) — None means
+        the owner stays on the per-lease pick_node path."""
+        return self._grant_block(block_id, owner_addr, resources, strategy,
+                                 locality_hint, prefer_node=None)
+
+    def rpc_lease_block_renew(self, conn, block_id: str, owner_addr: str,
+                              resources: Dict[str, float],
+                              prev_node_id: Optional[str] = None,
+                              strategy: Optional[Dict[str, Any]] = None):
+        """Low-water renewal: a NEW block_id per renewal (the memo keys on
+        it), preferring the previous node so a hot key's placement stays
+        sticky while the head retains the option to move it."""
+        return self._grant_block(block_id, owner_addr, resources, strategy,
+                                 None, prefer_node=prev_node_id)
+
+    def rpc_lease_block_revoke(self, conn, block_id: str):
+        """Owner-initiated release (shutdown, key went idle). Idempotent:
+        revoking an unknown/already-revoked block is True."""
+        self._revoke_blocks([block_id], notify=True)
+        return True
+
+    def _pop_blocks(self, *, node_id: Optional[str] = None,
+                    owner: Optional[str] = None) -> List[Tuple[str, str]]:
+        """Drop every block on a node / owned by an owner from the head
+        tables via the reverse indexes — O(blocks implicated), never a
+        full-table walk. Caller holds self._lock; returns
+        (block_id, node_addr) pairs for out-of-lock node notification."""
+        if node_id is not None:
+            ids = self._node_blocks.pop(node_id, set())
+        else:
+            ids = self._owner_blocks.pop(owner, set())
+        out: List[Tuple[str, str]] = []
+        for bid in ids:
+            ent = self._lease_blocks.pop(bid, None)
+            if ent is None:
+                continue
+            out.append((bid, ent["node_addr"]))
+            if node_id is not None:
+                ob = self._owner_blocks.get(ent["owner"])
+                if ob is not None:
+                    ob.discard(bid)
+                    if not ob:
+                        del self._owner_blocks[ent["owner"]]
+            else:
+                nb = self._node_blocks.get(ent["node_id"])
+                if nb is not None:
+                    nb.discard(bid)
+                    if not nb:
+                        del self._node_blocks[ent["node_id"]]
+        return out
+
+    def _notify_blocks_revoked(self, targets: List[Tuple[str, str]]) -> None:
+        """Best-effort node notification for already-scrubbed blocks (the
+        node's TTL sweep is the backstop for a lost notify). One TOTAL
+        deadline across the fan-out: N unreachable nodes must not
+        serialize N control timeouts inside a death/drain report."""
+        deadline = time.monotonic() + cfg.rpc_control_timeout_s
+        for bid, addr in targets:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break  # the nodes' TTL sweeps reclaim the rest
+            try:
+                self._pool.get(addr).retrying_call("lease_block_revoke",
+                                                   bid,
+                                                   timeout=min(2.0, left))
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort: an unreachable node expires the block by TTL
+                pass
+
+    def _revoke_blocks(self, block_ids: List[str], notify: bool) -> None:
+        """Tear down blocks by id: scrub head tables, then (if the node
+        is presumed alive) tell it to stop admitting. Notification is
+        best-effort — the node's TTL sweep is the backstop."""
+        targets: List[Tuple[str, str]] = []
+        with self._lock:
+            for bid in block_ids:
+                ent = self._lease_blocks.pop(bid, None)
+                if ent is None:
+                    continue
+                nb = self._node_blocks.get(ent["node_id"])
+                if nb is not None:
+                    nb.discard(bid)
+                    if not nb:
+                        del self._node_blocks[ent["node_id"]]
+                ob = self._owner_blocks.get(ent["owner"])
+                if ob is not None:
+                    ob.discard(bid)
+                    if not ob:
+                        del self._owner_blocks[ent["owner"]]
+                if notify:
+                    targets.append((bid, ent["node_addr"]))
+        self._notify_blocks_revoked(targets)
+
+    def _sweep_expired_blocks(self) -> None:
+        """Health-lap backstop: drop head-side records for blocks past
+        their TTL (the node refuses + releases them independently, so no
+        notify — this only keeps the head tables O(live blocks))."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [bid for bid, ent in self._lease_blocks.items()
+                       if now > ent["expires_at"]]
+        if expired:
+            self._revoke_blocks(expired, notify=False)
 
     # ------------------------------------------------------------- actors
 
@@ -988,6 +1420,11 @@ class HeadServer:
             victims = [a for a in self._actors.values()
                        if a.worker_addr == worker_addr and a.state == ALIVE]
             self._scrub_channels(owner=worker_addr)
+            # Blocks OWNED by the dead process are admission budget nobody
+            # will ever spend: release them at the nodes now so the lease
+            # census drains to zero without waiting out the TTL.
+            doomed = self._pop_blocks(owner=worker_addr)
+        self._notify_blocks_revoked(doomed)
         for a in victims:
             self._actor_died(a, "worker process died", try_restart=True)
         return True
@@ -1004,15 +1441,36 @@ class HeadServer:
         Idempotent: re-registering the same channel overwrites (a
         respawned reader re-announces itself)."""
         with self._lock:
-            self._channels[channel_id] = {
+            old = self._channels.get(channel_id)
+            if old is not None:
+                self._channel_index_drop(channel_id, old)
+            self._channels[channel_id] = ent = {
                 "addr": addr, "owner": owner, "node_id": node_id,
                 "alive": True, "ts": time.time()}
+            self._channel_index_add(channel_id, ent)
             self._channels.move_to_end(channel_id)
             while len(self._channels) > self._CHANNELS_MAX:
-                self._channels.popitem(last=False)
+                cid, evicted = self._channels.popitem(last=False)
+                self._channel_index_drop(cid, evicted)
         _flight.record("channel_register", ch=channel_id.hex()[:12],
                        addr=addr)
         return True
+
+    def _channel_index_add(self, cid: bytes, ent: dict) -> None:
+        self._channels_by_owner.setdefault(
+            ent.get("owner", ""), set()).add(cid)
+        self._channels_by_node.setdefault(
+            ent.get("node_id", ""), set()).add(cid)
+
+    def _channel_index_drop(self, cid: bytes, ent: dict) -> None:
+        for idx, key in ((self._channels_by_owner, ent.get("owner", "")),
+                         (self._channels_by_node,
+                          ent.get("node_id", ""))):
+            s = idx.get(key)
+            if s is not None:
+                s.discard(cid)
+                if not s:
+                    del idx[key]
 
     def rpc_channel_lookup(self, conn, channel_id: bytes):
         """Endpoint + liveness for one channel (None = never
@@ -1027,7 +1485,9 @@ class HeadServer:
         """Graceful reader teardown. Idempotent — unregistering an
         unknown channel is True (the state 'not registered' holds)."""
         with self._lock:
-            self._channels.pop(channel_id, None)
+            ent = self._channels.pop(channel_id, None)
+            if ent is not None:
+                self._channel_index_drop(channel_id, ent)
         return True
 
     def _scrub_channels(self, owner: Optional[str] = None,
@@ -1036,11 +1496,17 @@ class HeadServer:
         registrations owned by a dead worker/node to alive=False so
         writers blocked mid-transfer learn the peer died instead of
         timing out blind. Entries stay (bounded by the register cap)
-        so lookup can still ANSWER with the death verdict."""
-        for ent in self._channels.values():
-            if owner is not None and ent.get("owner", "") == owner:
-                ent["alive"] = False
-            elif node_id is not None and ent.get("node_id") == node_id:
+        so lookup can still ANSWER with the death verdict. The reverse
+        indexes bound the walk to the dead entity's own registrations
+        (one death report used to sweep all _CHANNELS_MAX entries)."""
+        cids: Set[bytes] = set()
+        if owner is not None:
+            cids |= self._channels_by_owner.get(owner, set())
+        if node_id is not None:
+            cids |= self._channels_by_node.get(node_id, set())
+        for cid in cids:
+            ent = self._channels.get(cid)
+            if ent is not None:
                 ent["alive"] = False
 
     @blocking_rpc
@@ -1106,54 +1572,73 @@ class HeadServer:
     # state through them) and for wire compatibility. A NEW direct
     # notify of either from an outbox-owning module is a
     # direct-notify-bypasses-outbox lint finding.
+    @staticmethod
+    def _apply_dir_entries(sh: "_DirShard", node_id: str, entries) -> None:
+        """Apply one shard's slice of a directory batch. Caller holds
+        sh.lock. Idempotent per entry (set add/discard): a dir_resync
+        replay overlapping frames still in flight converges."""
+        node_set = sh.node_objects.setdefault(node_id, set())
+        for kind, oid, size in entries:
+            if kind == "add":
+                sh.object_dir.setdefault(oid, set()).add(node_id)
+                node_set.add(oid)
+                if size:
+                    sh.object_sizes[oid] = int(size)
+            else:
+                locs = sh.object_dir.get(oid)
+                if locs:
+                    locs.discard(node_id)
+                    if not locs:
+                        del sh.object_dir[oid]
+                        sh.object_sizes.pop(oid, None)
+                node_set.discard(oid)
+
     def rpc_object_added(self, conn, oid: bytes, node_id: str,
                          size: Optional[int] = None):
-        with self._lock:
-            self._object_dir.setdefault(oid, set()).add(node_id)
-            self._node_objects.setdefault(node_id, set()).add(oid)
-            if size:
-                self._object_sizes[oid] = int(size)
+        sh = self._shard_for(oid)
+        with sh.lock:
+            self._apply_dir_entries(sh, node_id, [("add", oid, size)])
         return True
 
     def rpc_object_removed(self, conn, oid: bytes, node_id: str):
-        with self._lock:
-            locs = self._object_dir.get(oid)
-            if locs:
-                locs.discard(node_id)
-                if not locs:
-                    del self._object_dir[oid]
-                    self._object_sizes.pop(oid, None)
-            no = self._node_objects.get(node_id)
-            if no is not None:
-                no.discard(oid)
+        sh = self._shard_for(oid)
+        with sh.lock:
+            self._apply_dir_entries(sh, node_id, [("rm", oid, None)])
         return True
 
-    def rpc_object_batch(self, conn, node_id: str, entries):
+    def rpc_object_batch(self, conn, node_id: str, entries,
+                         cursor: Optional[int] = None,
+                         snapshot: bool = False):
         """Batched directory updates from one owner/node: entries are
-        ("add", oid, size) / ("rm", oid, None) in submission order — one
-        frame + one lock acquisition per put burst instead of per object
-        (the per-put notify serialized multi-writer put throughput at the
-        head's dispatch path)."""
+        ("add", oid, size) / ("rm", oid, None) in submission order,
+        grouped by shard so a burst takes each touched shard's lock once
+        — and NEVER the scheduler lock. ``cursor`` is the node's journal
+        seq after this frame (advances the per-node sync cursor the
+        heartbeat audits); ``snapshot`` means the frame is a full mirror
+        republish — the node's previous entries are scrubbed first so a
+        post-restart rehydration can't resurrect departed objects."""
         if _rpcdbg.enabled():
             # RTPU_DEBUG_RPC: assert the node's directory stream arrived
             # in order (strips the sequence stamp).
             entries = _rpcdbg.check_outbox("head", entries)
-        with self._lock:
-            node_set = self._node_objects.setdefault(node_id, set())
-            for kind, oid, size in entries:
-                if kind == "add":
-                    self._object_dir.setdefault(oid, set()).add(node_id)
-                    node_set.add(oid)
-                    if size:
-                        self._object_sizes[oid] = int(size)
-                else:
-                    locs = self._object_dir.get(oid)
-                    if locs:
-                        locs.discard(node_id)
-                        if not locs:
-                            del self._object_dir[oid]
-                            self._object_sizes.pop(oid, None)
-                    node_set.discard(oid)
+        if snapshot:
+            with self._dir_cursor_lock:
+                self._dir_cursors.pop(node_id, None)
+            self._scrub_node_objects(node_id)
+        by_shard: Dict[int, list] = {}
+        nshards = len(self._dir_shards)
+        import zlib
+
+        for e in entries:
+            by_shard.setdefault(zlib.crc32(e[1]) % nshards, []).append(e)
+        for idx, es in by_shard.items():
+            sh = self._dir_shards[idx]
+            with sh.lock:
+                self._apply_dir_entries(sh, node_id, es)
+        if cursor is not None:
+            with self._dir_cursor_lock:
+                if cursor > self._dir_cursors.get(node_id, 0):
+                    self._dir_cursors[node_id] = cursor
         return True
 
     def rpc_object_locations(self, conn, oid: bytes,
@@ -1162,10 +1647,13 @@ class HeadServer:
         requester: holders sharing the requester's "zone" label sort
         ahead of cross-zone ones (the simulated-DCN distance signal), so
         a puller's first fetch attempt goes to the cheapest copy."""
+        sh = self._shard_for(oid)
+        with sh.lock:
+            holders = list(sh.object_dir.get(oid, ()))
         with self._lock:
             # Filter BEFORE sorting: a drained/unknown node id lingering
             # in the directory must not crash the lookup.
-            node_ids = [nid for nid in self._object_dir.get(oid, ())
+            node_ids = [nid for nid in holders
                         if nid in self._nodes and self._nodes[nid].alive]
             req = self._nodes.get(requester_node_id) \
                 if requester_node_id else None
@@ -1183,11 +1671,18 @@ class HeadServer:
     def rpc_scheduler_stats(self, conn):
         """Locality accounting for the head's pick decisions (the owner
         dispatch keeps its own counters; this one covers spillbacks)."""
+        objects = 0
+        obj_bytes = 0
+        for sh in self._dir_shards:
+            with sh.lock:
+                objects += len(sh.object_dir)
+                obj_bytes += sum(sh.object_sizes.values())
         with self._lock:
             return {"locality_hits": self._locality_hits,
                     "locality_misses": self._locality_misses,
-                    "objects_tracked": len(self._object_dir),
-                    "object_bytes_tracked": sum(self._object_sizes.values()),
+                    "objects_tracked": objects,
+                    "object_bytes_tracked": obj_bytes,
+                    "lease_blocks": len(self._lease_blocks),
                     "head_incarnation": self.incarnation}
 
     def _fanout_pool(self):

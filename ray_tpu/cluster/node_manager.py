@@ -9,7 +9,19 @@ object_manager.h:206,214 Push/Pull), re-architected:
 - worker pool: spawns `python -m ray_tpu.cluster.worker_main` processes,
   caches idle workers, reaps idle ones after `worker_pool_idle_ttl_s`
 - lease protocol: request_lease(resources) -> (worker_addr, lease_id) or
-  None (infeasible here -> caller spills back to another node via the head)
+  None (infeasible here -> caller spills back to another node via the head).
+  Steady state skips the head entirely: after the first head-mediated pick
+  for a scheduling key the head pushes a lease BLOCK here
+  (lease_block_install: block_id, owner, resources, count, TTL) and the
+  owner dispatches node-direct with request_lease(..., block_id=...) —
+  admission debits the block's remaining budget (credited back on a
+  decline/env failure), an unknown/expired/exhausted block answers
+  {"block_revoked": True} so the owner falls back to a head pick, and a
+  TTL sweep reaps blocks the head could no longer reach to revoke
+- directory sync: holder-set updates stream to the head as cursor-stamped
+  deltas from a bounded journal; a heartbeat ("dir_resync", cursor) ack
+  replays only the tail past the head's cursor (journal overflow or a
+  head restart rebases with a store-filtered snapshot)
 - placement-group bundle reservation (prepare+commit collapsed; the head
   drives the 2-phase dance and rollbacks)
 - object transfer: pull_object fetches a remote object via the owner node's
@@ -176,6 +188,27 @@ class _SimStore:
         pass
 
 
+class _SimProc:
+    """Popen-shaped stub behind a simulated node's lease grants: always
+    "alive", signals are no-ops. Lets the scale bench's task storm run
+    the REAL lease/block accounting (grant, return, census, witness)
+    without spawning a process per simulated lease."""
+
+    pid = -1
+
+    def poll(self) -> Optional[int]:
+        return None
+
+    def terminate(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+    def wait(self, timeout=None) -> int:
+        return 0
+
+
 class NodeManager:
     chaos_role = "node"  # fault-injection scope (devtools/chaos.py)
 
@@ -258,6 +291,19 @@ class NodeManager:
         # re-register would otherwise be unrecoverable — the head knows
         # the node again, so no further False-ack would ever retrigger).
         self._republish_needed = False
+        # Directory-journal cursor sync: every entry this node sends to
+        # the head gets a monotonically-increasing sequence number and a
+        # bounded journal copy; the head acks its applied cursor via the
+        # heartbeat ("dir_resync", cursor) when it falls behind (head
+        # restart, dropped frame). Recovery replays only the journal
+        # tail PAST the cursor — a full _store_filtered_mirror snapshot
+        # only when the journal no longer reaches back that far — so
+        # steady-state head directory cost is O(touched objects), not
+        # O(store) per resync. All three fields are guarded by
+        # _head_batch_lock (same lock that orders the wire stream).
+        self._dir_seq = 0
+        self._dir_journal = collections.deque()
+        self._head_dir_cursor = 0
         self.pull_stats: Dict[str, int] = {
             "bytes_pulled": 0, "pulls_started": 0, "pulls_completed": 0,
             "pulls_coalesced": 0, "multi_source_pulls": 0}
@@ -278,6 +324,15 @@ class NodeManager:
         # lease this node never granted or already reaped. Bounded FIFO.
         self._returned_leases: set = set()
         self._returned_order = collections.deque()
+        # Owner-routed lease blocks (head-granted admission budget):
+        # block_id -> {owner, resources, remaining, size, expires_at}.
+        # request_lease calls carrying a block_id admit against the
+        # budget without a head round-trip; an expired/exhausted/unknown
+        # block replies {"block_revoked": True} and the owner falls back
+        # to the normal head pick. Blocks are leases in the RES witness
+        # ("lease_block"): install acquires, revoke/expiry/shutdown
+        # release — the census must drain to zero.
+        self._lease_blocks: Dict[str, dict] = {}
         self._pool = ClientPool()
         self._server = RpcServer(self, host).start()
         self.address = self._server.address
@@ -368,6 +423,12 @@ class NodeManager:
     def shutdown(self) -> None:
         self._stop.set()
         self._hb_wake.set()  # release a heartbeat loop parked in wait()
+        with self._lock:
+            # Lease blocks die with the node: release them in the witness
+            # (the head scrubs its own tables via the death/drain path).
+            for bid in list(self._lease_blocks):
+                del self._lease_blocks[bid]
+                _resdbg.note_release("lease_block", bid)
         if self._metrics_exporter is not None:
             self._metrics_exporter.stop()
             self._metrics_exporter = None
@@ -447,13 +508,25 @@ class NodeManager:
                 # (threshold x period) expires — one lost packet became a
                 # false node death under RPC chaos.
                 acked = self._head.call("heartbeat", self.node_id, payload,
-                                        version, is_delta, timeout=period)
+                                        version, is_delta, self._dir_seq,
+                                        timeout=period)
                 _flight.record("hb", acked=str(acked), delta=is_delta)
                 beats += 1
                 sync_every = cfg.clock_sync_period_beats
                 if sync_every > 0 and beats % sync_every == 1 % sync_every:
                     self._sync_clock()
                     self._note_evictions()
+                if (isinstance(acked, tuple) and len(acked) == 2
+                        and acked[0] == "dir_resync"):
+                    # The head's directory cursor fell behind our
+                    # journal (dropped object_batch frame or a head that
+                    # restarted and re-learned us). Record ITS cursor so
+                    # _try_republish replays only the tail past it; the
+                    # beat itself succeeded, so resource versioning
+                    # proceeds as a normal True ack.
+                    self._head_dir_cursor = int(acked[1])
+                    self._republish_needed = True
+                    acked = True
                 if acked is True:
                     last_sent = avail
                     version += 1
@@ -487,6 +560,7 @@ class NodeManager:
             if self._republish_needed:
                 self._try_republish()
             self._check_worker_deaths()
+            self._sweep_expired_lease_blocks()
 
     def _sync_clock(self) -> None:
         """Heartbeat-RTT clock offset vs the head: one clock_probe RPC,
@@ -568,28 +642,59 @@ class NodeManager:
                             "(%s -> head:%s)", l.lease_id[:8], l.lessee,
                             new_inc)
                 self.rpc_return_lease(None, l.lease_id)
+        # A restarted head applied NONE of our journal: rebase the
+        # cursor to zero so the republish path replays from the journal
+        # floor (or snapshots past an overflow) rather than trusting the
+        # optimistic pre-restart cursor.
+        self._head_dir_cursor = 0
         self._republish_needed = True
         self._try_republish()
 
     def _try_republish(self) -> None:
-        """Push the store-filtered holder-set mirror to the head; retried
-        from the heartbeat loop until one publish succeeds (the head
-        acks True once it knows us again, so a failed send here has no
-        other retrigger). Entries evicted from the store since they
-        were mirrored are pruned rather than resurrected. MUST NOT
-        raise: the per-beat retry runs outside the heartbeat loop's
-        try/except, and a dead heartbeat thread reads as a dead node."""
+        """Re-sync the head's view of this node's holder set; retried
+        from the heartbeat loop until one publish succeeds. Three cases,
+        cheapest first, against the head's acked cursor:
+
+        1. cursor == dir_seq: nothing in flight was lost — done.
+        2. journal still reaches back to cursor+1: replay only the tail
+           PAST the cursor (O(touched objects), the steady-state path
+           for a dropped frame).
+        3. journal gap (head restart after long uptime, journal
+           overflow): full store-filtered-mirror snapshot with
+           snapshot=True so the head rebases this node's entries.
+
+        MUST NOT raise: the per-beat retry runs outside the heartbeat
+        loop's try/except, and a dead heartbeat thread reads as a dead
+        node."""
         try:
-            entries = [("add", oid, size)
-                       for oid, size in self._store_filtered_mirror()]
-            if entries:
-                self._head_object_batch(entries)
+            cursor = self._head_dir_cursor
+            with self._head_batch_lock:
+                seq = self._dir_seq
+                if self._dir_journal:
+                    floor = self._dir_journal[0][0]
+                    tail = [e for s, e in self._dir_journal if s > cursor]
+                else:
+                    floor, tail = seq + 1, []
+            if seq == cursor:
+                self._republish_needed = False
+                return
+            if floor <= cursor + 1:
+                if tail:
+                    self._head_object_batch(tail)
+            else:
+                entries = [("add", oid, size)
+                           for oid, size in self._store_filtered_mirror()]
+                # An EMPTY snapshot still has to reach the head: the
+                # scrub is what clears stale entries a restartless head
+                # holds for us past a journal overflow.
+                self._head_object_batch(entries, snapshot=True)
+            self._head_dir_cursor = self._dir_seq
             self._republish_needed = False
         except Exception as e:
             logger.debug("holder-set republish failed (will retry on "
                          "the next beat): %r", e)
 
-    def _head_object_batch(self, entries) -> None:
+    def _head_object_batch(self, entries, snapshot: bool = False) -> None:
         """The ONE sender of this node's object-directory frames to the
         head (republish, owner-batch forward, pull landings all route
         here): a single ordered stream per node means a head-side
@@ -599,6 +704,11 @@ class NodeManager:
         ``object_removed`` notifies from this module are an outbox
         bypass (the ``dist`` lint family flags them).
 
+        Every frame carries the journal cursor AFTER its entries;
+        ``snapshot=True`` tells the head to scrub this node's directory
+        entries first (full-mirror rebase when the journal can't bridge
+        the head's cursor gap).
+
         Stamp and send are atomic under one lock: heartbeat republish,
         per-peer forward threads, and pull landings all call here, and
         a seq assigned before losing the send race would put frames on
@@ -606,10 +716,25 @@ class NodeManager:
         owner-side flusher holds _obj_notify_flush_lock across its
         stamp+send for the same reason)."""
         with self._head_batch_lock:
+            entries = list(entries)
+            # Journal with FRESH seqs even on replay/snapshot resends
+            # (single journaling mode): ops are idempotent set add /
+            # discard at the head, so an overlap between a replayed tail
+            # and entries already applied converges — while a dual-path
+            # "don't re-journal resends" mode would have to prove the
+            # un-journaled frame can never itself be lost.
+            cap = max(1, int(cfg.object_dir_journal_max))
+            for e in entries:
+                self._dir_seq += 1
+                self._dir_journal.append((self._dir_seq, e))
+            while len(self._dir_journal) > cap:
+                self._dir_journal.popleft()
+            cursor = self._dir_seq
             if _rpcdbg.enabled():
                 entries = _rpcdbg.stamp_outbox(f"node:{self.node_id}",
-                                               list(entries))
-            self._head.notify("object_batch", self.node_id, entries)
+                                               entries)
+            self._head.notify("object_batch", self.node_id, entries,
+                              cursor, snapshot)
 
     def rpc_object_batch(self, conn, entries) -> bool:
         """Owner-side directory updates route THROUGH the node manager
@@ -1234,14 +1359,20 @@ class NodeManager:
                           req_id: Optional[str] = None,
                           lessee: Optional[str] = None,
                           runtime_env: Optional[Dict[str, Any]] = None,
-                          queue_block_ms: Optional[int] = None):
+                          queue_block_ms: Optional[int] = None,
+                          block_id: Optional[str] = None):
         """Returns (worker_addr, lease_id) or None if infeasible (spillback).
         `req_id` makes retries idempotent: the memo is CLAIMED before the
         (slow) worker pop, so a retry arriving mid-flight waits for the
         original outcome instead of double-acquiring resources.
         `queue_block_ms` overrides how long the request queues for
         resources before declining (locality-hinted requests wait a
-        shorter, configured window at a full holder)."""
+        shorter, configured window at a full holder).
+        `block_id` is the owner-routed steady-state path: the call admits
+        against a head-granted lease block instead of a fresh head pick —
+        an unknown/expired/exhausted block replies
+        {"block_revoked": True} (memoized like any grant) and the owner
+        falls back to the head."""
         entry = None
         am_owner = True
         if req_id is not None:
@@ -1262,9 +1393,32 @@ class NodeManager:
                 return entry[1]
         grant = None
         try:
-            grant = self._do_request_lease(resources, pg, lessee,
-                                           runtime_env, queue_block_ms)
-            if grant is not None and conn.peer_info.get("gone"):
+            if block_id is not None:
+                # Decrement AFTER the req_id memo claim (above): the
+                # RTPU_DEBUG_RPC duplicate audit re-delivers this call,
+                # and a pre-memo decrement would spend two admission
+                # units per task.
+                with self._lock:
+                    ent = self._lease_blocks.get(block_id)
+                    if (ent is None or ent["remaining"] <= 0
+                            or time.monotonic() > ent["expires_at"]):
+                        grant = {"block_revoked": True}
+                    else:
+                        ent["remaining"] -= 1
+            if grant is None:
+                grant = self._do_request_lease(resources, pg, lessee,
+                                               runtime_env, queue_block_ms)
+                if block_id is not None and (grant is None
+                                             or isinstance(grant, dict)):
+                    # Declined / env failure: the admission unit was not
+                    # spent on a worker — credit it back so a transient
+                    # decline doesn't bleed the block dry.
+                    with self._lock:
+                        ent = self._lease_blocks.get(block_id)
+                        if ent is not None:
+                            ent["remaining"] += 1
+            if (grant is not None and not isinstance(grant, dict)
+                    and conn.peer_info.get("gone")):
                 # Requester died while queued: reclaim immediately.
                 self.rpc_return_lease(conn, grant[1])
                 grant = None
@@ -1273,6 +1427,47 @@ class NodeManager:
                 entry[1] = grant
                 entry[0].set()
         return grant
+
+    # ---------------------------------------------------------- lease blocks
+
+    def rpc_lease_block_install(self, conn, block_id: str, owner_addr: str,
+                                resources: Dict[str, float], size: int,
+                                ttl_ms: int) -> bool:
+        """Head-pushed admission budget (see rpc_request_lease's block_id
+        path). Idempotent: re-installing an existing block is a no-op —
+        refreshing `remaining` on a retry would double the budget."""
+        with self._lock:
+            if block_id not in self._lease_blocks:
+                self._lease_blocks[block_id] = {
+                    "owner": owner_addr, "resources": dict(resources),
+                    "remaining": int(size), "size": int(size),
+                    "expires_at": time.monotonic() + ttl_ms / 1000.0}
+                # Same-lock acquire as the table insert (witness rule —
+                # see the lease grant path).
+                _resdbg.note_acquire("lease_block", key=block_id,
+                                     owner=self)
+        _flight.record("lease_block_install", block=block_id[:12])
+        return True
+
+    def rpc_lease_block_revoke(self, conn, block_id: str) -> bool:
+        """Head-driven teardown (drain, owner death) — also the owner's
+        own release path at shutdown. Idempotent: revoking an unknown or
+        already-revoked block is True ('not installed' holds)."""
+        with self._lock:
+            if self._lease_blocks.pop(block_id, None) is not None:
+                _resdbg.note_release("lease_block", block_id)
+        return True
+
+    def _sweep_expired_lease_blocks(self) -> None:
+        """Heartbeat-lap backstop: a dead owner's (or unreachable head's)
+        block must not pin admission state forever."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [bid for bid, ent in self._lease_blocks.items()
+                       if now > ent["expires_at"]]
+            for bid in expired:
+                del self._lease_blocks[bid]
+                _resdbg.note_release("lease_block", bid)
 
     def _do_request_lease(self, resources: Dict[str, float],
                           pg: Optional[Tuple[bytes, int]],
@@ -1296,9 +1491,17 @@ class NodeManager:
         from ray_tpu.exceptions import RuntimeEnvSetupError
 
         try:
-            w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
-                                 tpu=resources.get("TPU", 0) > 0,
-                                 runtime_env=runtime_env)
+            if self.simulated:
+                # Scale mode has no worker machinery (no spawner thread —
+                # _pop_worker would park until the lease timeout): mint a
+                # stub so the REAL grant/return/block/census accounting
+                # runs end-to-end at 1000 nodes.
+                w = WorkerProc(_SimProc(), uuid.uuid4().hex)
+                w.address = f"sim:{self.node_id[:8]}:{w.worker_id[:8]}"
+            else:
+                w = self._pop_worker(timeout=cfg.lease_timeout_ms / 1000.0,
+                                     tpu=resources.get("TPU", 0) > 0,
+                                     runtime_env=runtime_env)
         except RuntimeEnvSetupError as e:
             lease = Lease("", None, resources, resolved)
             with self._lock:

@@ -339,6 +339,13 @@ IDEMPOTENT_RPCS = frozenset({
     # (re-delivery is a no-op returning True), unregister of an
     # already-gone channel is True — the state "not registered" holds
     "channel_register", "channel_unregister",
+    # lease blocks (owner-routed steady-state dispatch): grant/renew
+    # memo the reply by caller-supplied block_id (a retry returns the
+    # SAME grant), install re-applies the same block (no-op when
+    # present), and revoke of an unknown/already-revoked block is True
+    # — the state "not installed" holds either way
+    "lease_block_grant", "lease_block_renew", "lease_block_revoke",
+    "lease_block_install",
 })
 
 #: Caller-side acked-retry loops with explicit loss handling; a

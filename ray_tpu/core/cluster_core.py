@@ -99,6 +99,29 @@ class _Lease:
         self.broken = False
 
 
+class _LeaseBlock:
+    """Owner-held admission budget for one scheduling key: the head
+    pre-negotiated `size` lease admissions at one node, so dispatch for
+    this key goes node-direct (no pick_node round trip) until the budget
+    or TTL runs out. Guarded by ClusterCore._lease_lock."""
+
+    __slots__ = ("block_id", "node_id", "node_addr", "remaining", "size",
+                 "expires_at", "renewing")
+
+    def __init__(self, block_id: str, node_id: str, node_addr: str,
+                 size: int, ttl_ms: int):
+        self.block_id = block_id
+        self.node_id = node_id
+        self.node_addr = node_addr
+        self.remaining = int(size)
+        self.size = int(size)
+        self.expires_at = time.monotonic() + ttl_ms / 1000.0
+        # True while a low-water renewal is in flight (one renewer at a
+        # time; the flag rides the BLOCK so a replaced block can't leave
+        # a stale "renewing" latch on the key).
+        self.renewing = False
+
+
 class _InflightTask:
     __slots__ = ("spec_blob", "return_ids", "worker_addr", "retries_left",
                  "sched_key", "resources", "strategy", "name", "sys_retries",
@@ -201,7 +224,8 @@ class _KeyQueue:
 
     __slots__ = ("key", "queue", "leases", "dispatcher_running",
                  "pending_lease_requests", "wake", "lease_fail_deadline",
-                 "lease_backoff", "next_lease_attempt", "avg_task_s")
+                 "lease_backoff", "next_lease_attempt", "avg_task_s",
+                 "block", "block_pending")
 
     def __init__(self, key: tuple):
         import collections
@@ -213,6 +237,12 @@ class _KeyQueue:
         self.pending_lease_requests = 0
         self.wake = threading.Event()
         self.lease_fail_deadline = None
+        # Owner-routed lease block for this key (steady-state head
+        # bypass): None until the first head-mediated grant succeeds and
+        # the background block negotiation lands. block_pending latches
+        # while a grant request is in flight (one per key).
+        self.block: Optional[_LeaseBlock] = None
+        self.block_pending = False
         # Declined-lease backoff: a saturated cluster must not cost a
         # pick_node RPC + requester thread every 50ms per scheduling key.
         self.lease_backoff = 0.0
@@ -306,6 +336,14 @@ class ClusterCore:
 
         self._key_queues: Dict[tuple, _KeyQueue] = {}
         self._lease_lock = make_lock("cluster_core._lease_lock")
+        # Steady-state dispatch accounting (bench.py --scale reads this):
+        # head_picks counts pick_node/pick_nodes FRAMES, block_dispatches
+        # counts leases admitted node-direct against a block,
+        # block_fallbacks counts block attempts that fell back to the
+        # head path. Guarded by _lease_lock.
+        self.dispatch_stats: Dict[str, int] = {
+            "head_picks": 0, "block_grants": 0,
+            "block_dispatches": 0, "block_fallbacks": 0}
         # Owner-side object locality cache: oid bytes -> (node_id, size).
         # Populated for free from task completions ("in_store" results
         # carry the sealing node) and local plasma puts; consulted by the
@@ -1953,6 +1991,8 @@ class ClusterCore:
                               tuple(sorted(s.resources.items())))
                 reqs.append((s.resources, s.strategy, [], demand_key,
                              self._locality_hint_for(s)))
+            with self._lease_lock:
+                self.dispatch_stats["head_picks"] += 1
             got = self.head.retrying_call("pick_nodes", reqs, timeout=10)
             if isinstance(got, list) and len(got) == len(samples):
                 picks = got
@@ -1968,12 +2008,20 @@ class ClusterCore:
 
         env_err = None
         lease = None
+        via_block = False
         hint = self._locality_hint_for(sample)
         t_lease0 = time.time() if sample.trace_ctx is not None else 0.0
         try:
-            lease = self._request_new_lease(sample.resources, sample.strategy,
-                                            sample.runtime_env, hint,
-                                            first_pick=first_pick)
+            # Steady state: admit against the key's lease block
+            # node-direct; only a missing/dead block pays the
+            # head-mediated pick below.
+            lease = self._request_lease_via_block(kq, sample)
+            via_block = lease is not None
+            if lease is None:
+                lease = self._request_new_lease(sample.resources,
+                                                sample.strategy,
+                                                sample.runtime_env, hint,
+                                                first_pick=first_pick)
         except RuntimeEnvSetupError as e:
             env_err = e
         finally:
@@ -2028,6 +2076,18 @@ class ClusterCore:
                 except Exception:
                     pass
                 return
+            if (not via_block and cfg.lease_block_enabled
+                    and sample.strategy is None):
+                # First head-mediated grant for this key succeeded:
+                # negotiate the block in the background so the NEXT
+                # dispatch round goes node-direct.
+                with self._lease_lock:
+                    start = kq.block is None and not kq.block_pending
+                    if start:
+                        kq.block_pending = True
+                if start:
+                    threading.Thread(target=self._negotiate_block,
+                                     args=(kq, sample), daemon=True).start()
             kq.wake.set()
             return
         # Infeasible right now. If nothing is making progress for too long,
@@ -2203,6 +2263,8 @@ class ClusterCore:
                 picked = first_pick
             else:
                 try:
+                    with self._lease_lock:
+                        self.dispatch_stats["head_picks"] += 1
                     picked = self.head.retrying_call(
                         "pick_node", resources, strategy, exclude,
                         demand_key, locality_hint, timeout=10)
@@ -2247,6 +2309,140 @@ class ClusterCore:
             worker_addr, lease_id = granted
             return _Lease(worker_addr, lease_id, node_addr, node_id)
         return None
+
+    # ------------------------------------------------------------ lease blocks
+
+    def _request_lease_via_block(self, kq: "_KeyQueue",
+                                 sample: _InflightTask) -> Optional[_Lease]:
+        """Steady-state node-direct dispatch: admit against the key's
+        head-granted lease block, skipping the pick_node round trip.
+        None = no usable block — the caller falls back to the normal
+        head-mediated path, so a revoked/expired/exhausted block degrades
+        gracefully, never wrongly."""
+        if not cfg.lease_block_enabled or sample.strategy is not None:
+            return None
+        renew = False
+        with self._lease_lock:
+            blk = kq.block
+            if blk is None:
+                return None
+            if blk.remaining <= 0 or time.monotonic() > blk.expires_at:
+                # Spent or expired: next head-mediated grant renegotiates.
+                kq.block = None
+                dead_id = blk.block_id
+            else:
+                dead_id = None
+                blk.remaining -= 1
+                if (blk.remaining
+                        <= blk.size * cfg.lease_block_renew_lowwater
+                        and not blk.renewing):
+                    blk.renewing = True
+                    renew = True
+        if dead_id is not None:
+            self._revoke_block_async(dead_id)
+            return None
+        if renew:
+            # Ahead-of-exhaustion renewal OFF the dispatch path: dispatch
+            # keeps draining the old budget while this round-trips.
+            threading.Thread(target=self._negotiate_block,
+                             args=(kq, sample, blk), daemon=True).start()
+        pg = pg_key_from_strategy(sample.strategy)
+        req_id = uuid.uuid4().hex
+        try:
+            granted = self._pool.get(blk.node_addr).retrying_call(
+                "request_lease", sample.resources, True, pg, req_id,
+                self.owner_addr, sample.runtime_env, None, blk.block_id,
+                timeout=cfg.lease_timeout_ms / 1000.0 + 5)
+        except (ConnectionLost, TimeoutError):
+            # Node unreachable (died under the block): drop it and fall
+            # back to a head pick — the head's death path revokes.
+            with self._lease_lock:
+                if kq.block is blk:
+                    kq.block = None
+                self.dispatch_stats["block_fallbacks"] += 1
+            return None
+        if isinstance(granted, dict):
+            if "env_error" in granted:
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                raise RuntimeEnvSetupError(granted["env_error"])
+            # {"block_revoked": True}: the node no longer honors the
+            # block (head revoked it / TTL beat the owner's clock).
+            with self._lease_lock:
+                if kq.block is blk:
+                    kq.block = None
+                self.dispatch_stats["block_fallbacks"] += 1
+            return None
+        if granted is None:
+            # Saturated node declined; the node credited the admission
+            # unit back — mirror that locally and spill back to the head.
+            with self._lease_lock:
+                blk.remaining += 1
+                self.dispatch_stats["block_fallbacks"] += 1
+            return None
+        with self._lease_lock:
+            self.dispatch_stats["block_dispatches"] += 1
+        worker_addr, lease_id = granted
+        return _Lease(worker_addr, lease_id, blk.node_addr, blk.node_id)
+
+    def _negotiate_block(self, kq: "_KeyQueue", sample: _InflightTask,
+                         prev: Optional[_LeaseBlock] = None) -> None:
+        """Background block grant (prev=None, after the first successful
+        head-mediated lease for the key) or low-water renewal (prev =
+        the draining block, placement stays sticky to its node). Never
+        called on the dispatch path."""
+        block_id = uuid.uuid4().hex
+        got = None
+        try:
+            if prev is None:
+                got = self.head.retrying_call(
+                    "lease_block_grant", block_id, self.owner_addr,
+                    sample.resources, sample.strategy,
+                    self._locality_hint_for(sample), timeout=10)
+            else:
+                got = self.head.retrying_call(
+                    "lease_block_renew", block_id, self.owner_addr,
+                    sample.resources, prev.node_id, sample.strategy,
+                    timeout=10)
+        except Exception as e:
+            logger.debug("lease block negotiation for %r failed: %r",
+                         kq.key, e)
+            got = None
+        stale_id = None
+        with self._lease_lock:
+            if prev is None:
+                kq.block_pending = False
+            else:
+                prev.renewing = False
+            if got is not None:
+                node_id, node_addr, size, ttl_ms = got
+                if self._key_queues.get(kq.key) is not kq:
+                    # The kq was reaped while the grant was in flight:
+                    # nobody will ever dispatch against this block.
+                    stale_id = block_id
+                else:
+                    stale = kq.block
+                    kq.block = _LeaseBlock(block_id, node_id, node_addr,
+                                           size, ttl_ms)
+                    self.dispatch_stats["block_grants"] += 1
+                    if stale is not None:
+                        stale_id = stale.block_id
+        if stale_id is not None:
+            self._revoke_block_async(stale_id)
+
+    def _revoke_block_async(self, block_id: str) -> None:
+        """Best-effort head-routed release of a block this owner no
+        longer uses (replaced, expired, key reaped) — keeps the node's
+        admission budget and the census honest without waiting out the
+        TTL backstop."""
+        def _go():
+            try:
+                self.head.retrying_call("lease_block_revoke", block_id,
+                                        timeout=5)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort: TTL expiry at head and node is the backstop
+                pass
+
+        threading.Thread(target=_go, daemon=True).start()
 
     def _on_worker_conn_lost(self, client: RpcClient) -> None:
         """A worker connection died: fail/retry its inflight tasks, mark its
@@ -2327,6 +2523,7 @@ class ClusterCore:
                 except Exception:
                     pass
             to_release = []
+            doomed_blocks: List[str] = []
             with self._lease_lock:
                 for key, kq in list(self._key_queues.items()):
                     keep = []
@@ -2344,6 +2541,14 @@ class ClusterCore:
                         # grant landing on a popped (orphaned) kq would
                         # leak the lease's resources on its node forever.
                         self._key_queues.pop(key, None)
+                        if kq.block is not None:
+                            # The key went idle: hand the admission
+                            # budget back instead of pinning it at the
+                            # node until TTL.
+                            doomed_blocks.append(kq.block.block_id)
+                            kq.block = None
+            for bid in doomed_blocks:
+                self._revoke_block_async(bid)
             for l in to_release:
                 # BROKEN leases are returned too: "broken" only means OUR
                 # connection to the worker died — if the worker is actually
@@ -2983,6 +3188,24 @@ class ClusterCore:
             self._flush_object_notifies()
         except Exception:
             pass
+        # Hand lease blocks back: a dead owner's blocks would otherwise
+        # pin admission budget at their nodes until the TTL backstop.
+        with self._lease_lock:
+            final_blocks = [kq.block.block_id
+                            for kq in self._key_queues.values()
+                            if kq.block is not None]
+            for kq in self._key_queues.values():
+                kq.block = None
+        revoke_deadline = time.monotonic() + 5.0
+        for bid in final_blocks:
+            left = revoke_deadline - time.monotonic()
+            if left <= 0:
+                break  # TTL expiry reclaims the rest; don't stall exit
+            try:
+                self.head.retrying_call("lease_block_revoke", bid,
+                                        timeout=min(2.0, left))
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort: TTL expiry is the backstop at head and node
+                pass
         self._server.stop()
         self._pool.close_all()
         # _shutdown_flag is set above: the reaper's next 50ms lap exits.

@@ -162,6 +162,38 @@ _d("lease_linger_ms", int, 100,
    "how long an idle lease is kept before returning the worker to its "
    "node (covers sync submit-get loops); long lingers serialize worker "
    "handoff between competing submitters")
+_d("lease_block_enabled", bool, True,
+   "owner-routed lease blocks: after the first head-mediated pick for a "
+   "scheduling key the head grants the owner a pre-negotiated block "
+   "(node, count, TTL) and repeat dispatch goes node-direct, skipping "
+   "the head in steady state; off = every lease pays a pick_node "
+   "round trip (the PR 14 path — bench.py --scale A/Bs this)")
+_d("lease_block_size", int, 16,
+   "lease admissions pre-negotiated per block grant: each unit lets one "
+   "request_lease skip the head; bigger blocks raise the steady-state "
+   "head bypass rate (1 - 1/size) but pin placement to one node longer")
+_d("lease_block_ttl_ms", int, 10_000,
+   "lease-block validity: the node refuses admissions against an "
+   "expired block (the owner falls back to a head pick) and the expiry "
+   "sweep releases it, so a dead owner's block can never pin admission "
+   "state forever")
+_d("lease_block_renew_lowwater", float, 0.25,
+   "remaining/size fraction at which the owner renews its block in the "
+   "background (ahead of exhaustion, so the dispatch path never stalls "
+   "on the renew round trip)")
+_d("head_index_min_nodes", int, 64,
+   "node count at which the head switches its pick scoring and lease "
+   "census onto the O(touched) indexed paths (util buckets, implicated-"
+   "node prefilter); below it the exact full scans run — small clusters "
+   "and unit tests keep byte-identical behavior")
+_d("object_dir_shards", int, 16,
+   "lock shards of the head object directory (oid-hash partitioned): "
+   "directory churn from object_batch frames contends on shard locks, "
+   "never on the scheduler-critical head lock")
+_d("object_dir_journal_max", int, 8192,
+   "per-node directory mutation journal entries kept for cursor-delta "
+   "republish; a head further behind than the journal floor gets a "
+   "full snapshot instead of a replay")
 _d("worker_zygote_enabled", bool, True,
    "default-env CPU workers fork from a pre-imported zygote process "
    "(linux; ~10ms/worker instead of ~0.4s interpreter+import CPU)")
@@ -426,6 +458,11 @@ _d("dag_ring_spill_bytes", int, 1 << 18,
    "file next to the ring (the ring carries the reference); the writer "
    "pins the spill until the reader consumes it and reclaims it on "
    "teardown — a reader death can never leak the payload")
+_d("dag_spill_reclaim_grace_s", float, 5.0,
+   "how long a closing writer waits for the reader to consume pending "
+   "spill side-files before reclaiming (unlinking) them; a reader that "
+   "already closed is not waited for — the grace only covers a LIVE "
+   "reader mid-read (unlinking under it was the bench.py --dag flake)")
 _d("dag_channel_dir", str, "",
    "directory for same-node channel rings/spills ('' = /dev/shm when "
    "present, else the system temp dir). Both endpoints of an edge must "

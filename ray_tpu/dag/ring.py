@@ -438,7 +438,29 @@ class RingChannel:
             pass
         # Reclaim spills the reader never consumed (reader death must
         # not strand multi-MB side files: the res-lint
-        # acquire-without-release shape, settled here).
+        # acquire-without-release shape, settled here). But a spill
+        # whose ring record the reader ALREADY dequeued may be opened by
+        # _spill_in any instant now — an immediate unlink raced that
+        # open and killed the reader with FileNotFoundError (the
+        # bench.py --dag flake). Observe consumption first: poll rpos
+        # until the ledger settles, the reader declares itself closed,
+        # or the grace window expires — only what is still unconsumed
+        # THEN is treated as stranded and reclaimed.
+        if self._spills and self._role == "w":
+            from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+            deadline = time.monotonic() + cfg.dag_spill_reclaim_grace_s
+            pause = 0.0005
+            while self._spills:
+                try:
+                    self._settle_spills(self._u64(_O_RPOS))
+                    if (not self._spills or self._mm[_O_RCLOSED]
+                            or time.monotonic() > deadline):
+                        break
+                except (ValueError, OSError):
+                    break
+                time.sleep(pause)
+                pause = min(pause * 2, 0.02)
         for _end, path in self._spills:
             try:
                 os.unlink(path)

@@ -40,6 +40,15 @@ repo actually shipped and found by hand in post-review:
       attribute or ``self.chaos_role = ...``) and no known role-setting
       base: the server silently opts out of every role-targeted chaos
       plan (``kill:role=head:...`` never fires on it).
+  retry-unsafe-block-rpc
+      a lease-block handler (``rpc_lease_block_*``) whose method is
+      classified but NOT retry-safe. Blocks are leases: their grant/
+      renew/install/revoke RPCs are retried by owners and double-
+      delivered by the RTPU_DEBUG_RPC witness, so a non-idempotent
+      classification means a retried grant double-installs admission
+      budget and the lease census never drains to zero. Unclassified
+      block handlers are caught by unclassified-rpc-handler; this rule
+      closes the other gap (classified, but on the wrong side).
 
 Classification sets are read from the linted source itself when it
 declares them (fixtures), else statically from the repo's
@@ -234,6 +243,7 @@ class _DistLinter:
         # instead of growing protocol.py; the RTPU_DEBUG_RPC witness
         # honors the same attributes).
         local: Set[str] = set()
+        local_safe: Set[str] = set()
         for stmt in cls.body:
             if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
                     and isinstance(stmt.targets[0], ast.Name) \
@@ -246,6 +256,8 @@ class _DistLinter:
                 lit = _literal_strings(val)
                 if lit:
                     local.update(lit)
+                    if stmt.targets[0].id != "extra_non_retryable_rpcs":
+                        local_safe.update(lit)
         for h in handlers:
             method = h.name[len("rpc_"):]
             if method not in self._classified and method not in local:
@@ -256,6 +268,17 @@ class _DistLinter:
                     "NON_RETRYABLE_RPCS — declare its retry/idempotency "
                     "semantics in cluster/protocol.py (re-delivery and "
                     "blind chaos drops key on that contract)")
+            elif (method.startswith("lease_block_")
+                    and method not in self._retry_safe
+                    and method not in local_safe):
+                self._emit(
+                    "retry-unsafe-block-rpc", h,
+                    f"lease-block handler '{h.name}' is classified "
+                    "non-retryable — block grant/renew/install/revoke "
+                    "must be retry-safe (owners retry them and the "
+                    "RTPU_DEBUG_RPC witness double-delivers them; a "
+                    "non-idempotent grant double-installs admission "
+                    "budget and leaks the lease census)")
         self._check_chaos_role(cls)
 
     def _check_chaos_role(self, cls: ast.ClassDef) -> None:

@@ -92,6 +92,7 @@ DIST_RULES = (
     "unclassified-rpc-handler", "retry-unsafe-call",
     "direct-notify-bypasses-outbox", "serial-fanout-no-deadline",
     "wall-clock-deadline", "missing-chaos-role",
+    "retry-unsafe-block-rpc",
 )
 RES_RULES = (
     "acquire-without-release", "begin-without-commit",

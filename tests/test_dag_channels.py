@@ -150,15 +150,59 @@ def test_ring_spill_large_payload_and_reclaim(monkeypatch):
     # A consumed spill settles once the writer observes the cursor.
     w.write(b"small", 1)
     assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 0
-    # Unconsumed spill + writer close => reclaimed, not leaked.
+    # Unconsumed spill + writer close => reclaimed, not leaked. (Close
+    # grants an alive reader a grace window to consume in-flight spills
+    # first; this reader is parked, so keep the wait short.)
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    old_grace = cfg.dag_spill_reclaim_grace_s
+    cfg.set("dag_spill_reclaim_grace_s", 0.05)
     w.write(big, 2)
     assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 1
-    w.close()
+    try:
+        w.close()
+    finally:
+        cfg.set("dag_spill_reclaim_grace_s", old_grace)
     assert res_debug.outstanding("channel_spill").get("channel_spill", 0) == 0
     assert res_debug.outstanding("channel_ring").get("channel_ring", 0) == 1  # reader still open
     r.close(unlink=True)
     assert res_debug.outstanding("channel_ring").get("channel_ring", 0) == 0
     res_debug.reset()
+
+
+def test_ring_writer_close_waits_for_inflight_spill_read(monkeypatch):
+    """Regression (bench.py --dag flake): the reader dequeues a spill
+    record, then opens the side file — rpos only advances AFTER the
+    open. A writer closing in that window used to unlink the file out
+    from under the open (FileNotFoundError in _spill_in). close() must
+    observe consumption before reclaiming a spill an alive reader can
+    still reach."""
+    big = os.urandom(1 << 19)  # > dag_ring_spill_bytes: rides a side file
+    w, r = _pair(capacity=4)
+    orig = RingChannel._spill_in
+
+    def slow_spill_in(self, kind, name_b):
+        time.sleep(0.3)  # widen the dequeue -> open race window
+        return orig(self, kind, name_b)
+
+    monkeypatch.setattr(RingChannel, "_spill_in", slow_spill_in)
+    w.write(big, 0)
+    out = {}
+
+    def reader():
+        try:
+            out["val"] = r.read(0, timeout=10)
+        except Exception as e:  # noqa: BLE001 — surfaced via assert below
+            out["err"] = e
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.1)  # reader is inside _spill_in, file not yet opened
+    w.close()  # must wait out the in-flight read, not unlink blindly
+    t.join(10)
+    assert "err" not in out, f"reader died: {out.get('err')!r}"
+    assert out["val"] == big
+    r.close(unlink=True)
 
 
 def test_ring_stop_sentinel_and_error_forwarding():

@@ -85,6 +85,71 @@ def test_module_level_rpc_function_not_a_handler():
     assert lint_source(src, "m", "m.py") == []
 
 
+# ------------------------------------------------ retry-unsafe-block-rpc
+
+
+def test_retry_unsafe_block_rpc_flagged():
+    """A lease-block handler classified NON-retryable is the new lint
+    failure: owners retry grants and the RPC witness double-delivers
+    them, so a non-idempotent block RPC double-installs admission
+    budget."""
+    src = SETS.replace(
+        "NON_RETRYABLE_RPCS = frozenset({'object_batch', 'trace_spans'})",
+        "NON_RETRYABLE_RPCS = frozenset({'object_batch', "
+        "'lease_block_install'})") + (
+        "class Server:\n"
+        "    chaos_role = 'node'\n"
+        "    def rpc_lease_block_install(self, conn, bid):\n"
+        "        return True\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["retry-unsafe-block-rpc"]
+    assert "lease_block_install" in fs[0].message
+
+
+def test_retry_safe_block_rpc_clean():
+    src = SETS.replace(
+        "IDEMPOTENT_RPCS = frozenset({'request_lease'})",
+        "IDEMPOTENT_RPCS = frozenset({'request_lease', "
+        "'lease_block_install'})") + (
+        "class Server:\n"
+        "    chaos_role = 'node'\n"
+        "    def rpc_lease_block_install(self, conn, bid):\n"
+        "        return True\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_unclassified_block_rpc_reports_unclassified_only():
+    """An UNCLASSIFIED block handler is the other rule's report — one
+    defect, one finding."""
+    src = SETS + (
+        "class Server:\n"
+        "    chaos_role = 'node'\n"
+        "    def rpc_lease_block_grant(self, conn, bid):\n"
+        "        return None\n")
+    fs = lint_source(src, "m", "m.py")
+    assert rules(fs) == ["unclassified-rpc-handler"]
+
+
+def test_local_extra_safe_block_declaration_honored():
+    src = SETS + (
+        "class Fixture:\n"
+        "    chaos_role = 'node'\n"
+        "    extra_idempotent_rpcs = frozenset({'lease_block_revoke'})\n"
+        "    def rpc_lease_block_revoke(self, conn, bid):\n"
+        "        return True\n")
+    assert lint_source(src, "m", "m.py") == []
+
+
+def test_repo_lease_block_rpcs_are_retry_safe():
+    """The live protocol.py contract the whole design rides on: every
+    lease-block RPC is classified AND retry-safe."""
+    retry_safe, non_retryable = _protocol_sets()
+    for m in ("lease_block_grant", "lease_block_renew",
+              "lease_block_revoke", "lease_block_install"):
+        assert m in retry_safe, m
+        assert m not in non_retryable, m
+
+
 def test_repo_protocol_sets_extracted():
     """The static extractor resolves the real protocol.py tables,
     including the union assignment."""
