@@ -122,6 +122,7 @@ SCALE_TIMEOUT_S = 300      # scale child (100 simulated nodes, head hot paths)
 DAG_TIMEOUT_S = 420        # dag child (2-actor cluster, channel vs RPC hops)
 DATA_TIMEOUT_S = 420       # data child (channel-vs-task shuffle + ingest A/B)
 DISAGG_TIMEOUT_S = 900     # disagg serve sweep (colocated vs disagg TTFT)
+KV_FLEET_TIMEOUT_S = 600   # fleet KV tier A/B (spill/pull vs recompute)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -2601,6 +2602,203 @@ def serve_disagg_main() -> int:
 
 
 # --------------------------------------------------------------------------
+# fleet KV tier: spill/pull vs recompute, same-window A/B with churn
+# --------------------------------------------------------------------------
+
+def kv_fleet_child_main() -> int:
+    """PR 9's prefix sweep extended to the fleet KV tier (PR 18): two
+    single-slot engines share a page store, and round-robin group
+    traffic makes every admission evict the previous group — so the
+    fleet tier (spill on evict, pull on re-admission) is the ONLY
+    prefix reuse available. Mid-sweep one engine is killed and
+    replaced: its HBM cache dies, its spilled pages don't. The A/B
+    alternates fleet off/on twice in the same window so drift can't
+    masquerade as a win; post-kill TTFTs are reported separately
+    (``p50_ttft_ms_churn`` — the metric the tier exists to flatten)."""
+    from ray_tpu.models import llama
+    from ray_tpu.serve.engine.kv_fleet import LocalKVPageStore
+    from ray_tpu.serve.llm import LLMEngine
+
+    BLOCK = 8
+    GROUPS, TURNS = 6, 3
+    # 80-token shared prefix (10 blocks) + 8-token per-turn suffix:
+    # fleet-on re-admissions pull 10 pages + prefill an 8-bucket tail,
+    # fleet-off recomputes the whole 88 tokens in the 96 bucket.
+    prefixes = [[(g * 97 + j) % 251 + 1 for j in range(80)]
+                for g in range(GROUPS)]
+
+    def prompt_for(g, turn):
+        return prefixes[g] + [(g * 31 + turn * 7 + j) % 251 + 1
+                              for j in range(8)]
+
+    # Wider than tiny_config on purpose: recompute FLOPs grow with
+    # d_model^2 while page bytes grow linearly, and the tier only pays
+    # off when a block costs more to recompute than to copy. The
+    # default tiny model is in the opposite (recompute-is-free) regime
+    # — which the measured crossover on the "on" rows makes visible.
+    cfg = llama.tiny_config(d_model=384, n_layers=6, n_heads=8,
+                            n_kv_heads=2, d_ff=1536, max_seq_len=96)
+    ek = dict(max_batch=1, max_len=96,
+              prompt_buckets=[8, 16, 32, 64, 96], decode_chunk=4,
+              seed=0, prefix_block=BLOCK)
+
+    def new_engine(mode, store):
+        if mode == "on":
+            # Gate 0 = always pull; the MEASURED crossover is reported
+            # alongside so the merged line shows what "auto" would do.
+            return LLMEngine(cfg, kv_fleet_min_prefix_blocks=0,
+                             kv_fleet_store=store, **ek)
+        return LLMEngine(cfg, **ek)
+
+    def warm(e):
+        # Compile every program the sweep uses, off the clock.
+        e.generate([5] * 88, max_new_tokens=1)
+        e.generate([6] * 8, max_new_tokens=1)
+
+    def eng_reused(e):
+        st = e.stats()
+        return (st.get("prefix_tokens_reused", 0)
+                + st.get("kv_fleet_tokens_reused", 0))
+
+    # Round-robin turns across groups (group -> engine by g % 2): the
+    # slot is always evicted between a group's consecutive turns.
+    sched = [(g, t) for t in range(TURNS) for g in range(GROUPS)]
+    kill_at = len(sched) // 2
+
+    rows = []
+    for mode in ("off", "on", "off", "on"):  # same-window alternating
+        store = LocalKVPageStore(capacity_bytes=256 << 20)
+        engines = [new_engine(mode, store), new_engine(mode, store)]
+        try:
+            for e in engines:
+                warm(e)
+            baseline = [eng_reused(e) for e in engines]
+            reused_total = 0
+            prompt_tokens = 0
+            ttfts, churn_ttfts = [], []
+            for i, (g, t) in enumerate(sched):
+                if i == kill_at:
+                    # "Replica kill": engine 0's HBM cache dies with
+                    # it. Bank its measured reuse, then rebuild and
+                    # re-warm (restart compiles are off the clock —
+                    # churn TTFT measures the CACHE loss, not XLA).
+                    reused_total += eng_reused(engines[0]) - baseline[0]
+                    engines[0].close()
+                    engines[0] = new_engine(mode, store)
+                    warm(engines[0])
+                    baseline[0] = eng_reused(engines[0])
+                e = engines[g % 2]
+                p = prompt_for(g, t)
+                t0 = time.perf_counter()
+                e.generate(p, max_new_tokens=1)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                prompt_tokens += len(p)
+                ttfts.append(dt_ms)
+                if i >= kill_at:
+                    churn_ttfts.append(dt_ms)
+            reused_total += sum(eng_reused(e) - baseline[j]
+                                for j, e in enumerate(engines))
+            ttfts.sort()
+            churn_ttfts.sort()
+            row = {
+                "metric": "kv_fleet_sweep",
+                "config": "small-cpu",
+                "mode": mode,
+                "requests": len(sched),
+                "hit_rate": round(reused_total / max(1, prompt_tokens),
+                                  3),
+                "p50_ttft_ms": round(ttfts[len(ttfts) // 2], 2),
+                "p50_ttft_ms_churn": round(
+                    churn_ttfts[len(churn_ttfts) // 2], 2),
+            }
+            if mode == "on":
+                st = engines[1].stats()
+                # The measured crossover table: store-side costs from
+                # the start-of-engine probe, recompute side from real
+                # prefill EWMAs accumulated during this sweep.
+                for k in ("kv_fleet_pull_ms_per_page",
+                          "kv_fleet_lookup_ms",
+                          "kv_fleet_prefill_ms_per_block",
+                          "kv_pull_vs_recompute_crossover_blocks",
+                          "kv_fleet_spilled_blocks",
+                          "kv_fleet_pulled_blocks",
+                          "kv_fleet_rejects"):
+                    row[k] = st.get(k)
+            rows.append(row)
+        finally:
+            for e in engines:
+                try:
+                    e.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return 0
+
+
+def _kv_fleet_rows() -> list:
+    try:
+        proc = _run(["--kv-fleet-child"], KV_FLEET_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "kv_fleet",
+                 "error": f"timeout {KV_FLEET_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "kv_fleet",
+                "error": "rc=%d: %s" % (proc.returncode,
+                                        " | ".join(tail))})
+    return out
+
+
+def _merge_kv_fleet_rows(rows: list) -> dict:
+    """Median across the repeated off/on phases (2 each): one headline
+    pair per metric, so a single noisy phase can't flip the A/B."""
+    merged: dict = {"metric": "kv_fleet"}
+    err = next((r["error"] for r in rows if "error" in r), None)
+    if err:
+        merged["error"] = err
+        return merged
+
+    def med(vals):
+        vals = sorted(v for v in vals if v is not None)
+        return vals[len(vals) // 2] if vals else None
+
+    on = [r for r in rows if r.get("mode") == "on"]
+    off = [r for r in rows if r.get("mode") == "off"]
+    if not on or not off:
+        merged["error"] = "missing off/on phase rows"
+        return merged
+    merged["kv_fleet_hit_rate"] = med([r.get("hit_rate") for r in on])
+    merged["kv_fleet_hit_rate_off"] = med(
+        [r.get("hit_rate") for r in off])
+    merged["kv_fleet_p50_ttft_ms_churn"] = med(
+        [r.get("p50_ttft_ms_churn") for r in on])
+    merged["kv_fleet_p50_ttft_ms_churn_off"] = med(
+        [r.get("p50_ttft_ms_churn") for r in off])
+    merged["kv_fleet_p50_ttft_ms"] = med(
+        [r.get("p50_ttft_ms") for r in on])
+    merged["kv_fleet_p50_ttft_ms_off"] = med(
+        [r.get("p50_ttft_ms") for r in off])
+    co = [r.get("kv_pull_vs_recompute_crossover_blocks") for r in on
+          if r.get("kv_pull_vs_recompute_crossover_blocks") is not None]
+    if co:
+        merged["kv_pull_vs_recompute_crossover_blocks"] = co[-1]
+    return merged
+
+
+def kv_fleet_bench_main() -> int:
+    rows = _kv_fleet_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_kv_fleet_rows(rows)))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+# --------------------------------------------------------------------------
 # parent supervisor
 # --------------------------------------------------------------------------
 
@@ -2840,6 +3038,16 @@ def main() -> int:
     for r in data_rows:
         print(json.dumps(r), flush=True)
 
+    # Phase 11: fleet KV tier A/B on CPU (spill/pull vs recompute,
+    # replica kill mid-sweep). Tracked from this PR.
+    kvf_rows: list = []
+    try:
+        kvf_rows = _kv_fleet_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        kvf_rows = [{"metric": "kv_fleet", "error": repr(e)[:200]}]
+    for r in kvf_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -2990,6 +3198,16 @@ def main() -> int:
                 merged[k] = da[k]
     elif da:
         merged["data_error"] = da["error"]
+    kvf_merged = _merge_kv_fleet_rows(kvf_rows)
+    if "error" not in kvf_merged:
+        for k in ("kv_fleet_hit_rate", "kv_fleet_hit_rate_off",
+                  "kv_fleet_p50_ttft_ms_churn",
+                  "kv_fleet_p50_ttft_ms_churn_off",
+                  "kv_pull_vs_recompute_crossover_blocks"):
+            if kvf_merged.get(k) is not None:
+                merged[k] = kvf_merged[k]
+    else:
+        merged["kv_fleet_error"] = kvf_merged["error"]
     print(json.dumps(merged))
     return 0
 
@@ -3035,6 +3253,10 @@ if __name__ == "__main__":
         sys.exit(serve_disagg_child_main())
     if "--serve-disagg" in sys.argv:
         sys.exit(serve_disagg_main())
+    if "--kv-fleet-child" in sys.argv:
+        sys.exit(kv_fleet_child_main())
+    if "--kv-fleet" in sys.argv:
+        sys.exit(kv_fleet_bench_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

@@ -375,6 +375,28 @@ _d("serve_snapshot_ttl_s", float, 5.0,
 _d("serve_snapshot_prefix_hashes", int, 256,
    "cap on resident prefix-block chain hashes exported per replica "
    "load snapshot")
+_d("serve_kv_fleet_min_prefix_blocks", int, -1,
+   "fleet KV-cache economy: minimum contiguous pullable prefix (in "
+   "blocks) before an engine pulls spilled KV pages from the tiered "
+   "object store instead of recomputing them. -1 (default) disables "
+   "the fleet tier entirely — engines are byte-identical to "
+   "per-replica caching; 0 always pulls; n>0 pulls only runs of at "
+   "least n blocks (engines may also be built with 'auto' to gate on "
+   "the measured pull-vs-recompute crossover)")
+_d("serve_router_fleet_kv_weight", float, 0.0,
+   "scored routing: weight of a replica's FLEET KV residency (spilled "
+   "prefix pages it can re-install without recompute) — 0 (default) "
+   "keeps scores byte-identical to per-replica prefix affinity; "
+   "fleet-enabled deployments set it so multi-turn traffic lands "
+   "where its evicted prefixes still live in the shm tier")
+_d("serve_snapshot_fleet_hashes", int, 32,
+   "cap on recently-spilled/pulled prefix-block chain hashes exported "
+   "per replica load snapshot (the fleet-residency summary the "
+   "router's fleet term scores on)")
+_d("serve_kv_fleet_local_bytes", int, 256 << 20,
+   "byte cap of the in-process fleet KV page store used when no "
+   "cluster shm store is attached (store-free engines, unit tests); "
+   "oldest pages evict LRU past the cap")
 _d("serve_slo_ttft_budget_ms", float, 0.0,
    "admission control: p99 TTFT budget per deployment at the ingress "
    "proxy — past it, new requests queue (bounded) then shed with a "

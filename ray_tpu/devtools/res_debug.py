@@ -59,9 +59,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: count the streaming Dataset executor's bounded inter-operator queues
 #: and long-lived operator actors: a pipeline torn down without closing
 #: its edges or killing its lanes is a leak.
+#: ``kv_page_obj`` (serve/engine/core.py + kv_fleet.py) counts IN-FLIGHT
+#: fleet KV page transfers — a spilled block exported off-device but not
+#: yet landed in the page store, or a pulled payload fetched but not yet
+#: installed/rejected. Resident store objects are a cache, not a leak;
+#: only a tier TRANSITION abandoned halfway is.
 LEAK_KINDS = ("buffer_lease", "lease", "kv_spec",
               "channel_ring", "channel_spill", "channel_sock",
-              "data_queue", "data_operator")
+              "data_queue", "data_operator", "kv_page_obj")
 
 
 def enabled() -> bool:

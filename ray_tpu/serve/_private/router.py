@@ -96,6 +96,9 @@ class Router:
                 if hashes is not None and not isinstance(hashes,
                                                          frozenset):
                     snap["prefix_hashes"] = frozenset(hashes)
+                fleet = snap.get("fleet_kv_hashes")
+                if fleet is not None and not isinstance(fleet, frozenset):
+                    snap["fleet_kv_hashes"] = frozenset(fleet)
                 # The controller ships snapshot AGE (its own clock, one
                 # process): restamp onto THIS process's clock so the
                 # TTL check in _fresh_loads never compares wall clocks
@@ -263,6 +266,25 @@ class Router:
                  - w_kv * kv)
         if w_ttft:
             score -= w_ttft * snap.get("ewma_ttft_ms", 0.0) / 1e3
+        # Fleet KV residency (the spill tier, PR 18): a replica holding
+        # this prompt's evicted prefix pages in its shm tier re-installs
+        # them instead of recomputing — weaker than an HBM-resident
+        # prefix (a pull costs a store roundtrip) so it scores as a
+        # separate, smaller term. Weight 0 (the default) keeps scores
+        # byte-identical to per-replica prefix affinity.
+        w_fleet = w.get("fleet", cfg.serve_router_fleet_kv_weight)
+        if w_fleet:
+            fleet_resident = snap.get("fleet_kv_hashes")
+            if chain and fleet_resident and bs:
+                fdepth = 0
+                for h in chain:
+                    if h in fleet_resident:
+                        fdepth += 1
+                    else:
+                        break
+                if fdepth > depth:
+                    score += w_fleet * min(
+                        1.0, (fdepth - depth) * bs / max(1, prompt_len))
         return score, depth
 
     def _choose_scored(self, loads: Dict[Any, Dict[str, Any]],

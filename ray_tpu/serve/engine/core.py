@@ -19,9 +19,12 @@ class.
 from __future__ import annotations
 
 import dataclasses
+import math
 import queue
 import threading
 import time
+import zlib
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -30,10 +33,11 @@ from ray_tpu.devtools import jax_debug
 from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.serve.engine.decode_loop import DecodeLoop
 from ray_tpu.serve.engine.drafter import PromptLookupDrafter, SpecControl
-from ray_tpu.serve.engine.kv_manager import KVCacheManager
+from ray_tpu.serve.engine.kv_manager import KVCacheManager, chain_hashes
 from ray_tpu.serve.engine.metrics import (SERVE_TTFT_BREAKDOWN_MS,
                                           EngineMetrics)
-from ray_tpu.serve.engine.scheduler import EngineRequest, Scheduler
+from ray_tpu.serve.engine.scheduler import (EngineRequest, Scheduler,
+                                            bucket_for)
 from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import tracing as _tracing
 
@@ -131,6 +135,8 @@ class InferenceEngine:
                  paged_decode: Any = False,
                  role: str = "colocated",
                  seed: int = 0,
+                 kv_fleet_min_prefix_blocks: Any = None,
+                 kv_fleet_store: Any = None,
                  name: Optional[str] = None):
         import jax
 
@@ -175,13 +181,28 @@ class InferenceEngine:
         self.drafter = (PromptLookupDrafter(ngram_max=spec_ngram_max)
                         if self.spec_draft_len else None)
 
+        # Fleet KV tier gate (kv_fleet.py). None defers to the config
+        # knob; -1 = off (the engine below is byte-identical to the
+        # pre-fleet one: no transfer programs for colocated roles, no
+        # spill hook, no extra snapshot keys); 0 = always pull; n>0 =
+        # pull only contiguous runs of >= n blocks; "auto" = gate on
+        # the measured pull-vs-recompute crossover.
+        gate = kv_fleet_min_prefix_blocks
+        if gate is None:
+            from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+            gate = _cfg.serve_kv_fleet_min_prefix_blocks
+        self._fleet_min_blocks = gate
+        fleet_on = not (isinstance(gate, int) and gate < 0)
+
         self.loop = DecodeLoop(self.cfg, max_len=self.max_len,
                                chunk=self.decode_chunk,
                                spec_window=self.spec_draft_len + 1,
                                spec_chunk=spec_chunk,
                                prefill_budget=len(self.buckets),
                                kv_page=(prefix_block
-                                        if role != "colocated" else 0))
+                                        if (role != "colocated" or fleet_on)
+                                        else 0))
         # Verify windows span spec_draft_len+1 rows; the scratch strip
         # past max_len absorbs parked/overrun writes so they can never
         # clamp back onto resident rows (decode_loop docstring). Row
@@ -193,12 +214,14 @@ class InferenceEngine:
             # scratch strip — never written, masked out by lengths).
             page = self.cfg.decode_page
             cache_rows = -(-cache_rows // page) * page
-        if role != "colocated":
+        if role != "colocated" or fleet_on:
             # KV-page export/install moves whole pages: pad the
             # allocation so the tail page of a max-length prompt never
             # needs the transfer programs' defensive clamp (a clamped
             # start on ONE side of a prefill→decode pair whose scratch
-            # strips differ would land rows at the wrong offset).
+            # strips differ would land rows at the wrong offset). The
+            # fleet spill/pull tier moves the same pages, so a
+            # fleet-enabled colocated engine pads identically.
             cache_rows = -(-cache_rows // prefix_block) * prefix_block
         self.cache = llama.init_kv_cache(self.cfg, max_batch, cache_rows)
 
@@ -210,6 +233,43 @@ class InferenceEngine:
         self.prefill_chunk = self.scheduler.prefill_chunk
         self.multi_step = bool(multi_step)
         self.metrics = EngineMetrics(name)
+
+        # Fleet KV page tier: evicted prefix blocks spill into a shared
+        # page store (shm when a cluster runtime is attached, an
+        # in-process LRU otherwise) and cache misses pull them back
+        # through the install_page + chain-verify seam. self._fleet is
+        # the off switch every fleet code path gates on.
+        self._fleet = None
+        if fleet_on:
+            from ray_tpu.serve.engine import kv_fleet as _kvf
+
+            self._fleet = _kvf.resolve_store(kv_fleet_store)
+            self._fleet_ns = _kvf.fleet_namespace(
+                self.cfg, self.kv.block_size, quantize, seed)
+            self._fleet_lock = threading.Lock()
+            self._fleet_recent: "OrderedDict[int, None]" = OrderedDict()
+            self._fleet_block_count = 0
+            self._fleet_stats = {"kv_fleet_hits": 0,
+                                 "kv_fleet_pulled_blocks": 0,
+                                 "kv_fleet_spilled_blocks": 0,
+                                 "kv_fleet_tokens_reused": 0,
+                                 "kv_fleet_rejects": 0}
+            # Pull-vs-recompute crossover inputs: store-side costs are
+            # measured now (synthetic page roundtrip); the recompute
+            # side arrives from real prefill timings (_note_prefill_cost).
+            self._fleet_pf_ms_blk: Optional[float] = None
+            self._fleet_pf_samples = 0
+            self._fleet_pull_ms_page, self._fleet_lookup_ms = \
+                self._measure_fleet_costs()
+            self.kv.spill_hook = self._spill_evicted
+            # Serialization + store puts happen off the engine thread:
+            # the engine only exports (device work must stay on its
+            # thread) and hands host pages over.
+            self._spill_q: "queue.Queue" = queue.Queue()
+            self._spill_thread = _resdbg.track_thread(
+                threading.Thread(target=self._spill_loop, daemon=True,
+                                 name="llm-kv-spill"), owner=self)
+            self._spill_thread.start()
 
         # Chunked-prefill jobs in flight (admitted requests whose
         # suffix is still materializing, one chunk per tick) and the
@@ -352,6 +412,18 @@ class InferenceEngine:
             out["compiled_programs"] = programs
         out.update(self.kv.stats())
         out.update(self.metrics.snapshot())
+        if self._fleet is not None:
+            with self._fleet_lock:
+                out.update(self._fleet_stats)
+            out["kv_pull_vs_recompute_crossover_blocks"] = \
+                self._crossover_blocks()
+            out["kv_fleet_pull_ms_per_page"] = self._fleet_pull_ms_page
+            out["kv_fleet_lookup_ms"] = self._fleet_lookup_ms
+            out["kv_fleet_prefill_ms_per_block"] = self._fleet_pf_ms_blk
+            try:
+                out["kv_fleet_store"] = self._fleet.stats()
+            except Exception:  # rtpu-lint: disable=swallowed-exception — stats enrichment; a store without a stats endpoint is fine
+                pass
         return out
 
     def load_snapshot(self) -> Dict[str, Any]:
@@ -362,7 +434,7 @@ class InferenceEngine:
         from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
         m = self.metrics.snapshot()
-        return {
+        snap = {
             "role": self.role,
             "waiting": (self._queue.qsize() + self.scheduler.queue_depth()
                         + len(self._install_waiting)
@@ -383,6 +455,16 @@ class InferenceEngine:
             "prefix_hashes": self.kv.resident_hashes(
                 cfg.serve_snapshot_prefix_hashes),
         }
+        if self._fleet is not None:
+            # Fleet-residency summary for the router's fleet term:
+            # distinct blocks this replica can re-install without
+            # recompute, plus the capped newest chain hashes. Keys
+            # exist ONLY when the tier is on, so fleet-off snapshots
+            # stay byte-identical.
+            with self._fleet_lock:
+                snap["fleet_kv_blocks"] = self._fleet_block_count
+                snap["fleet_kv_hashes"] = list(self._fleet_recent)
+        return snap
 
     def close(self) -> None:
         self._shutdown = True
@@ -400,6 +482,18 @@ class InferenceEngine:
         # off = one env read.
         _resdbg.check_balanced("engine.close", kinds=("kv_spec",),
                                owner=self.kv)
+        if self._fleet is not None:
+            # Drain the spill worker AFTER the engine thread is gone
+            # (it was the only producer): every exported page either
+            # lands in the store or is released — an in-flight tier
+            # transition abandoned here is what kv_page_obj catches.
+            self._spill_q.put(None)
+            if (self._spill_thread.is_alive()
+                    and self._spill_thread
+                    is not threading.current_thread()):
+                self._spill_thread.join(timeout=30.0)
+            _resdbg.check_balanced("engine.close", kinds=("kv_page_obj",),
+                                   owner=self)
         if self._thread is not threading.current_thread():
             _resdbg.check_balanced("engine.close", kinds=("thread",),
                                    owner=self)
@@ -427,7 +521,305 @@ class InferenceEngine:
         fully on their admission tick)."""
         self.scheduler.drain_into(self._queue)
         for adm in self.scheduler.admissions():
+            if (self._fleet is not None
+                    and adm.cached_len < len(adm.request.prompt_ids) - 1):
+                try:
+                    self._fleet_extend(adm)
+                except Exception:  # rtpu-lint: disable=swallowed-exception — a failed pull is a skipped optimization; recompute covers it
+                    # A failed pull/install is a skipped optimization:
+                    # rows it may have touched sit past cached_len and
+                    # the suffix prefill overwrites them.
+                    pass
             self._prefilling.append(_PrefillJob(adm, pos=adm.cached_len))
+
+    # -------------------------------------------------- fleet KV tier
+
+    def export_pages(self, slot: int, block_starts: List[int],
+                     tag: str = "kv_export"):
+        """THE KV page export path — the disagg handoff
+        (_finish_handoff) and the spill tier (_spill_evicted) both go
+        through here, so they cannot drift: one jitted program per
+        page, ONE counted host sync for the whole batch, and the
+        padded-tail invariant stated once — the cache allocation is
+        padded to a page multiple whenever the transfer programs are
+        built, so export_page's defensive clamp (start <= S - P) never
+        fires and every page lands at the exact offset install_page
+        will write it back to. Returns host (pages_k, pages_v, crcs);
+        each CRC covers the page BYTES (chain hashes cover only token
+        identity)."""
+        pages_dev = [self.loop.export_page(self.cache,
+                                           self._put(np.int32(slot)),
+                                           self._put(np.int32(s)))
+                     for s in block_starts]
+        pages = self._fetch(pages_dev, tag=tag)
+        pages_k = [np.ascontiguousarray(k) for k, _v in pages]
+        pages_v = [np.ascontiguousarray(v) for _k, v in pages]
+        crcs = [zlib.crc32(k.tobytes()) ^ zlib.crc32(v.tobytes())
+                for k, v in zip(pages_k, pages_v)]
+        return pages_k, pages_v, crcs
+
+    def _spill_evicted(self, slot: int, resident, chain,
+                       keep_blocks: int) -> None:
+        """kv_manager spill hook: an acquire is about to overwrite this
+        slot's resident rows — export every COMPLETE block the page
+        store doesn't already hold (HBM -> shm tier transition). The
+        kept prefix (blocks < ``keep_blocks``) is exported too, not
+        just the dying suffix: under affinity routing a hot prefix may
+        NEVER be fully evicted on its home replica, and spilling it on
+        first reuse is what makes it pullable by the rest of the fleet
+        (and survivable past this replica's death) — the contains
+        dedupe makes the steady-state cost zero. Runs on the engine
+        thread before any row is written (the new admission's first
+        prefill chunk dispatches strictly later), so the dynamic_slice
+        snapshots are taken from live rows; the fetch-to-host is the
+        batch's one counted sync (tag kv_spill) and serialization/puts
+        happen on the spill worker."""
+        from ray_tpu.serve.engine import kv_fleet as _kvf
+
+        P = self.kv.block_size
+        todo = []
+        for i in range(min(len(chain), len(resident) // P)):
+            oid = _kvf.page_object_id(self._fleet_ns, chain[i])
+            if not self._fleet.contains(oid):
+                todo.append((i, oid))
+        if not todo:
+            return
+        req = getattr(self.kv, "current_request", None)
+        traced = req is not None and req.trace_ctx is not None
+        t0w = time.time() if traced else 0.0
+        pages_k, pages_v, crcs = self.export_pages(
+            slot, [i * P for i, _ in todo], tag="kv_spill")
+        jobs = []
+        for (i, oid), k, v, crc in zip(todo, pages_k, pages_v, crcs):
+            key = _resdbg.note_acquire("kv_page_obj", owner=self,
+                                       note=f"spill block {i}")
+            jobs.append((oid, tuple(resident[i * P:(i + 1) * P]),
+                         tuple(chain[:i + 1]), k, v, crc, key))
+        self._spill_q.put(jobs)
+        if traced:
+            _tracing.emit_span("engine.kv_spill", t0w, time.time(),
+                               parent=req.trace_ctx,
+                               attrs={"blocks": len(todo), "slot": slot})
+
+    def _spill_loop(self) -> None:
+        """Spill worker: pack + store-put the exported pages. Pure host
+        work on host arrays — no device access, so it needs no tick
+        guard and never contends with the engine thread's dispatch."""
+        from ray_tpu.serve.engine import kv_fleet as _kvf
+
+        while True:
+            jobs = self._spill_q.get()
+            if jobs is None:
+                return
+            for oid, toks, ch, k, v, crc, key in jobs:
+                try:
+                    payload = _kvf.pack_page(toks, ch, k, v, crc)
+                    if self._fleet.put(oid, payload):
+                        with self._fleet_lock:
+                            self._fleet_stats[
+                                "kv_fleet_spilled_blocks"] += 1
+                        self._note_fleet_hash(ch[-1])
+                except Exception:  # rtpu-lint: disable=swallowed-exception — a failed put is a skipped optimization, never a veto
+                    pass
+                finally:
+                    _resdbg.note_release("kv_page_obj", key)
+
+    def _fleet_extend(self, adm) -> None:
+        """Fleet lookup on a (partial) prefix-cache miss: walk the
+        prompt's block chain depth by depth past the local hit, pull
+        each resident page from the tier store, and install through the
+        same install_page + chain/CRC-verify seam as the disagg handoff
+        — then shrink the admission's prefill plan to the suffix.
+        Longest-contiguous-resident-prefix wins; the walk stops at the
+        first miss or rejected payload and never partially applies: a
+        failure before commit leaves cached_len untouched and the
+        suffix prefill overwrites any rows already written."""
+        from ray_tpu.serve.engine import kv_fleet as _kvf
+
+        req = adm.request
+        plen = len(req.prompt_ids)
+        P = self.kv.block_size
+        want = chain_hashes(req.prompt_ids, P)
+        max_d = min(len(want), (plen - 1) // P)
+        d0 = adm.cached_len // P
+        if max_d <= d0:
+            return
+        traced = req.trace_ctx is not None
+        t0w = time.time() if traced else 0.0
+        payloads = []
+        for d in range(d0 + 1, max_d + 1):
+            oid = _kvf.page_object_id(self._fleet_ns, want[d - 1])
+            try:
+                raw = self._fleet.get(oid)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — a store/pull error is a tier miss; the walk stops here
+                raw = None
+            if raw is None:
+                break
+            page = _kvf.unpack_page(raw)
+            if (page is None
+                    or page["chain"] != [int(h) for h in want[:d]]
+                    or page["tokens"] != [
+                        int(t) for t in
+                        req.prompt_ids[(d - 1) * P:d * P]]):
+                # Corrupt bytes (CRC/framing) or a chain-hash collision:
+                # reject — recompute covers this depth and everything
+                # past it, and the slot keeps its local state.
+                with self._fleet_lock:
+                    self._fleet_stats["kv_fleet_rejects"] += 1
+                break
+            payloads.append(page)
+        run = len(payloads)
+        # Same depth veto as scheduler.admissions: the bucket-padded
+        # suffix prefill must still fit under max_len.
+        while run > 0 and (adm.cached_len + run * P
+                           + self.scheduler._prefill_rows(
+                               plen - adm.cached_len - run * P)
+                           > self.max_len):
+            run -= 1
+        if run <= 0 or run < self._fleet_gate():
+            return
+        keys = [_resdbg.note_acquire("kv_page_obj", owner=self,
+                                     note="fleet pull")
+                for _ in range(run)]
+        try:
+            # Pages are verified depth-by-depth but INSTALLED as one
+            # contiguous run: install_page's update-slice is
+            # polymorphic over the page-row dimension, so stacking the
+            # run along the token axis writes all blocks in a single
+            # dispatch (one program per run length) instead of one
+            # dispatch per block — on small models the per-call
+            # overhead of a per-block loop costs more than the prefill
+            # it saves.
+            k_run = np.concatenate(
+                [p["k_page"] for p in payloads[:run]], axis=2)
+            v_run = np.concatenate(
+                [p["v_page"] for p in payloads[:run]], axis=2)
+            self.cache = self.loop.install_page(
+                self.cache, self._put(k_run), self._put(v_run),
+                self._put(np.int32(adm.slot)),
+                self._put(np.int32(d0 * P)))
+            new_cached = adm.cached_len + run * P
+            self.kv.commit_prefill(adm.slot, req.prompt_ids[:new_cached])
+            got_chain = list(self.kv.slot_chain(adm.slot))
+            if got_chain != [int(h) for h in want[:d0 + run]]:
+                raise RuntimeError(
+                    "KV chain mismatch after fleet install: the slot's "
+                    "block hashes disagree with the pulled prefix's")
+        finally:
+            for key in keys:
+                _resdbg.note_release("kv_page_obj", key)
+        adm.cached_len = new_cached
+        req.cached_len = new_cached
+        suffix = plen - new_cached
+        adm.chunks = self.scheduler.prefill_plan(suffix)
+        adm.bucket = bucket_for(suffix, self.buckets)
+        with self._fleet_lock:
+            self._fleet_stats["kv_fleet_hits"] += 1
+            self._fleet_stats["kv_fleet_pulled_blocks"] += run
+            self._fleet_stats["kv_fleet_tokens_reused"] += run * P
+        for j in range(run):
+            self._note_fleet_hash(want[d0 + j])
+        if traced:
+            _tracing.emit_span(
+                "engine.kv_fleet_pull", t0w, time.time(),
+                parent=req.trace_ctx,
+                attrs={"blocks": run, "tokens": run * P,
+                       "slot": adm.slot})
+
+    def _note_fleet_hash(self, h: int) -> None:
+        """Record a chain hash this replica can serve from the fleet
+        tier (spilled or pulled) — the capped newest-first summary the
+        load snapshot ships for the router's fleet term."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        cap = max(1, cfg.serve_snapshot_fleet_hashes)
+        with self._fleet_lock:
+            if h not in self._fleet_recent:
+                self._fleet_block_count += 1
+            self._fleet_recent[h] = None
+            self._fleet_recent.move_to_end(h)
+            while len(self._fleet_recent) > cap:
+                self._fleet_recent.popitem(last=False)
+
+    def _note_prefill_cost(self, seconds: float,
+                           suffix_tokens: int) -> None:
+        """Recompute-side crossover input: EWMA of measured prefill
+        milliseconds per block. The engine's first admission is
+        excluded — it pays the bucket compiles, which are not a
+        recompute cost."""
+        self._fleet_pf_samples += 1
+        if self._fleet_pf_samples == 1 or suffix_tokens <= 0:
+            return
+        ms_blk = seconds * 1e3 * self.kv.block_size / suffix_tokens
+        prev = self._fleet_pf_ms_blk
+        self._fleet_pf_ms_blk = (ms_blk if prev is None
+                                 else 0.8 * prev + 0.2 * ms_blk)
+
+    def _measure_fleet_costs(self):
+        """Pull-side crossover inputs, measured at engine start: the
+        per-page cost of a store roundtrip (put+get+decode of a
+        real-shaped synthetic page) and the per-walk lookup cost
+        (contains probe). Host-only — no device work, no compiles."""
+        from ray_tpu.serve.engine import kv_fleet as _kvf
+
+        P = self.kv.block_size
+        page = np.zeros((self.cfg.n_layers, self.cfg.n_kv_heads, P,
+                         self.cfg.head_dim), np.float32)
+        crc = zlib.crc32(page.tobytes()) ^ zlib.crc32(page.tobytes())
+        probe_hash = hash(("rtpu-kv-fleet-probe", id(self)))
+        oid = _kvf.page_object_id(self._fleet_ns, probe_hash)
+        payload = _kvf.pack_page([0] * P, [probe_hash], page, page, crc)
+        pull_ms, lookup_ms = [], []
+        try:
+            for _ in range(5):
+                self._fleet.delete(oid)
+                t0 = time.perf_counter()
+                self._fleet.put(oid, payload)
+                raw = self._fleet.get(oid)
+                if raw is not None:
+                    _kvf.unpack_page(raw)
+                pull_ms.append((time.perf_counter() - t0) * 1e3)
+                t0 = time.perf_counter()
+                self._fleet.contains(oid)
+                lookup_ms.append((time.perf_counter() - t0) * 1e3)
+        except Exception:  # rtpu-lint: disable=swallowed-exception — an unprobeable store just disables the measured crossover
+            return None, None
+        finally:
+            try:
+                self._fleet.delete(oid)
+            except Exception:  # rtpu-lint: disable=swallowed-exception — best-effort probe-object cleanup
+                pass
+        if not pull_ms:
+            return None, None
+        return min(pull_ms), min(lookup_ms)
+
+    def _crossover_blocks(self) -> Optional[int]:
+        """Measured pull-vs-recompute crossover: the contiguous run
+        length (blocks) past which pulling beats recomputing. Pulling d
+        blocks costs ~lookup + d*pull_page; recomputing them rides the
+        suffix prefill at ~d*prefill_block. None until the recompute
+        side has a sample; -1 when pulling never pays off."""
+        pf, pull = self._fleet_pf_ms_blk, self._fleet_pull_ms_page
+        if pf is None or pull is None:
+            return None
+        margin = pf - pull
+        if margin <= 0:
+            return -1
+        return max(1, math.ceil((self._fleet_lookup_ms or 0.0) / margin))
+
+    def _fleet_gate(self) -> int:
+        """Effective minimum pullable run: the knob when explicit, the
+        measured crossover when 'auto' (optimistic single-block pulls
+        until the recompute side has a sample)."""
+        g = self._fleet_min_blocks
+        if isinstance(g, int):
+            return max(0, g)
+        co = self._crossover_blocks()
+        if co is None:
+            return 1
+        if co < 0:
+            return 1 << 30
+        return co
 
     def _prefill_tick(self) -> None:
         """Advance EVERY in-progress prefill by one chunk. Intermediate
@@ -503,6 +895,9 @@ class InferenceEngine:
                                         labels={"component": "queue"})
         SERVE_TTFT_BREAKDOWN_MS.observe(prefill_s * 1e3,
                                         labels={"component": "prefill"})
+        if self._fleet is not None:
+            self._note_prefill_cost(prefill_s,
+                                    len(req.prompt_ids) - cached)
         if traced:
             # Wall-clock span boundaries reconstructed from the
             # perf_counter intervals measured above (prefill spans
@@ -544,19 +939,13 @@ class InferenceEngine:
                       "cached_prefix_len": req.cached_len}
         else:
             P = self.kv.block_size
-            pages_dev = []
-            for p in range(-(-plen // P)):
-                pages_dev.append(self.loop.export_page(
-                    self.cache, self._put(np.int32(slot)),
-                    self._put(np.int32(p * P))))
-            # ONE host sync lands every page of the slot (tagged so the
+            # Shared export path (export_pages): one program per page,
+            # ONE host sync for the batch, tagged kv_export so the
             # RTPU_DEBUG_JAX witness attributes it separately from the
-            # counted prefill sync).
-            pages = self._fetch(pages_dev, tag="kv_export")
-            pages_k = [np.ascontiguousarray(k) for k, _v in pages]
-            pages_v = [np.ascontiguousarray(v) for _k, v in pages]
-            import zlib
-
+            # counted prefill sync.
+            pages_k, pages_v, crcs = self.export_pages(
+                slot, [p * P for p in range(-(-plen // P))],
+                tag="kv_export")
             result = {
                 "kv_handoff": True,
                 "prompt_ids": list(req.prompt_ids),
@@ -572,9 +961,7 @@ class InferenceEngine:
                 # these cover the page BYTES, so a transport/export bug
                 # that mangles KV data fails the install instead of
                 # decoding garbage.
-                "page_crc": [zlib.crc32(k.tobytes())
-                             ^ zlib.crc32(v.tobytes())
-                             for k, v in zip(pages_k, pages_v)],
+                "page_crc": crcs,
                 "chain": list(self.kv.slot_chain(slot)),
                 "cached_prefix_len": req.cached_len,
             }
@@ -618,7 +1005,11 @@ class InferenceEngine:
         # the slot's rows wholesale, so counting a resident-prefix
         # "hit" here would pollute the prefix-cache stats with reuse
         # that never happens.
-        got = self.kv.acquire(req.prompt_ids, fit=lambda c: False)
+        self.kv.current_request = req
+        try:
+            got = self.kv.acquire(req.prompt_ids, fit=lambda c: False)
+        finally:
+            self.kv.current_request = None
         if got is None:
             raise RuntimeError("no free slot for KV install")
         slot, _cached = got

@@ -1,0 +1,282 @@
+"""Fleet KV-cache economy: chain-hashed prefix pages as tiered objects.
+
+Per-replica prefix caching (kv_manager.py) dies with its process — an
+evicted block's KV is recomputed even when an identical prefix was
+materialized seconds ago on this node or a peer. This module gives the
+chain-hashed KV page a cluster-object lifecycle instead:
+
+  HBM (slot rows)  --evict-->  shm store  --LRU clock-->  disk spill
+        ^                          |
+        +------- fleet pull -------+   (local memcpy, or the peer /
+                                        multi-source pull path when the
+                                        holder is another node)
+
+One object per COMPLETE prefix block, keyed by a deterministic object
+id derived from (model fingerprint, chain hash). The chain-hash
+property — ``h_i = H(h_{i-1}, block_i)`` — means a single hash
+identifies the whole prefix through block ``i``, so a puller walks its
+own prompt's chain depth by depth and stops at the first miss:
+longest-resident-prefix wins without a directory range scan.
+
+The payload is the PR 15 export shape (``k_page``/``v_page`` of
+``[L, KH, P, D]`` + per-page CRC + the chain prefix), and installs go
+through the same ``install_page`` + chain-verify seam as the disagg
+handoff, so wrong KV cannot decode silently no matter which tier it
+came from.
+
+Two store backends behind one duck type (``put/get/contains/stats``):
+
+* ``LocalKVPageStore`` — in-process dict with an LRU byte cap. The
+  store-free fallback (unit tests, single-process serving without a
+  cluster runtime); also shareable between engines in one process to
+  model a node's shm tier.
+* ``ClusterKVPageStore`` — rides the real shm object store: puts
+  register in the sharded head object directory like any other object,
+  gets fall back to a directory lookup + ``pull_object`` through the
+  multi-source pull manager, and tier residency (shm -> disk spill)
+  rides the store's existing global eviction clock for free.
+
+Model identity matters: chain hashes cover TOKENS only, so the object
+id namespace folds in every config knob that changes KV bytes for the
+same tokens (dims, layers, dtype, quantization, block size, param
+seed). Two deployments of different models can share a store without
+ever resolving each other's pages.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import struct
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# Matches ids._FLAG_PUT: fleet page ids present as ordinary put-objects
+# to the directory/pull plumbing (no task lineage to reconstruct them).
+_PUT_FLAGS = struct.pack("<I", 0x1)
+
+_MAGIC = b"RTKV1\n"
+
+
+def fleet_namespace(cfg, block_size: int, quantize: Optional[str],
+                    seed: int) -> bytes:
+    """20-byte namespace digest over everything that changes KV BYTES
+    for the same token ids. Engines whose namespaces differ can never
+    resolve each other's pages — the silent-wrong-KV failure mode is
+    structurally unreachable, not just checked."""
+    ident = (
+        "rtpu-kv-fleet", int(cfg.vocab_size), int(cfg.d_model),
+        int(cfg.n_layers), int(cfg.n_heads), int(cfg.n_kv_heads),
+        int(cfg.max_seq_len), str(getattr(cfg, "dtype", "")),
+        str(quantize), int(seed), int(block_size),
+    )
+    return hashlib.blake2b(repr(ident).encode(), digest_size=20).digest()
+
+
+def page_object_id(namespace: bytes, chain_hash: int):
+    """Deterministic ObjectID for the prefix ending at ``chain_hash``.
+    Layout matches ids.ObjectID (index 4B + task 20B + flags 4B): the
+    24 content bytes come from hashing (namespace, chain hash), the
+    flags mark it a put-object. Every holder of the same prefix derives
+    the same id — which is what makes dedupe and fleet lookup work with
+    no coordination."""
+    from ray_tpu.core.ids import ObjectID
+
+    h = hashlib.blake2b(
+        namespace + struct.pack("<Q", chain_hash & (2 ** 64 - 1)),
+        digest_size=24).digest()
+    return ObjectID(h + _PUT_FLAGS)
+
+
+def pack_page(block_tokens, chain, k_page: np.ndarray,
+              v_page: np.ndarray, crc: int) -> bytes:
+    """Serialize one block's payload. np.save framing (not pickle):
+    shape/dtype ride in the header, the page bytes stream raw, and
+    unpack never executes attacker-controlled bytecode."""
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    toks = np.asarray(block_tokens, np.int64)
+    ch = np.asarray(chain, np.int64)
+    buf.write(struct.pack("<qII", crc & 0xFFFFFFFF, len(toks), len(ch)))
+    buf.write(toks.tobytes())
+    buf.write(ch.tobytes())
+    np.save(buf, np.ascontiguousarray(k_page), allow_pickle=False)
+    np.save(buf, np.ascontiguousarray(v_page), allow_pickle=False)
+    return buf.getvalue()
+
+
+def unpack_page(raw: bytes) -> Optional[Dict[str, Any]]:
+    """Decode + integrity-check one payload. Returns None on ANY
+    corruption (bad magic, short read, CRC mismatch) — the caller
+    treats it exactly like a store miss and recomputes."""
+    try:
+        buf = io.BytesIO(raw)
+        if buf.read(len(_MAGIC)) != _MAGIC:
+            return None
+        crc, nt, nc = struct.unpack("<qII", buf.read(16))
+        tokens = np.frombuffer(buf.read(8 * nt), np.int64)
+        chain = np.frombuffer(buf.read(8 * nc), np.int64)
+        k_page = np.load(buf, allow_pickle=False)
+        v_page = np.load(buf, allow_pickle=False)
+    except Exception:  # rtpu-lint: disable=swallowed-exception — truncated/garbled frame reads as a store miss by design
+        return None
+    got = (zlib.crc32(np.ascontiguousarray(k_page).tobytes())
+           ^ zlib.crc32(np.ascontiguousarray(v_page).tobytes()))
+    if (got & 0xFFFFFFFF) != (crc & 0xFFFFFFFF):
+        return None
+    return {"tokens": [int(t) for t in tokens],
+            "chain": [int(h) for h in chain],
+            "k_page": k_page, "v_page": v_page, "crc": int(crc)}
+
+
+class LocalKVPageStore:
+    """In-process page tier: dict + LRU byte cap. The store-free
+    fallback when no cluster runtime (and thus no shm arena) is
+    attached; tests share one instance between engines to model the
+    node-local shm tier without the native library."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None):
+        if capacity_bytes is None:
+            from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+            capacity_bytes = cfg.serve_kv_fleet_local_bytes
+        self.capacity_bytes = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._objs: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.evictions = 0
+
+    def put(self, oid, payload: bytes) -> bool:
+        key = oid.binary()
+        with self._lock:
+            if key in self._objs:
+                return False
+            self._objs[key] = payload
+            self._bytes += len(payload)
+            while self._bytes > self.capacity_bytes and len(self._objs) > 1:
+                _k, old = self._objs.popitem(last=False)
+                self._bytes -= len(old)
+                self.evictions += 1
+            return True
+
+    def get(self, oid) -> Optional[bytes]:
+        key = oid.binary()
+        with self._lock:
+            raw = self._objs.get(key)
+            if raw is not None:
+                self._objs.move_to_end(key)  # a hit is a hotness signal
+            return raw
+
+    def contains(self, oid) -> bool:
+        with self._lock:
+            return oid.binary() in self._objs
+
+    def delete(self, oid) -> bool:
+        key = oid.binary()
+        with self._lock:
+            raw = self._objs.pop(key, None)
+            if raw is not None:
+                self._bytes -= len(raw)
+            return raw is not None
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"objects": len(self._objs), "bytes": self._bytes,
+                    "evictions": self.evictions}
+
+
+_local_singleton: Optional[LocalKVPageStore] = None
+_local_lock = threading.Lock()
+
+
+def local_store() -> LocalKVPageStore:
+    """Process-wide LocalKVPageStore: engines in one process share the
+    "node" tier even without a cluster runtime."""
+    global _local_singleton
+    with _local_lock:
+        if _local_singleton is None:
+            _local_singleton = LocalKVPageStore()
+        return _local_singleton
+
+
+class ClusterKVPageStore:
+    """Page tier over the real cluster object plane. Puts land in the
+    node's shm arena and register in the sharded head directory via the
+    same batched object-notify path as task outputs; gets try the local
+    arena (memcpy), then one directory-guided ``pull_object`` through
+    the node manager's multi-source pull manager. Eviction needs no new
+    code: the arena's global LRU clock spills cold pages to disk and
+    ``get`` transparently restores them."""
+
+    def __init__(self, core, pull_timeout_ms: int = 2000):
+        self._core = core          # ClusterCore (driver or worker runtime)
+        self._pull_timeout_ms = int(pull_timeout_ms)
+
+    def put(self, oid, payload: bytes) -> bool:
+        store = self._core.store
+        try:
+            if store.contains(oid):
+                return False
+            store.put_bytes(oid, payload)
+        except Exception:  # rtpu-lint: disable=swallowed-exception — duplicate-create race / arena pressure; see below
+            # Duplicate create (a sibling replica on this node raced the
+            # same chain hash) or arena pressure: the page tier is a
+            # cache — a failed put is a skipped optimization, never an
+            # error the engine should see.
+            return False
+        self._core._queue_object_notify("add", oid.binary(), len(payload))
+        return True
+
+    def get(self, oid, remote: bool = True) -> Optional[bytes]:
+        store = self._core.store
+        raw = store.get_bytes(oid)
+        if raw is not None or not remote:
+            return raw
+        try:
+            holders = self._core.head.call(
+                "object_locations", oid.binary(),
+                getattr(self._core, "node_id", None), timeout=2)
+        except Exception:  # rtpu-lint: disable=swallowed-exception — directory unreachable == tier miss; recompute covers it
+            return None
+        if not holders:
+            return None
+        try:
+            ok = bool(self._core.node.call(
+                "pull_object", oid.binary(), self._pull_timeout_ms, None,
+                timeout=self._pull_timeout_ms / 1e3 + 2))
+        except Exception:  # rtpu-lint: disable=swallowed-exception — failed peer pull == tier miss; recompute covers it
+            return None
+        return store.get_bytes(oid) if ok else None
+
+    def contains(self, oid) -> bool:
+        return self._core.store.contains(oid)
+
+    def delete(self, oid) -> bool:
+        store = self._core.store
+        if store.delete(oid):
+            self._core._queue_object_notify("rm", oid.binary())
+            return True
+        return False
+
+    def stats(self) -> Dict[str, int]:
+        used, cap, n, ev = self._core.store.stats()
+        return {"objects": n, "bytes": used, "evictions": ev}
+
+
+def resolve_store(explicit=None):
+    """Pick the page tier for an engine: an explicit store instance
+    (tests, bench), else the cluster shm store when a runtime is
+    attached, else the process-local fallback."""
+    if explicit is not None:
+        return explicit
+    from ray_tpu.core.runtime_context import get_runtime
+
+    rt = get_runtime()
+    if (rt is not None and getattr(rt, "store", None) is not None
+            and getattr(rt, "node", None) is not None):
+        return ClusterKVPageStore(rt)
+    return local_store()

@@ -87,6 +87,17 @@ class KVCacheManager:
         self.hits = 0
         self.misses = 0
         self.tokens_reused = 0
+        # Fleet KV tier (kv_fleet.py): when the engine sets this, every
+        # acquire that is about to destroy still-valid resident rows
+        # reports them FIRST — hook(slot, resident, chain, keep_blocks)
+        # runs before any row is unindexed or overwritten, so the
+        # engine can export the dying blocks off-device (HBM -> shm
+        # spill). keep_blocks leading blocks survive in HBM (a prefix
+        # hit keeps them); the hook must never raise into admission.
+        self.spill_hook = None
+        # The request whose acquire is in flight (set by callers around
+        # acquire): the spill hook parents its tracing span on it.
+        self.current_request = None
 
     # ------------------------------------------------------------- hashing
 
@@ -167,6 +178,19 @@ class KVCacheManager:
             slot = self._free.pop(0)
             cached_len = 0
             self.misses += 1
+        if self.spill_hook is not None:
+            # The victim's rows are still valid HERE (nothing is written
+            # until the new admission's first prefill chunk dispatches,
+            # and this whole path runs on the engine thread): the spill
+            # tier's one chance to export blocks beyond the kept prefix
+            # before resident/chain are overwritten below.
+            victim = self._slots[slot]
+            if len(victim.resident) >= self.block_size:
+                try:
+                    self.spill_hook(slot, victim.resident, victim.chain,
+                                    cached_len // self.block_size)
+                except Exception:  # rtpu-lint: disable=swallowed-exception — the spill tier is an optimization, never an admission veto
+                    pass
         self._unindex(slot)
         info = self._slots[slot]
         info.in_use = True

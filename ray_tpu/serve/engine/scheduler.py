@@ -178,10 +178,14 @@ class Scheduler:
             # silently shift the suffix KV onto the wrong rows. (Chunked
             # prefill pads only the FINAL chunk, so its row bound is
             # usually tighter than the one-shot bucket.)
-            got = self.kv.acquire(
-                req.prompt_ids,
-                fit=lambda c: (c + self._prefill_rows(plen - c)
-                               <= self.max_len))
+            self.kv.current_request = req
+            try:
+                got = self.kv.acquire(
+                    req.prompt_ids,
+                    fit=lambda c: (c + self._prefill_rows(plen - c)
+                                   <= self.max_len))
+            finally:
+                self.kv.current_request = None
             if got is None:  # raced to exhaustion
                 self._waiting.appendleft(req)
                 return

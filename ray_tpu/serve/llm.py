@@ -38,9 +38,18 @@ __all__ = ["GenerationRequest", "LLMEngine", "build_llm_deployment"]
 #: Decode-pool routing profile: KV headroom dominates (the decode
 #: replica's scarce resource is cache blocks), queue pressure second,
 #: prefix affinity zero (installed pages overwrite the slot wholesale —
-#: residency buys a decode replica nothing at admission time).
+#: residency buys a decode replica nothing at admission time, and the
+#: same goes for fleet-tier residency).
 DECODE_POOL_WEIGHTS = {"prefix": 0.0, "queue": 0.5, "kv": 2.0,
-                       "ttft": 0.0}
+                       "ttft": 0.0, "fleet": 0.0}
+
+#: Fleet-enabled colocated pools (build_llm_deployment callers that
+#: turn the KV page tier on) typically route with this profile: HBM
+#: residency still dominates, but a replica holding the prompt's
+#: SPILLED prefix pages beats a cold one — a shm pull is cheaper than
+#: recompute past the measured crossover.
+FLEET_POOL_WEIGHTS = {"prefix": 1.5, "queue": 0.5, "kv": 1.0,
+                      "ttft": 0.0, "fleet": 0.75}
 
 
 class DecodeReplicaDied(RuntimeError):
