@@ -123,6 +123,8 @@ DAG_TIMEOUT_S = 420        # dag child (2-actor cluster, channel vs RPC hops)
 DATA_TIMEOUT_S = 420       # data child (channel-vs-task shuffle + ingest A/B)
 DISAGG_TIMEOUT_S = 900     # disagg serve sweep (colocated vs disagg TTFT)
 KV_FLEET_TIMEOUT_S = 600   # fleet KV tier A/B (spill/pull vs recompute)
+SERVE_SCALE_TIMEOUT_S = 900  # serve-scale suite (router sim + QoS flood
+#                              + streaming disagg A/B cluster)
 
 
 def peak_flops_for(device_kind: str) -> float:
@@ -2799,6 +2801,383 @@ def kv_fleet_bench_main() -> int:
 
 
 # --------------------------------------------------------------------------
+# serve-scale suite: million-session router sim + QoS flood + streaming A/B
+# --------------------------------------------------------------------------
+
+def _scale_session_deck(n: int = 1_000_000,
+                        space: int = 1_000_000) -> list:
+    """A heavy-tailed (Pareto) deck of session ids: a handful of hot
+    multi-turn sessions dominate while the tail spans ~1M distinct
+    users — the popularity shape the session-affinity LRU and the
+    prefix index are built for."""
+    import random
+
+    rng = random.Random(1234)
+    return [min(int((rng.paretovariate(1.1) - 1.0) * 4000.0), space - 1)
+            for _ in range(n)]
+
+
+def _router_scale_sim(n_replicas: int, deck: list, templates: list,
+                      chains: list, measure_s: float = 3.0) -> dict:
+    """Route the session deck against ``n_replicas`` simulated load
+    snapshots with NO cluster: the router's choose() hot path is what
+    scales (candidate subsets + incremental rank + delta'd snapshot
+    fan-in), so driving it directly measures decisions/s at fleet
+    sizes the box can't boot. A ~1% delta sweep lands every ~0.5s —
+    the controller-journal cadence — so freshness never lapses into
+    the pow-2 fallback and the rank keeps absorbing O(touched)
+    updates mid-measure."""
+    import random
+
+    from ray_tpu.devtools.lock_debug import make_lock
+    from ray_tpu.serve._private.router import Router
+
+    rng = random.Random(n_replicas)
+    # Equal candidate pressure at every scale: ~20 replicas hold each
+    # prompt-template chain (the affinity-candidate cap saturates), so
+    # per-decision work is identical and the flatness ratio measures
+    # the fleet-size dependence alone.
+    P = max(8, min(len(templates), n_replicas // 20))
+
+    def _snap(i, now):
+        return {"ts": now, "queue_depth": (i * 7) % 5, "waiting": 0,
+                "slots": 4, "kv_free_blocks": (i * 3) % 9,
+                "kv_total_blocks": 8, "prefix_block_size": 4,
+                "prefix_hashes": chains[i % P]}
+
+    now = time.time()
+    replicas = [f"r{i}" for i in range(n_replicas)]
+    r = Router.__new__(Router)
+    r._controller = None
+    r._deployment = "scale-sim"
+    r._lock = make_lock("serve.router._lock")
+    r._replicas = []
+    r._version = -1
+    r._load_gen = -1
+    r._loads = {}
+    r._inflight = {}
+    r._model_affinity = {}
+    r._scored_routes = 0
+    r._pow2_routes = 0
+    r._affinity_routes = 0
+    r._poller_started = True  # sim mode: never spawn the long-poller
+    r._poll_thread = None
+    r._stopped = False
+    t0 = time.perf_counter()
+    r._apply(1, replicas, 1, [_snap(i, now) for i in range(n_replicas)])
+    apply_ms = (time.perf_counter() - t0) * 1e3
+    sweep = max(1, n_replicas // 100)
+    gen = 1
+    decisions = 0
+    sessions = set()
+    di = rng.randrange(len(deck))
+    # Warm the route path (first choose touches lazy state), then
+    # measure a fixed wall window.
+    r.done(r.choose(prefix_tokens=templates[0], session_key=deck[di]))
+    t_next_delta = time.monotonic() + 0.5
+    t_end = time.monotonic() + measure_s
+    t_start = time.monotonic()
+    while True:
+        now_m = time.monotonic()
+        if now_m >= t_end:
+            break
+        if now_m >= t_next_delta:
+            gen += 1
+            ups = {}
+            for _ in range(sweep):
+                i = rng.randrange(n_replicas)
+                ups[i] = _snap(i, time.time())
+            assert r._apply_delta(1, ups, load_gen=gen)
+            t_next_delta = now_m + 0.5
+            continue
+        s = deck[di]
+        di = (di + 1) % len(deck)
+        sessions.add(s)
+        choice = r.choose(prefix_tokens=templates[s % P], session_key=s)
+        r.done(choice)
+        decisions += 1
+    span = time.monotonic() - t_start
+    st = r.stats()
+    scored = max(1, st["scored_routes"])
+    return {
+        "metric": f"serve_scale_router_{n_replicas}",
+        "replicas": n_replicas,
+        "decisions": decisions,
+        "decisions_per_s": round(decisions / span, 1),
+        "apply_full_ms": round(apply_ms, 2),
+        "avg_candidates_scored": round(
+            st["candidates_scored"] / scored, 2),
+        "scored_frac": round(st["scored_routes"]
+                             / max(1, decisions + 1), 4),
+        "session_affinity_routes": st["session_affinity_routes"],
+        "distinct_sessions": len(sessions),
+        "deck_sessions": len(deck),
+        "delta_sweeps": gen - 1,
+    }
+
+
+def _qos_flood_sim(measure_s: float = 3.0) -> dict:
+    """Hostile-tenant flood against the WFQ admission gate, no
+    cluster: 4 well-behaved tenants and one flooder firing ~50x its
+    token budget. The contract is per-tenant isolation — the flooder
+    sheds on ITS OWN bucket + queue while the good tenants' p99
+    acquire latency stays flat."""
+    import threading
+
+    from ray_tpu.serve._private.slo import (AdmissionController,
+                                            DeploymentOverloadedError)
+
+    ac = AdmissionController(budget_ms=0.0, queue_depth=64,
+                             queue_timeout_s=0.25, window=256,
+                             min_samples=1, probe_inflight=4)
+    ac.configure_tenant("flood", weight=1.0, tokens_per_s=20.0,
+                        burst_tokens=10.0)
+    good = [f"good{i}" for i in range(4)]
+    stop = threading.Event()
+    lat = {t: [] for t in good + ["flood"]}
+    shed_local = {"flood": 0}
+    lock = threading.Lock()
+
+    def tenant_loop(t, cost, rate_hz):
+        period = 1.0 / rate_hz
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                ac.acquire("d", tenant=t, cost=cost)
+            except DeploymentOverloadedError:
+                with lock:
+                    shed_local[t] = shed_local.get(t, 0) + 1
+                continue
+            wait_ms = (time.perf_counter() - t0) * 1e3
+            time.sleep(0.002)  # simulated service time
+            ac.record_ttft("d", wait_ms + 2.0, tenant=t)
+            ac.release("d", tenant=t)
+            with lock:
+                lat[t].append(wait_ms + 2.0)
+            time.sleep(max(0.0, period - 0.002))
+
+    threads = [threading.Thread(target=tenant_loop, args=(t, 5.0, 40.0),
+                                daemon=True) for t in good]
+    threads += [threading.Thread(target=tenant_loop,
+                                 args=("flood", 5.0, 50.0), daemon=True)
+                for _ in range(4)]  # ~200 req/s vs a 4 req/s budget
+    for t in threads:
+        t.start()
+    time.sleep(measure_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    def _p99(vals):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(len(vals) * 0.99))], 2)
+
+    snap = ac.snapshot()["d"]["tenants"]
+    good_p99 = max(_p99(lat[t]) or 0.0 for t in good)
+    return {
+        "metric": "serve_scale_qos",
+        "good_p99_ttft_ms": good_p99,
+        "good_admitted": sum(len(lat[t]) for t in good),
+        "good_shed": sum(snap.get(t, {}).get("shed", 0) for t in good),
+        "flood_p99_ttft_ms": _p99(lat["flood"]),
+        "flood_admitted": len(lat["flood"]),
+        "flood_shed": snap.get("flood", {}).get("shed", 0),
+    }
+
+
+def serve_scale_child_main() -> int:
+    """Simulated-serve scale suite: (1) router decisions/s against
+    100 -> 10k replica load snapshots under a ~1M-session heavy-tailed
+    deck (flatness is the O(touched) acceptance bar), (2) WFQ flood
+    isolation, (3) a REAL mini-cluster streaming-disagg A/B — p50
+    TTFT of the token stream vs the non-streaming probe in the same
+    window — then (4) the RTPU_DEBUG_RES leak census over all of it."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+    from ray_tpu.serve.engine.kv_manager import chain_hashes
+
+    rows = []
+    cfg.set("serve_router_policy", "scored")
+    templates = [[(t * 7 + j) % 251 + 1 for j in range(12)]
+                 for t in range(512)]
+    chains = [chain_hashes(p, 4) for p in templates]
+    deck = _scale_session_deck()
+    for n in (100, 1000, 10000):
+        rows.append(_router_scale_sim(n, deck, templates, chains))
+    rows.append(_qos_flood_sim())
+    rows.append(_stream_ab_row())
+    try:
+        from ray_tpu.devtools import res_debug
+
+        rows.append({
+            "metric": "serve_scale_res",
+            "leaked_resources": sum(res_debug.outstanding().values()),
+            "res_violations": len(res_debug.violations()),
+        })
+    except Exception as e:  # noqa: BLE001 — census never blocks rows
+        rows.append({"metric": "serve_scale_res", "error": repr(e)[:200]})
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+def _stream_ab_row() -> dict:
+    """Same-window streaming-vs-probe A/B on a real disagg deployment
+    (1 prefill + 1 decode) plus a colocated streaming reference: the
+    stream's first token leaves at prefill time over the reverse
+    channel, so its p50 TTFT must hold the non-streaming probe's line
+    — streaming is free, not a second hop."""
+    try:
+        import ray_tpu
+        import ray_tpu.serve as serve
+        from ray_tpu.serve.llm import build_llm_deployment
+    except Exception as e:  # noqa: BLE001 — import gap -> error row
+        return {"metric": "serve_scale_stream", "error": repr(e)[:200]}
+
+    ek = dict(max_batch=4, max_len=288,
+              prompt_buckets=[16, 32, 64, 128, 256], decode_chunk=4,
+              prefill_chunk=32, seed=0)
+    measure_s = 10.0
+    row = {"metric": "serve_scale_stream"}
+    try:
+        ray_tpu.init(num_cpus=24)
+        try:
+            colo = serve.run(build_llm_deployment(
+                name="scstcolo", engine_kwargs=ek))
+            dis = serve.run(build_llm_deployment(
+                name="scstdis", disaggregated=True,
+                num_prefill_replicas=1, num_decode_replicas=1,
+                engine_kwargs=ek))
+            warm = {"prompt_ids": [7] * 16, "max_new_tokens": 4}
+            colo.remote(dict(warm)).result(timeout=600)
+            dis.remote(dict(warm)).result(timeout=600)
+
+            def _stream_once(h, i, new_tokens):
+                req = {"prompt_ids": [(i * 11 + j) % 251 + 1
+                                      for j in range(16)],
+                       "max_new_tokens": new_tokens}
+                t0 = time.perf_counter()
+                first = last = None
+                n = 0
+                for _ in h.options("stream", stream=True).remote(req):
+                    last = time.perf_counter()
+                    if first is None:
+                        first = last
+                    n += 1
+                ttft = (first - t0) * 1e3
+                tpot = ((last - first) / max(1, n - 1)) * 1e3
+                return ttft, tpot, n
+
+            for name, h in (("colo", colo), ("disagg", dis)):
+                ttfts, tpots, sprobes, probes = [], [], [], []
+                t_end = time.monotonic() + measure_s
+                i = 0
+                while time.monotonic() < t_end:
+                    # Interleave a full stream, a STREAMED probe and a
+                    # non-streaming probe: the A/B shares the window and
+                    # the replica state, and probe-vs-stream-probe is
+                    # the same request shape (completes at token 1), so
+                    # any gap is the streaming plumbing itself.
+                    ttft, tpot, n = _stream_once(h, i, 24)
+                    ttfts.append(ttft)
+                    tpots.append(tpot)
+                    sprobes.append(_stream_once(h, i, 1)[0])
+                    t0 = time.perf_counter()
+                    h.remote({"prompt_ids": [3] * 16,
+                              "max_new_tokens": 1}).result(timeout=300)
+                    probes.append((time.perf_counter() - t0) * 1e3)
+                    i += 1
+                for k, vals in (("stream_p50_ttft_ms", ttfts),
+                                ("stream_p50_tpot_ms", tpots),
+                                ("stream_probe_p50_ttft_ms", sprobes),
+                                ("probe_p50_ttft_ms", probes)):
+                    vals.sort()
+                    row[f"{name}_{k}"] = round(vals[len(vals) // 2], 2)
+                row[f"{name}_streams"] = len(ttfts)
+        finally:
+            try:
+                serve.shutdown()
+            finally:
+                ray_tpu.shutdown()
+    except Exception as e:  # noqa: BLE001 — cluster gap -> error row
+        row["error"] = repr(e)[:200]
+    return row
+
+
+def _serve_scale_rows() -> list:
+    try:
+        proc = _run(["--serve-scale-child"], SERVE_SCALE_TIMEOUT_S,
+                    env_extra={"JAX_PLATFORMS": "cpu",
+                               "RTPU_DEBUG_RES": "1"})
+    except subprocess.TimeoutExpired:
+        return [{"metric": "serve_scale",
+                 "error": f"timeout {SERVE_SCALE_TIMEOUT_S}s"}]
+    lines = _json_lines(proc.stdout)
+    if lines and proc.returncode == 0:
+        return lines
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    out = lines or []
+    out.append({"metric": "serve_scale",
+                "error": "rc=%d: %s" % (proc.returncode,
+                                        " | ".join(tail))})
+    return out
+
+
+def _merge_serve_scale_rows(rows: list) -> dict:
+    by = {r.get("metric"): r for r in rows}
+    merged: dict = {"metric": "serve_scale"}
+    err = next((r["error"] for r in rows if "error" in r), None)
+    if err:
+        merged["error"] = err
+    lo = by.get("serve_scale_router_100", {})
+    hi = by.get("serve_scale_router_10000", {})
+    if lo.get("decisions_per_s") and hi.get("decisions_per_s"):
+        merged["router_decisions_per_s"] = hi["decisions_per_s"]
+        merged["router_decisions_per_s_100"] = lo["decisions_per_s"]
+        # ~1.0 == flat: choose() cost held while the snapshot set grew
+        # 100x (the O(touched) acceptance bar is 0.8+).
+        merged["router_scale_flatness"] = round(
+            hi["decisions_per_s"] / lo["decisions_per_s"], 3)
+        merged["router_avg_candidates_scored_10k"] = \
+            hi.get("avg_candidates_scored")
+    qos = by.get("serve_scale_qos", {})
+    for src, dst in (("good_p99_ttft_ms", "serve_qos_good_p99_ttft_ms"),
+                     ("flood_p99_ttft_ms",
+                      "serve_qos_flood_p99_ttft_ms"),
+                     ("flood_shed", "serve_qos_flood_shed")):
+        if qos.get(src) is not None:
+            merged[dst] = qos[src]
+    st = by.get("serve_scale_stream", {})
+    if "error" not in st:
+        for src, dst in (
+                ("disagg_stream_p50_ttft_ms",
+                 "serve_stream_disagg_p50_ttft_ms"),
+                ("disagg_stream_p50_tpot_ms",
+                 "serve_stream_disagg_p50_tpot_ms"),
+                ("disagg_stream_probe_p50_ttft_ms",
+                 "serve_stream_disagg_probe_p50_ttft_ms"),
+                ("disagg_probe_p50_ttft_ms",
+                 "serve_disagg_probe_p50_ttft_ms"),
+                ("colo_stream_p50_ttft_ms",
+                 "serve_stream_colo_p50_ttft_ms")):
+            if st.get(src) is not None:
+                merged[dst] = st[src]
+    res = by.get("serve_scale_res", {})
+    if res.get("leaked_resources") is not None:
+        merged["serve_scale_leaked_resources"] = res["leaked_resources"]
+    return merged
+
+
+def serve_scale_main() -> int:
+    rows = _serve_scale_rows()
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    print(json.dumps(_merge_serve_scale_rows(rows)))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+# --------------------------------------------------------------------------
 # parent supervisor
 # --------------------------------------------------------------------------
 
@@ -3048,6 +3427,17 @@ def main() -> int:
     for r in kvf_rows:
         print(json.dumps(r), flush=True)
 
+    # Phase 12: serve-scale suite on CPU (1M-session router sim at
+    # 100 -> 10k snapshots, WFQ flood isolation, streaming disagg
+    # TTFT/TPOT A/B). Tracked from this PR.
+    svs_rows: list = []
+    try:
+        svs_rows = _serve_scale_rows()
+    except Exception as e:  # noqa: BLE001 — never blocks the bench
+        svs_rows = [{"metric": "serve_scale", "error": repr(e)[:200]}]
+    for r in svs_rows:
+        print(json.dumps(r), flush=True)
+
     # Final merged line (the driver parses the tail line): headline is the
     # 8B north star when it measured, else the 1B row.
     by_metric = {r.get("metric"): r for r in rows}
@@ -3208,6 +3598,22 @@ def main() -> int:
                 merged[k] = kvf_merged[k]
     else:
         merged["kv_fleet_error"] = kvf_merged["error"]
+    svs_merged = _merge_serve_scale_rows(svs_rows)
+    for k in ("router_decisions_per_s", "router_decisions_per_s_100",
+              "router_scale_flatness",
+              "router_avg_candidates_scored_10k",
+              "serve_qos_good_p99_ttft_ms",
+              "serve_qos_flood_p99_ttft_ms", "serve_qos_flood_shed",
+              "serve_stream_disagg_p50_ttft_ms",
+              "serve_stream_disagg_p50_tpot_ms",
+              "serve_stream_disagg_probe_p50_ttft_ms",
+              "serve_disagg_probe_p50_ttft_ms",
+              "serve_stream_colo_p50_ttft_ms",
+              "serve_scale_leaked_resources"):
+        if svs_merged.get(k) is not None:
+            merged[k] = svs_merged[k]
+    if "error" in svs_merged:
+        merged["serve_scale_error"] = svs_merged["error"]
     print(json.dumps(merged))
     return 0
 
@@ -3257,6 +3663,10 @@ if __name__ == "__main__":
         sys.exit(kv_fleet_child_main())
     if "--kv-fleet" in sys.argv:
         sys.exit(kv_fleet_bench_main())
+    if "--serve-scale-child" in sys.argv:
+        sys.exit(serve_scale_child_main())
+    if "--serve-scale" in sys.argv:
+        sys.exit(serve_scale_main())
     if "--probe" in sys.argv:
         sys.exit(probe_main())
     sys.exit(main())

@@ -429,6 +429,36 @@ _d("serve_autoscale_down_threshold", float, 0.5,
 _d("serve_autoscale_cooldown_s", float, 5.0,
    "serve autoscaling: min seconds between replica-count changes "
    "(hysteresis both directions)")
+_d("serve_qos_tokens_per_s", float, 0.0,
+   "per-tenant QoS: default token-budget refill rate (LLM tokens/s — "
+   "prompt + max_new per request) for tenants without an explicit "
+   "TenantConfig. 0 (default) = unlimited budget; WFQ ordering and "
+   "priority classes still apply between contending tenants")
+_d("serve_qos_burst_tokens", float, 0.0,
+   "per-tenant QoS: default token-bucket capacity; 0 derives 4 seconds "
+   "of the refill rate (a short burst rides through, sustained flood "
+   "pins the tenant to its rate)")
+_d("serve_qos_queue_depth", int, 0,
+   "per-tenant QoS: max requests parked PER TENANT at the admission "
+   "gate before that tenant sheds (isolation: one flooding tenant "
+   "fills only its own queue). 0 = use serve_slo_queue_depth")
+_d("serve_router_topk", int, 4,
+   "scored routing at scale (> serve_router_score_all_max replicas): "
+   "how many best-base-score candidates the incremental rank feeds "
+   "into full scoring per decision — O(topk), not O(replicas)")
+_d("serve_router_affinity_cands", int, 4,
+   "scored routing at scale: cap on prefix/fleet-affinity candidates "
+   "pulled from the inverted hash index per decision (joined with the "
+   "top-k base candidates)")
+_d("serve_router_session_affinity_max", int, 8192,
+   "sticky-session routing: cap on session-key -> replica pins held "
+   "per router (FIFO evict past it); multi-turn sessions re-land on "
+   "the replica holding their prefix blocks")
+_d("serve_snapshot_journal", int, 64,
+   "controller load-snapshot delta fan-out: how many recent load "
+   "generations of per-replica change sets are journaled per "
+   "deployment — long-pollers within the window receive only changed "
+   "snapshots (O(touched)); anyone further behind gets a full resync")
 
 # --- client tier ---
 _d("client_ref_flush_period_s", float, 0.2,

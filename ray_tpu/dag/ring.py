@@ -336,10 +336,26 @@ class RingChannel:
 
     def _spill_in(self, kind: int, name_b: bytes):
         path = os.path.join(channel_dir(), name_b.decode())
-        with open(path, "rb") as f:
+        # CLAIM the side file by atomic rename before touching its
+        # contents: the writer's close() reclaims spills it believes
+        # unconsumed once its grace window expires, and a plain open()
+        # here raced that unlink (the bench.py --dag flake — the reader
+        # had dequeued the ring record but not yet opened the file).
+        # rename vs unlink is atomic either way: if we win, the writer's
+        # unlink of the original ENOENTs harmlessly; if the writer won,
+        # the rename fails and the stream is truthfully reported closed.
+        claimed = path + ".rd"
+        try:
+            os.rename(path, claimed)
+        except FileNotFoundError:
+            raise ChannelClosedError(
+                f"channel {self.edge}: spill {os.path.basename(path)} "
+                "reclaimed by writer close before the reader consumed "
+                "it") from None
+        with open(claimed, "rb") as f:
             payload = f.read()
         try:
-            os.unlink(path)
+            os.unlink(claimed)
         except OSError:
             pass
         return (KIND_OK if kind == KIND_SPILL else KIND_ERR), payload

@@ -11,6 +11,7 @@ count (reference autoscaling_policy.py:12).
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -69,6 +70,14 @@ class ServeController:
         """Callers hold self._lock. Wakes every long-poller."""
         self._version_clock += 1
         self._set_versions[name] = self._version_clock
+        # Journal entries index into the replica LIST; a set change
+        # renumbers it, so the delta history is void (routers detect
+        # the version move and take a full payload anyway).
+        d = self._deployments.get(name)
+        if d is not None:
+            j = d.get("journal")
+            if j is not None:
+                j.clear()
         self._set_cond.notify_all()
 
     # ------------------------------------------------------------- deploy
@@ -337,6 +346,8 @@ class ServeController:
                 changed.append((name, loads))
         if not changed:
             return
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
         with self._lock:
             for name, loads in changed:
                 d = self._deployments.get(name)
@@ -344,11 +355,27 @@ class ServeController:
                     continue
                 # Keep only entries for replicas still in the set.
                 current = set(d["replicas"])
-                d["loads"] = {r: s for r, s in loads.items()
-                              if r in current}
+                old_loads = d.get("loads") or {}
+                new_loads = {r: s for r, s in loads.items()
+                             if r in current}
+                d["loads"] = new_loads
                 d["loads_mono"] = time.monotonic()
                 self._version_clock += 1
-                self._load_gens[name] = self._version_clock
+                gen = self._load_gens[name] = self._version_clock
+                # Delta journal: which replica INDICES actually changed
+                # this sweep. Routers long-polling via
+                # listen_for_update_delta get only those snapshots —
+                # O(touched) fan-out instead of O(replicas) — as long
+                # as their known generation is still inside the bounded
+                # history.
+                touched = frozenset(
+                    i for i, r in enumerate(d["replicas"])
+                    if new_loads.get(r) != old_loads.get(r))
+                j = d.get("journal")
+                if j is None or j.maxlen != cfg.serve_snapshot_journal:
+                    j = d["journal"] = collections.deque(
+                        j or (), maxlen=max(1, cfg.serve_snapshot_journal))
+                j.append((gen, touched))
             self._set_cond.notify_all()
 
     def _check_replica_health(self) -> None:
@@ -481,6 +508,75 @@ class ServeController:
                     replicas = list(d["replicas"])
                     return v, replicas, g, self._loads_for(d, replicas)
                 self._set_cond.wait(remaining)
+
+    def _delta_since(self, d: Dict[str, Any],
+                     known_load_gen: int) -> Optional[Dict[int, Any]]:
+        """Callers hold self._lock. The touched-replica snapshot map
+        {index: snapshot} accumulated since ``known_load_gen``, or None
+        when the bounded journal no longer covers that generation (the
+        caller ships a full payload instead). Coverage requires the
+        caller's generation to still BE in the journal — the full/seed
+        paths hand out the latest journaled generation, so a router
+        that kept up always finds it; one that fell
+        serve_snapshot_journal sweeps behind resyncs with one full
+        payload. A replica that missed this sweep ships None (the
+        router drops its entry and falls back to pow-2, exactly the
+        full-payload semantics)."""
+        j = d.get("journal")
+        if not j:
+            return None
+        if known_load_gen != j[0][0] \
+                and not any(g == known_load_gen for g, _ in j):
+            return None
+        touched: set = set()
+        for g, idxs in j:
+            if g > known_load_gen:
+                touched.update(idxs)
+        loads = d.get("loads") or {}
+        replicas = d["replicas"]
+        out: Dict[int, Any] = {}
+        for i in touched:
+            if i >= len(replicas):
+                return None  # set raced the journal: full payload
+            out[i] = loads.get(replicas[i])
+        return out
+
+    def listen_for_update_delta(self, name: str, known_set_version: int,
+                                known_load_gen: int,
+                                timeout: float = 30.0):
+        """listen_for_update's O(touched) twin: same park/wake
+        contract, but when ONLY the load generation moved and the
+        bounded journal still covers the caller's generation, the
+        payload is ``("delta", {replica_index: snapshot}, age_s)`` with
+        replicas=None — the router merges the touched entries in place
+        instead of re-ingesting the whole set. Set-version changes,
+        journal gaps, and deletions degrade to the full shapes:
+        ``("full", loads)`` with the replica list, or (v, None, g,
+        None) for a deleted deployment."""
+        deadline = time.monotonic() + timeout
+        with self._set_cond:
+            while True:
+                d = self._deployments.get(name)
+                v = self._set_versions.get(name, 0)
+                g = self._load_gens.get(name, 0)
+                expired = deadline - time.monotonic() <= 0
+                if v != known_set_version or g != known_load_gen \
+                        or expired:
+                    if d is None:
+                        return v, None, g, None
+                    if v == known_set_version:
+                        delta = self._delta_since(d, known_load_gen)
+                        if delta is not None:
+                            age = round(max(0.0, time.monotonic()
+                                            - d.get("loads_mono",
+                                                    float("-inf"))), 3)
+                            if age == float("inf"):  # no sweep yet
+                                age = 0.0
+                            return v, None, g, ("delta", delta, age)
+                    replicas = list(d["replicas"])
+                    return (v, replicas, g,
+                            ("full", self._loads_for(d, replicas)))
+                self._set_cond.wait(deadline - time.monotonic())
 
     # -------------------------------------------------------- HTTP proxies
 

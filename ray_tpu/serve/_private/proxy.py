@@ -146,6 +146,24 @@ class HTTPProxyActor:
         except (ValueError, json.JSONDecodeError) as e:
             return web.json_response({"error": f"bad json: {e}"},
                                      status=400)
+        # Tenant attribution for WFQ/budget admission (qos.py): the
+        # x-tenant header wins, else a "tenant" field in the JSON body;
+        # unattributed traffic shares the default tenant. Cost is the
+        # request's LLM-token footprint — the unit tenant budgets are
+        # denominated in.
+        tenant = request.headers.get("x-tenant")
+        cost = 1.0
+        if isinstance(payload, dict):
+            if tenant is None:
+                t = payload.get("tenant")
+                tenant = t if isinstance(t, str) else None
+            ids = payload.get("prompt_ids")
+            try:
+                cost = max(1.0, (len(ids) if isinstance(ids, (list, tuple))
+                                 else 0)
+                           + float(payload.get("max_new_tokens", 32)))
+            except (TypeError, ValueError):
+                cost = 1.0
         loop = asyncio.get_event_loop()
         # Request-lifecycle trace: serve.request roots the tree; every
         # downstream span (admission, route, replica, engine phases,
@@ -160,13 +178,14 @@ class HTTPProxyActor:
         # admission parks up to the queue timeout). A shed request
         # never touches the router.
         try:
-            if self._admission.budget_ms <= 0:
+            if not self._admission.may_block():
                 # Gating disabled (the default): acquire() cannot park,
                 # so the hot path skips the executor round-trip.
-                self._admission.acquire(name)
+                self._admission.acquire(name, tenant, cost)
             else:
                 await loop.run_in_executor(self._gate_pool,
-                                           self._admission.acquire, name)
+                                           self._admission.acquire,
+                                           name, tenant, cost)
         except DeploymentOverloadedError as e:
             if root is not None:
                 tracing.emit_span(
@@ -192,7 +211,8 @@ class HTTPProxyActor:
             h = self._get_handle(name)
             if stream:
                 resp = await self._stream(request, h, method, payload,
-                                          name, t_admit, root_ctx)
+                                          name, t_admit, root_ctx,
+                                          tenant)
                 root_ok = True
                 return resp
             # Routing runs in the executor: choose() is normally a dict
@@ -212,7 +232,7 @@ class HTTPProxyActor:
             # (first byte == last byte here); the stream path records
             # true first-chunk time.
             self._admission.record_ttft(
-                name, (time.perf_counter() - t_admit) * 1e3)
+                name, (time.perf_counter() - t_admit) * 1e3, tenant)
             root_ok = True
             return web.json_response({"result": result})
         except Exception as e:  # noqa: BLE001 — surfaced as 500
@@ -225,7 +245,7 @@ class HTTPProxyActor:
                     {"error": f"no deployment {name!r}"}, status=404)
             return web.json_response({"error": str(e)}, status=500)
         finally:
-            self._admission.release(name)
+            self._admission.release(name, tenant)
             if root is not None:
                 tracing.end_span(root, ok=root_ok)
                 # Off-loop (see the shed path): the span ship must never
@@ -238,7 +258,8 @@ class HTTPProxyActor:
                 self._admission.forget(name)
 
     async def _stream(self, request, h, method, payload,
-                      name=None, t_admit=None, trace_ctx=None):
+                      name=None, t_admit=None, trace_ctx=None,
+                      tenant=None):
         """Chunked transfer: one JSON line per streamed item (reference:
         proxy_response_generator.py writes streaming responses the same
         incremental way over ASGI)."""
@@ -264,7 +285,8 @@ class HTTPProxyActor:
             async for item in gen:
                 if first and t_admit is not None:
                     self._admission.record_ttft(
-                        name, (time.perf_counter() - t_admit) * 1e3)
+                        name, (time.perf_counter() - t_admit) * 1e3,
+                        tenant)
                 first = False
                 items += 1
                 await resp.write(
@@ -300,6 +322,14 @@ class HTTPProxyActor:
         return resp
 
     # ----------------------------------------------------------- actor API
+
+    def configure_qos(self, tenants: Dict[str, Dict[str, Any]]) -> bool:
+        """Push per-tenant QoS contracts to this proxy's admission gate:
+        ``{tenant: {weight, priority, tokens_per_s, burst_tokens}}``.
+        Idempotent (safe to re-push the same map to every proxy)."""
+        for tenant, kw in tenants.items():
+            self._admission.configure_tenant(tenant, **kw)
+        return True
 
     def address(self) -> str:
         import socket

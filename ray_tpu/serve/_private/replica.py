@@ -92,11 +92,17 @@ class ReplicaActor:
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: Dict[str, Any],
                                  context: Optional[Dict[str, Any]] = None,
-                                 ) -> str:
+                                 first_wait_s: float = 1.0,
+                                 ) -> Tuple[str, list, bool]:
         """Start a streaming call: the user method must return an
-        iterator/generator. Returns a stream id for next_chunks cursor
-        polling (reference: streaming responses flow as
-        ObjectRefGenerators; here the cursor rides the actor plane)."""
+        iterator/generator. Returns ``(sid, items, done)`` — the first
+        chunk piggybacks on the start RPC (bounded by ``first_wait_s``)
+        so streaming TTFT costs ONE actor round trip, same as a
+        non-streaming call; later chunks ride next_chunks cursor polls
+        (reference: streaming responses flow as ObjectRefGenerators;
+        here the cursor rides the actor plane). A first token slower
+        than ``first_wait_s`` returns an empty chunk and the consumer
+        falls back to polling — never an error."""
         self._reap_stale_streams()
         target = self._resolve_target(method)
         sid = uuid.uuid4().hex
@@ -149,7 +155,13 @@ class ReplicaActor:
 
         threading.Thread(target=drain, daemon=True,
                          name=f"serve-stream-{sid[:8]}").start()
-        return sid
+        if first_wait_s <= 0:
+            return sid, [], False
+        # Same semantics as the consumer's first next_chunks poll —
+        # including raising a pre-first-token stream error here, which
+        # the caller surfaces exactly like a failed poll.
+        items, done = self.next_chunks(sid, wait_s=first_wait_s)
+        return sid, items, done
 
     _STREAM_TTL_S = 600.0
 

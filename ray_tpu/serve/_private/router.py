@@ -71,44 +71,202 @@ class Router:
         self._scored_routes = 0
         self._pow2_routes = 0
         self._affinity_routes = 0  # scored routes that matched >=1 block
+        self._init_scale_state()
         self._poller_started = False
         self._poll_thread: Optional[threading.Thread] = None
         self._stopped = False
 
+    def _init_scale_state(self) -> None:
+        """State for O(touched) routing past serve_router_score_all_max
+        replicas: an incrementally-maintained base-score rank (top-K
+        candidates without an O(N) scan per decision), an inverted
+        prefix-hash index (affinity candidates by lookup instead of by
+        scoring everyone), and a session→replica pin map. Split out so
+        __new__-built unit routers (and pre-upgrade pickles) can be
+        healed lazily by _apply."""
+        self._rank: List[tuple] = []       # sorted (-base_score, seq)
+        self._rank_seq: Dict[Any, int] = {}    # replica -> live seq
+        self._seq_replica: Dict[int, Any] = {}  # seq -> replica
+        self._next_seq = 0
+        self._hash_index: Dict[Any, set] = {}  # block hash -> replicas
+        self._indexed: Dict[Any, frozenset] = {}  # replica -> hashes
+        self._indexed_bs: Dict[Any, int] = {}     # replica -> block size
+        self._block_sizes: Dict[int, int] = {}    # block size -> refcount
+        self._session_affinity: Dict[Any, Any] = {}
+        self._session_affinity_routes = 0
+        self._candidates_scored = 0
+        self._loads_ts = 0.0  # the set's sweep stamp (min snapshot ts)
+        self._delta_unsupported = False
+
     # ------------------------------------------------------------- updates
+
+    @staticmethod
+    def _normalize_snap(snap: Dict[str, Any]) -> Dict[str, Any]:
+        """Copy + canonicalize one pushed snapshot: hash lists become
+        frozensets once, at apply time, and the controller-shipped AGE
+        (its own clock, one process) is restamped onto THIS process's
+        clock so the TTL check in _fresh_loads never compares wall
+        clocks across hosts — NTP skew would otherwise silently pin
+        scored routing on (always-stale) or off (never-stale)."""
+        snap = dict(snap)
+        hashes = snap.get("prefix_hashes")
+        if hashes is not None and not isinstance(hashes, frozenset):
+            snap["prefix_hashes"] = frozenset(hashes)
+        fleet = snap.get("fleet_kv_hashes")
+        if fleet is not None and not isinstance(fleet, frozenset):
+            snap["fleet_kv_hashes"] = frozenset(fleet)
+        age = snap.pop("age_s", None)
+        if age is not None:
+            snap["ts"] = time.time() - float(age)
+        return snap
 
     def _apply(self, version: int, replicas: Optional[List[Any]],
                load_gen: int = -1,
                loads: Optional[List[Any]] = None) -> None:
         with self._lock:
+            if not hasattr(self, "_rank"):  # __new__-built unit router
+                self._init_scale_state()
             self._version = version
             self._replicas = list(replicas or [])
             self._inflight = {r: self._inflight.get(r, 0)
                               for r in self._replicas}
             if load_gen >= 0:
                 self._load_gen = load_gen
+            # Full apply: rebuild the scale-state wholesale (set changes
+            # invalidate journal indices anyway); deltas go through
+            # _apply_delta and touch only their upserts.
+            self._rank = []
+            self._rank_seq = {}
+            self._seq_replica = {}
+            self._hash_index = {}
+            self._indexed = {}
+            self._indexed_bs = {}
+            self._block_sizes = {}
             new_loads: Dict[Any, Dict[str, Any]] = {}
+            min_ts: Optional[float] = None
             for r, snap in zip(self._replicas, loads or []):
                 if snap is None:
                     continue
-                snap = dict(snap)
-                hashes = snap.get("prefix_hashes")
-                if hashes is not None and not isinstance(hashes,
-                                                         frozenset):
-                    snap["prefix_hashes"] = frozenset(hashes)
-                fleet = snap.get("fleet_kv_hashes")
-                if fleet is not None and not isinstance(fleet, frozenset):
-                    snap["fleet_kv_hashes"] = frozenset(fleet)
-                # The controller ships snapshot AGE (its own clock, one
-                # process): restamp onto THIS process's clock so the
-                # TTL check in _fresh_loads never compares wall clocks
-                # across hosts — NTP skew would otherwise silently pin
-                # scored routing on (always-stale) or off (never-stale).
-                age = snap.pop("age_s", None)
-                if age is not None:
-                    snap["ts"] = time.time() - float(age)
+                snap = self._normalize_snap(snap)
                 new_loads[r] = snap
+                ts = float(snap.get("ts", 0.0))
+                min_ts = ts if min_ts is None else min(min_ts, ts)
+                self._ingest_scale(r, snap)
             self._loads = new_loads
+            self._loads_ts = min_ts if min_ts is not None else 0.0
+
+    # --------------------------------------------- O(touched) scale state
+
+    def _base_score(self, snap: Dict[str, Any]) -> float:
+        """The request-independent part of _score (queue + KV + TTFT
+        pressure; no prefix affinity, no caller-local inflight) — what
+        the incremental rank orders candidates by."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        w = getattr(self, "_weights", None) or {}
+        slots = max(1, snap.get("slots", 1))
+        queue = snap.get("queue_depth", 0) + snap.get("waiting", 0)
+        kv = 0.0
+        total_blocks = snap.get("kv_total_blocks", 0)
+        if total_blocks:
+            kv = 1.0 - snap.get("kv_free_blocks", 0) / total_blocks
+        s = (-w.get("queue", cfg.serve_router_queue_weight) * queue / slots
+             - w.get("kv", cfg.serve_router_kv_weight) * kv)
+        w_ttft = w.get("ttft", cfg.serve_router_ttft_weight)
+        if w_ttft:
+            s -= w_ttft * snap.get("ewma_ttft_ms", 0.0) / 1e3
+        return s
+
+    def _ingest_scale(self, r, snap: Dict[str, Any]) -> None:
+        """Callers hold self._lock. Fold one replica's (normalized)
+        snapshot into the rank + inverted index, O(changed hashes).
+        The replaced rank entry is left in place as garbage (its seq no
+        longer matches _rank_seq) — lazy deletion keeps the update at
+        O(log N) insort instead of an O(N) list delete; reads skip
+        stale entries and _maybe_compact_rank bounds the garbage."""
+        import bisect
+
+        seq = self._next_seq
+        self._next_seq += 1
+        self._rank_seq[r] = seq
+        self._seq_replica[seq] = r
+        bisect.insort(self._rank, (-self._base_score(snap), seq))
+        # Inverted prefix-hash index: delta against what this replica
+        # had indexed before.
+        new = snap.get("prefix_hashes") or frozenset()
+        if not isinstance(new, frozenset):
+            new = frozenset(new)
+        old = self._indexed.get(r, frozenset())
+        for h in old - new:
+            s = self._hash_index.get(h)
+            if s is not None:
+                s.discard(r)
+                if not s:
+                    del self._hash_index[h]
+        for h in new - old:
+            self._hash_index.setdefault(h, set()).add(r)
+        self._indexed[r] = new
+        bs = int(snap.get("prefix_block_size", 0) or 0)
+        old_bs = self._indexed_bs.get(r, 0)
+        if bs != old_bs:
+            if old_bs:
+                n = self._block_sizes.get(old_bs, 0) - 1
+                if n <= 0:
+                    self._block_sizes.pop(old_bs, None)
+                else:
+                    self._block_sizes[old_bs] = n
+            if bs:
+                self._block_sizes[bs] = self._block_sizes.get(bs, 0) + 1
+            self._indexed_bs[r] = bs
+
+    def _maybe_compact_rank(self) -> None:
+        """Callers hold self._lock. Purge lazily-deleted rank entries
+        once garbage outnumbers live entries (amortized O(log N) per
+        update)."""
+        if len(self._rank) <= 2 * max(16, len(self._rank_seq)):
+            return
+        live = set(self._rank_seq.values())
+        self._rank = [e for e in self._rank if e[1] in live]
+        self._seq_replica = {seq: r
+                             for r, seq in self._rank_seq.items()}
+
+    def _apply_delta(self, version: int, upserts: Dict[Any, Any],
+                     load_gen: int = -1, age_s: float = 0.0) -> bool:
+        """Merge a touched-only snapshot delta (controller journal
+        push): {replica_index: snapshot}. O(touched), not O(replicas).
+        Returns False when the delta can't be trusted (replica-set
+        version moved, or an index is out of range) — the caller falls
+        back to a full fetch."""
+        with self._lock:
+            if not hasattr(self, "_rank"):
+                self._init_scale_state()
+            if version != self._version:
+                return False
+            n = len(self._replicas)
+            try:
+                idx_snaps = [(int(i), s) for i, s in upserts.items()]
+            except (TypeError, ValueError):
+                return False
+            if any(not 0 <= i < n for i, _ in idx_snaps):
+                return False
+            now = time.time()
+            for i, snap in idx_snaps:
+                r = self._replicas[i]
+                if snap is None:
+                    self._loads.pop(r, None)  # replica missed the sweep
+                    continue
+                snap = self._normalize_snap(snap)
+                snap["ts"] = now - float(age_s or 0.0)
+                self._loads[r] = snap
+                self._ingest_scale(r, snap)
+            if load_gen >= 0:
+                self._load_gen = load_gen
+            # Every sweep polls EVERY replica; "untouched" means equal
+            # content, not unpolled — so the whole set's freshness
+            # restamps to this sweep's age.
+            self._loads_ts = now - float(age_s or 0.0)
+            self._maybe_compact_rank()
+            return True
 
     def _seed(self) -> None:
         """Synchronous first fetch (and recovery fetch after errors)."""
@@ -158,14 +316,12 @@ class Router:
         deleted_backoff = 0.0
         while not self._stopped:
             try:
-                version, replicas, gen, loads = ray_tpu.get(
-                    self._controller.listen_for_update.remote(
-                        self._deployment, self._version, self._load_gen,
-                        30.0),
-                    timeout=60)
+                version, replicas, gen, loads = self._listen_once()
                 failures = 0
                 if self._stopped:
                     return  # stop() raced the park: exit, don't re-park
+                if loads == "delta-applied":
+                    continue  # _listen_once merged the delta in place
                 if replicas is None:
                     # Deployment deleted. The next listen parks on the
                     # controller condvar, but each park still holds a
@@ -196,6 +352,54 @@ class Router:
                     except Exception as e:
                         logger.debug("controller re-resolve failed: %r", e)
 
+    def _listen_once(self):
+        """One long-poll round. Prefers the delta endpoint
+        (listen_for_update_delta: touched-only snapshot fan-out, riding
+        the controller's bounded journal); when the delta applies
+        cleanly in place, returns loads == "delta-applied" so the poll
+        loop skips the full _apply. Any delta problem — old controller
+        without the endpoint, journal gap, set-version race — falls
+        back to the full-payload endpoint for this round."""
+        import ray_tpu
+
+        if not getattr(self, "_delta_unsupported", False):
+            try:
+                version, replicas, gen, payload = ray_tpu.get(
+                    self._controller.listen_for_update_delta.remote(
+                        self._deployment, self._version, self._load_gen,
+                        30.0),
+                    timeout=60)
+                if payload is None and replicas is None:
+                    return version, None, gen, None  # deleted
+                if isinstance(payload, tuple) and payload \
+                        and payload[0] == "delta":
+                    _tag, upserts, age_s = payload
+                    if self._apply_delta(version, upserts, gen, age_s):
+                        return version, None, gen, "delta-applied"
+                    # Version raced or bad index: full fetch heals it.
+                    self._seed()
+                    return (self._version, None, self._load_gen,
+                            "delta-applied")
+                if isinstance(payload, tuple) and payload \
+                        and payload[0] == "full":
+                    return version, replicas, gen, payload[1]
+                return version, replicas, gen, payload
+            except AttributeError:
+                self._delta_unsupported = True
+            except Exception as e:
+                # Distinguish "old controller" (remote AttributeError
+                # arrives wrapped) from a transient failure the caller
+                # should count.
+                if "listen_for_update_delta" in str(e) \
+                        or "AttributeError" in type(e).__name__:
+                    self._delta_unsupported = True
+                else:
+                    raise
+        return ray_tpu.get(
+            self._controller.listen_for_update.remote(
+                self._deployment, self._version, self._load_gen, 30.0),
+            timeout=60)
+
     def stop(self) -> None:
         self._stopped = True
         # Bounded join: the poller re-checks _stopped after every
@@ -212,17 +416,18 @@ class Router:
 
     def _fresh_loads(self) -> Optional[Dict[Any, Dict[str, Any]]]:
         """Callers hold self._lock. The snapshot map iff EVERY replica
-        has one fresh enough to trust; else None (pow-2 fallback)."""
+        has one fresh enough to trust; else None (pow-2 fallback).
+        O(1): snapshots land set-at-a-time (one controller sweep), so
+        freshness is the sweep stamp (_loads_ts, the min snapshot ts
+        maintained at apply time) plus a coverage count — not an O(N)
+        per-decision scan."""
         from ray_tpu.core.config import GLOBAL_CONFIG as cfg
 
         if len(self._loads) < len(self._replicas):
             return None
-        ttl = cfg.serve_snapshot_ttl_s
-        now = time.time()
-        for r in self._replicas:
-            snap = self._loads.get(r)
-            if snap is None or now - snap.get("ts", 0.0) > ttl:
-                return None
+        if time.time() - getattr(self, "_loads_ts", 0.0) \
+                > cfg.serve_snapshot_ttl_s:
+            return None
         return self._loads
 
     def _score(self, replica, snap: Dict[str, Any],
@@ -287,9 +492,77 @@ class Router:
                         1.0, (fdepth - depth) * bs / max(1, prompt_len))
         return score, depth
 
+    def _candidate_subset(self, loads: Dict[Any, Dict[str, Any]],
+                          prefix_tokens: Optional[Sequence[int]],
+                          session_key: Optional[Any]) -> List[Any]:
+        """Callers hold self._lock. The O(touched) candidate set for a
+        replica pool too large to score wholesale: the top-K of the
+        incrementally-maintained base-score rank (best queue/KV
+        headroom), UNION the replicas the inverted prefix-hash index
+        says hold this prompt's leading blocks (deepest matches first,
+        capped), UNION the session's pinned home. Cost per decision is
+        O(topk + affinity_cands + garbage skipped), independent of
+        len(self._replicas)."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.serve.engine.kv_manager import chain_hashes
+
+        cands: List[Any] = []
+        seen: set = set()
+
+        def _add(r) -> None:
+            if r is not None and r not in seen and r in loads:
+                seen.add(r)
+                cands.append(r)
+
+        # 1) Session affinity: multi-turn users land back on the
+        # replica already holding their conversation's prefix blocks.
+        if session_key is not None:
+            _add(self._session_affinity.get(session_key))
+        # 2) Inverted-index affinity hits, deepest block chain first
+        # (chain hashes are cumulative, so a replica resident at block
+        # i is resident at every shallower block too).
+        acap = cfg.serve_router_affinity_cands
+        if prefix_tokens and acap > 0 and self._hash_index:
+            max_blocks = cfg.serve_router_prefix_blocks
+            hits = 0
+            for bs in self._block_sizes:
+                chain = chain_hashes(
+                    list(prefix_tokens)[:bs * max_blocks], bs)
+                for h in reversed(chain):
+                    for r in self._hash_index.get(h, ()):
+                        if r in seen or r not in loads:
+                            continue
+                        _add(r)
+                        hits += 1
+                        if hits >= acap:
+                            break
+                    if hits >= acap:
+                        break
+                if hits >= acap:
+                    break
+        # 3) Base-score top-K (lazy-deletion rank: skip entries whose
+        # seq is no longer the replica's live one).
+        k = max(1, cfg.serve_router_topk)
+        got = 0
+        for _neg, seq in self._rank:
+            r = self._seq_replica.get(seq)
+            if r is None or self._rank_seq.get(r) != seq:
+                continue
+            if r in seen or r not in loads:
+                continue
+            _add(r)
+            got += 1
+            if got >= k:
+                break
+        if not cands:  # empty rank (never applied): degrade to pow-2
+            cands = random.sample(self._replicas,
+                                  min(2, len(self._replicas)))
+        return cands
+
     def _choose_scored(self, loads: Dict[Any, Dict[str, Any]],
                        prefix_tokens: Optional[Sequence[int]],
-                       decision: Optional[Dict[str, Any]] = None):
+                       decision: Optional[Dict[str, Any]] = None,
+                       session_key: Optional[Any] = None):
         """Callers hold self._lock and have verified fresh loads.
         ``decision`` (optional dict) is filled with the winning score and
         prefix-match depth — the routing-decision span's attributes."""
@@ -298,7 +571,10 @@ class Router:
 
         if len(self._replicas) <= cfg.serve_router_score_all_max:
             cands = self._replicas
-        else:
+        elif hasattr(self, "_rank"):
+            cands = self._candidate_subset(loads, prefix_tokens,
+                                           session_key)
+        else:  # __new__-built router predating the scale state
             cands = random.sample(self._replicas, 2)
         # One chain per block size present (homogeneous deployments pay
         # one hash pass over the leading blocks).
@@ -332,8 +608,23 @@ class Router:
                 best.append(r)
         choice = best[0] if len(best) == 1 else random.choice(best)
         self._scored_routes += 1
+        self._candidates_scored = (
+            getattr(self, "_candidates_scored", 0) + len(cands))
         if match_depth.get(choice):
             self._affinity_routes += 1
+        if session_key is not None and hasattr(self, "_session_affinity"):
+            prev = self._session_affinity.pop(session_key, None)
+            if prev == choice:  # equality: handles re-pickle per push
+                self._session_affinity_routes += 1
+            # Re-insert at the end: active sessions stay pinned, idle
+            # ones age out of the front when the cap bites.
+            self._session_affinity[session_key] = choice
+            from ray_tpu.core.config import GLOBAL_CONFIG as _cfg
+
+            cap = _cfg.serve_router_session_affinity_max
+            while len(self._session_affinity) > cap:
+                self._session_affinity.pop(
+                    next(iter(self._session_affinity)))
         if decision is not None:
             decision["score"] = round(float(best_key[0]), 4) \
                 if best_key is not None else 0.0
@@ -343,7 +634,8 @@ class Router:
 
     def choose(self, model_id: Optional[str] = None,
                prefix_tokens: Optional[Sequence[int]] = None,
-               decision: Optional[Dict[str, Any]] = None):
+               decision: Optional[Dict[str, Any]] = None,
+               session_key: Optional[Any] = None):
         """Pick a replica. With fresh snapshots for the whole set and
         policy 'scored': score prefix affinity + queue + KV headroom.
         Otherwise pow-2: two random candidates, fewer local in-flight
@@ -387,7 +679,7 @@ class Router:
                              if policy == "scored" else None)
                     if loads is not None:
                         choice = self._choose_scored(loads, prefix_tokens,
-                                                     decision)
+                                                     decision, session_key)
                     else:
                         a, b = random.sample(self._replicas, 2)
                         choice = (a if self._inflight.get(a, 0)
@@ -427,4 +719,8 @@ class Router:
         with self._lock:
             return {"scored_routes": self._scored_routes,
                     "pow2_routes": self._pow2_routes,
-                    "affinity_routes": self._affinity_routes}
+                    "affinity_routes": self._affinity_routes,
+                    "session_affinity_routes": getattr(
+                        self, "_session_affinity_routes", 0),
+                    "candidates_scored": getattr(
+                        self, "_candidates_scored", 0)}

@@ -31,6 +31,7 @@ import time
 from typing import Any, Deque, Dict, Optional
 
 from ray_tpu.exceptions import RayTpuError
+from ray_tpu.serve._private.qos import DEFAULT_TENANT, TenantConfig, WFQQueue
 from ray_tpu.util import metrics as _m
 
 ADMITTED_TOTAL = _m.Counter(
@@ -55,7 +56,7 @@ class DeploymentOverloadedError(RayTpuError):
 
 class _DeploymentState:
     __slots__ = ("ttfts", "inflight", "queued", "admitted_total",
-                 "queued_total", "shed_total")
+                 "queued_total", "shed_total", "wfq")
 
     def __init__(self, window: int):
         self.ttfts: Deque[float] = collections.deque(maxlen=window)  # ms
@@ -64,6 +65,10 @@ class _DeploymentState:
         self.admitted_total = 0
         self.queued_total = 0
         self.shed_total = 0
+        # Per-tenant WFQ ordering + token budgets (qos.py); with one
+        # (default) tenant and no budgets it degenerates to the FIFO
+        # gate this class always was.
+        self.wfq = WFQQueue(window=window)
 
 
 class AdmissionController:
@@ -93,11 +98,18 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._deployments: Dict[str, _DeploymentState] = {}
+        # Tenant contracts pushed via configure_tenant, applied to every
+        # deployment's WFQ (including ones created later).
+        self._tenant_cfgs: Dict[str, TenantConfig] = {}
+        self._qos_may_block = False
 
     def _state(self, name: str) -> _DeploymentState:
         st = self._deployments.get(name)
         if st is None:
             st = self._deployments[name] = _DeploymentState(self.window)
+            now = time.monotonic()
+            for tenant, tcfg in self._tenant_cfgs.items():
+                st.wfq.configure(tenant, tcfg, now)
         return st
 
     @staticmethod
@@ -124,53 +136,148 @@ class AdmissionController:
 
     # ----------------------------------------------------------- gate API
 
-    def acquire(self, name: str) -> None:
+    def configure_tenant(self, tenant: str, *, weight: float = 1.0,
+                         priority: int = 0, tokens_per_s: float = 0.0,
+                         burst_tokens: float = 0.0) -> None:
+        """Push one tenant's QoS contract (applies to every deployment
+        this gate guards). Idempotent; reconfiguring adjusts the live
+        bucket/weight in place."""
+        cfg = TenantConfig(weight=weight, priority=priority,
+                           tokens_per_s=tokens_per_s,
+                           burst_tokens=burst_tokens)
+        with self._cond:
+            self._tenant_cfgs[tenant] = cfg
+            now = time.monotonic()
+            for st in self._deployments.values():
+                st.wfq.configure(tenant, cfg, now)
+            if tokens_per_s > 0:
+                self._qos_may_block = True
+            self._cond.notify_all()
+
+    def may_block(self) -> bool:
+        """Whether acquire() can park the caller: the asyncio proxy
+        keeps the inline fast path only while this is False."""
+        if self.budget_ms > 0 or self._qos_may_block:
+            return True
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        return cfg.serve_qos_tokens_per_s > 0
+
+    def _tenant_queue_depth(self) -> int:
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+        return cfg.serve_qos_queue_depth or self.queue_depth
+
+    def _admit_locked(self, st: _DeploymentState, name: str, tk,
+                      now: float) -> None:
+        tk.admitted = True
+        st.wfq.admit(tk, now)
+        st.inflight += 1
+        st.admitted_total += 1
+        ADMITTED_TOTAL.inc(labels={"deployment": name})
+
+    def _drain_locked(self, st: _DeploymentState, name: str,
+                      now: float) -> int:
+        """Handoff admission (callers hold the lock): while capacity
+        remains, admit eligible queue heads IN PLACE — their parked
+        threads observe ``tk.admitted`` on wake and return. Without
+        this, an open gate with a parked backlog would shed hot
+        arrivals (they're not head) while the winners sleep until the
+        next notify — admission would stall, then stampede. Returns
+        how many tickets were admitted."""
+        n = 0
+        while self._admittable(st):
+            tk = st.wfq.head(now)
+            if tk is None:
+                break
+            self._admit_locked(st, name, tk, now)
+            n += 1
+        return n
+
+    def acquire(self, name: str, tenant: Optional[str] = None,
+                cost: float = 1.0) -> None:
         """Block until admitted; raises DeploymentOverloadedError when
-        shed. Every successful acquire must be paired with release()."""
+        shed. Every successful acquire must be paired with release().
+
+        ``tenant`` attributes the request for WFQ ordering and token
+        budgets (qos.py); ``cost`` is its LLM-token footprint (prompt +
+        max_new), the unit the tenant buckets are denominated in.
+        Unattributed requests share the default tenant and behave
+        exactly like the pre-QoS FIFO gate."""
+        tenant = tenant or DEFAULT_TENANT
         with self._cond:
             st = self._state(name)
-            if self._admittable(st):
-                st.inflight += 1
-                st.admitted_total += 1
-                ADMITTED_TOTAL.inc(labels={"deployment": name})
+            now = time.monotonic()
+            tk = st.wfq.submit(tenant, cost, now)
+            # Handoff drain: earlier heads take capacity first, then —
+            # capacity and budget permitting — this arrival (its own
+            # head once the backlog admits). Parked winners are woken
+            # below.
+            if self._drain_locked(st, name, now) > (1 if tk.admitted
+                                                    else 0):
+                self._cond.notify_all()
+            if tk.admitted:
                 return
-            if st.queued >= self.queue_depth:
+            # Not admittable right now (over budget, behind other
+            # waiters, or budget-blocked): bounded per-TENANT queue —
+            # one flooding tenant fills only its own line.
+            if st.wfq.queued(tenant) - 1 >= self._tenant_queue_depth():
+                st.wfq.cancel(tk)
                 st.shed_total += 1
+                st.wfq.note_shed(tenant, now)
                 SHED_TOTAL.inc(labels={"deployment": name})
                 raise DeploymentOverloadedError(
-                    f"deployment {name!r} is over its "
-                    f"{self.budget_ms:.0f} ms p99 TTFT budget and the "
-                    f"admission queue ({self.queue_depth}) is full")
+                    f"deployment {name!r}: tenant {tenant!r} admission "
+                    f"queue ({self._tenant_queue_depth()}) is full")
             st.queued += 1
             st.queued_total += 1
             QUEUED_TOTAL.inc(labels={"deployment": name})
             deadline = time.monotonic() + self.queue_timeout_s
             try:
                 while True:
-                    remaining = deadline - time.monotonic()
+                    now = time.monotonic()
+                    remaining = deadline - now
                     if remaining <= 0:
                         st.shed_total += 1
+                        st.wfq.note_shed(tenant, now)
                         SHED_TOTAL.inc(labels={"deployment": name})
                         raise DeploymentOverloadedError(
                             f"deployment {name!r}: admission queue wait "
                             f"exceeded {self.queue_timeout_s:.1f}s "
-                            f"(p99 TTFT over budget)")
-                    self._cond.wait(remaining)
-                    if self._admittable(st):
-                        st.inflight += 1
-                        st.admitted_total += 1
-                        ADMITTED_TOTAL.inc(labels={"deployment": name})
+                            f"(tenant {tenant!r} over budget or p99 "
+                            f"TTFT over budget)")
+                    # A budget-blocked head refills on the clock, not
+                    # on a notify: bound the park by the refill ETA.
+                    wait = remaining
+                    rw = st.wfq.next_refill_wait(now)
+                    if rw is not None:
+                        wait = min(wait, max(0.001, rw))
+                    self._cond.wait(wait)
+                    now = time.monotonic()
+                    # A notifier may have handed us capacity while we
+                    # slept; also self-drain for clock-driven refills
+                    # (a budget-blocked head has no notifier).
+                    if not tk.admitted:
+                        if self._drain_locked(st, name, now) > (
+                                1 if tk.admitted else 0):
+                            self._cond.notify_all()
+                    if tk.admitted:
                         return
             finally:
                 st.queued -= 1
+                if not tk.admitted:
+                    st.wfq.cancel(tk)
+                    self._cond.notify_all()
 
-    def release(self, name: str) -> None:
+    def release(self, name: str, tenant: Optional[str] = None) -> None:
         with self._cond:
             st = self._deployments.get(name)
             if st is None:
                 return
             if st.inflight > 0:
                 st.inflight -= 1
+            st.wfq.release(tenant or DEFAULT_TENANT)
+            self._drain_locked(st, name, time.monotonic())
             self._cond.notify_all()
 
     def forget(self, name: str) -> None:
@@ -180,17 +287,22 @@ class AdmissionController:
         URL path would leak a window-sized state entry forever."""
         with self._cond:
             st = self._deployments.get(name)
-            if st is not None and st.inflight == 0 and st.queued == 0:
+            if st is not None and st.inflight == 0 and st.queued == 0 \
+                    and st.wfq.idle():
                 del self._deployments[name]
 
-    def record_ttft(self, name: str, ttft_ms: float) -> None:
+    def record_ttft(self, name: str, ttft_ms: float,
+                    tenant: Optional[str] = None) -> None:
         """Feed the estimator (one sample per admitted request, at
         first-token/first-result time)."""
         with self._cond:
             st = self._state(name)
             st.ttfts.append(ttft_ms)
+            st.wfq.record_ttft(tenant or DEFAULT_TENANT, ttft_ms,
+                               time.monotonic())
             TTFT_P99_MS.set(self._p99(st.ttfts),
                             labels={"deployment": name})
+            self._drain_locked(st, name, time.monotonic())
             self._cond.notify_all()
 
     # ---------------------------------------------------------- inspection
@@ -210,5 +322,6 @@ class AdmissionController:
                     "admitted_total": st.admitted_total,
                     "queued_total": st.queued_total,
                     "shed_total": st.shed_total,
+                    "tenants": st.wfq.snapshot(),
                 }
             return out

@@ -253,7 +253,8 @@ class DeploymentHandle:
         replica = self._router.choose(
             model_id=self._model_id,
             prefix_tokens=self._prefix_hint(args, kwargs),
-            decision=decision)
+            decision=decision,
+            session_key=self._session_hint(args, kwargs))
         SERVE_TTFT_BREAKDOWN_MS.observe(
             (_time.perf_counter() - t0) * 1e3,
             labels={"component": "route"})
@@ -282,12 +283,27 @@ class DeploymentHandle:
                 return list(ids)
         return None
 
+    @staticmethod
+    def _session_hint(args, kwargs) -> Optional[str]:
+        """Session-affinity key: ``{"session": "..."}`` in the payload
+        pins a multi-turn conversation back onto the replica already
+        holding its prefix blocks (router LRU pin; falls through to
+        scoring when the pinned replica disappears)."""
+        payload = args[0] if args else kwargs.get("request")
+        if isinstance(payload, dict):
+            sk = payload.get("session")
+            if isinstance(sk, str) and sk:
+                return sk
+        return None
+
     def remote(self, *args, **kwargs):
         replica, trace_ctx = self._route(args, kwargs)
         if self._stream:
             try:
-                sid = ray_tpu.get(replica.handle_request_streaming.remote(
-                    self._method, args, kwargs, self._context(trace_ctx)),
+                sid, items, done = ray_tpu.get(
+                    replica.handle_request_streaming.remote(
+                        self._method, args, kwargs,
+                        self._context(trace_ctx)),
                     timeout=60)
             except BaseException:
                 # The choose() above counted us in-flight; a failed stream
@@ -295,7 +311,14 @@ class DeploymentHandle:
                 # replica.
                 self._router.done(replica)
                 raise
-            return DeploymentResponseGenerator(replica, sid, self._router)
+            gen = DeploymentResponseGenerator(replica, sid, self._router)
+            # First chunk piggybacked on the start RPC: streaming TTFT
+            # is one round trip, same as a non-streaming call.
+            gen._buf.extend(items)
+            if done:
+                gen._done = True
+                self._router.done(replica)
+            return gen
         ref = replica.handle_request.remote(self._method, args, kwargs,
                                             self._context(trace_ctx))
         # One replay budget for a dead-replica result (submission itself

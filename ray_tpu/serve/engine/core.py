@@ -278,6 +278,12 @@ class InferenceEngine:
         # one chunk respectively.
         self._prefilling: List[_PrefillJob] = []
         self._inflight: Optional[Dict[str, Any]] = None
+        # Priority preemption (per-tenant QoS): parked lower-priority
+        # requests awaiting resume, plus lifetime counters. Engine-
+        # thread-only state like the roster itself.
+        self._parked: List[EngineRequest] = []
+        self._preempts = 0
+        self._resumes = 0
         self._last_retire_t = 0.0  # TPOT cadence anchor (see _retire_chunk)
         self._queue: "queue.Queue[EngineRequest]" = queue.Queue()
         # Decode role: KV-page install jobs handed over from prefill
@@ -296,22 +302,28 @@ class InferenceEngine:
 
     def generate(self, prompt_ids: List[int], max_new_tokens: int = 32,
                  eos_id: Optional[int] = None,
-                 timeout: float = 300.0) -> Dict[str, Any]:
+                 timeout: float = 300.0, tenant: str = "",
+                 priority: int = 0) -> Dict[str, Any]:
         """Blocking generation (replicas call this per request; batching
-        happens inside the engine across concurrent callers)."""
-        req = self._make_request(prompt_ids, max_new_tokens, eos_id)
+        happens inside the engine across concurrent callers).
+        ``priority`` selects the admission class (higher first; a
+        starved higher class may preempt lower-priority actives)."""
+        req = self._make_request(prompt_ids, max_new_tokens, eos_id,
+                                 tenant=tenant, priority=priority)
         self._queue.put(req)
         return req.future.result(timeout=timeout)
 
     def generate_stream(self, prompt_ids: List[int],
                         max_new_tokens: int = 32,
                         eos_id: Optional[int] = None,
-                        timeout: float = 300.0):
+                        timeout: float = 300.0, tenant: str = "",
+                        priority: int = 0):
         """Token-streaming generation: yields token ids as the engine
         decodes them. Tokens within one request always arrive in decode
         order (the engine thread is the only producer per stream)."""
         req = self._make_request(prompt_ids, max_new_tokens, eos_id,
-                                 stream=True)
+                                 stream=True, tenant=tenant,
+                                 priority=priority)
         self._queue.put(req)
         while True:
             kind, val = req.stream_queue.get(timeout=timeout)
@@ -325,7 +337,8 @@ class InferenceEngine:
     def prefill_remote(self, prompt_ids: List[int],
                        max_new_tokens: int = 32,
                        eos_id: Optional[int] = None,
-                       timeout: float = 300.0) -> Dict[str, Any]:
+                       timeout: float = 300.0, tenant: str = "",
+                       priority: int = 0) -> Dict[str, Any]:
         """Prefill-role entry (disaggregated serving): run admission +
         (chunked) prefill for ``prompt_ids`` and return a KV HANDOFF
         payload — the slot's hash-chained KV pages plus the first
@@ -337,7 +350,8 @@ class InferenceEngine:
         if self.role != "prefill":
             raise RuntimeError("prefill_remote requires role='prefill'")
         req = self._make_request(prompt_ids, max_new_tokens, eos_id,
-                                 handoff=True)
+                                 handoff=True, tenant=tenant,
+                                 priority=priority)
         self._queue.put(req)
         return req.future.result(timeout=timeout)
 
@@ -353,7 +367,14 @@ class InferenceEngine:
                 f"vs engine block {self.kv.block_size}")
         req = self._make_request(payload["prompt_ids"],
                                  payload["max_new_tokens"],
-                                 payload.get("eos_id"))
+                                 payload.get("eos_id"),
+                                 stream=bool(payload.get("stream")),
+                                 tenant=str(payload.get("tenant") or ""),
+                                 priority=int(payload.get("priority", 0)))
+        # The handoff's first token was generated at prefill time and
+        # already delivered to the caller there — record it for result
+        # accounting but never push it onto the stream queue (disagg
+        # stream frames start at absolute index 1).
         req.generated.append(int(payload["first_token"]))
         self._install_queue.put((req, payload))
         return req
@@ -365,11 +386,13 @@ class InferenceEngine:
 
     def _make_request(self, prompt_ids, max_new_tokens, eos_id,
                       stream: bool = False,
-                      handoff: bool = False) -> EngineRequest:
+                      handoff: bool = False, tenant: str = "",
+                      priority: int = 0) -> EngineRequest:
         req = EngineRequest(list(prompt_ids), max_new_tokens, eos_id,
                             stream_queue=queue.Queue() if stream else None,
                             arrival_t=time.perf_counter(),
-                            handoff=handoff)
+                            handoff=handoff, tenant=tenant,
+                            priority=priority)
         if _tracing.enabled():
             # Captured on the CALLER's thread (replica request context /
             # driver span); the engine thread parents its queued/prefill/
@@ -403,7 +426,10 @@ class InferenceEngine:
                "prefilling": len(self._prefilling),
                "installs_waiting": len(self._install_waiting),
                "waiting": (self._queue.qsize()
-                           + self.scheduler.queue_depth())}
+                           + self.scheduler.queue_depth()),
+               "parked": len(self._parked),
+               "preempts": self._preempts,
+               "resumes": self._resumes}
         if self.quantize is not None:
             out["weight_bytes"], out["weight_bytes_f32"] = \
                 self._weight_bytes
@@ -445,6 +471,9 @@ class InferenceEngine:
             # separately so routers that predate the key see unchanged
             # waiting/active semantics.
             "prefilling": len(self._prefilling),
+            # Parked (preempted) requests will re-admit: queue pressure
+            # the router should see even though they hold no slot.
+            "parked": len(self._parked),
             "slots": self.max_batch,
             "free_slots": self.kv.free_slots(),
             "kv_free_blocks": self.kv.free_blocks(),
@@ -520,6 +549,16 @@ class InferenceEngine:
         ``prefill_chunk`` is off, so unchunked admissions still prefill
         fully on their admission tick)."""
         self.scheduler.drain_into(self._queue)
+        if self._parked:
+            self._resume_tick()
+        self._run_admissions()
+        if self.scheduler.queue_depth() and not self.kv.free_slots():
+            # Slot-starved with waiters present: a strictly higher
+            # priority class may preempt the lowest-priority active.
+            if self._preempt_tick():
+                self._run_admissions()
+
+    def _run_admissions(self) -> None:
         for adm in self.scheduler.admissions():
             if (self._fleet is not None
                     and adm.cached_len < len(adm.request.prompt_ids) - 1):
@@ -531,6 +570,128 @@ class InferenceEngine:
                     # the suffix prefill overwrites them.
                     pass
             self._prefilling.append(_PrefillJob(adm, pos=adm.cached_len))
+
+    # -------------------------------------------- priority preemption
+
+    def _preempt_tick(self) -> bool:
+        """Park the lowest-priority active request when a strictly
+        higher-priority arrival is starved for a slot. The victim's
+        slot recycles with its confirmed rows prefix-resident
+        (scheduler.preempt), so the resume continuation re-prefills
+        from cache — or pulls the pages back through the fleet spill
+        tier once they're evicted (the export/install seam). Returns
+        True when a slot was freed."""
+        hp = self.scheduler.max_waiting_priority()
+        if hp is None or not self.scheduler.active:
+            return False
+        # Victim: lowest class, newest arrival within it (LIFO — the
+        # request with the least sunk decode work loses its slot).
+        victim = min(self.scheduler.active,
+                     key=lambda r: (r.priority, -r.arrival_t))
+        if victim.priority >= hp:
+            return False
+        if self._inflight is not None:
+            # Land the in-flight decode chunk BEFORE recycling a slot.
+            # _retire_chunk delivers by slot to whoever is active at
+            # fetch time; the done-mask guard only covers FINISHED
+            # slots (frozen on device), so a chunk dispatched with the
+            # victim in its roster would otherwise hand the victim's
+            # tokens to the preemptor admitted into the same slot.
+            prev, self._inflight = self._inflight, None
+            if not self._retire_chunk(prev):
+                return False
+            if self.kv.free_slots():
+                return True  # retirement finished someone: slot free
+            if victim not in self.scheduler.active:
+                victim = min(self.scheduler.active,
+                             key=lambda r: (r.priority, -r.arrival_t))
+                if victim.priority >= hp:
+                    return False
+        traced = victim.trace_ctx is not None
+        t0w = time.time() if traced else 0.0
+        self.scheduler.preempt(victim)
+        self._parked.append(victim)
+        self._preempts += 1
+        if traced:
+            _tracing.emit_span(
+                "engine.preempt_park", t0w, time.time(),
+                parent=victim.trace_ctx,
+                attrs={"priority": victim.priority,
+                       "generated": len(victim.generated),
+                       "remaining": victim.remaining()})
+        return True
+
+    def _resume_tick(self) -> None:
+        """Re-admit parked requests (highest priority first) while
+        slots are free and no strictly higher-priority request is
+        still waiting — a resume that would immediately be preempted
+        again is thrash, not progress."""
+        if not self.kv.free_slots():
+            return
+        self._parked.sort(key=lambda r: (-r.priority, r.arrival_t))
+        waiting_hp = self.scheduler.max_waiting_priority()
+        resumed: List[EngineRequest] = []
+        for req in self._parked:
+            if not self.kv.free_slots():
+                break
+            if waiting_hp is not None and waiting_hp > req.priority:
+                break
+            self._resume_one(req)
+            resumed.append(req)
+        for req in resumed:
+            self._parked.remove(req)
+
+    def _resume_one(self, orig: EngineRequest) -> None:
+        """Resume a parked request as a CONTINUATION: a fresh request
+        whose prompt is ``prompt + generated`` (greedy determinism
+        makes the regenerated suffix token-identical) and whose budget
+        is the remainder. The continuation shares the stream queue —
+        tokens keep flowing on the original stream — and its result
+        merges into the original future. Admission runs the normal
+        path, so the parked rows come back as a prefix-cache hit or a
+        fleet pull (the park/resume KV round-trip)."""
+        traced = orig.trace_ctx is not None
+        t0w = time.time() if traced else 0.0
+        cont = EngineRequest(
+            list(orig.prompt_ids) + list(orig.generated),
+            max_new_tokens=orig.remaining(),
+            eos_id=orig.eos_id,
+            stream_queue=orig.stream_queue,
+            arrival_t=orig.arrival_t,
+            trace_ctx=orig.trace_ctx,
+            tenant=orig.tenant, priority=orig.priority)
+        if self.spec_draft_len:
+            cap = (self.loop.spec_chunk * (self.spec_draft_len + 1)) - 1
+            cont.spec = SpecControl(
+                allowance=self.spec_draft_len,
+                max_allowance=cap if self.spec_adaptive
+                else self.spec_draft_len)
+
+        def _merge(fut, _orig=orig):
+            try:
+                r = fut.result()
+            except BaseException as e:  # noqa: BLE001 — delivered upstream
+                if not _orig.future.done():
+                    _orig.future.set_exception(e)
+                return
+            out = dict(r)
+            out["token_ids"] = list(_orig.generated) + list(r["token_ids"])
+            out["num_generated"] = len(out["token_ids"])
+            out["cached_prefix_len"] = _orig.cached_len
+            out["preempted"] = out.get("preempted", 0) + 1
+            if not _orig.future.done():
+                _orig.future.set_result(out)
+
+        cont.future.add_done_callback(_merge)
+        self.scheduler.submit(cont)
+        self._resumes += 1
+        if traced:
+            _tracing.emit_span(
+                "engine.preempt_resume", t0w, time.time(),
+                parent=orig.trace_ctx,
+                attrs={"priority": orig.priority,
+                       "resume_prompt": len(cont.prompt_ids),
+                       "remaining": cont.max_new_tokens})
 
     # -------------------------------------------------- fleet KV tier
 
@@ -965,6 +1126,12 @@ class InferenceEngine:
                 "chain": list(self.kv.slot_chain(slot)),
                 "cached_prefix_len": req.cached_len,
             }
+            if req.tenant or req.priority:
+                # QoS attribution survives the handoff: the decode-role
+                # engine schedules the installed request in the same
+                # class the prefill side admitted it in.
+                result["tenant"] = req.tenant
+                result["priority"] = req.priority
         self.kv.release(slot, resident_tokens=req.prompt_ids)
         req.slot = -1
         if not req.future.done():

@@ -62,6 +62,13 @@ class EngineRequest:
     # pages and resolves the future with a handoff payload instead of
     # joining the decode roster (core._advance_prefill).
     handoff: bool = False
+    # Per-tenant QoS: tenant attribution (stats only at engine tier)
+    # and the strict priority class — admission serves higher classes
+    # first, and a starved higher-priority arrival may PREEMPT a
+    # lower-priority active request (core._preempt_tick parks it; its
+    # KV rows stay prefix-resident and it resumes as a continuation).
+    tenant: str = ""
+    priority: int = 0
 
     def remaining(self) -> int:
         """Token budget left (per-request accounting)."""
@@ -138,6 +145,24 @@ class Scheduler:
     def queue_depth(self) -> int:
         return len(self._waiting)
 
+    def max_waiting_priority(self) -> Optional[int]:
+        """Highest priority class among waiting requests (None when the
+        line is empty) — core's preemption trigger reads it."""
+        return max((r.priority for r in self._waiting), default=None)
+
+    def _pop_next(self) -> EngineRequest:
+        """Next admission: strict priority classes, FIFO within a class
+        (all-equal priorities — the default — is exactly FIFO)."""
+        best_i, best_p = 0, self._waiting[0].priority
+        for i, r in enumerate(self._waiting):
+            if r.priority > best_p:
+                best_i, best_p = i, r.priority
+        if best_i == 0:
+            return self._waiting.popleft()
+        r = self._waiting[best_i]
+        del self._waiting[best_i]
+        return r
+
     # ---------------------------------------------------------- admission
 
     def prefill_plan(self, suffix: int) -> List[tuple]:
@@ -170,7 +195,7 @@ class Scheduler:
         exhaustion — later arrivals wait for a recycled slot (admitted
         between device chunks, never mid-chunk)."""
         while self._waiting and self.kv.free_slots():
-            req = self._waiting.popleft()
+            req = self._pop_next()
             plen = len(req.prompt_ids)
             # Reuse depths whose bucket-padded suffix prefill would write
             # past max_len are vetoed: the padded chunk lands at rows
@@ -225,6 +250,18 @@ class Scheduler:
         its resident tokens recorded for prefix reuse. Rows [0, length)
         hold KV for prompt + generated[:-1] (the final generated token
         never went back through the model)."""
+        if req in self.active:
+            self.active.remove(req)
+        resident = list(req.prompt_ids) + list(req.generated[:-1])
+        self.kv.release(req.slot, resident_tokens=resident)
+        req.slot = -1
+
+    def preempt(self, req: EngineRequest) -> None:
+        """Park an active request (priority preemption): the slot
+        returns to the pool with the CONFIRMED rows resident — prompt +
+        generated[:-1], exactly what finish() would seed — so the
+        resume continuation's re-prefill is a prefix-cache hit (or,
+        once those rows are evicted and spilled, a fleet-tier pull)."""
         if req in self.active:
             self.active.remove(req)
         resident = list(req.prompt_ids) + list(req.generated[:-1])

@@ -153,6 +153,18 @@ class DecodeLLMServer:
                 except BaseException as e:  # noqa: BLE001 — shipped back
                     _out.put((_rid, False, e))
 
+            if req.stream_queue is not None:
+                # Streaming handoff: a per-request pump drains the
+                # engine's stream queue into the SHARED result channel
+                # as ("tok", (abs_index, [tokens])) delta frames — one
+                # edge multiplexes every live stream by req_id. The
+                # final result still rides the future callback below
+                # (it may overtake trailing tok frames in the outbox;
+                # the prefill side reconciles by absolute index).
+                threading.Thread(
+                    target=self._stream_pump, args=(req_id, req, outbox),
+                    daemon=True,
+                    name=f"disagg-stream-{req_id[:6]}").start()
             req.future.add_done_callback(_deliver)
         reader.close()
         edge["outbox"].put(None)
@@ -161,6 +173,41 @@ class DecodeLLMServer:
         # for the life of the replica.
         with self._lock:
             self._edges.pop(edge["writer_tag"], None)
+
+    def _stream_pump(self, req_id: str, req, outbox) -> None:
+        """Forward one streamed request's token deltas to the prefill
+        peer. Frames carry the ABSOLUTE token index (0 is the handoff's
+        first token, emitted at prefill time, so deltas start at 1):
+        after a decode-death re-route the replacement decode replica
+        replays the greedy stream from index 1 and the prefill-side
+        cursor drops the already-delivered prefix. Terminal records
+        ("done"/"error") emit no frame — the future callback ships the
+        authoritative final result on the same channel."""
+        import queue as _q
+
+        idx = 1
+        q = req.stream_queue
+        while not self._stopped.is_set():
+            try:
+                kind, val = q.get(timeout=1.0)
+            except _q.Empty:
+                continue
+            if kind != "token":
+                return
+            batch = [int(val)]
+            # Greedy drain: tokens retired in one engine chunk ride one
+            # frame (channel sends are cheap but not free).
+            while True:
+                try:
+                    k2, v2 = q.get_nowait()
+                except _q.Empty:
+                    break
+                if k2 != "token":
+                    outbox.put((req_id, "tok", (idx, batch)))
+                    return
+                batch.append(int(v2))
+            outbox.put((req_id, "tok", (idx, batch)))
+            idx += len(batch)
 
     def _respond_loop(self, edge: Dict[str, Any]) -> None:
         import queue as _q
@@ -232,13 +279,37 @@ class PrefillLLMServer:
                               score_weights=DECODE_POOL_WEIGHTS)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        h = self.engine.prefill_remote(
-            request["prompt_ids"],
-            max_new_tokens=request.get("max_new_tokens", 32),
-            eos_id=request.get("eos_id"))
+        h = self._prefill(request)
         if not h.get("kv_handoff"):
             return h  # finished at the first token: no decode needed
         return self._dispatch(h)
+
+    def _prefill(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.prefill_remote(
+            request["prompt_ids"],
+            max_new_tokens=request.get("max_new_tokens", 32),
+            eos_id=request.get("eos_id"),
+            tenant=str(request.get("tenant") or ""),
+            priority=int(request.get("priority", 0)))
+
+    def stream(self, request: Dict[str, Any]):
+        """Token-streaming entry (handle.options(stream=True)) for the
+        DISAGGREGATED topology: the first token streams at prefill
+        time (TTFT needs no decode round-trip), then decode-side token
+        deltas ride the edge's reverse result channel, multiplexed by
+        request id. Greedy output is token-identical to colocated
+        streaming; a decode death mid-stream re-routes the retained
+        handoff and the replayed stream resumes where it left off."""
+        h = self._prefill(request)
+        if not h.get("kv_handoff"):
+            # Finished at the first token: the whole stream is the
+            # prefill result.
+            for t in h["token_ids"]:
+                yield int(t)
+            return
+        h["stream"] = True
+        yield int(h["first_token"])
+        yield from self._dispatch_stream(h)
 
     # ------------------------------------------------------------ edges
 
@@ -288,7 +359,7 @@ class PrefillLLMServer:
         reader = ChannelReader(res_ch)
         reader.prepare()
         edge = {"writer": writer, "reader": reader, "dead": False,
-                "pending": {}, "lock": threading.Lock()}
+                "pending": {}, "streams": {}, "lock": threading.Lock()}
         with self._lock:
             self._edges[replica] = edge
         threading.Thread(target=self._collect_loop,
@@ -309,14 +380,28 @@ class PrefillLLMServer:
                 break
             except Exception:  # noqa: BLE001 — edge is failed below
                 break
+            if ok == "tok":
+                # Streaming token-delta frame, multiplexed on the same
+                # edge: route to the request's stream cursor (dropped
+                # when the stream already completed — the final result
+                # can overtake trailing frames in the decode outbox).
+                with edge["lock"]:
+                    sq = edge["streams"].get(req_id)
+                if sq is not None:
+                    sq.put(("tok", result))
+                continue
             with edge["lock"]:
                 fut = edge["pending"].pop(req_id, None)
-            if fut is None:
-                continue
-            if ok:
-                fut.set_result(result)
-            else:
-                fut.set_exception(result)
+                sq = edge["streams"].pop(req_id, None)
+            if fut is not None:
+                if ok:
+                    fut.set_result(result)
+                else:
+                    fut.set_exception(result)
+            if sq is not None:
+                # Wake the stream consumer: the terminal outcome is in
+                # the future it holds.
+                sq.put(("end", None))
         self._kill_edge(replica, edge)
 
     def _kill_edge(self, replica, edge: Dict[str, Any]) -> None:
@@ -335,10 +420,15 @@ class PrefillLLMServer:
         edge["reader"].close()
         with edge["lock"]:
             pending, edge["pending"] = dict(edge["pending"]), {}
+            streams, edge["streams"] = dict(edge["streams"]), {}
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(DecodeReplicaDied(
                     "decode edge torn down mid-flight"))
+        for sq in streams.values():
+            # The paired future (failed above) carries the typed error;
+            # the sentinel just wakes the stream consumer to read it.
+            sq.put(("end", None))
 
     # --------------------------------------------------------- dispatch
 
@@ -368,6 +458,23 @@ class PrefillLLMServer:
                     raise DecodeReplicaDied(
                         f"decode replica unreachable: {e!r}") from e
 
+    def _choose_avoiding(self, dead) -> Any:
+        """Router choice that skips replicas THIS request already saw
+        die: the router's own view lags the controller's prune sweep,
+        so a redirect choosing blind can burn every retry attempt on
+        the same dead replica (handles hash by actor id, so the set
+        survives re-pickled snapshot pushes). When every choice is a
+        known-dead replica the last pick goes through anyway — the
+        controller may have respawned it under the same id."""
+        replica = None
+        for _ in range(8 if dead else 1):
+            if replica is not None:
+                self._router.done(replica)  # discarded pick
+            replica = self._router.choose()
+            if replica not in dead:
+                break
+        return replica
+
     def _dispatch(self, handoff: Dict[str, Any]) -> Dict[str, Any]:
         import time as _time
         from concurrent.futures import TimeoutError as _FutTimeout
@@ -377,8 +484,9 @@ class PrefillLLMServer:
 
         deadline = _time.monotonic() + cfg.serve_handle_timeout_s
         last_err: Optional[BaseException] = None
+        dead: set = set()
         for _attempt in range(cfg.serve_disagg_max_redirects + 1):
-            replica = self._router.choose()
+            replica = self._choose_avoiding(dead)
             edge = None
             try:
                 try:
@@ -387,6 +495,7 @@ class PrefillLLMServer:
                     # failure (e.g. the chosen replica died before
                     # open_kv_channel): re-route like a transfer failure
                     last_err = e
+                    dead.add(replica)
                     self._router.invalidate()
                     if _time.monotonic() > deadline:
                         break
@@ -418,6 +527,7 @@ class PrefillLLMServer:
                     # and re-route the SAME request to another decode
                     # replica.
                     last_err = e
+                    dead.add(replica)
                     self._kill_edge(replica, edge)
                     self._router.invalidate()
                     if _time.monotonic() > deadline:
@@ -428,6 +538,126 @@ class PrefillLLMServer:
             f"disaggregated dispatch failed after "
             f"{cfg.serve_disagg_max_redirects + 1} attempts: "
             f"{last_err!r}")
+
+    def _dispatch_stream(self, handoff: Dict[str, Any]):
+        """Streaming twin of :meth:`_dispatch`: same redirect loop over
+        the retained handoff, but the consumer is a generator fed by
+        the edge's per-request stream cursor. ``delivered`` counts
+        tokens already yielded (ABSOLUTE index; the prefill-time first
+        token is index 0), so a re-routed decode's replayed stream
+        deduplicates instead of double-yielding."""
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        import queue as _q
+
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.dag.errors import ChannelError, ChannelTimeoutError
+
+        deadline = _time.monotonic() + cfg.serve_handle_timeout_s
+        delivered = 1  # the caller already yielded the first token
+        last_err: Optional[BaseException] = None
+        dead: set = set()
+        for _attempt in range(cfg.serve_disagg_max_redirects + 1):
+            replica = self._choose_avoiding(dead)
+            try:
+                try:
+                    edge = self._edge_for(replica)
+                except Exception as e:  # noqa: BLE001 — negotiation
+                    # failure: re-route like a transfer failure
+                    last_err = e
+                    dead.add(replica)
+                    self._router.invalidate()
+                    if _time.monotonic() > deadline:
+                        break
+                    continue
+                req_id = uuid.uuid4().hex
+                fut: Future = Future()
+                sq: "_q.Queue" = _q.Queue()
+                with edge["lock"]:
+                    edge["pending"][req_id] = fut
+                    edge["streams"][req_id] = sq
+                try:
+                    edge["writer"].send((req_id, handoff), timeout=60.0)
+                    for tok in self._stream_recv(replica, edge, fut, sq,
+                                                 deadline, delivered):
+                        delivered += 1
+                        yield tok
+                    return
+                except _FutTimeout as e:
+                    # Deadline expired with the decode replica healthy:
+                    # fail only THIS stream (see _dispatch).
+                    last_err = e
+                    with edge["lock"]:
+                        edge["pending"].pop(req_id, None)
+                        edge["streams"].pop(req_id, None)
+                    break
+                except (DecodeReplicaDied, ChannelError,
+                        ChannelTimeoutError, OSError) as e:
+                    # Retained-handoff redirect: the payload is still in
+                    # hand — tear the edge down and replay on a live
+                    # decode replica. Tokens already yielded stay
+                    # yielded; the replayed stream's duplicate prefix is
+                    # dropped by the delivered cursor.
+                    last_err = e
+                    dead.add(replica)
+                    self._kill_edge(replica, edge)
+                    self._router.invalidate()
+                    if _time.monotonic() > deadline:
+                        break
+            finally:
+                self._router.done(replica)
+        raise RuntimeError(
+            f"disaggregated stream dispatch failed after "
+            f"{cfg.serve_disagg_max_redirects + 1} attempts: "
+            f"{last_err!r}")
+
+    def _stream_recv(self, replica, edge: Dict[str, Any], fut: Future,
+                     sq, deadline: float, start_abs: int):
+        """Yield NEW tokens (absolute index >= ``start_abs``) from one
+        decode attempt's stream cursor, health-probing the replica
+        while parked (a SIGKILLed decode replica can't close its ring
+        side). Terminates on the final-result frame — the tail past the
+        last tok frame is reconciled from the result's token_ids, which
+        covers the final-result-overtakes-tok-frames outbox race."""
+        import queue as _q
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        import ray_tpu
+
+        next_abs = start_abs
+        while True:
+            try:
+                kind, body = sq.get(timeout=3.0)
+            except _q.Empty:
+                if fut.done():
+                    kind, body = "end", None  # terminal raced the wake
+                elif _time.monotonic() > deadline:
+                    raise _FutTimeout()
+                else:
+                    try:
+                        ray_tpu.get(replica.health_check.remote(),
+                                    timeout=15)
+                    except Exception as e:  # noqa: BLE001 — any probe
+                        # failure = replica gone: re-route
+                        raise DecodeReplicaDied(
+                            f"decode replica unreachable: {e!r}") from e
+                    continue
+            if kind == "tok":
+                idx, toks = body
+                for j, t in enumerate(toks):
+                    if idx + j == next_abs:  # drop re-route replays
+                        next_abs += 1
+                        yield int(t)
+                continue
+            # Terminal: the future carries the result (or the typed
+            # error the dispatcher re-routes on).
+            result = fut.result(timeout=60.0)
+            for t in result["token_ids"][next_abs:]:
+                next_abs += 1
+                yield int(t)
+            return
 
     def stats(self):
         out = self.engine.stats()
@@ -460,8 +690,13 @@ def build_llm_deployment(name: str = "llm", *, num_replicas: int = 1,
     router scores the prefill pool by queue/TTFT and the decode pool by
     KV headroom. Greedy output is token-identical to the colocated
     deployment. Requests route exactly as before —
-    ``handle.remote({"prompt_ids": ...})`` — streaming is colocated-only
-    for now."""
+    ``handle.remote({"prompt_ids": ...})`` — and streaming works in
+    both modes: ``handle.options("stream", stream=True)`` on the
+    disaggregated deployment yields the prefill-time first token, then
+    token deltas relayed from the decode replica over the reverse
+    result channel (per-request stream ids multiplexed on the same
+    negotiated edge; a decode death mid-stream re-routes via the
+    retained handoff with the already-delivered prefix deduplicated)."""
     from ray_tpu.serve import api as serve_api
 
     engine_kwargs = engine_kwargs or {}

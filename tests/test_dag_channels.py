@@ -205,6 +205,52 @@ def test_ring_writer_close_waits_for_inflight_spill_read(monkeypatch):
     r.close(unlink=True)
 
 
+def test_ring_spill_claim_race_grace_zero(monkeypatch):
+    """Regression: with the reclaim grace forced to ZERO the writer
+    unlinks unconsumed spills the instant close() runs — racing a
+    reader that already dequeued the ring record. The reader must
+    either CLAIM the side file (atomic rename in _spill_in) and return
+    the payload, or surface a clean ChannelClosedError; a raw
+    FileNotFoundError escaping _spill_in is the bug."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    big = os.urandom(1 << 19)  # > dag_ring_spill_bytes: rides a side file
+    orig = RingChannel._spill_in
+
+    def slow_spill_in(self, kind, name_b):
+        time.sleep(0.15)  # widen the dequeue -> claim race window
+        return orig(self, kind, name_b)
+
+    monkeypatch.setattr(RingChannel, "_spill_in", slow_spill_in)
+    for _ in range(3):
+        w, r = _pair(capacity=4)
+        w.write(big, 0)
+        out = {}
+
+        def reader():
+            try:
+                out["val"] = r.read(0, timeout=10)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                out["err"] = e
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)  # reader is inside _spill_in, pre-claim
+        old_grace = cfg.dag_spill_reclaim_grace_s
+        cfg.set("dag_spill_reclaim_grace_s", 0.0)
+        try:
+            w.close()
+        finally:
+            cfg.set("dag_spill_reclaim_grace_s", old_grace)
+        t.join(10)
+        err = out.get("err")
+        assert err is None or isinstance(err, ChannelClosedError), \
+            repr(err)
+        if err is None:
+            assert out["val"] == big
+        r.close(unlink=True)
+
+
 def test_ring_stop_sentinel_and_error_forwarding():
     w, r = _pair()
     try:

@@ -200,6 +200,86 @@ def serve_cluster():
     ray_tpu.shutdown()
 
 
+def _collect_stream(handle, payload, timeout=240.0):
+    gen = handle.options("stream", stream=True).remote(payload)
+    import time as _t
+
+    deadline = _t.time() + timeout
+    toks = []
+    for t in gen:
+        toks.append(int(t))
+        assert _t.time() < deadline, "stream stalled"
+    return toks
+
+
+def test_serve_disagg_stream_token_identity(serve_cluster):
+    """Disaggregated streaming (prefill-time first token + decode
+    deltas over the reverse result channel) is token-identical to
+    colocated streaming AND to the non-streaming result — including
+    the multi-page prompt and a mid-stream EOS stop."""
+    import ray_tpu.serve as serve
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    ek = dict(max_batch=2, max_len=96, prompt_buckets=[8, 16, 32],
+              decode_chunk=4, seed=0)
+    colo = serve.run(build_llm_deployment(name="stcolo",
+                                          engine_kwargs=ek))
+    dis = serve.run(build_llm_deployment(
+        name="stdis", disaggregated=True, num_decode_replicas=2,
+        engine_kwargs=ek))
+    for p in PROMPTS:
+        req = {"prompt_ids": p, "max_new_tokens": 12}
+        ref = colo.remote(dict(req)).result(timeout=120)["token_ids"]
+        assert _collect_stream(colo, dict(req)) == ref, p
+        assert _collect_stream(dis, dict(req)) == ref, p
+    # Mid-stream EOS: pick a token the reference emits mid-generation
+    # and make it the stop token — both streams must truncate there,
+    # including the EOS token itself, identically.
+    p = PROMPTS[2]
+    ref = colo.remote({"prompt_ids": p, "max_new_tokens": 12}
+                      ).result(timeout=120)["token_ids"]
+    eos = ref[4]
+    req = {"prompt_ids": p, "max_new_tokens": 12, "eos_id": eos}
+    want = colo.remote(dict(req)).result(timeout=120)["token_ids"]
+    assert want[-1] == eos and len(want) < len(ref)
+    assert _collect_stream(colo, dict(req)) == want
+    assert _collect_stream(dis, dict(req)) == want
+
+
+def test_serve_disagg_stream_reroute_on_decode_death(serve_cluster):
+    """SIGKILL the decode replicas after the stream has delivered a
+    few tokens: the retained handoff re-routes to a (re-spawned or
+    surviving) decode replica and the REPLAYED stream resumes where it
+    left off — the consumer sees one token-identical sequence."""
+    import ray_tpu
+    import ray_tpu.serve as serve
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    ek = dict(max_batch=2, max_len=96, prompt_buckets=[8, 16, 32],
+              decode_chunk=4, seed=0)
+    colo = serve.run(build_llm_deployment(name="skcolo",
+                                          engine_kwargs=ek))
+    dis = serve.run(build_llm_deployment(
+        name="skdis", disaggregated=True, num_decode_replicas=2,
+        engine_kwargs=ek))
+    p = PROMPTS[1]
+    req = {"prompt_ids": p, "max_new_tokens": 16}
+    ref = colo.remote(dict(req)).result(timeout=120)["token_ids"]
+    gen = dis.options("stream", stream=True).remote(dict(req))
+    got = []
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    for t in gen:
+        got.append(int(t))
+        if len(got) == 3:
+            _, replicas = ray_tpu.get(
+                controller.get_replica_set.remote("skdis-decode"),
+                timeout=30)
+            for rep in replicas:
+                ray_tpu.kill(rep)
+    assert got == ref
+
+
 def test_serve_disagg_equivalence_and_reroute_on_death(serve_cluster):
     import ray_tpu
     import ray_tpu.serve as serve

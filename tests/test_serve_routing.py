@@ -199,6 +199,111 @@ def test_done_underflow_guard():
     assert counts["a"] >= 1 and counts["b"] >= 1, counts
 
 
+def test_candidate_subset_bounds_scoring_at_scale():
+    """Past serve_router_score_all_max replicas the router scores only
+    the O(touched) candidate subset (session pin + inverted prefix
+    index + base-score top-K), never the whole pool — and the index
+    still finds the one resident replica out of 200."""
+    n = 200
+    prompt = list(range(16))
+    chain = chain_hashes(prompt, 4)
+    loads = [snap() for _ in range(n)]
+    loads[137] = snap(prefix_hashes=chain)
+    r = make_router([f"r{i}" for i in range(n)], loads)
+    for _ in range(8):
+        choice = r.choose(prefix_tokens=prompt)
+        assert choice == "r137"
+        r.done(choice)
+    st = r.stats()
+    assert st["scored_routes"] == 8
+    bound = cfg.serve_router_topk + cfg.serve_router_affinity_cands + 1
+    assert st["candidates_scored"] <= 8 * bound, st
+
+
+def test_session_affinity_pin_survives_index_outage():
+    """The session-affinity LRU keeps a conversation on its home
+    replica even when the inverted index can't surface it (the
+    delta-lag window): the pin injects the home into the candidate
+    set, and prefix residency wins the score."""
+    n = 64
+    prompt = list(range(16))
+    chain = chain_hashes(prompt, 4)
+    loads = [snap() for _ in range(n)]
+    loads[50] = snap(prefix_hashes=chain)
+    r = make_router([f"r{i}" for i in range(n)], loads)
+    assert r.choose(prefix_tokens=prompt, session_key="u") == "r50"
+    r.done("r50")
+    old = cfg.serve_router_affinity_cands
+    cfg.set("serve_router_affinity_cands", 0)  # index blind
+    try:
+        for _ in range(4):
+            assert r.choose(prefix_tokens=prompt,
+                            session_key="u") == "r50"
+            r.done("r50")
+    finally:
+        cfg.set("serve_router_affinity_cands", old)
+    assert r.stats()["session_affinity_routes"] >= 4
+
+
+def test_session_affinity_lru_capped():
+    old = cfg.serve_router_session_affinity_max
+    cfg.set("serve_router_session_affinity_max", 4)
+    try:
+        n = 32
+        r = make_router([f"r{i}" for i in range(n)],
+                        [snap() for _ in range(n)])
+        for i in range(7):
+            r.done(r.choose(session_key=f"s{i}"))
+        assert len(r._session_affinity) == 4
+        assert "s0" not in r._session_affinity  # oldest aged out
+        assert "s6" in r._session_affinity
+    finally:
+        cfg.set("serve_router_session_affinity_max", old)
+
+
+def test_apply_delta_updates_routing():
+    """A journal delta flips the routing decision in place; deltas
+    from a moved replica-set version or with out-of-range indices are
+    refused (caller re-seeds with a full payload)."""
+    r = make_router(["a", "b"], [snap(queue_depth=9), snap()])
+    assert r.choose() == "b"
+    r.done("b")
+    assert r._apply_delta(1, {0: snap(), 1: snap(queue_depth=9)},
+                          load_gen=2)
+    assert r.choose() == "a"
+    assert r._load_gen == 2
+    assert not r._apply_delta(99, {0: snap()})  # version moved
+    assert not r._apply_delta(1, {7: snap()})   # index out of range
+
+
+def test_apply_delta_none_snapshot_drops_entry():
+    """snap=None in a delta means the replica missed the sweep: its
+    loads entry drops (pow-2 fallback semantics), matching what a full
+    payload without that replica would do."""
+    r = make_router(["a", "b"], [snap(), snap()])
+    assert r._apply_delta(1, {0: None})
+    assert "a" not in r._loads and "b" in r._loads
+
+
+def test_controller_delta_since_unit():
+    """_delta_since ships exactly the touched indices past the
+    caller's generation; a generation that fell out of the bounded
+    journal forces a full resync (None)."""
+    import collections
+
+    from ray_tpu.serve._private.controller import ServeController
+
+    d = {"replicas": ["a", "b", "c"],
+         "loads": {"a": snap(), "b": snap(), "c": snap()},
+         "journal": collections.deque(
+             [(5, frozenset({0})), (6, frozenset({1, 2}))], maxlen=8)}
+    ds = ServeController._delta_since
+    assert set(ds(None, d, 5)) == {1, 2}
+    assert ds(None, d, 6) == {}      # caught up: empty delta
+    assert ds(None, d, 4) is None    # journal gap: full payload
+    assert ds(None, d, 7) is None    # future gen: full payload
+
+
 def test_stop_joins_poller():
     r = make_router(["a"], [snap()])
     done = threading.Event()
