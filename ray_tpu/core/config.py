@@ -438,6 +438,12 @@ _d("serve_qos_burst_tokens", float, 0.0,
    "per-tenant QoS: default token-bucket capacity; 0 derives 4 seconds "
    "of the refill rate (a short burst rides through, sustained flood "
    "pins the tenant to its rate)")
+_d("serve_qos_tenant_idle_s", float, 600.0,
+   "per-tenant QoS: reap a lazily-minted tenant lane (bucket, WFQ "
+   "state, TTFT window) after this many seconds with nothing queued, "
+   "inflight, or recorded. Tenants installed via configure_tenant are "
+   "pinned and never reaped. 0 disables reaping (the tenant map then "
+   "grows with the distinct-tenant universe — bounded only by churn)")
 _d("serve_qos_queue_depth", int, 0,
    "per-tenant QoS: max requests parked PER TENANT at the admission "
    "gate before that tenant sheds (isolation: one flooding tenant "

@@ -8,6 +8,17 @@ edges (ring.py), persistent peer sockets carrying scatter frames for
 cross-node edges (peer.py) — so a steady-state hop never touches the
 head, the scheduler, or a lease. The disaggregated prefill/decode
 serving tier (serve/llm.py) streams KV pages over the same channels.
+
+Runtime witness: ``RTPU_DEBUG_CHAN=1`` (zero overhead off) makes every
+ring/peer endpoint check its own frame protocol online — per-edge seq
+monotonicity, credit windows, ack-after-consume, cursor ordering, a
+Lamport clock carried in frame headers, a sampled payload checksum
+(every 16th frame, send vs. consume — catches torn reads and
+mutate-after-send), and spill side-file pin/reclaim pairing.
+Violations print ``RTPU_CHAN:`` lines, are queryable via
+``devtools.chan_debug.violations()``, and ride flight-recorder dumps
+under the ``"chan_debug"`` key; the static half is the rtpu-lint
+``chan`` rule family (``devtools/chanlint.py``).
 """
 
 from ray_tpu.dag.channel import (ChannelClosedError, ChannelEndpoint,

@@ -15,8 +15,13 @@ message; this costs none of either.
 Wire format, writer → reader (one socket per channel edge)::
 
     hello:  u32 0xC0DE0001 | u32 idlen | channel_id
-    data:   u32 size | u8 kind | u64 seq | u32 nparts | u32 lens[nparts]
-            | parts...                      (size = sum of lens)
+    data:   u32 size | u8 kind | u64 seq | u64 clock | u32 crc
+            | u32 nparts | u32 lens[nparts] | parts...
+                                            (size = sum of lens)
+
+``clock``/``crc`` carry the RTPU_DEBUG_CHAN witness's Lamport stamp
+and sampled payload checksum (``devtools/chan_debug.py``); both are 0
+when the witness is off.
 
 reader → writer (same socket)::
 
@@ -52,6 +57,7 @@ logger = logging.getLogger(__name__)
 
 from ray_tpu.dag.errors import ChannelClosedError, ChannelTimeoutError
 from ray_tpu.dag.ring import KIND_ERR, KIND_OK, KIND_STOP
+from ray_tpu.devtools import chan_debug as _chandbg
 from ray_tpu.devtools import res_debug as _resdbg
 from ray_tpu.devtools.lock_debug import make_lock
 
@@ -190,11 +196,12 @@ class ChannelEndpoint:
 
     def _pump(self, conn: socket.socket, cid: bytes, ib: _Inbox) -> None:
         while not self._stopped and not ib.closed:
-            hdr = _recv_exact(conn, 17)
+            hdr = _recv_exact(conn, 29)
             if hdr is None:
                 return
-            size, kind, seq = struct.unpack("<IBQ", hdr[:13])
-            (nparts,) = struct.unpack("<I", hdr[13:17])
+            size, kind, seq, clock, crc = struct.unpack("<IBQQI",
+                                                        hdr[:25])
+            (nparts,) = struct.unpack("<I", hdr[25:29])
             lens_raw = _recv_exact(conn, 4 * nparts)
             if lens_raw is None:
                 return
@@ -219,7 +226,7 @@ class ChannelEndpoint:
                     "channel": cid.hex()[:12], "seq": seq,
                     "last": ib.last_seq})
             try:
-                ib.q.put((kind, seq, parts), timeout=60.0)
+                ib.q.put((kind, seq, clock, crc, parts), timeout=60.0)
             except queue.Full:
                 # last_seq NOT advanced: the frame never reached the
                 # application, so a retransmit after reconnect must
@@ -376,6 +383,15 @@ class CrossNodeChannel:
         self._inbox: Optional[_Inbox] = None
         self._registered = False
 
+    def _witness_key(self) -> str:
+        # Endpoint token, not the bare edge name: a reopened channel
+        # restarts seqs at 0 and must not trip the witness's
+        # monotonicity checks against the previous incarnation.
+        k = getattr(self, "_wkey", None)
+        if k is None:
+            k = self._wkey = f"{self.edge}@{id(self) & 0xFFFFFF:06x}"
+        return k
+
     # ------------------------------------------------------------- reader
 
     def prepare_read(self) -> str:
@@ -414,7 +430,7 @@ class CrossNodeChannel:
             step = 0.5 if deadline is None else max(
                 0.0, min(0.5, deadline - time.monotonic()))
             try:
-                kind, got_seq, parts = ib.q.get(timeout=step)
+                kind, got_seq, clock, crc, parts = ib.q.get(timeout=step)
                 break
             except queue.Empty:
                 if self._closed or ib.closed:
@@ -426,11 +442,20 @@ class CrossNodeChannel:
                         edge=self.edge, seq=seq,
                         bytes_in_flight=ib.bytes_received,
                         peer_alive=None)
+        if _chandbg.enabled():
+            # Witness BEFORE the mismatch raise: the witness must see
+            # the gap/inversion even when the caller turns it into an
+            # exception (and record the consume so the ack below is
+            # checked against it).
+            _chandbg.note_consume(self._witness_key(), got_seq, clock,
+                                  crc, *parts)
         if got_seq != seq:
             raise ChannelClosedError(
                 f"channel {self.edge}: seq mismatch (got {got_seq}, "
                 f"expected {seq})")
         get_endpoint().ack(ib, seq)  # consumption credit -> writer
+        if _chandbg.enabled():
+            _chandbg.note_ack(self._witness_key(), seq)
         nbytes = sum(len(p) for p in parts)
         if traced:
             _tracing.emit_span(
@@ -582,7 +607,11 @@ class CrossNodeChannel:
         parts = [head_bytes] + [memoryview(b) for b in bufs]
         lens = [len(p) for p in parts]
         size = sum(lens)
-        hdr = (struct.pack("<IBQI", size, kind, seq, len(parts))
+        witness = _chandbg.enabled()
+        clock = _chandbg.clock_stamp(self._witness_key()) if witness else 0
+        crc = _chandbg.payload_crc(seq, *parts) if witness else 0
+        hdr = (struct.pack("<IBQQII", size, kind, seq, clock, crc,
+                           len(parts))
                + struct.pack("<%dI" % len(parts), *lens))
         from ray_tpu.cluster.protocol import _sendmsg_all
 
@@ -624,6 +653,10 @@ class CrossNodeChannel:
                 with self._ack_cond:
                     self._sent_bytes += size
                     self._inflight_sizes[seq] = size
+                    floor = self._acked
+                if witness:
+                    _chandbg.note_send(self._witness_key(), seq, size,
+                                       window=(floor, self.capacity))
                 if traced:
                     _tracing.emit_span(
                         "dag.channel.send", t0w, time.time(),

@@ -22,9 +22,14 @@ Layout (offsets in bytes)::
     40  writer_closed u8 / reader_closed u8
     64  data[capacity]
 
-Records never wrap: ``[u32 size | u32 kind | u64 seq | payload]``
+Records never wrap:
+``[u32 size | u32 kind | u64 seq | u64 clock | u32 crc | payload]``
 padded to 8 bytes; when the contiguous tail is too small the writer
 stamps a wrap marker (size = 0xFFFFFFFF) and continues at offset 0.
+``clock``/``crc`` carry the RTPU_DEBUG_CHAN witness's Lamport stamp
+and sampled payload checksum (``devtools/chan_debug.py``) and are 0
+when the witness is off; layout v3 bumped the version so a stale
+attacher fails loudly instead of misparsing records.
 Position publishes happen AFTER the payload memcpy, so the reader only
 ever observes complete records (aligned 8-byte stores are atomic on
 the platforms this runtime targets).
@@ -48,12 +53,13 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from ray_tpu.dag.errors import ChannelClosedError, ChannelTimeoutError
+from ray_tpu.devtools import chan_debug as _chandbg
 from ray_tpu.devtools import res_debug as _resdbg
 
 _MAGIC = 0x52545543  # "RTUC"
-_VERSION = 2
+_VERSION = 3
 _HDR = 64
-_REC_HDR = 16
+_REC_HDR = 32  # <IIQQI = 28 bytes of header, padded to 8-alignment
 _WRAP = 0xFFFFFFFF
 
 # Record kinds (mirrored by the cross-node transport in peer.py).
@@ -186,6 +192,13 @@ class RingChannel:
                             "ring rendezvous: header never initialized",
                             edge=self.edge)
                     time.sleep(0.001)
+                ver = struct.unpack_from("<I", mm, _O_VERSION)[0]
+                if ver != _VERSION:
+                    mm.close()
+                    raise ChannelClosedError(
+                        f"channel {self.edge}: ring layout v{ver} != "
+                        f"v{_VERSION} — both endpoints must run the "
+                        "same build (record headers are incompatible)")
         finally:
             os.close(fd)
         self._mm = mm
@@ -197,6 +210,15 @@ class RingChannel:
         _resdbg.note_acquire("channel_ring",
                              key=(os.getpid(), id(self)), owner=self)
         return mm
+
+    def _witness_key(self) -> str:
+        """RTPU_DEBUG_CHAN endpoint token: edge + object identity, so a
+        reopened channel under the same edge name starts a fresh
+        stream in the witness registry."""
+        k = getattr(self, "_wkey", None)
+        if k is None:
+            k = self._wkey = f"{self.edge}@{id(self) & 0xFFFFFF:06x}"
+        return k
 
     # ------------------------------------------------------------- cursors
 
@@ -237,6 +259,14 @@ class RingChannel:
         self._role = "w"
         traced = _tracing.enabled()
         t0w = time.time() if traced else 0.0
+        witness = _chandbg.enabled()
+        clock = crc = 0
+        if witness:
+            clock = _chandbg.clock_stamp(self._witness_key())
+            # crc over the ORIGINAL payload, before any spill-out: the
+            # reader recomputes after spill resolution, so a side file
+            # mutated between send and consume is caught too.
+            crc = _chandbg.payload_crc(seq, payload)
         if len(payload) > cfg.dag_ring_spill_bytes:
             payload = self._spill_out(payload, seq)
             kind = KIND_SPILL if kind == KIND_OK else KIND_SPILL_ERR
@@ -274,13 +304,23 @@ class RingChannel:
                 struct.pack_into("<I", mm, _HDR + off, _WRAP)
             wpos += tail
             off = 0
-        struct.pack_into("<IIQ", mm, _HDR + off, size, kind, seq)
+        struct.pack_into("<IIQQI", mm, _HDR + off, size, kind, seq,
+                         clock, crc)
         mm[_HDR + off + _REC_HDR:_HDR + off + _REC_HDR + size] = payload
         # Publish AFTER the payload memcpy: the reader never sees a
         # partial record.
         self._set_u64(_O_WPOS, wpos + rec)
         if kind in (KIND_SPILL, KIND_SPILL_ERR):
             self._spills.append((wpos + rec, self._last_spill_path))
+            if witness:
+                _chandbg.note_spill_pin(self._witness_key(),
+                                        self._last_spill_path,
+                                        wpos + rec)
+        if witness:
+            _chandbg.note_cursor(self._witness_key(), "wpos", wpos + rec)
+            _chandbg.note_send(self._witness_key(), seq, size,
+                               window=(self._u64(_O_RSEQ),
+                                       self.capacity))
         self._settle_spills(self._u64(_O_RPOS))
         if traced:
             _tracing.emit_span(
@@ -304,6 +344,7 @@ class RingChannel:
         while self._spills and self._spills[0][0] <= rpos:
             _end, path = self._spills.pop(0)
             _resdbg.note_release("channel_spill", (os.getpid(), path))
+            _chandbg.note_spill_release(self._witness_key(), path)
 
     # -------------------------------------------------------------- reader
 
@@ -374,8 +415,8 @@ class RingChannel:
                 if tail < _REC_HDR:
                     self._set_u64(_O_RPOS, rpos + tail)
                     continue
-                size, kind, seq = struct.unpack_from("<IIQ", mm,
-                                                     _HDR + off)
+                size, kind, seq, clock, crc = struct.unpack_from(
+                    "<IIQQI", mm, _HDR + off)
                 if size == _WRAP:
                     self._set_u64(_O_RPOS, rpos + tail)
                     continue
@@ -388,9 +429,15 @@ class RingChannel:
                     # reader crash in the window strand the file with
                     # the witness showing it released.
                     kind, payload = self._spill_in(kind, payload)
-                self._set_u64(_O_RPOS, rpos + _REC_HDR + _align8(size))
+                new_rpos = rpos + _REC_HDR + _align8(size)
+                self._set_u64(_O_RPOS, new_rpos)
                 self._set_u64(_O_RSEQ, seq + 1)
                 self._read_seq = seq + 1
+                if _chandbg.enabled():
+                    _chandbg.note_cursor(self._witness_key(), "rpos",
+                                         new_rpos)
+                    _chandbg.note_consume(self._witness_key(), seq,
+                                          clock, crc, payload)
                 return kind, seq, payload
             if self._peer_closed("r"):
                 raise ChannelClosedError(
@@ -477,12 +524,22 @@ class RingChannel:
                     break
                 time.sleep(pause)
                 pause = min(pause * 2, 0.02)
+        if self._spills and self._role == "w" and _chandbg.enabled():
+            # A pin whose record the reader already dequeued but that
+            # never settled is the PR 19 reclaim race: reclaiming it
+            # below would unlink a file _spill_in may open any instant.
+            try:
+                _chandbg.note_close(self._witness_key(),
+                                    self._u64(_O_RPOS))
+            except (ValueError, OSError):
+                pass
         for _end, path in self._spills:
             try:
                 os.unlink(path)
             except OSError:
                 pass
             _resdbg.note_release("channel_spill", (os.getpid(), path))
+            _chandbg.note_spill_release(self._witness_key(), path)
         self._spills = []
         path, mm, self._mm = self._path, self._mm, None
         try:

@@ -412,6 +412,12 @@ RES_REGISTRY_MODULES = {
     "ray_tpu.serve._private.router",
     "ray_tpu.serve._private.proxy",
     "ray_tpu.serve._private.slo",
+    # PR 19 serving state: per-tenant WFQ lanes (idle-reaped unless
+    # pinned by configure) and streaming cursor slots (settled on
+    # done/error/cancel or the TTL reaper).
+    "ray_tpu.serve._private.qos",
+    "ray_tpu.serve._private.replica",
+    "ray_tpu.serve.engine.core",
     "ray_tpu.devtools.rpc_debug",
     "ray_tpu.devtools.res_debug",
     "ray_tpu.util.tracing",
@@ -452,3 +458,73 @@ RES_OPEN_NAME_CALLS = {"open"}
 #: fd still needs close; either counts as "handled" here — the
 #: close-without-shutdown rule owns the pairing).
 RES_CLOSE_ATTRS = {"close", "shutdown", "detach"}
+
+# ======================================================================
+# Channel-protocol invariants (rule family "chan", chanlint.py).
+#
+# PRs 15-19 made pre-negotiated channels (shm SPSC rings, peer
+# sockets, pickle-5 scatter frames) the hot data plane — and every
+# recent real bug lived there: the PR 19 ``ring.py _spill_in``
+# spill-reclaim race (writer close unlinked a side-file the reader was
+# still opening), seq inversions on the peer socket, credit-window
+# stalls, and mutate-after-send aliasing on zero-copy frames. Each
+# table below feeds a chanlint rule; the runtime half is
+# devtools/chan_debug.py (RTPU_DEBUG_CHAN=1).
+# ======================================================================
+
+#: Receiver-name heuristic: a call like ``X.write(v, seq)`` /
+#: ``X.read(seq)`` is only treated as a CHANNEL op when the receiver
+#: name looks channel-ish — bare ``.write``/``.read`` on files and
+#: sockets must not light the seq/deadline rules up repo-wide.
+CHAN_RECEIVER_RE = re.compile(
+    r"(^|_)(chan|channel|ring|edge|lane)(nel|s)?($|_)", re.IGNORECASE)
+
+#: Ring cursor publish evidence: storing the write cursor via the
+#: ``_set_u64(_O_WPOS, ...)`` idiom (or any *pos-named helper). The
+#: publish must come AFTER the payload memcpy into the mmap — a
+#: publish that precedes the fill hands the reader a cursor over
+#: garbage bytes.
+CHAN_CURSOR_PUBLISH_RE = re.compile(r"(wpos|write_pos|_O_WPOS)")
+#: The mmap/buffer objects whose subscript-store is "the payload fill".
+CHAN_MM_NAME_RE = re.compile(r"(^|_)(mm|mmap|buf|shm)($|_)")
+
+#: Spill-ledger attr names (the pin side of the PR 19 race) and the
+#: evidence that a teardown path OBSERVES consumption before
+#: reclaiming (settle helper, rpos check, or the reclaim grace poll).
+CHAN_SPILL_ATTR_RE = re.compile(r"spill", re.IGNORECASE)
+CHAN_SETTLE_EVIDENCE_RE = re.compile(
+    r"(settle|rpos|_O_RPOS|reclaim_grace|\.rd\b|claim)")
+
+#: Reader-side inbox queues for the ack-before-consume rule: the ack
+#: must FOLLOW the application-side ``q.get`` (acking on socket
+#: receipt re-opens the credit window before the app consumed).
+CHAN_INBOX_NAME_RE = re.compile(r"(^|_)(q|queue|inbox)($|_)")
+
+#: Modules allowed to pass raw seqs into channel write/read — the
+#: auto-seq facades themselves and the transports under them. Anyone
+#: else routing a literal/derived seq into ``.write(v, seq)`` can mint
+#: a gap or duplicate the witness then sees as send-seq-gap.
+CHAN_SEQ_EXEMPT_MODULES = {
+    "ray_tpu.dag.compiled_dag",
+    "ray_tpu.dag.channel",
+    "ray_tpu.dag.ring",
+    "ray_tpu.dag.peer",
+    # CpuCommunicator keeps per-peer monotonic counters — it IS an
+    # auto-seq facade (one stream per (src, dst) rank pair).
+    "ray_tpu.dag.communicator",
+}
+
+#: Transport modules whose classes dial peers: every
+#: ``socket.create_connection`` there needs a _GONE/liveness handling
+#: branch class-wide (a dial with no death branch spins forever on a
+#: torn-down reader).
+CHAN_TRANSPORT_MODULES = {"ray_tpu.dag.peer"}
+CHAN_LIVENESS_RE = re.compile(
+    r"(gone|alive|liveness|dead|_GONE)", re.IGNORECASE)
+
+#: Mutating attribute-calls for the mutate-after-send rule: calling
+#: one of these on a buffer AFTER it was handed to a zero-copy send
+#: races the reader's view of the frame.
+CHAN_MUTATING_ATTRS = {"fill", "sort", "resize", "put", "setfield",
+                       "partition", "byteswap", "append", "extend",
+                       "insert", "update", "clear"}

@@ -42,9 +42,16 @@ wall-clock-deadline, missing-chaos-role). A fourth, ``res``
 (``reslint.py``), enforces resource lifetimes (acquire-without-release,
 begin-without-commit, unbounded-registry-growth, thread-without-stop,
 fd-leak-on-error) with ``res_debug.py``'s RTPU_DEBUG_RES runtime
-witness as its dynamic half.
-``--family {all,concurrency,jax,dist,res}`` selects which families run
-(default: all).
+witness as its dynamic half. A fifth, ``chan`` (``chanlint.py``),
+enforces the channel-protocol contract on the pre-negotiated data
+plane (chan-cursor-publish-order, chan-spill-pin-unreleased,
+chan-ack-before-consume, chan-raw-seq-send,
+chan-register-without-unregister, chan-dial-without-liveness,
+chan-blocking-op-no-deadline, chan-mutate-after-send) with
+``chan_debug.py``'s RTPU_DEBUG_CHAN frame-stream witness as its
+dynamic half.
+``--family {all,concurrency,jax,dist,res,chan}`` selects which
+families run (default: all).
 
 Baseline workflow: legacy findings live in ``lint_baseline.json``,
 sectioned per rule family with a per-family schema version
@@ -99,10 +106,18 @@ RES_RULES = (
     "unbounded-registry-growth", "thread-without-stop",
     "fd-leak-on-error",
 )
-FAMILIES = ("concurrency", "jax", "dist", "res")
+CHAN_RULES = (
+    "chan-cursor-publish-order", "chan-spill-pin-unreleased",
+    "chan-ack-before-consume", "chan-raw-seq-send",
+    "chan-register-without-unregister", "chan-dial-without-liveness",
+    "chan-blocking-op-no-deadline", "chan-mutate-after-send",
+)
+FAMILIES = ("concurrency", "jax", "dist", "res", "chan")
 FAMILY_RULES = {"concurrency": RULES, "jax": JAX_RULES,
-                "dist": DIST_RULES, "res": RES_RULES}
-FAMILY_SCHEMA = {"concurrency": 1, "jax": 1, "dist": 1, "res": 1}
+                "dist": DIST_RULES, "res": RES_RULES,
+                "chan": CHAN_RULES}
+FAMILY_SCHEMA = {"concurrency": 1, "jax": 1, "dist": 1, "res": 1,
+                 "chan": 1}
 RULE_FAMILY = {rule: fam for fam, rules in FAMILY_RULES.items()
                for rule in rules}
 
@@ -678,12 +693,15 @@ def lint_paths(paths: List[str], root: str,
     run_conc = "concurrency" in families
     run_dist = "dist" in families
     run_res = "res" in families
+    run_chan = "chan" in families
     if run_jax:
         from ray_tpu.devtools import jaxlint  # deferred: jaxlint imports us
     if run_dist:
         from ray_tpu.devtools import distlint  # deferred: ditto
     if run_res:
         from ray_tpu.devtools import reslint  # deferred: ditto
+    if run_chan:
+        from ray_tpu.devtools import chanlint  # deferred: ditto
     findings: List[Finding] = []
     for path in iter_py_files(paths):
         try:
@@ -715,6 +733,9 @@ def lint_paths(paths: List[str], root: str,
             if run_res:
                 rows.extend(reslint.lint_source(source, module, rel,
                                                 tree=tree))
+            if run_chan:
+                rows.extend(chanlint.lint_source(source, module, rel,
+                                                 tree=tree))
         findings.extend(rows)  # both linters already emit rel paths
     return findings
 

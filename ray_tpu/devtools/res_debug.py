@@ -64,9 +64,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 #: yet landed in the page store, or a pulled payload fetched but not yet
 #: installed/rejected. Resident store objects are a cache, not a leak;
 #: only a tier TRANSITION abandoned halfway is.
+#: The PR 19 serving state joins the same ledger: ``qos_tenant``
+#: (serve/_private/qos.py) counts live WFQ tenant lanes — configure()d
+#: tenants are pinned by the operator, but lazily-minted ones must be
+#: reaped once idle or a tenant-churn workload grows the scheduler
+#: forever; ``serve_stream`` (serve/_private/replica.py) counts open
+#: streaming cursor slots, released on completion, error, cancel, or
+#: the TTL reaper; ``parked_kv`` (serve/engine/core.py) counts
+#: preempted sessions parked with their KV residency — released on
+#: resume or engine close. All three must balance after a
+#: tenant-churn + stream-cancel loop drains.
 LEAK_KINDS = ("buffer_lease", "lease", "kv_spec",
               "channel_ring", "channel_spill", "channel_sock",
-              "data_queue", "data_operator", "kv_page_obj")
+              "data_queue", "data_operator", "kv_page_obj",
+              "qos_tenant", "serve_stream", "parked_kv")
 
 
 def enabled() -> bool:

@@ -34,6 +34,8 @@ import dataclasses
 import itertools
 from typing import Any, Deque, Dict, Optional
 
+from ray_tpu.devtools import res_debug as _resdbg
+
 #: Requests with no tenant attribution share one bucket/queue under
 #: this id (single-tenant deployments behave exactly like the pre-QoS
 #: FIFO gate: one tenant, equal tags, unlimited budget).
@@ -69,7 +71,8 @@ class _Ticket:
 
 class _Tenant:
     __slots__ = ("cfg", "bucket", "last_refill", "vfinish", "queue",
-                 "inflight", "admitted", "shed", "ttfts")
+                 "inflight", "admitted", "shed", "ttfts",
+                 "last_active", "pinned")
 
     def __init__(self, cfg: TenantConfig, now: float, window: int):
         self.cfg = cfg
@@ -83,6 +86,12 @@ class _Tenant:
         # Per-tenant TTFT window: the bench's per-tenant p99 rows and
         # the flood-isolation assertion read these.
         self.ttfts: Deque[float] = collections.deque(maxlen=window)
+        # Idle-reap state: lazily-minted lanes (pinned=False) are
+        # evicted by WFQQueue.reap_idle once quiet for the TTL, so a
+        # tenant-churn workload (a new tenant id per request) can't
+        # grow the scheduler without bound. configure() pins.
+        self.last_active = now
+        self.pinned = False
 
     @staticmethod
     def _cap(cfg: TenantConfig) -> float:
@@ -116,12 +125,15 @@ class WFQQueue:
     method takes ``now`` explicitly so unit tests drive virtual time.
     """
 
-    def __init__(self, window: int = 64):
+    def __init__(self, window: int = 64,
+                 idle_ttl: Optional[float] = None):
         self._window = window
         self._tenants: Dict[str, _Tenant] = {}
         self._vtime = 0.0
         self._seq = itertools.count()
         self._defaults: Optional[TenantConfig] = None
+        self._idle_ttl = idle_ttl  # None = read config lazily
+        self._last_now = 0.0  # freshest caller clock (for release())
 
     # -------------------------------------------------------------- config
 
@@ -129,8 +141,15 @@ class WFQQueue:
                   now: float) -> None:
         t = self._tenants.get(tenant)
         if t is None:
-            self._tenants[tenant] = _Tenant(cfg, now, self._window)
+            t = self._tenants[tenant] = _Tenant(cfg, now, self._window)
+            t.pinned = True  # operator-installed: never idle-reaped
             return
+        if not t.pinned:
+            # A lazily-minted lane graduates to operator-owned: it
+            # leaves the reap-eligible ledger (qos_tenant counts only
+            # lanes that MUST eventually be reaped or released).
+            t.pinned = True
+            _resdbg.note_release("qos_tenant", (id(self), tenant))
         t.cfg = cfg
         t.bucket = min(t.bucket, _Tenant._cap(cfg))
         t.refill(now)
@@ -149,6 +168,13 @@ class WFQQueue:
         if t is None:
             t = self._tenants[name] = _Tenant(self._default_cfg(), now,
                                               self._window)
+            # RTPU_DEBUG_RES: every lazily-minted lane must be settled
+            # by reap_idle (or pinned by configure) — the tenant-churn
+            # leak the res witness's balance assertion covers.
+            _resdbg.note_acquire("qos_tenant", key=(id(self), name),
+                                 owner=self, note="lazy_tenant")
+        self._last_now = max(self._last_now, now)
+        t.last_active = now
         return t
 
     # --------------------------------------------------------------- queue
@@ -176,11 +202,35 @@ class WFQQueue:
         t.refill(now)
         return t.bucket >= min(tk.cost, _Tenant._cap(t.cfg))
 
+    def reap_idle(self, now: float) -> int:
+        """Evict lazily-minted tenant lanes quiet for the idle TTL
+        (``serve_qos_tenant_idle_s``; 0 disables). Pinned (configure'd)
+        lanes and lanes with queued or inflight work are never touched.
+        Called from head() — the admission gate's own cadence bounds
+        the map without a dedicated reaper thread. Returns the count
+        reaped."""
+        self._last_now = max(self._last_now, now)
+        ttl = self._idle_ttl
+        if ttl is None:
+            from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+            ttl = float(cfg.serve_qos_tenant_idle_s)
+        if ttl <= 0:
+            return 0
+        dead = [name for name, t in self._tenants.items()
+                if not t.pinned and not t.queue and t.inflight == 0
+                and now - t.last_active > ttl]
+        for name in dead:
+            del self._tenants[name]
+            _resdbg.note_release("qos_tenant", (id(self), name))
+        return len(dead)
+
     def head(self, now: float) -> Optional[_Ticket]:
         """The ticket that should admit next: highest priority class,
         then smallest virtual finish tag, among tenants whose bucket
         covers their head request. None when every queued tenant is
         budget-blocked (or nothing is queued)."""
+        self.reap_idle(now)
         best: Optional[_Ticket] = None
         best_t: Optional[_Tenant] = None
         for t in self._tenants.values():
@@ -228,12 +278,27 @@ class WFQQueue:
         t = self._tenants.get(tenant)
         if t is not None and t.inflight > 0:
             t.inflight -= 1
+            # release() takes no clock (callers settle on completion
+            # paths without one); the freshest clock any caller passed
+            # keeps the idle TTL counted from request COMPLETION, not
+            # admission — a long decode must not look like idleness.
+            t.last_active = max(t.last_active, self._last_now)
 
     def record_ttft(self, tenant: str, ttft_ms: float, now: float) -> None:
         self.tenant(tenant, now).ttfts.append(ttft_ms)
 
     def note_shed(self, tenant: str, now: float) -> None:
         self.tenant(tenant, now).shed += 1
+
+    def close(self) -> None:
+        """Settle the witness ledger when the owning deployment state
+        is dropped (AdmissionController.forget): every still-live
+        lazily-minted lane is released deliberately — teardown is a
+        drain, not a leak."""
+        for name, t in self._tenants.items():
+            if not t.pinned:
+                _resdbg.note_release("qos_tenant", (id(self), name))
+        self._tenants.clear()
 
     def idle(self) -> bool:
         return all(not t.queue and not t.inflight

@@ -15,6 +15,8 @@ import time
 import uuid
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu.devtools import res_debug as _resdbg
+
 _logger = logging.getLogger(__name__)
 
 # Per-request serve context (multiplexed model id, ...). A ContextVar so
@@ -109,6 +111,11 @@ class ReplicaActor:
         buf: "_queue_mod.Queue" = _queue_mod.Queue()
         cancelled = threading.Event()
         self._streams[sid] = [buf, cancelled, time.monotonic()]
+        # RTPU_DEBUG_RES: every open cursor slot must be settled by
+        # completion, error, cancel, or the TTL reaper — the balance
+        # the leak witness asserts after a stream-cancel loop.
+        _resdbg.note_acquire("serve_stream", key=(id(self), sid),
+                             owner=self, note="stream_open")
         ctx = context or {}
 
         def drain():
@@ -165,6 +172,12 @@ class ReplicaActor:
 
     _STREAM_TTL_S = 600.0
 
+    def _settle_stream(self, sid: str) -> None:
+        """Settle the witness ledger at every cursor-slot drop site
+        (done / error / cancel / TTL reap). Idempotent — re-entered
+        release paths must never turn into a false report."""
+        _resdbg.note_release("serve_stream", (id(self), sid))
+
     def _reap_stale_streams(self) -> None:
         now = time.monotonic()
         for sid, entry in list(self._streams.items()):
@@ -172,6 +185,7 @@ class ReplicaActor:
                 entry[1].set()
                 self._streams.pop(sid, None)
                 self._stream_errors.pop(sid, None)
+                self._settle_stream(sid)
 
     def next_chunks(self, sid: str, max_items: int = 64,
                     wait_s: float = 10.0) -> Tuple[list, bool]:
@@ -180,6 +194,7 @@ class ReplicaActor:
         pending_err = self._stream_errors.pop(sid, None)
         if pending_err is not None:
             self._streams.pop(sid, None)
+            self._settle_stream(sid)
             raise pending_err
         entry = self._streams.get(sid)
         if entry is None:
@@ -196,6 +211,7 @@ class ReplicaActor:
                 items.append(val)
             elif kind == "done":
                 self._streams.pop(sid, None)
+                self._settle_stream(sid)
                 return items, True
             else:
                 if items:
@@ -204,6 +220,7 @@ class ReplicaActor:
                     self._stream_errors[sid] = val
                     return items, False
                 self._streams.pop(sid, None)
+                self._settle_stream(sid)
                 raise val
             if len(items) >= max_items:
                 return items, False
@@ -218,6 +235,7 @@ class ReplicaActor:
         if entry is None:
             return False
         entry[1].set()  # the drain thread stops pulling the generator
+        self._settle_stream(sid)
         return True
 
     def queue_len(self) -> int:

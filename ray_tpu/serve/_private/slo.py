@@ -289,6 +289,7 @@ class AdmissionController:
             st = self._deployments.get(name)
             if st is not None and st.inflight == 0 and st.queued == 0 \
                     and st.wfq.idle():
+                st.wfq.close()  # settle the qos_tenant witness ledger
                 del self._deployments[name]
 
     def record_ttft(self, name: str, ttft_ms: float,

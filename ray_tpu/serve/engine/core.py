@@ -511,6 +511,14 @@ class InferenceEngine:
         # off = one env read.
         _resdbg.check_balanced("engine.close", kinds=("kv_spec",),
                                owner=self.kv)
+        # Sessions still parked at close never resume: settle their
+        # pins deliberately (teardown mid-workload is a drain, not a
+        # leak), then assert nothing else is left outstanding.
+        for req in self._parked:
+            _resdbg.note_release("parked_kv", (id(self), id(req)))
+        self._parked.clear()
+        _resdbg.check_balanced("engine.close", kinds=("parked_kv",),
+                               owner=self)
         if self._fleet is not None:
             # Drain the spill worker AFTER the engine thread is gone
             # (it was the only producer): every exported page either
@@ -611,6 +619,11 @@ class InferenceEngine:
         t0w = time.time() if traced else 0.0
         self.scheduler.preempt(victim)
         self._parked.append(victim)
+        # RTPU_DEBUG_RES: a parked session pins scheduler + KV residency
+        # until it resumes (or the engine closes) — an entry left behind
+        # by a resume/close path is exactly the leak the witness flags.
+        _resdbg.note_acquire("parked_kv", key=(id(self), id(victim)),
+                             owner=self, note="preempt_park")
         self._preempts += 1
         if traced:
             _tracing.emit_span(
@@ -640,6 +653,7 @@ class InferenceEngine:
             resumed.append(req)
         for req in resumed:
             self._parked.remove(req)
+            _resdbg.note_release("parked_kv", (id(self), id(req)))
 
     def _resume_one(self, orig: EngineRequest) -> None:
         """Resume a parked request as a CONTINUATION: a fresh request

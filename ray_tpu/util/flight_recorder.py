@@ -137,6 +137,13 @@ def dump_payload(clock_offset_s: Optional[float] = None) -> Dict[str, Any]:
 
     if _resdbg.enabled():
         payload["res_debug"] = _resdbg.dump_payload()
+    # RTPU_DEBUG_CHAN witness too: per-process frame/violation counts
+    # so bench.py --chaos aggregates a cluster-wide chan_violations
+    # verdict over the same dump_flight RPC.
+    from ray_tpu.devtools import chan_debug as _chandbg
+
+    if _chandbg.enabled():
+        payload["chan_debug"] = _chandbg.dump_payload()
     return payload
 
 

@@ -1,0 +1,247 @@
+"""RTPU_DEBUG_CHAN witness: the dynamic half of the ``chan`` rule
+family. Three injected faults — a seq gap, a late buffer mutation
+(caught via the sampled frame checksum), and an unreleased spill pin
+(the PR 19 reclaim race) — must each be reported online EXACTLY once,
+while a clean run over both transports produces zero violations with
+nonzero frames witnessed (a 0-violation verdict over 0 frames is
+vacuous). Registry-level invariants (acks, cursors, Lamport clocks)
+are unit-tested against the note_* API directly.
+"""
+
+import os
+import uuid
+
+import pytest
+
+from ray_tpu.dag.ring import RingChannel
+from ray_tpu.devtools import chan_debug
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("RTPU_DEBUG_CHAN", "1")
+    chan_debug.reset()
+    yield
+    chan_debug.reset()
+
+
+def kinds():
+    return [v["kind"] for v in chan_debug.violations()]
+
+
+def _ring_pair(capacity=4, ring_bytes=8192):
+    cid = uuid.uuid4().bytes
+    return (RingChannel(cid, capacity=capacity, ring_bytes=ring_bytes),
+            RingChannel(cid, capacity=capacity, ring_bytes=ring_bytes))
+
+
+# ------------------------------------------------------- clean surface
+
+
+def test_clean_ring_traffic_zero_violations(witness):
+    w, r = _ring_pair()
+    try:
+        for i in range(40):
+            w.write({"i": i}, i, timeout=10)
+            assert r.read(i, timeout=10) == {"i": i}
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert chan_debug.violations() == []
+    assert chan_debug.frames_witnessed() >= 40
+
+
+def test_clean_peer_traffic_zero_violations(witness):
+    from ray_tpu.dag.peer import CrossNodeChannel
+
+    cid = uuid.uuid4().bytes
+    rd = CrossNodeChannel(cid, capacity=8, edge="w->r")
+    addr = rd.prepare_read()
+    wr = CrossNodeChannel(cid, capacity=8, edge="w->r", addr=addr)
+    try:
+        for i in range(20):
+            wr.write({"i": i}, i, timeout=10)
+            assert rd.read(i, timeout=10) == {"i": i}
+    finally:
+        wr.close()
+        rd.close()
+    assert chan_debug.violations() == []
+    assert chan_debug.frames_witnessed() >= 20
+
+
+def test_clean_spill_roundtrip_zero_violations(witness):
+    """A spill pin that settles (consumption observed) is not a
+    violation at close."""
+    w, r = _ring_pair()
+    big = os.urandom(1 << 19)  # > dag_ring_spill_bytes: rides a side file
+    try:
+        w.write(big, 0, timeout=10)
+        assert r.read(0, timeout=10) == big
+        w.write("after", 1, timeout=10)  # cursor advance settles the pin
+        assert r.read(1, timeout=10) == "after"
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert chan_debug.violations() == []
+
+
+# -------------------------------------------------- injection: seq gap
+
+
+def test_injected_seq_gap_reported_exactly_once(witness):
+    w, r = _ring_pair()
+    try:
+        w.write("a", 0, timeout=10)
+        w.write("b", 2, timeout=10)  # skipped seq 1: a hand-minted gap
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert kinds() == ["send-seq-gap"]
+    assert chan_debug.violations()[0]["seq"] == 2
+
+
+# ----------------------------------- injection: late buffer mutation
+
+
+def test_injected_late_mutation_reported_exactly_once(witness):
+    """Mutate the frame bytes AFTER the send published them (the
+    mutate-after-send race, simulated in the shared ring): seq 0 is
+    checksum-sampled, so the consume-side recompute must flag it."""
+    w, r = _ring_pair()
+    try:
+        w.write(b"A" * 200, 0, timeout=10)
+        idx = w._mm.find(b"A" * 50)
+        assert idx > 0
+        w._mm[idx:idx + 1] = b"B"  # the writer "mutating its buffer"
+        got = r.read(0, timeout=10)
+        assert got != b"A" * 200  # the reader really saw torn bytes
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert kinds() == ["payload-mismatch"]
+
+
+# -------------------------------- injection: unreleased spill pin
+
+
+def test_injected_unreleased_spill_pin_reported_exactly_once(
+        witness, monkeypatch):
+    """Resurrect the pre-PR-19 shape dynamically: the settle path is
+    disabled, so the consumed spill's pin is still open when the
+    writer closes — note_close must flag the reclaim race once."""
+    from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+
+    monkeypatch.setattr(RingChannel, "_settle_spills",
+                        lambda self, rpos: None)
+    old_grace = cfg.dag_spill_reclaim_grace_s
+    cfg.set("dag_spill_reclaim_grace_s", 0.05)
+    w, r = _ring_pair()
+    big = os.urandom(1 << 19)
+    try:
+        w.write(big, 0, timeout=10)
+        assert r.read(0, timeout=10) == big  # consumed, never settled
+        w.close()
+        assert kinds() == ["spill-reclaim-race"]
+    finally:
+        cfg.set("dag_spill_reclaim_grace_s", old_grace)
+        w.close()
+        r.close(unlink=True)
+
+
+# ------------------------------------------------ registry unit checks
+
+
+def test_note_ack_before_consume_flagged(witness):
+    chan_debug.note_consume("e@1", 0, 0, 0, b"x")
+    chan_debug.note_ack("e@1", 0)  # fine: consumed
+    chan_debug.note_ack("e@1", 3)  # phantom credit
+    assert kinds() == ["ack-before-consume"]
+
+
+def test_note_cursor_regression_flagged(witness):
+    chan_debug.note_cursor("e@1", "wpos", 128)
+    chan_debug.note_cursor("e@1", "wpos", 256)
+    chan_debug.note_cursor("e@1", "wpos", 64)
+    assert kinds() == ["cursor-regression"]
+
+
+def test_note_send_duplicate_flagged(witness):
+    chan_debug.note_send("e@1", 0, 10)
+    chan_debug.note_send("e@1", 1, 10)
+    chan_debug.note_send("e@1", 1, 10)
+    assert kinds() == ["send-seq-duplicate"]
+
+
+def test_note_send_credit_overrun_flagged(witness):
+    chan_debug.note_send("e@1", 9, 10, window=(0, 4))
+    assert kinds() == ["credit-overrun"]
+
+
+def test_clock_inversion_flagged(witness):
+    chan_debug.note_consume("e@1", 0, 7, 0, b"x")
+    chan_debug.note_consume("e@1", 1, 5, 0, b"x")  # stamp went backwards
+    assert kinds() == ["clock-inversion"]
+
+
+def test_lamport_merge_advances_process_clock(witness):
+    chan_debug.note_consume("e@1", 0, 1000, 0, b"x")
+    assert chan_debug.clock_stamp("e@2") > 1000
+
+
+def test_endpoint_tokens_isolate_reopened_channels(witness):
+    """A reopened channel restarts seqs at 0 under the SAME edge name —
+    distinct endpoint tokens keep that from tripping monotonicity."""
+    chan_debug.note_send("edge@aaa", 5, 10)
+    chan_debug.note_send("edge@bbb", 0, 10)  # fresh incarnation
+    assert chan_debug.violations() == []
+
+
+# ----------------------------------------------------- off by default
+
+
+def test_zero_overhead_when_off(monkeypatch):
+    monkeypatch.delenv("RTPU_DEBUG_CHAN", raising=False)
+    chan_debug.reset()
+    assert chan_debug.clock_stamp("e@1") == 0
+    assert chan_debug.payload_crc(0, b"payload") == 0
+    chan_debug.note_send("e@1", 9, 10, window=(0, 1))
+    chan_debug.note_consume("e@1", 3, 1, 1, b"x")
+    chan_debug.note_ack("e@1", 7)
+    assert chan_debug.violations() == []
+    assert chan_debug.frames_witnessed() == 0
+    w, r = _ring_pair()
+    try:
+        w.write("x", 0)
+        assert r.read(0, timeout=10) == "x"
+    finally:
+        w.close()
+        r.close(unlink=True)
+    assert chan_debug.frames_witnessed() == 0  # transports skipped hooks
+
+
+# ----------------------------------------------------------- reporting
+
+
+def test_report_and_dump_payload_shapes(witness):
+    w, r = _ring_pair()
+    try:
+        w.write("x", 0, timeout=10)
+        assert r.read(0, timeout=10) == "x"
+    finally:
+        w.close()
+        r.close(unlink=True)
+    rep = chan_debug.report()
+    assert rep["frames"] >= 1 and rep["violations"] == 0
+    assert rep["edges"]  # per-endpoint stream state present
+    dump = chan_debug.dump_payload()
+    assert set(dump) == {"frames", "edges", "open_pins", "violations"}
+    assert dump["open_pins"] == 0
+
+
+def test_flight_recorder_carries_chan_debug(witness):
+    from ray_tpu.util import flight_recorder
+
+    payload = flight_recorder.dump_payload()
+    assert "chan_debug" in payload
+    assert set(payload["chan_debug"]) == {"frames", "edges",
+                                          "open_pins", "violations"}

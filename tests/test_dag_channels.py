@@ -367,7 +367,8 @@ def test_peer_seq_monotonicity_witness():
 
         def frame(seq):
             body = pickle.dumps(("ok", seq), protocol=5)
-            return (struct.pack("<IBQI", len(body), 0, seq, 1)
+            # clock=0 / crc=0: unsampled frame, witness checks skipped
+            return (struct.pack("<IBQQII", len(body), 0, seq, 0, 0, 1)
                     + struct.pack("<I", len(body)) + body)
 
         s.sendall(frame(0) + frame(2) + frame(1))  # gap, then inversion

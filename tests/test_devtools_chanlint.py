@@ -1,0 +1,355 @@
+"""chan-lint rule family: positive + negative fixtures per rule, the
+resurrected pre-PR-19 ``_spill_in`` reclaim-race fixture asserted
+caught, and the per-family baseline mechanics for the ``chan`` section
+— the 5-family matrix: a partial ``--family chan --write-baseline``
+must carry concurrency/jax/dist/res over verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.devtools import lint
+from ray_tpu.devtools.chanlint import lint_source
+
+PEER = "ray_tpu.dag.peer"       # declared transport module
+FACADE = "ray_tpu.dag.channel"  # seq-exempt facade module
+OTHER = "some.app.module"       # neither
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------- chan-cursor-publish-order
+
+
+def test_cursor_published_before_fill_flagged():
+    src = ("def emit(self, payload, off):\n"
+           "    self._set_u64(_O_WPOS, off + len(payload))\n"
+           "    self._mm[off:off + len(payload)] = payload\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-cursor-publish-order"]
+    assert "garbage" in fs[0].message
+
+
+def test_cursor_published_after_fill_clean():
+    src = ("def emit(self, payload, off):\n"
+           "    struct.pack_into('<I', self._mm, off, len(payload))\n"
+           "    self._mm[off + 4:off + 4 + len(payload)] = payload\n"
+           "    self._set_u64(_O_WPOS, off + 4 + len(payload))\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_cursor_attr_store_before_fill_flagged():
+    src = ("def emit(self, payload, off):\n"
+           "    self.write_pos = off + len(payload)\n"
+           "    self._buf[off:] = payload\n")
+    assert rules(lint_source(src, OTHER, "m.py")) == [
+        "chan-cursor-publish-order"]
+
+
+def test_reader_rpos_publish_not_a_wpos_publish():
+    """The reader's rpos store after a payload COPY-OUT is not the
+    writer-publish shape (rpos intentionally unmatched)."""
+    src = ("def next_record(self, rpos, size):\n"
+           "    payload = bytes(self._mm[rpos:rpos + size])\n"
+           "    self._set_u64(_O_RPOS, rpos + size)\n"
+           "    self._mm[0:1] = b'x'\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# --------------------------------------------- chan-spill-pin-unreleased
+
+
+def test_pr19_spill_in_race_caught():
+    """The resurrected pre-PR-19 ``close()``: force-unlink every spill
+    side-file with zero consumption evidence — the reader's
+    ``_spill_in`` raced this unlink and got FileNotFoundError."""
+    src = ("def close(self):\n"
+           "    for end, path in self._spills:\n"
+           "        os.unlink(path)\n"
+           "    self._spills = []\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-spill-pin-unreleased"]
+    assert "PR 19" in fs[0].message
+
+
+def test_spill_reclaim_with_grace_and_settle_clean():
+    """The post-PR-19 shape: settle against rpos, grace-poll, then
+    reclaim what the reader provably never got to."""
+    src = ("def close(self):\n"
+           "    self._settle_spills(self._u64(_O_RPOS))\n"
+           "    deadline = now() + cfg.dag_spill_reclaim_grace_s\n"
+           "    for end, path in self._spills:\n"
+           "        os.unlink(path)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_spill_unlink_outside_teardown_not_flagged():
+    """The settle helper itself unlinks claimed files — not a teardown
+    path, so not this rule's shape."""
+    src = ("def settle(self, claimed):\n"
+           "    os.unlink(claimed)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ----------------------------------------------- chan-ack-before-consume
+
+
+def test_ack_before_inbox_get_flagged():
+    src = ("def read(self, ib, seq, ep):\n"
+           "    ep.ack(ib, seq)\n"
+           "    kind, got, parts = ib.q.get(timeout=1.0)\n"
+           "    return parts\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-ack-before-consume"]
+
+
+def test_ack_after_inbox_get_clean():
+    src = ("def read(self, ib, seq, ep):\n"
+           "    kind, got, parts = ib.q.get(timeout=1.0)\n"
+           "    ep.ack(ib, seq)\n"
+           "    return parts\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ----------------------------------------------------- chan-raw-seq-send
+
+
+def test_raw_seq_write_outside_facade_flagged():
+    src = ("def f(chan, v):\n"
+           "    chan.write(v, 7)\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-raw-seq-send"]
+
+
+def test_raw_seq_write_stop_flagged():
+    src = ("def f(self, seq):\n"
+           "    self.channel.write_stop(seq)\n")
+    assert rules(lint_source(src, OTHER, "m.py")) == [
+        "chan-raw-seq-send"]
+
+
+def test_raw_seq_in_facade_module_exempt():
+    src = ("def f(chan, v):\n"
+           "    chan.write(v, 7)\n")
+    assert lint_source(src, FACADE, "m.py") == []
+
+
+def test_seqless_write_clean():
+    src = ("def f(chan, v):\n"
+           "    chan.write(v)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_non_channel_receiver_ignored():
+    """Bare .write on files/sockets must not light the rule up
+    repo-wide — the receiver-name evidence gate."""
+    src = ("def f(fh, v):\n"
+           "    fh.write(v, 7)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_raw_seq_suppression_honored():
+    src = ("def f(chan, v):\n"
+           "    chan.write(v, 7)  # rtpu-lint: disable=chan-raw-seq-send\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ------------------------------------- chan-register-without-unregister
+
+
+def test_register_without_unregister_flagged():
+    src = ("def reg(head, cid, addr):\n"
+           "    head.retrying_call('channel_register', cid, addr,\n"
+           "                       timeout=10)\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-register-without-unregister"]
+
+
+def test_register_with_unregister_elsewhere_clean():
+    src = ("def reg(head, cid, addr):\n"
+           "    head.retrying_call('channel_register', cid, addr,\n"
+           "                       timeout=10)\n"
+           "def close(head, cid):\n"
+           "    head.notify('channel_unregister', cid)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_register_string_outside_rpc_send_ignored():
+    """A flight-recorder tag or log line naming channel_register is
+    not a registration — only RPC-shaped sends count."""
+    src = ("def audit(flight, cid):\n"
+           "    flight.record('channel_register', ch=cid)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ----------------------------------------------- chan-dial-without-liveness
+
+
+def test_dial_without_liveness_flagged():
+    src = ("class Writer:\n"
+           "    def connect(self, host, port):\n"
+           "        s = socket.create_connection((host, port))\n"
+           "        return s\n")
+    fs = lint_source(src, PEER, "m.py")
+    assert rules(fs) == ["chan-dial-without-liveness"]
+
+
+def test_dial_with_liveness_branch_clean():
+    src = ("class Writer:\n"
+           "    def connect(self, host, port):\n"
+           "        if self._peer_gone:\n"
+           "            raise ChannelClosedError('gone')\n"
+           "        return socket.create_connection((host, port))\n")
+    assert lint_source(src, PEER, "m.py") == []
+
+
+def test_dial_outside_transport_module_skipped():
+    src = ("class Writer:\n"
+           "    def connect(self, host, port):\n"
+           "        return socket.create_connection((host, port))\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ------------------------------------------- chan-blocking-op-no-deadline
+
+
+def test_blocking_read_no_deadline_flagged():
+    src = ("def pull(chan):\n"
+           "    return chan.read(5)\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-blocking-op-no-deadline"]
+
+
+def test_blocking_recv_no_deadline_flagged():
+    src = ("def pull(self):\n"
+           "    return self._channel.recv()\n")
+    assert rules(lint_source(src, OTHER, "m.py")) == [
+        "chan-blocking-op-no-deadline"]
+
+
+def test_read_with_timeout_kwarg_clean():
+    src = ("def pull(chan):\n"
+           "    return chan.read(5, timeout=2.0)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_read_with_positional_timeout_clean():
+    src = ("def pull(chan, t):\n"
+           "    return chan.read(5, t)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_read_under_enclosing_deadline_clean():
+    src = ("def pull(chan):\n"
+           "    deadline = monotonic() + 5\n"
+           "    while monotonic() < deadline:\n"
+           "        poll()\n"
+           "    return chan.read(5)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ---------------------------------------------- chan-mutate-after-send
+
+
+def test_subscript_mutation_after_send_flagged():
+    src = ("def f(chan, buf):\n"
+           "    chan.send(buf)\n"
+           "    buf[0] = 0\n")
+    fs = lint_source(src, OTHER, "m.py")
+    assert rules(fs) == ["chan-mutate-after-send"]
+    assert "zero-copy" in fs[0].message
+
+
+def test_mutating_method_after_send_flagged():
+    src = ("def f(chan, buf):\n"
+           "    chan.send(buf)\n"
+           "    buf.fill(0)\n")
+    assert rules(lint_source(src, OTHER, "m.py")) == [
+        "chan-mutate-after-send"]
+
+
+def test_mutation_before_send_clean():
+    src = ("def f(chan, buf):\n"
+           "    buf[0] = 0\n"
+           "    chan.send(buf)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_rebind_after_send_clean():
+    """Rebinding the NAME is safe — only in-place mutation aliases the
+    frame the reader sees."""
+    src = ("def f(chan, buf, other):\n"
+           "    chan.send(buf)\n"
+           "    buf = other\n"
+           "    chan.send(buf)\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+def test_unsent_buffer_mutation_clean():
+    src = ("def f(chan, buf, scratch):\n"
+           "    chan.send(buf)\n"
+           "    scratch[0] = 1\n")
+    assert lint_source(src, OTHER, "m.py") == []
+
+
+# ------------------------------------------------------ family mechanics
+
+
+def test_chan_family_registered():
+    assert "chan" in lint.FAMILIES
+    assert lint.FAMILY_RULES["chan"] == lint.CHAN_RULES
+    for rule in lint.CHAN_RULES:
+        assert lint.RULE_FAMILY[rule] == "chan"
+
+
+def test_partial_chan_write_preserves_other_four_families(tmp_path):
+    """The 5-family matrix: --family chan --write-baseline must carry
+    concurrency, jax, dist, AND res over verbatim."""
+    path = tmp_path / "baseline.json"
+    conc = lint.Finding("swallowed-exception", "a.py", 3, "f", "m1")
+    jax = lint.Finding("pallas-shape-rules", "b.py", 4, "g", "m2")
+    dist = lint.Finding("wall-clock-deadline", "c.py", 5, "h", "m3")
+    res = lint.Finding("acquire-without-release", "d.py", 6, "i", "m4")
+    lint.write_baseline(str(path), [conc, jax, dist, res])
+    before = json.loads(path.read_text())
+    chan = lint.Finding("chan-raw-seq-send", "e.py", 7, "j", "m5")
+    lint.write_baseline(str(path), [chan], families=("chan",))
+    data = json.loads(path.read_text())
+    for fam in ("concurrency", "jax", "dist", "res"):
+        assert data["families"][fam] == before["families"][fam]
+    assert chan.fingerprint() in data["families"]["chan"]["findings"]
+    # And a chan-only rewrite with no findings empties ONLY chan.
+    lint.write_baseline(str(path), [], families=("chan",))
+    data = json.loads(path.read_text())
+    assert data["families"]["chan"]["findings"] == {}
+    for fam in ("concurrency", "jax", "dist", "res"):
+        assert data["families"][fam] == before["families"][fam]
+
+
+def test_cli_chan_family_selection(tmp_path):
+    """--family chan runs only the chan rules over the given paths."""
+    src = ("def f(chan, v):\n"
+           "    chan.write(v, 7)\n"
+           "def g(chan):\n"
+           "    return chan.read(5)\n")
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    b = tmp_path / "empty.json"
+    b.write_text("{}")
+    rc = lint.run([str(p), "--baseline", str(b), "--family", "chan"])
+    assert rc == 1
+    findings = lint.lint_paths([str(p)], str(tmp_path),
+                               families=("chan",))
+    assert rules(findings) == ["chan-blocking-op-no-deadline",
+                               "chan-raw-seq-send"]
+    assert all(f.rule in lint.CHAN_RULES for f in findings)
+
+
+def test_in_tree_chan_baseline_is_empty():
+    """The acceptance bar: the chan family ships with an EMPTY baseline
+    section — every in-tree finding was fixed or allow-commented."""
+    data = json.loads(open(lint.DEFAULT_BASELINE).read())
+    assert data["families"]["chan"]["findings"] == {}

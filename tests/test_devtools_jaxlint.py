@@ -475,7 +475,8 @@ def test_cli_family_selection(tmp_path):
 def test_rule_family_map_is_total():
     assert set(lint.RULE_FAMILY) == (set(lint.RULES) | set(lint.JAX_RULES)
                                      | set(lint.DIST_RULES)
-                                     | set(lint.RES_RULES))
+                                     | set(lint.RES_RULES)
+                                     | set(lint.CHAN_RULES))
     for rule in lint.RULES:
         assert lint.RULE_FAMILY[rule] == "concurrency"
     for rule in lint.JAX_RULES:

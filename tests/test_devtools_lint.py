@@ -725,10 +725,10 @@ def test_repo_baseline_file_checked_in():
     assert data["version"] == 2
     fams = data["families"]
     # Every rule family has a section with a schema version; the
-    # concurrency section carries the legacy debt, the jax, dist, and
-    # res sections start (and should stay) empty — their findings are
-    # fixed or allow-commented, not baselined.
-    assert set(fams) == {"concurrency", "jax", "dist", "res"}
+    # concurrency section carries the legacy debt, the jax, dist, res,
+    # and chan sections start (and should stay) empty — their findings
+    # are fixed or allow-commented, not baselined.
+    assert set(fams) == {"concurrency", "jax", "dist", "res", "chan"}
     for sec in fams.values():
         assert isinstance(sec["schema"], int)
     assert fams["concurrency"]["findings"]

@@ -297,6 +297,66 @@ def test_fleet_tier_transitions_balance_under_res_debug(monkeypatch):
     res_debug.reset()
 
 
+def test_eviction_under_preemption_cross_replica_resume():
+    """ROADMAP carry-forward: a PREEMPTED session's parked KV pages are
+    evicted under memory pressure, spill into the shared fleet store,
+    and the session resumes TOKEN-IDENTICALLY on a DIFFERENT replica
+    that pulls them back — priority park/resume (PR 19) composed with
+    the spill tier (PR 18). Replica A never resumes the victim; the
+    continuation (prompt + confirmed tokens, remaining budget) runs on
+    replica B against the store alone."""
+    eng_ref = _engine()
+    try:
+        ref = eng_ref.generate(P1, max_new_tokens=24)["token_ids"]
+    finally:
+        eng_ref.close()
+
+    store = _store()
+    eng_a = _engine(kv_fleet_min_prefix_blocks=0, kv_fleet_store=store)
+    # The victim stays parked on A (the replica it must leave): resume
+    # is disabled, so only the cross-replica continuation can finish it.
+    eng_a._resume_tick = lambda: None
+    try:
+        lo = eng_a._make_request(P1, 24, None, stream=True, priority=0)
+        eng_a._queue.put(lo)
+        # First streamed token: lo holds the slot with sunk decode work
+        # — the continuation below must splice, not recompute from zero.
+        kind, val = lo.stream_queue.get(timeout=120)
+        assert kind not in ("done", "error"), (kind, val)
+        hi = eng_a._make_request(list(range(200, 216)), 8, None,
+                                 priority=5)
+        eng_a._queue.put(hi)
+        deadline = time.time() + 120
+        while not eng_a._parked:
+            assert time.time() < deadline, "lo never parked"
+            time.sleep(0.001)
+        hi.future.result(timeout=120)
+        # Memory pressure on A: a disjoint admission storms the slot
+        # pool, evicting the parked session's resident prefix rows —
+        # their complete blocks spill into the shared store.
+        eng_a.generate(P2, max_new_tokens=8)
+        _wait_objects(store, 4)  # the victim's 4 complete prompt blocks
+        assert eng_a._preempts >= 1
+        assert eng_a._parked and eng_a._parked[0] is lo
+        prefix = list(lo.prompt_ids) + list(lo.generated)
+        remaining = lo.remaining()
+        assert lo.generated and remaining > 0
+    finally:
+        eng_a.close()
+
+    eng_b = _engine(kv_fleet_min_prefix_blocks=0, kv_fleet_store=store)
+    try:
+        out = eng_b.generate(prefix, max_new_tokens=remaining)
+        st = eng_b.stats()
+    finally:
+        eng_b.close()
+    # Token identity across park + evict + spill + cross-replica pull.
+    assert list(lo.generated) + out["token_ids"] == ref
+    # ...and the resume really rode the fleet tier, not pure recompute.
+    assert st["kv_fleet_hits"] >= 1
+    assert st["kv_fleet_pulled_blocks"] >= 1
+
+
 def test_router_fleet_term_scores_spilled_residency():
     """Score identity at weight 0 (the default) and a fleet boost when
     the deployment opts in — on a __new__-built Router, the satellite's

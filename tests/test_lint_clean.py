@@ -1,5 +1,5 @@
 """Tier-1 guard: the repo lints clean against its checked-in baseline,
-across ALL FOUR rule families.
+across ALL FIVE rule families.
 
 A NEW violation of any codified invariant — concurrency family (lock
 order, blocking-under-lock, close-without-shutdown, banned jax<0.5 /
@@ -9,9 +9,13 @@ host-sync-in-hot-path, unclamped-dynamic-update-slice,
 pallas-shape-rules, rng-reinit-per-mesh), dist family
 (unclassified-rpc-handler, retry-unsafe-call,
 direct-notify-bypasses-outbox, serial-fanout-no-deadline,
-wall-clock-deadline, missing-chaos-role), or res family
+wall-clock-deadline, missing-chaos-role), res family
 (acquire-without-release, begin-without-commit,
-unbounded-registry-growth, thread-without-stop, fd-leak-on-error) —
+unbounded-registry-growth, thread-without-stop, fd-leak-on-error), or
+chan family (chan-cursor-publish-order, chan-spill-pin-unreleased,
+chan-ack-before-consume, chan-raw-seq-send,
+chan-register-without-unregister, chan-dial-without-liveness,
+chan-blocking-op-no-deadline, chan-mutate-after-send) —
 fails this test, the same check `python -m ray_tpu.devtools.lint` runs
 standalone. After an intentional change, regenerate with
 ``python -m ray_tpu.devtools.lint --write-baseline`` (add
@@ -99,3 +103,23 @@ def test_repo_dist_family_clean():
         + "\n".join(str(f) for f in fresh))
     baseline = lint._read_baseline_json(lint.DEFAULT_BASELINE)
     assert baseline["families"]["dist"]["findings"] == {}
+
+
+def test_repo_chan_family_clean():
+    """The chan family holds the same strong line as jax/dist/res: its
+    baseline section is EMPTY — ring writers publish after the fill,
+    spill reclaims observe consumption, acks follow application
+    consume, seqs route through the auto-seq facades, registrations
+    have death-scrubs, dials have liveness branches, blocking channel
+    ops carry deadlines, and sent buffers are never mutated in place.
+    Every recent real data-plane bug (the PR 19 _spill_in race, peer
+    seq inversions, credit stalls) lived in this layer: fix or
+    allow-comment new findings, never baseline them. The dynamic half
+    is chan_debug.py's RTPU_DEBUG_CHAN witness."""
+    fresh = _fresh(families=("chan",))
+    assert not fresh, (
+        "new chan-lint findings (fix or allow-comment with a one-line "
+        "justification — the chan baseline section stays empty):\n"
+        + "\n".join(str(f) for f in fresh))
+    baseline = lint._read_baseline_json(lint.DEFAULT_BASELINE)
+    assert baseline["families"]["chan"]["findings"] == {}

@@ -217,6 +217,127 @@ def test_chaos_kill_snapshot_carries_res_debug(witness_on, tmp_path,
         cfg.set("flight_recorder_dump_dir", old_dir)
 
 
+# ------------------------------------- PR 19 serving state (qos/streams)
+
+
+def test_qos_tenant_churn_reaped_and_balanced(witness_on):
+    """Tenant churn (a fresh tenant id per request) mints one ledger
+    entry per lane; once each lane is quiet past the idle TTL the
+    admission gate's own cadence (head -> reap_idle) evicts it and the
+    witness balances. The operator-configured tenant is pinned and
+    survives."""
+    from ray_tpu.serve._private.qos import TenantConfig, WFQQueue
+
+    q = WFQQueue(idle_ttl=5.0)
+    q.configure("vip", TenantConfig(weight=2.0), 0.0)  # pinned lane
+    for i in range(20):
+        name = f"ephemeral-{i}"
+        tk = q.submit(name, 1.0, float(i))
+        assert q.head(float(i)) is tk
+        q.admit(tk, float(i))
+        q.release(name)
+    assert q.head(100.0) is None  # nothing queued; reap runs
+    assert res_debug.outstanding("qos_tenant") == {}
+    assert "vip" in q._tenants
+    assert not any(n.startswith("ephemeral") for n in q._tenants)
+    assert res_debug.violations() == []
+
+
+def test_qos_lane_with_work_never_reaped(witness_on):
+    """Queued or inflight lanes are immune to the idle TTL no matter
+    how stale their activity stamp is."""
+    from ray_tpu.serve._private.qos import WFQQueue
+
+    q = WFQQueue(idle_ttl=1.0)
+    tk = q.submit("busy", 1.0, 0.0)
+    assert q.reap_idle(1000.0) == 0  # queued: immune
+    q.admit(tk, 1000.0)
+    q._tenants["busy"].last_active = 0.0
+    assert q.reap_idle(2000.0) == 0  # inflight: immune
+    q.release("busy")
+    assert q.reap_idle(5000.0) == 1  # quiet past TTL: reaped
+    assert res_debug.outstanding("qos_tenant") == {}
+
+
+def test_qos_configure_pins_lazy_lane_and_settles_ledger(witness_on):
+    """configure() on a lazily-minted lane graduates it to
+    operator-owned: its ledger entry settles and it leaves the
+    reap-eligible set."""
+    from ray_tpu.serve._private.qos import TenantConfig, WFQQueue
+
+    q = WFQQueue(idle_ttl=1.0)
+    q.tenant("t", 0.0)
+    assert res_debug.outstanding("qos_tenant") == {"qos_tenant": 1}
+    q.configure("t", TenantConfig(weight=2.0), 0.0)
+    assert res_debug.outstanding("qos_tenant") == {}
+    q.reap_idle(100.0)
+    assert "t" in q._tenants  # pinned lanes survive idleness
+
+
+def test_qos_close_settles_ledger(witness_on):
+    from ray_tpu.serve._private.qos import WFQQueue
+
+    q = WFQQueue()
+    q.tenant("a", 0.0)
+    q.tenant("b", 0.0)
+    assert res_debug.outstanding("qos_tenant") == {"qos_tenant": 2}
+    q.close()
+    assert res_debug.outstanding("qos_tenant") == {}
+
+
+class _Streamer:
+    def gen(self, n):
+        for i in range(n):
+            yield i
+
+    def boom(self):
+        yield 0
+        raise ValueError("boom")
+
+
+def test_stream_cancel_loop_balanced(witness_on):
+    """The serve_stream ledger balances across every cursor-slot
+    outcome: drained to done, cancelled mid-stream, and a raised
+    stream error."""
+    from ray_tpu.serve._private.replica import ReplicaActor
+
+    rep = ReplicaActor(_Streamer, (), {})
+    for _ in range(3):  # completion path
+        sid, items, done = rep.handle_request_streaming("gen", (4,), {})
+        while not done:
+            more, done = rep.next_chunks(sid, wait_s=5.0)
+            items += more
+        assert items == [0, 1, 2, 3]
+    for _ in range(3):  # cancel path: the consumer walks away
+        sid, _, done = rep.handle_request_streaming(
+            "gen", (100000,), {}, first_wait_s=0)
+        assert rep.cancel_stream(sid) or done
+    # Error path: the pending error settles the slot when raised.
+    sid, items, done = rep.handle_request_streaming(
+        "boom", (), {}, first_wait_s=0)
+    with pytest.raises(ValueError, match="boom"):
+        while not done:
+            more, done = rep.next_chunks(sid, wait_s=5.0)
+    assert rep._streams == {} and rep._stream_errors == {}
+    assert res_debug.outstanding("serve_stream") == {}
+    assert res_debug.violations() == []
+
+
+def test_stream_ttl_reaper_settles_ledger(witness_on):
+    """A stream abandoned without a cancel (client crash) settles via
+    the TTL reaper, not as a leak."""
+    from ray_tpu.serve._private.replica import ReplicaActor
+
+    rep = ReplicaActor(_Streamer, (), {})
+    sid, _, _ = rep.handle_request_streaming(
+        "gen", (100000,), {}, first_wait_s=0)
+    assert res_debug.outstanding("serve_stream") == {"serve_stream": 1}
+    rep._streams[sid][2] -= 10_000  # last poll "long ago"
+    rep._reap_stale_streams()
+    assert sid not in rep._streams
+    assert res_debug.outstanding("serve_stream") == {}
+
+
 # --------------------------------------------------- engine end-to-end
 
 

@@ -85,6 +85,32 @@ def pair():
     server.stop()
 
 
+class _ChannelHandler:
+    """Channel-negotiation handlers shaped like the head's (the PR 19
+    cross-node edge surface, classified in protocol.py): register
+    overwrites with the same entry, lookup is read-only, unregister of
+    an unknown channel holds at True."""
+
+    chaos_role = "node"
+
+    def __init__(self):
+        self.channels = {}
+
+    def rpc_channel_register(self, conn, channel_id, addr, owner="",
+                             node_id=""):
+        self.channels[channel_id] = {"addr": addr, "owner": owner,
+                                     "node_id": node_id, "alive": True}
+        return True
+
+    def rpc_channel_lookup(self, conn, channel_id):
+        ent = self.channels.get(channel_id)
+        return dict(ent) if ent is not None else None
+
+    def rpc_channel_unregister(self, conn, channel_id):
+        self.channels.pop(channel_id, None)
+        return True
+
+
 # ------------------------------------------------- classification holes
 
 
@@ -165,6 +191,33 @@ def test_buffer_lease_dup_compared_and_released(witness, pair):
     # Both deliveries' leases released: the dup's by the witness, the
     # original's by the response path after the frame went out.
     assert h.releases == 2
+
+
+def test_channel_negotiation_dup_delivery_smoke(witness):
+    """The channel-negotiation RPCs hold at-most-once under the
+    witness's double delivery: a re-delivered register re-applies the
+    same entry (same True), unregister of an already-gone channel
+    stays True (the state 'not registered' holds), and lookup is
+    read-only — never dup-audited."""
+    h = _ChannelHandler()
+    server = RpcServer(h).start()
+    client = RpcClient(server.address)
+    try:
+        cid = b"c" * 16
+        assert client.call("channel_register", cid, "tcp://h:1",
+                           "ownerA", "node1", timeout=5) is True
+        assert rpc_debug.dup_audit_counts().get("channel_register") == 1
+        ent = client.call("channel_lookup", cid, timeout=5)
+        assert ent["addr"] == "tcp://h:1" and ent["alive"]
+        assert client.call("channel_unregister", cid, timeout=5) is True
+        assert client.call("channel_lookup", cid, timeout=5) is None
+        assert rpc_debug.dup_audit_counts().get(
+            "channel_unregister") == 1
+        assert "channel_lookup" not in rpc_debug.dup_audit_counts()
+        assert rpc_debug.violations() == []
+    finally:
+        client.close()
+        server.stop()
 
 
 def test_dup_nth_sampling(witness, pair, monkeypatch):

@@ -238,6 +238,40 @@ def test_priority_preemption_park_resume_token_identity(tiny_model):
     assert out_lo.get("preempted", 0) >= 1
 
 
+def test_preemption_parked_kv_witness_balanced(tiny_model, monkeypatch):
+    """RTPU_DEBUG_RES: the parked_kv ledger balances across a real
+    preempt + resume cycle — every park settles on resume (or on a
+    deliberate engine close), so a drained run leaves nothing open."""
+    from ray_tpu.devtools import res_debug
+
+    monkeypatch.setenv("RTPU_DEBUG_RES", "1")
+    res_debug.reset()
+    try:
+        eng = make_engine(tiny_model)
+        try:
+            lo = eng._make_request([5, 9, 2, 7, 7, 1], 40, None,
+                                   priority=0)
+            eng._queue.put(lo)
+            deadline = time.time() + 120
+            while not any(r is lo for r in eng.scheduler.active):
+                assert time.time() < deadline, "lo never activated"
+                time.sleep(0.001)
+            hi = eng._make_request(list(range(1, 17)), 8, None,
+                                   priority=5)
+            eng._queue.put(hi)
+            hi.future.result(timeout=120)
+            lo.future.result(timeout=120)
+        finally:
+            eng.close()
+        assert eng._preempts >= 1 and eng._resumes >= 1
+        assert res_debug.outstanding("parked_kv") == {}
+        bad = [v for v in res_debug.violations()
+               if "parked_kv" in v.get("outstanding", {})]
+        assert not bad, bad
+    finally:
+        res_debug.reset()
+
+
 def test_preemption_streams_survive_park_resume(tiny_model):
     """The victim's token stream spans the park: stream consumers see
     one uninterrupted, token-identical sequence across preempt +
